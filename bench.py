@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Benchmark: 25-epoch data-parallel CIFAR-10 training wall-clock.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Headline comparison (BASELINE.md): the reference's 8-process MPI data-parallel
+run takes 1642 s of training time for 25 epochs at bs=16 on an 8-core
+i7-9800X (report Table 1; measured child train time 1566.3 s in
+`log/log_epochs25_proc8_children.txt:2`). This bench runs the same workload -
+25 epochs, bs=16 per worker, epoch-edge parameter averaging, per-epoch eval -
+on the available TPU mesh (all visible devices; 1 chip under the single-chip
+harness, 8 on a v5e-8) and reports training+sync wall-clock.
+`vs_baseline` = reference_seconds / ours, so > 1 means faster than the
+reference.
+
+Data: real CIFAR-10 if present under ./data (see data/cifar10.py), else the
+synthetic stand-in with identical shapes - wall-clock comparable either way;
+accuracy only meaningful on real data.
+"""
+
+import argparse
+import json
+import sys
+
+REFERENCE_TRAIN_S = 1642.0  # report Table 1, 8 procs, 25 epochs, bs=16
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--epochs", type=int, default=25)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--nb-proc", type=int, default=None, help="default: all devices")
+    p.add_argument("--sync-mode", choices=("epoch", "step"), default="epoch")
+    p.add_argument("--compute-dtype", default="float32")
+    p.add_argument("--data", default="auto")
+    p.add_argument("--synthetic-size", type=int, default=None)
+    args = p.parse_args()
+
+    from distributed_neural_network_tpu.train.cli import honor_platform_env
+
+    honor_platform_env()
+
+    import jax
+
+    from distributed_neural_network_tpu.data.cifar10 import load_split
+    from distributed_neural_network_tpu.train.engine import Engine, TrainConfig
+    from distributed_neural_network_tpu.utils import timers as T
+
+    n = args.nb_proc or jax.device_count()
+    train_split = load_split(True, source=args.data, synthetic_size=args.synthetic_size)
+    test_split = load_split(
+        False,
+        source=args.data,
+        synthetic_size=max(1, args.synthetic_size // 5)
+        if args.synthetic_size
+        else None,
+    )
+    cfg = TrainConfig(
+        batch_size=args.batch_size,
+        epochs=args.epochs,
+        nb_proc=n,
+        regime="data_parallel",
+        sync_mode=args.sync_mode,
+        compute_dtype=args.compute_dtype,
+    )
+    timers = T.PhaseTimers()
+    engine = Engine(cfg, train_split, test_split)
+    # warm-up epoch outside the timed region: XLA compilation is a one-time
+    # cost (cached for the remaining epochs), not a training-throughput cost;
+    # reset_state() then rewinds params so the measured run trains exactly
+    # cfg.epochs epochs from the same init
+    engine.run_epoch(0, timers=T.PhaseTimers())
+    engine.reset_state()
+    for epoch in range(cfg.epochs):
+        engine.run_epoch(epoch, timers=timers)
+
+    train_s = timers.get(T.TRAINING) + timers.get(T.COMMUNICATION)
+    final = engine.history[-1]
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    f"cifar10_dp_train_s_{cfg.epochs}ep_bs{cfg.batch_size}"
+                    f"_dev{n}_{train_split.source}"
+                    f"_acc{final.val_acc:.2f}"
+                ),
+                "value": round(train_s, 3),
+                "unit": "s",
+                "vs_baseline": round(REFERENCE_TRAIN_S / max(train_s, 1e-9), 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,32 @@
+"""distributed_neural_network_tpu - a TPU-native distributed training framework.
+
+A from-scratch JAX/XLA re-design of the capabilities of
+dat-rohit/distributed-neural-network (see SURVEY.md): CIFAR-10 CNN training
+under three regimes - single-device, model replication, and data parallelism
+with epoch-wise parameter averaging - plus fault simulation, phase timing,
+metrics, and the reference's CLI surface, all expressed over a
+`jax.sharding.Mesh` with XLA collectives instead of MPI point-to-point.
+"""
+
+from .data.cifar10 import Split, load_split, make_synthetic, normalize
+from .models.cnn import Network, param_count
+from .parallel.mesh import DATA_AXIS, create_mesh, device_count
+from .train.engine import Engine, EpochMetrics, TrainConfig
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "DATA_AXIS",
+    "Engine",
+    "EpochMetrics",
+    "Network",
+    "Split",
+    "TrainConfig",
+    "create_mesh",
+    "device_count",
+    "load_split",
+    "make_synthetic",
+    "normalize",
+    "param_count",
+    "__version__",
+]

@@ -1,0 +1,61 @@
+"""On-device batching: epoch index plans instead of host DataLoaders.
+
+The reference iterates a torch DataLoader per epoch (shuffle + batch on the
+host, copy per batch - `data_parallelism_train.py:73-79,193`). On TPU that
+pattern serializes the input pipeline on the host and pays a host->device
+transfer per batch. Here the dataset lives in HBM (uploaded once) and an
+epoch is described by an **index plan**: a (steps, batch) int32 array of row
+indices plus a (steps, batch) float32 weight mask. The plan is computed
+*inside jit* from a PRNG key, so a whole training epoch - shuffle included -
+runs as one compiled `lax.scan` with zero host involvement.
+
+Semantics parity:
+- shuffle=True per epoch for train (`data_parallelism_train.py:76`),
+  sequential for eval (`:88-91`);
+- torch DataLoader keeps the final partial batch (no drop_last); we keep it
+  too by padding the last batch and masking padded rows with weight 0, which
+  preserves static shapes for XLA while matching per-sample loss/grad math.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def plan_shape(n_rows: int, batch_size: int) -> tuple[int, int]:
+    """(steps, batch) for a split of n_rows - final partial batch kept."""
+    if n_rows <= 0:
+        raise ValueError(f"n_rows must be positive, got {n_rows}")
+    steps = -(-n_rows // batch_size)  # ceil
+    return steps, batch_size
+
+
+def epoch_plan(key: jax.Array, n_rows: int, batch_size: int):
+    """Shuffled epoch index plan, built on device.
+
+    Returns (idx, w): idx (steps, batch) int32 row indices into the split,
+    w (steps, batch) float32 {0,1} validity mask (0 marks padding rows in the
+    final partial batch). Static args n_rows/batch_size make this jit-stable.
+    """
+    steps, bs = plan_shape(n_rows, batch_size)
+    perm = jax.random.permutation(key, n_rows)
+    return _pad_and_reshape(perm, n_rows, steps, bs)
+
+
+def eval_plan(n_rows: int, batch_size: int):
+    """Sequential (unshuffled) index plan for evaluation."""
+    steps, bs = plan_shape(n_rows, batch_size)
+    return _pad_and_reshape(jnp.arange(n_rows, dtype=jnp.int32), n_rows, steps, bs)
+
+
+def _pad_and_reshape(order: jax.Array, n_rows: int, steps: int, bs: int):
+    pad = steps * bs - n_rows
+    idx = jnp.concatenate([order.astype(jnp.int32), jnp.zeros(pad, jnp.int32)])
+    w = jnp.concatenate([jnp.ones(n_rows, jnp.float32), jnp.zeros(pad, jnp.float32)])
+    return idx.reshape(steps, bs), w.reshape(steps, bs)
+
+
+def gather_batch(images: jax.Array, labels: jax.Array, idx: jax.Array):
+    """Form one batch on device by row gather (jnp.take along axis 0)."""
+    return jnp.take(images, idx, axis=0), jnp.take(labels, idx, axis=0)

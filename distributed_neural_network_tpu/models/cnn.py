@@ -1,0 +1,121 @@
+"""LeNet-style CIFAR-10 CNN, TPU-native (Flax linen, NHWC).
+
+Capability parity with the reference `models/model.py:9-27` (`Network`):
+conv(3->6, k5, valid) -> maxpool2 -> conv(6->16, k5, valid) -> maxpool2
+-> flatten(400) -> fc 120 -> fc 84 -> fc 10, ReLU between.
+
+TPU-first deltas from the reference (documented per SURVEY.md section 7 step 1):
+
+- **Layout**: NHWC instead of torch's NCHW. On TPU, XLA's convolution
+  tiling wants the channel dimension minor; NHWC is the native layout and
+  avoids a transpose on every batch fed from the host pipeline.
+- **Flatten order**: flattening a (N, 5, 5, 16) activation gives the 400
+  features in H,W,C order, vs torch's C,H,W (reference `models/model.py:24`).
+  This is a fixed permutation of fc1's input columns - training dynamics and
+  accuracy are unaffected; only raw weight tensors are not bit-comparable.
+- **Init**: `torch_uniform` reproduces torch's default
+  `kaiming_uniform_(a=sqrt(5))` for weights and `U(-1/sqrt(fan_in),
+  +1/sqrt(fan_in))` for biases, so the *training dynamics* match the
+  reference's observable behaviour (SURVEY.md section 7 "Numerical parity").
+  Both reduce to U(-1/sqrt(fan_in), +1/sqrt(fan_in)).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+from jax.nn.initializers import variance_scaling
+
+# torch's default kaiming_uniform_(a=sqrt(5)) == uniform with bound
+# gain*sqrt(3/fan_in), gain = sqrt(2/(1+5)) = sqrt(1/3)  =>  bound = sqrt(1/fan_in).
+# variance_scaling draws U(+-sqrt(3*scale/fan_in)); scale=1/3 gives that bound.
+torch_uniform_kernel = variance_scaling(1.0 / 3.0, "fan_in", "uniform")
+
+
+def torch_uniform_bias(fan_in: int):
+    """torch-style bias init: U(-1/sqrt(fan_in), +1/sqrt(fan_in)).
+
+    Flax bias initializers don't receive fan_in, so each layer closes over its
+    own (conv: k*k*in_channels, dense: in_features).
+    """
+    bound = 1.0 / np.sqrt(fan_in)
+
+    def init(key, shape, dtype=jnp.float32):
+        import jax
+
+        return jax.random.uniform(key, shape, dtype, -bound, bound)
+
+    return init
+
+
+class Network(nn.Module):
+    """The reference's 62K-param CIFAR-10 classifier, re-expressed for TPU.
+
+    Input:  (batch, 32, 32, 3) float32 (or bf16), normalized to [-1, 1].
+    Output: (batch, 10) logits.
+
+    `compute_dtype` lets the matmul/conv path run in bfloat16 on the MXU while
+    params stay float32 (mixed precision); default float32 for strict parity.
+    """
+
+    num_classes: int = 10
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.compute_dtype)
+        x = nn.Conv(
+            6,
+            (5, 5),
+            padding="VALID",
+            kernel_init=torch_uniform_kernel,
+            bias_init=torch_uniform_bias(5 * 5 * 3),
+            dtype=self.compute_dtype,
+            name="conv1",
+        )(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(
+            16,
+            (5, 5),
+            padding="VALID",
+            kernel_init=torch_uniform_kernel,
+            bias_init=torch_uniform_bias(5 * 5 * 6),
+            dtype=self.compute_dtype,
+            name="conv2",
+        )(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))  # (N, 5*5*16=400), H,W,C order
+        x = nn.Dense(
+            120,
+            kernel_init=torch_uniform_kernel,
+            bias_init=torch_uniform_bias(400),
+            dtype=self.compute_dtype,
+            name="fc1",
+        )(x)
+        x = nn.relu(x)
+        x = nn.Dense(
+            84,
+            kernel_init=torch_uniform_kernel,
+            bias_init=torch_uniform_bias(120),
+            dtype=self.compute_dtype,
+            name="fc2",
+        )(x)
+        x = nn.relu(x)
+        x = nn.Dense(
+            self.num_classes,
+            kernel_init=torch_uniform_kernel,
+            bias_init=torch_uniform_bias(84),
+            dtype=self.compute_dtype,
+            name="fc3",
+        )(x)
+        return x.astype(jnp.float32)  # logits/loss in f32 for stable CE
+
+
+def param_count(params) -> int:
+    """Total parameter count (reference Network: 62,006)."""
+    import jax
+
+    return sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))

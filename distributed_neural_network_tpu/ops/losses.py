@@ -1,0 +1,30 @@
+"""Loss and metric ops (masked for static-shape padded batches).
+
+Parity: the reference uses `nn.CrossEntropyLoss` (mean reduction) for train
+(`data_parallelism_train.py:29,196`) and eval (`:169`), and top-1 accuracy by
+argmax (`:173-174`). The weight mask handles padded rows in the final partial
+batch (see `data/pipeline.py`) so XLA sees static shapes; for fully valid
+batches the math is identical to the reference's.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def masked_cross_entropy(logits, labels, weights):
+    """Weighted-mean softmax cross entropy: sum(w*ce)/max(sum(w),1).
+
+    Equals torch CrossEntropyLoss(mean) on batches with all-ones weights.
+    """
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ce = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+    n = jnp.maximum(weights.sum(), 1.0)
+    return (ce * weights).sum() / n
+
+
+def masked_correct(logits, labels, weights):
+    """Count of correct top-1 predictions among valid (weight=1) rows."""
+    pred = jnp.argmax(logits, axis=-1)
+    return ((pred == labels).astype(jnp.float32) * weights).sum()

@@ -1,0 +1,28 @@
+"""SGD with momentum, torch-semantics, as pure tree ops.
+
+Parity: `torch.optim.SGD(lr, momentum)` (`data_parallelism_train.py:187`,
+`single_proc_train.py:54`): buf <- mu*buf + grad (no dampening, no nesterov),
+p <- p - lr*buf; the first step uses buf = grad, reproduced here by zero
+momentum init. Kept as hand-rolled tree ops (rather than optax) because the
+reference's observable dynamics include **re-creating the optimizer - and
+thus resetting the momentum buffer - every epoch** inside `run_child`
+(`data_parallelism_train.py:187`, SURVEY.md section 2 quirks); an explicit
+buffer tree makes that reset a one-liner inside the compiled epoch.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def init_momentum(params):
+    """Zero momentum buffers - equivalent to a freshly constructed torch SGD."""
+    return jax.tree.map(jnp.zeros_like, params)
+
+
+def sgd_step(params, mom, grads, lr: float, momentum: float):
+    """One SGD-momentum update; returns (new_params, new_mom)."""
+    mom = jax.tree.map(lambda m, g: momentum * m + g, mom, grads)
+    params = jax.tree.map(lambda p, m: p - lr * m, params, mom)
+    return params, mom

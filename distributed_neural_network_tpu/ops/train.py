@@ -1,0 +1,113 @@
+"""Compiled training and evaluation epochs (lax.scan over on-device batches).
+
+This replaces the reference's Python hot loops - `run_child`'s per-batch
+forward/backward/step (`data_parallelism_train.py:193-203`, ~98% of
+wall-clock per `log/bs16_log_epochs25_proc4_children.txt:2`) and the parent's
+serial eval (`:157-183`) - with whole-epoch XLA programs: the dataset lives in
+HBM, the per-epoch shuffle is a device-side PRNG permutation, and every batch
+step is one iteration of a `lax.scan`, so an entire epoch is a single device
+dispatch with zero host round-trips.
+
+Semantics knobs (SURVEY.md section 7 "Hard parts" - semantics, not speed):
+- `reset_momentum`: True reproduces the reference's observable dynamics of
+  re-creating the optimizer each epoch (`data_parallelism_train.py:187`).
+- `grad_sync_axis`: None = faithful local SGD (parameter averaging happens
+  only at the epoch edge, in `parallel/collectives.py`); an axis name =
+  idiomatic per-step gradient pmean DP - a *different* optimizer, offered as
+  the fast path and labelled as such.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..data.pipeline import epoch_plan, eval_plan, gather_batch
+from .losses import masked_correct, masked_cross_entropy
+from .sgd import init_momentum, sgd_step
+
+
+def make_batch_loss(apply_fn):
+    def batch_loss(params, x, y, w):
+        logits = apply_fn({"params": params}, x)
+        return masked_cross_entropy(logits, y, w)
+
+    return batch_loss
+
+
+def make_train_epoch(
+    apply_fn,
+    *,
+    lr: float,
+    momentum: float,
+    n_rows: int,
+    batch_size: int,
+    reset_momentum: bool = True,
+    grad_sync_axis: str | None = None,
+):
+    """Build f(params, mom, images, labels, key) -> (params, mom, loss_sum, n_batches).
+
+    One full epoch of SGD as a single scan. `loss_sum`/`n_batches` mirror the
+    reference child's `total_loss`/`total_batches` accounting
+    (`data_parallelism_train.py:201-202`) - per-batch mean losses summed, and
+    the *batch count* as denominator material (the reference's key-count bug,
+    SURVEY.md section 2, is fixed downstream).
+    """
+    batch_loss = make_batch_loss(apply_fn)
+    grad_fn = jax.value_and_grad(batch_loss)
+
+    def epoch(params, mom, images, labels, key):
+        idx, w = epoch_plan(key, n_rows, batch_size)
+        if reset_momentum:
+            mom = init_momentum(params)
+
+        def step(carry, xs):
+            params, mom = carry
+            bidx, bw = xs
+            x, y = gather_batch(images, labels, bidx)
+            loss, grads = grad_fn(params, x, y, bw)
+            if grad_sync_axis is not None:
+                grads = jax.tree.map(
+                    lambda g: jax.lax.pmean(g, grad_sync_axis), grads
+                )
+            params, mom = sgd_step(params, mom, grads, lr, momentum)
+            return (params, mom), loss
+
+        (params, mom), losses = jax.lax.scan(step, (params, mom), (idx, w))
+        n_batches = jnp.float32(losses.shape[0])
+        return params, mom, losses.sum(), n_batches
+
+    return epoch
+
+
+def make_eval_epoch(apply_fn, *, n_rows: int, batch_size: int):
+    """Build f(params, images, labels, row_weights) -> (loss_sum, n_batches, correct, n_valid).
+
+    Mirrors the reference `eval` (`data_parallelism_train.py:157-183`):
+    per-batch mean CE collected then averaged over batches (`np.mean(losses)`,
+    `:177`), top-1 correct count, total valid samples. `row_weights` masks
+    padded rows (sharded eval pads the split to equal per-device sizes);
+    batches with zero valid rows are excluded from the batch count so the
+    batch-mean average matches the reference's serial computation.
+    """
+
+    def epoch(params, images, labels, row_weights):
+        idx, w = eval_plan(n_rows, batch_size)
+
+        def step(_, xs):
+            bidx, bw = xs
+            x, y = gather_batch(images, labels, bidx)
+            rw = jnp.take(row_weights, bidx, axis=0) * bw
+            logits = apply_fn({"params": params}, x)
+            loss = masked_cross_entropy(logits, y, rw)
+            correct = masked_correct(logits, y, rw)
+            valid = rw.sum()
+            return None, (loss, correct, valid)
+
+        _, (losses, corrects, valids) = jax.lax.scan(step, None, (idx, w))
+        batch_has_valid = (valids > 0).astype(jnp.float32)
+        loss_sum = (losses * batch_has_valid).sum()
+        n_batches = batch_has_valid.sum()
+        return loss_sum, n_batches, corrects.sum(), valids.sum()
+
+    return epoch

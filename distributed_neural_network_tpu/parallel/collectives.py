@@ -1,0 +1,85 @@
+"""Mesh collectives: parameter synchronization and fault-masked averaging.
+
+This module is the TPU-native replacement for the reference's entire L1+L2
+communication/aggregation stack (SURVEY.md section 1): the parent's
+send/recv/average loop (`data_parallelism_train.py:118,226-244`) collapses
+into a single compiled weighted-psum over the mesh's data axis, executed on
+ICI. No pickling, no star topology, no idle parent.
+
+Fault-masked averaging implements SURVEY.md section 5.3's upgrade of the
+reference straggler simulation: a per-epoch live mask drops dead devices'
+contributions - `avg = sum(live_d * params_d) / sum(live_d)` - instead of
+blocking the epoch on them. The degenerate all-dead epoch degrades to a
+plain mean over all devices (no division by zero, no NaN poisoning).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .mesh import DATA_AXIS
+
+
+def pvary_tree(tree, axis_name: str = DATA_AXIS):
+    """Mark every leaf as device-varying along `axis_name` (no-op if already).
+
+    Needed because shard_map's autodiff inserts an implicit psum for
+    gradients w.r.t. *unvarying* (replicated) inputs - correct for sharded
+    per-step DP, but silently wrong for this framework's faithful local-SGD
+    regimes, where each device's epoch must be independent and parameters are
+    synchronized only at the epoch edge. Leaves that are already varying
+    (sharded feeds) pass through unchanged.
+    """
+
+    def vary(x):
+        try:
+            return jax.lax.pcast(x, axis_name, to="varying")
+        except ValueError:  # already varying along axis_name
+            return x
+
+    return jax.tree.map(vary, tree)
+
+
+def pmean_tree(tree, axis_name: str = DATA_AXIS):
+    """Plain parameter averaging over the mesh axis.
+
+    Exact analog of the parent's element-wise state-dict mean
+    (`data_parallelism_train.py:238-240`), as one fused XLA collective.
+    """
+    return jax.tree.map(lambda x: jax.lax.pmean(x, axis_name), tree)
+
+
+def masked_pmean_tree(tree, live: jax.Array, axis_name: str = DATA_AXIS):
+    """Average over live devices only.
+
+    `live` is this device's own {0,1} scalar weight (each device passes its
+    entry of the epoch live-mask). Dead devices' parameters are overwritten
+    with the survivors' average - they "rejoin" at the next epoch, the
+    drop-and-continue semantics of SURVEY.md section 5.3. The degenerate
+    all-dead epoch degrades to a plain mean over all devices (rather than
+    keeping per-device values, which would leave parameters unreplicated).
+    """
+    w = live.astype(jnp.float32)
+    n_live = jax.lax.psum(w, axis_name)
+    w = jnp.where(n_live > 0, w, 1.0)
+    denom = jax.lax.psum(w, axis_name)
+
+    def avg(x):
+        return jax.lax.psum(x * w.astype(x.dtype), axis_name) / denom.astype(x.dtype)
+
+    return jax.tree.map(avg, tree)
+
+
+def weighted_mean_scalar(
+    value: jax.Array, weight: jax.Array, axis_name: str = DATA_AXIS
+):
+    """sum(value)/sum(weight) across the mesh - correctly-scaled loss mean.
+
+    Replaces the reference's mis-scaled "Global Average Training Loss"
+    (`data_parallelism_train.py:233,248` divides by 10*(N-1) state-dict keys,
+    not batch count - documented fix per SURVEY.md section 2 quirks).
+    """
+    num = jax.lax.psum(value, axis_name)
+    den = jax.lax.psum(weight, axis_name)
+    return num / jnp.maximum(den, 1.0)

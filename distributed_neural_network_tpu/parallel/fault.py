@@ -1,0 +1,63 @@
+"""Fault injection: seeded per-epoch device failure simulation.
+
+Parity with `simulate_failure` (`data_parallelism_train.py:41-46`): each
+epoch, each worker fails independently with probability
+`--failure-probability`. The reference implements failure as an unseeded
+host `time.sleep(--failure-duration)` which - because the parent's recv
+blocks - stalls the *whole* epoch (straggler semantics, never benchmarked per
+report section 6.2). This build upgrades the capability (SURVEY.md
+section 5.3): a failed device's contribution is dropped from the epoch's
+parameter average (see `collectives.masked_pmean_tree`) and the run
+continues; `--failure-duration` is preserved as an optional host-side sleep
+so the original straggler wall-clock semantics remain reproducible.
+
+All randomness is explicit JAX PRNG (the reference's `np.random.rand()` at
+`:43` is unseeded - SURVEY.md section 5.2 calls for seeding as the fix).
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def live_mask(key: jax.Array, n_devices: int, failure_probability: float):
+    """(n_devices,) float32 {0,1}: 1 = device participates this epoch.
+
+    Pure function of (key, p) - identical on every host/device, so the mask
+    never needs broadcasting. p=0 (the reference default,
+    `data_parallelism_train.py:266`) short-circuits to all-live without
+    consuming randomness, keeping the fault-free path bit-identical whether
+    or not fault simulation is compiled in.
+    """
+    if failure_probability <= 0.0:
+        return jnp.ones((n_devices,), jnp.float32)
+    fail = jax.random.bernoulli(key, failure_probability, (n_devices,))
+    return (~fail).astype(jnp.float32)
+
+
+def epoch_key(seed: int, epoch: int) -> jax.Array:
+    """Deterministic per-epoch fault key, independent of the data PRNG stream."""
+    return jax.random.fold_in(jax.random.key(seed ^ 0x5EED_FA17), epoch)
+
+
+def straggler_sleep(mask_host, failure_duration: float, *, log=print) -> None:
+    """Optional host-side sleep preserving the reference's straggler timing.
+
+    The reference sleeps inside the worker process (`:44`); here the epoch
+    dispatch stalls for `failure_duration` seconds per failed device's epoch
+    if the caller opts in (duration > 0), logging the same fail/wake lines.
+    """
+    if failure_duration <= 0.0:
+        return
+    failed = [d for d, live in enumerate(mask_host) if not live]
+    for d in failed:
+        log(
+            f"Device {d} failed! Sleeping for {failure_duration} seconds."
+        )
+    if failed:
+        time.sleep(failure_duration)
+        for d in failed:
+            log(f"Device {d} woke up!")

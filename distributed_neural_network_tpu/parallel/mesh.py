@@ -1,0 +1,64 @@
+"""Device mesh construction - the TPU-native replacement for MPI.COMM_WORLD.
+
+The reference discovers its world via `MPI.COMM_WORLD.Get_rank()/Get_size()`
+(`data_parallelism_train.py:60-62`) and moves data over a star topology of
+blocking point-to-point sends through rank 0. Here the world is a
+`jax.sharding.Mesh` over the TPU slice's ICI fabric; collectives
+(psum/pmean) replace the send/recv loops, and there is no parent rank.
+
+Axes: the default mesh is 1-D ("data",) - the only parallelism axis the
+reference exercises (SURVEY.md section 2: TP/PP/SP/EP absent). `create_mesh`
+accepts a full axis spec so additional axes (e.g. ("data", "model")) can be
+added without touching callers - the open door noted in SURVEY.md section 7.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def create_mesh(
+    n_devices: int | None = None,
+    axis_names: Sequence[str] = (DATA_AXIS,),
+    axis_sizes: Sequence[int] | None = None,
+) -> Mesh:
+    """Build a mesh over the first n_devices devices.
+
+    `--nb-proc N` maps here: the reference's world size becomes the mesh's
+    data-axis size. With axis_sizes given, the devices are reshaped to a
+    multi-axis mesh (row-major, ICI-adjacent along the last axis).
+    """
+    devices = jax.devices()
+    n = n_devices if n_devices is not None else len(devices)
+    if n > len(devices):
+        raise ValueError(
+            f"requested {n} devices but only {len(devices)} available; "
+            f"for CPU testing set XLA_FLAGS=--xla_force_host_platform_device_count={n}"
+        )
+    selected = np.asarray(devices[:n])
+    if axis_sizes is None:
+        axis_sizes = (n,) if len(axis_names) == 1 else None
+    if axis_sizes is None or int(np.prod(axis_sizes)) != n:
+        raise ValueError(f"axis_sizes {axis_sizes} must multiply to {n}")
+    return Mesh(selected.reshape(tuple(axis_sizes)), tuple(axis_names))
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Rows sharded across the data axis (leading dim split over devices)."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully replicated - the analog of `jax.device_put_replicated` /
+    the parent's state_dict broadcast loop (`data_parallelism_train.py:118`)."""
+    return NamedSharding(mesh, P())

@@ -1,0 +1,55 @@
+"""Dataset partitioning across mesh devices.
+
+Parity with the reference `partition_dataset` (`data_parallelism_train.py:49-53`):
+contiguous shards of size total // n_workers, remainder rows silently dropped,
+shard assignment fixed for the whole run (only intra-shard shuffle per epoch).
+
+Topology delta (documented per SURVEY.md section 7 "Topology remap"): the
+reference gives worker rank r in [1, N-1] rows [(r-1)*p, r*p) because rank 0 is
+an idle parent. On the TPU mesh there is no parent - all N devices train - so
+device d in [0, N) gets rows [d*p, (d+1)*p) with p = total // N. At
+"--nb-proc N" the reference therefore has N-1 compute shards of size
+total//(N-1); this build has N shards of size total//N. Use
+``reference_compat=True`` to reproduce the reference's shard math exactly
+(N-1 shards over N-1 devices) when comparing accuracy curves at equal
+worker counts.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def shard_size(total: int, n_shards: int) -> int:
+    if n_shards <= 0:
+        raise ValueError(f"n_shards must be positive, got {n_shards}")
+    return total // n_shards
+
+
+def shard_bounds(total: int, n_shards: int) -> list[tuple[int, int]]:
+    """Contiguous [start, end) row bounds per shard; remainder dropped."""
+    p = shard_size(total, n_shards)
+    return [(d * p, (d + 1) * p) for d in range(n_shards)]
+
+
+def shard_rows(total: int, n_shards: int) -> np.ndarray:
+    """(n_shards, p) row-index matrix - the sharded feed for the mesh.
+
+    Row d is device d's contiguous shard, exactly the index set
+    `range((r-1)*p, r*p)` of the reference (`data_parallelism_train.py:52`)
+    with the 0-based all-devices-train convention.
+    """
+    p = shard_size(total, n_shards)
+    return np.arange(n_shards * p, dtype=np.int32).reshape(n_shards, p)
+
+
+def replicated_rows(total: int, n_shards: int) -> np.ndarray:
+    """(n_shards, total) - every device sees the full dataset.
+
+    This is the model-replication regime's feed (`model_replication_train.py:
+    39-47`: every rank builds the full train loader). Regime == sharding
+    policy; the trainer is identical (SURVEY.md section 7 step 3).
+    """
+    return np.broadcast_to(
+        np.arange(total, dtype=np.int32), (n_shards, total)
+    ).copy()

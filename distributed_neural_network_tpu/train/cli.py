@@ -1,0 +1,211 @@
+"""Shared CLI runner behind the three reference entry points.
+
+CLI parity (SURVEY.md section 5.6): the three top-level scripts keep the
+reference's names and flag surface - `--lr --momentum --batch-size --epochs
+--nb-proc --failure-probability --failure-duration`
+(`data_parallelism_train.py:259-271`) - with properly *typed* flags (the
+reference passed raw strings to SGD, so non-default `--lr` crashed it;
+SURVEY.md section 2 quirks). Framework-specific extensions are added behind
+new flags, defaults preserving reference behaviour.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+from ..data.cifar10 import load_split
+from ..utils import timers as T
+from ..utils.logfiles import write_phase_logs
+from ..utils.metrics import init_run
+from .engine import Engine, TrainConfig
+
+
+def add_common_flags(p: argparse.ArgumentParser, *, epochs: int, batch_size: int):
+    p.add_argument("--lr", dest="lr", type=float, default=0.001)
+    p.add_argument("--momentum", dest="momentum", type=float, default=0.9)
+    p.add_argument("--batch-size", dest="bs", type=int, default=batch_size)
+    p.add_argument("--epochs", dest="epochs", type=int, default=epochs)
+    # framework extensions (not in the reference CLI)
+    p.add_argument("--seed", type=int, default=0, help="PRNG seed (reference was unseeded)")
+    p.add_argument(
+        "--sync-mode",
+        choices=("epoch", "step"),
+        default="epoch",
+        help="epoch = faithful local SGD + epoch-edge parameter averaging "
+        "(reference semantics); step = per-step gradient pmean (idiomatic DP)",
+    )
+    p.add_argument(
+        "--no-momentum-reset",
+        action="store_true",
+        help="keep momentum across epochs (reference re-creates SGD per epoch)",
+    )
+    p.add_argument("--data", choices=("auto", "pickle", "npz", "synthetic"), default="auto")
+    p.add_argument("--data-root", default=None, help="dataset dir (default ./data)")
+    p.add_argument(
+        "--synthetic-size",
+        type=int,
+        default=None,
+        help="synthetic train rows (test = 1/5 of it); default: CIFAR-10 sizes",
+    )
+    p.add_argument("--log-dir", default="log", help="phase-time log directory")
+    p.add_argument("--metrics-jsonl", default=None, help="metrics JSONL path")
+    p.add_argument("--neptune", action="store_true", help="also log to Neptune (env creds)")
+    p.add_argument("--eval-batch-size", type=int, default=None)
+    p.add_argument(
+        "--compute-dtype", choices=("float32", "bfloat16"), default="float32"
+    )
+    p.add_argument("--eval-every", type=int, default=1)
+    return p
+
+
+def add_distributed_flags(p: argparse.ArgumentParser, *, nb_proc: int = 4):
+    p.add_argument(
+        "--nb-proc",
+        dest="nb_proc",
+        type=int,
+        default=nb_proc,
+        help="mesh data-axis size (reference: MPI world size)",
+    )
+    p.add_argument(
+        "--failure-probability",
+        dest="failure_probability",
+        type=float,
+        default=0.0,
+        help="Probability of simulated process failure at each epoch",
+    )
+    p.add_argument(
+        "--failure-duration",
+        dest="failure_duration",
+        type=float,
+        default=0.0,
+        help="Duration of simulated process failure in seconds",
+    )
+    p.add_argument(
+        "--reference-compat",
+        action="store_true",
+        help="N-1 compute workers at --nb-proc N, as the reference's idle-parent "
+        "topology (default: all N devices train)",
+    )
+    return p
+
+
+def config_from_args(args, regime: str) -> TrainConfig:
+    return TrainConfig(
+        lr=args.lr,
+        momentum=args.momentum,
+        batch_size=args.bs,
+        epochs=args.epochs,
+        nb_proc=getattr(args, "nb_proc", None),
+        regime=regime,
+        sync_mode=args.sync_mode,
+        reset_momentum=not args.no_momentum_reset,
+        failure_probability=getattr(args, "failure_probability", 0.0),
+        failure_duration=getattr(args, "failure_duration", 0.0),
+        seed=args.seed,
+        eval_batch_size=args.eval_batch_size,
+        compute_dtype=args.compute_dtype,
+        reference_compat=getattr(args, "reference_compat", False),
+    )
+
+
+def honor_platform_env() -> None:
+    """Re-assert JAX_PLATFORMS from the environment over plugin overrides.
+
+    Some TPU plugin site hooks force their platform into jax.config at
+    interpreter start, which makes `JAX_PLATFORMS=cpu` (the documented way to
+    run these CLIs on N virtual CPU devices, SURVEY.md sec. 4) silently
+    ineffective. If the user set the env var, it wins.
+    """
+    env = os.environ.get("JAX_PLATFORMS")
+    if env:
+        import jax
+
+        if jax.config.jax_platforms != env:
+            jax.config.update("jax_platforms", env)
+
+
+def run_training(args, regime: str, *, log=print) -> Engine:
+    """Load data, train, write phase logs - the shared main() body."""
+    honor_platform_env()
+    cfg = config_from_args(args, regime)
+    timers = T.PhaseTimers()
+
+    syn = getattr(args, "synthetic_size", None)
+    with timers.phase(T.DATA_LOADING):
+        train_split = load_split(
+            True,
+            root=args.data_root,
+            source=args.data,
+            seed=args.seed,
+            synthetic_size=syn,
+        )
+        test_split = load_split(
+            False,
+            root=args.data_root,
+            source=args.data,
+            seed=args.seed,
+            synthetic_size=max(1, syn // 5) if syn else None,
+        )
+    log(
+        f"(Loaded train dataset of length {len(train_split)} "
+        f"[source={train_split.source}], test length {len(test_split)})"
+    )
+
+    run = init_run(jsonl_path=args.metrics_jsonl, neptune=args.neptune)
+    run["parameters"] = {
+        "learning_rate": cfg.lr,
+        "optimizer": "SGD",
+        "model_name": {"single": "nodistmodel"}.get(regime, "distmodel"),
+        "epochs": cfg.epochs,
+        "batch_size": cfg.batch_size,
+        "regime": regime,
+        "sync_mode": cfg.sync_mode,
+        "nb_proc": cfg.nb_proc,
+        "seed": cfg.seed,
+    }
+
+    t0 = time.perf_counter()
+    engine = Engine(cfg, train_split, test_split)
+    engine.run(timers=timers, run=run, log=log, eval_every=args.eval_every)
+    wall = time.perf_counter() - t0
+    run.stop()
+
+    log(f"Train data loading time: {timers.get(T.DATA_LOADING)}")
+    log(f"Time spent on training: {timers.get(T.TRAINING)}")
+    log(f"Time spent on evaluation: {timers.get(T.EVALUATION)}")
+    log(
+        "Time spent on parent communication and param sync: "
+        f"{timers.get(T.COMMUNICATION)}"
+    )
+    log(f"Total wall-clock: {wall:.3f} s")
+
+    if args.log_dir:
+        nb_proc = getattr(args, "nb_proc", None) or 1
+        parent, children = write_phase_logs(
+            args.log_dir,
+            bs=cfg.batch_size,
+            epochs=cfg.epochs,
+            nb_proc=nb_proc,
+            timers=timers,
+        )
+        log(f"(Phase logs written: {parent}, {children})")
+
+    best = max(
+        (m for m in engine.history if m.val_acc is not None),
+        key=lambda m: m.val_acc,
+        default=None,
+    )
+    summary = {
+        "regime": regime,
+        "epochs": cfg.epochs,
+        "final_train_loss": engine.history[-1].train_loss if engine.history else None,
+        "final_val_acc": engine.history[-1].val_acc if engine.history else None,
+        "best_val_acc": best.val_acc if best else None,
+        "wall_clock_s": round(wall, 3),
+        "data_source": train_split.source,
+    }
+    log("SUMMARY " + json.dumps(summary))
+    return engine

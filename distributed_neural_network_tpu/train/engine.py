@@ -1,0 +1,388 @@
+"""The training engine: one trainer, three regimes as sharding policies.
+
+The reference implements its three regimes as three separate scripts with a
+parent/child star over MPI (SURVEY.md sections 1-3): `single_proc_train.py`
+(one process), `model_replication_train.py` (full data on every worker,
+epoch-edge parameter averaging), `data_parallelism_train.py` (disjoint
+contiguous shards, epoch-edge parameter averaging, fault sim, phase timing).
+
+Here there is exactly one engine, and a regime is a *data placement policy*
+over a `jax.sharding.Mesh` (SURVEY.md section 7 step 3):
+
+- ``single``        - mesh of 1, full dataset on the device;
+- ``replication``   - dataset replicated to all N devices, each with an
+                      independent per-epoch shuffle (`model_replication_train
+                      .py:39-47`);
+- ``data_parallel`` - contiguous 1/N row shards via the leading-axis
+                      NamedSharding (`partition.py` semantics).
+
+Per epoch, three compiled phases map onto the reference's observable phases:
+
+1. **train**  - `shard_map` of a whole local-SGD epoch per device (one
+   `lax.scan`, shuffle on device) == N children running `run_child`
+   (`data_parallelism_train.py:185-213`) - except all N devices train; no
+   idle parent rank.
+2. **sync**   - fault-masked parameter pmean over the data axis == the
+   parent's recv/average/load_state_dict (`:226-244`) plus the correctly
+   scaled global train loss (`:248` had a key-count bug, SURVEY.md sec. 2).
+3. **eval**   - sharded evaluation over the test split, psum-reduced == the
+   parent's serial `eval` (`:157-183`) but parallel across the mesh.
+
+Keeping sync as its own dispatch (rather than fusing into train) preserves
+the reference's communication-phase observability (`mpi_communication_time_*`
+accumulators, `:33-37`) with honest `block_until_ready` fencing.
+
+Fault tolerance upgrades the reference's straggler `time.sleep` (`:41-46`) to
+drop-and-continue: a seeded per-epoch Bernoulli live-mask excludes dead
+devices from the average (SURVEY.md section 5.3); `--failure-duration` is
+preserved as an optional host-side stall for wall-clock parity experiments.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..data.cifar10 import Split
+from ..models.cnn import Network
+from ..ops.train import make_eval_epoch, make_train_epoch
+from ..parallel.collectives import (
+    masked_pmean_tree,
+    pvary_tree,
+    weighted_mean_scalar,
+)
+from ..parallel.fault import epoch_key, live_mask, straggler_sleep
+from ..parallel.mesh import DATA_AXIS, create_mesh
+from ..parallel.partition import shard_size
+from ..utils import timers as T
+
+REGIMES = ("single", "data_parallel", "replication")
+SYNC_MODES = ("epoch", "step")
+
+
+@dataclass
+class TrainConfig:
+    """Typed config; field names follow the reference CLI (SURVEY.md sec. 5.6)."""
+
+    lr: float = 0.001
+    momentum: float = 0.9
+    batch_size: int = 16
+    epochs: int = 25
+    nb_proc: int | None = None  # mesh data-axis size; None = all devices
+    regime: str = "data_parallel"
+    sync_mode: str = "epoch"  # "epoch" = faithful local SGD; "step" = grad pmean
+    reset_momentum: bool = True  # reference re-creates SGD each epoch (:187)
+    failure_probability: float = 0.0
+    failure_duration: float = 0.0
+    seed: int = 0
+    eval_batch_size: int | None = None
+    compute_dtype: str = "float32"  # "bfloat16" for MXU-native mixed precision
+    reference_compat: bool = False  # True: N-1 workers as in the reference
+
+    def __post_init__(self):
+        if self.regime not in REGIMES:
+            raise ValueError(f"regime must be one of {REGIMES}, got {self.regime}")
+        if self.sync_mode not in SYNC_MODES:
+            raise ValueError(
+                f"sync_mode must be one of {SYNC_MODES}, got {self.sync_mode}"
+            )
+
+
+@dataclass
+class EpochMetrics:
+    epoch: int
+    train_loss: float
+    val_loss: float | None
+    val_acc: float | None
+    n_live: int
+
+
+class Engine:
+    def __init__(
+        self,
+        config: TrainConfig,
+        train_split: Split,
+        test_split: Split | None,
+        mesh: Mesh | None = None,
+    ):
+        self.config = c = config
+        if c.regime == "single":
+            n_workers = 1
+        else:
+            n = c.nb_proc if c.nb_proc is not None else jax.device_count()
+            n_workers = (n - 1) if c.reference_compat else n
+            if n_workers < 1:
+                raise ValueError(f"need >=1 workers, got nb_proc={c.nb_proc}")
+        self.n_workers = n_workers
+        self.mesh = mesh if mesh is not None else create_mesh(n_workers)
+        if self.mesh.devices.size != n_workers:
+            raise ValueError(
+                f"mesh has {self.mesh.devices.size} devices, expected {n_workers}"
+            )
+        self._shard = NamedSharding(self.mesh, P(DATA_AXIS))
+        self._repl = NamedSharding(self.mesh, P())
+
+        self.model = Network(
+            compute_dtype=jnp.bfloat16
+            if c.compute_dtype == "bfloat16"
+            else jnp.float32
+        )
+        self._place_data(train_split, test_split)
+        self._build_state()
+        self._build_steps()
+        self.history: list[EpochMetrics] = []
+
+    # ---------------------------------------------------------------- data
+
+    def _place_data(self, train_split: Split, test_split: Split | None):
+        c, n = self.config, self.n_workers
+        if c.regime == "data_parallel":
+            # contiguous 1/N shards, remainder dropped (partition.py parity)
+            p = shard_size(len(train_split), n)
+            if p < 1:
+                raise ValueError(
+                    f"{len(train_split)} rows cannot shard over {n} devices"
+                )
+            imgs = train_split.images[: n * p]
+            labels = train_split.labels[: n * p]
+            self.train_images = jax.device_put(imgs, self._shard)
+            self.train_labels = jax.device_put(labels, self._shard)
+            self.local_train_rows = p
+            self._train_data_spec = P(DATA_AXIS)
+        else:  # single / replication: every device sees the full dataset
+            self.train_images = jax.device_put(train_split.images, self._repl)
+            self.train_labels = jax.device_put(train_split.labels, self._repl)
+            self.local_train_rows = len(train_split)
+            self._train_data_spec = P()
+
+        if test_split is not None:
+            # pad to equal per-device sizes; padded rows carry weight 0
+            total = len(test_split)
+            q = -(-total // n)  # ceil
+            pad = n * q - total
+            imgs = np.concatenate(
+                [test_split.images, np.zeros((pad, *test_split.images.shape[1:]), np.float32)]
+            )
+            labels = np.concatenate([test_split.labels, np.zeros(pad, np.int32)])
+            weights = np.concatenate(
+                [np.ones(total, np.float32), np.zeros(pad, np.float32)]
+            )
+            self.test_images = jax.device_put(imgs, self._shard)
+            self.test_labels = jax.device_put(labels, self._shard)
+            self.test_weights = jax.device_put(weights, self._shard)
+            self.local_test_rows = q
+        else:
+            self.test_images = None
+
+    # --------------------------------------------------------------- state
+
+    def _build_state(self):
+        c = self.config
+        init_key = jax.random.key(c.seed)
+        dummy = jnp.zeros((1, 32, 32, 3), jnp.float32)
+        params = self.model.init(init_key, dummy)["params"]
+        self.params = jax.device_put(params, self._repl)
+        # per-device momentum buffers, stacked on the data axis
+        n = self.n_workers
+        mom = jax.tree.map(lambda p: jnp.zeros((n, *p.shape), p.dtype), params)
+        self.mom = jax.device_put(mom, self._shard)
+
+    def reset_state(self):
+        """Re-initialize params/momentum/history (same seed -> same init).
+
+        Compiled step functions are retained, so a warm-up epoch followed by
+        reset_state() separates XLA compile cost from training measurements
+        without contaminating the measured run's training trajectory.
+        """
+        self._build_state()
+        self.history = []
+
+    # --------------------------------------------------------------- steps
+
+    def _build_steps(self):
+        c, n, mesh = self.config, self.n_workers, self.mesh
+        apply_fn = self.model.apply
+        local_epoch = make_train_epoch(
+            apply_fn,
+            lr=c.lr,
+            momentum=c.momentum,
+            n_rows=self.local_train_rows,
+            batch_size=c.batch_size,
+            reset_momentum=c.reset_momentum,
+            grad_sync_axis=DATA_AXIS if c.sync_mode == "step" else None,
+        )
+        data_spec = self._train_data_spec
+        seed = c.seed
+
+        def train_shard(params, mom, images, labels, epoch):
+            # Mark params (and replicated data feeds) as device-varying before
+            # local training: shard_map's autodiff psums gradients w.r.t.
+            # unvarying inputs across the mesh axis - an implicit allreduce
+            # that would silently turn faithful local SGD into summed-gradient
+            # sync. pcast(to='varying') keeps each device's epoch independent;
+            # synchronization happens only where this framework says it does
+            # (sync phase, or the explicit per-step pmean in "step" mode).
+            params = pvary_tree(params, DATA_AXIS)
+            images = pvary_tree(images, DATA_AXIS)
+            labels = pvary_tree(labels, DATA_AXIS)
+            # distinct shuffle stream per (seed, epoch, device) - replication
+            # regime's independent full-data shuffles included
+            key = jax.random.fold_in(
+                jax.random.fold_in(jax.random.key(seed), epoch),
+                jax.lax.axis_index(DATA_AXIS),
+            )
+            mom_local = jax.tree.map(lambda m: m[0], mom)
+            params, mom_local, loss_sum, n_batches = local_epoch(
+                params, mom_local, images, labels, key
+            )
+            stack = lambda t: jax.tree.map(lambda x: x[None], t)
+            return (
+                stack(params),
+                stack(mom_local),
+                loss_sum[None],
+                n_batches[None],
+            )
+
+        self._train_fn = jax.jit(
+            jax.shard_map(
+                train_shard,
+                mesh=mesh,
+                in_specs=(P(), P(DATA_AXIS), data_spec, data_spec, P()),
+                out_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+            ),
+            donate_argnums=(1,),
+        )
+
+        def sync_shard(params_stacked, live, loss_sums, n_batches):
+            params_local = jax.tree.map(lambda x: x[0], params_stacked)
+            w = live[0]
+            avg = masked_pmean_tree(params_local, w, DATA_AXIS)
+            # all-dead epochs degrade to a plain mean (masked_pmean_tree
+            # semantics) - count every device's loss too, so the reported
+            # global loss describes the parameters actually produced
+            n_live = jax.lax.psum(w, DATA_AXIS)
+            w = jnp.where(n_live > 0, w, 1.0)
+            train_loss = weighted_mean_scalar(
+                loss_sums[0] * w, n_batches[0] * w, DATA_AXIS
+            )
+            return avg, train_loss
+
+        self._sync_fn = jax.jit(
+            jax.shard_map(
+                sync_shard,
+                mesh=mesh,
+                in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+                out_specs=(P(), P()),
+            ),
+            donate_argnums=(0,),
+        )
+
+        if self.test_images is not None:
+            eval_bs = c.eval_batch_size or c.batch_size
+            local_eval = make_eval_epoch(
+                apply_fn, n_rows=self.local_test_rows, batch_size=eval_bs
+            )
+
+            def eval_shard(params, images, labels, row_w):
+                loss_sum, n_batches, correct, n_valid = local_eval(
+                    params, images, labels, row_w
+                )
+                loss_sum = jax.lax.psum(loss_sum, DATA_AXIS)
+                n_batches = jax.lax.psum(n_batches, DATA_AXIS)
+                correct = jax.lax.psum(correct, DATA_AXIS)
+                n_valid = jax.lax.psum(n_valid, DATA_AXIS)
+                # reference val/loss = mean of per-batch mean losses (:177);
+                # val/acc = 100*correct/total (:178)
+                val_loss = loss_sum / jnp.maximum(n_batches, 1.0)
+                val_acc = 100.0 * correct / jnp.maximum(n_valid, 1.0)
+                return val_loss, val_acc
+
+            self._eval_fn = jax.jit(
+                jax.shard_map(
+                    eval_shard,
+                    mesh=mesh,
+                    in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+                    out_specs=(P(), P()),
+                )
+            )
+        else:
+            self._eval_fn = None
+
+    # ----------------------------------------------------------------- run
+
+    def run_epoch(
+        self, epoch: int, *, timers: T.PhaseTimers | None = None, do_eval: bool = True
+    ) -> EpochMetrics:
+        c = self.config
+        timers = timers if timers is not None else T.PhaseTimers()
+
+        # fault injection at epoch top (parity: simulate_failure call sites
+        # data_parallelism_train.py:117,141)
+        mask = live_mask(epoch_key(c.seed, epoch), self.n_workers, c.failure_probability)
+        mask_host = np.asarray(mask)
+        straggler_sleep(mask_host, c.failure_duration)
+
+        with timers.phase(T.TRAINING) as t:
+            params_stacked, self.mom, loss_sums, n_batches = self._train_fn(
+                self.params,
+                self.mom,
+                self.train_images,
+                self.train_labels,
+                jnp.uint32(epoch),
+            )
+            t.value = params_stacked
+
+        with timers.phase(T.COMMUNICATION) as t:
+            mask_dev = jax.device_put(mask_host, self._shard)
+            self.params, train_loss = self._sync_fn(
+                params_stacked, mask_dev, loss_sums, n_batches
+            )
+            t.value = (self.params, train_loss)
+
+        val_loss = val_acc = None
+        if do_eval and self._eval_fn is not None:
+            with timers.phase(T.EVALUATION) as t:
+                val_loss, val_acc = self._eval_fn(
+                    self.params, self.test_images, self.test_labels, self.test_weights
+                )
+                t.value = (val_loss, val_acc)
+            val_loss = float(val_loss)
+            val_acc = float(val_acc)
+
+        m = EpochMetrics(
+            epoch=epoch,
+            train_loss=float(train_loss),
+            val_loss=val_loss,
+            val_acc=val_acc,
+            n_live=int(mask_host.sum()),
+        )
+        self.history.append(m)
+        return m
+
+    def run(
+        self,
+        *,
+        timers: T.PhaseTimers | None = None,
+        run=None,
+        log=print,
+        eval_every: int = 1,
+    ) -> list[EpochMetrics]:
+        """Full training run; `run` is a MetricsRun-like sink (utils.metrics)."""
+        for epoch in range(self.config.epochs):
+            log(f"Starting epoch  {epoch}")
+            do_eval = eval_every > 0 and (epoch + 1) % eval_every == 0
+            m = self.run_epoch(epoch, timers=timers, do_eval=do_eval)
+            log(f"Global Average Training Loss: {m.train_loss}")
+            if run is not None:
+                run.append("train/loss", m.train_loss)
+            if m.val_acc is not None:
+                log(f"Validation loss of updated master model:  {m.val_loss}")
+                log(f"Validation Accuracy: {m.val_acc:.2f} %")
+                if run is not None:
+                    run.append("val/loss", m.val_loss)
+                    run.append("val/acc", m.val_acc)
+        return self.history

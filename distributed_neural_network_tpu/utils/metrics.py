@@ -1,0 +1,108 @@
+"""Experiment metrics: series tracking with the reference's channel layout.
+
+Parity (SURVEY.md section 5.5): the reference logs three channels -
+(1) Neptune series `train/loss`, `val/loss`, `val/acc` plus a `parameters`
+dict (`data_parallelism_train.py:106-112,180-181,250`), (2) stdout epoch
+prints, (3) phase-time files under `log/`. This module provides the same
+series names over pluggable sinks: an always-on JSONL writer (local,
+credential-free - the hardcoded Neptune API tokens at
+`single_proc_train.py:22` are deliberately NOT reproduced), stdout, and an
+optional real Neptune sink if the library + env credentials are present.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+TRAIN_LOSS = "train/loss"
+VAL_LOSS = "val/loss"
+VAL_ACC = "val/acc"
+
+
+class MetricsRun:
+    """A metrics run: `run.append(series, value)`, `run["parameters"] = {...}`.
+
+    Mirrors the subset of the neptune.Run API the reference uses
+    (`run["train/loss"].append(...)` => `run.append("train/loss", ...)`).
+    """
+
+    def __init__(self, sinks):
+        self.sinks = list(sinks)
+
+    def __setitem__(self, key: str, value) -> None:
+        for s in self.sinks:
+            s.set_value(key, value)
+
+    def append(self, series: str, value) -> None:
+        for s in self.sinks:
+            s.append(series, float(value))
+
+    def stop(self) -> None:
+        for s in self.sinks:
+            s.stop()
+
+
+class JsonlSink:
+    """One JSON object per event: {"t": ..., "series": ..., "value"/"data": ...}."""
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "a", buffering=1)
+        self._step: dict[str, int] = {}
+
+    def set_value(self, key, value):
+        self._write({"t": time.time(), "series": key, "data": value})
+
+    def append(self, series, value):
+        step = self._step.get(series, 0)
+        self._step[series] = step + 1
+        self._write({"t": time.time(), "series": series, "step": step, "value": value})
+
+    def _write(self, obj):
+        self._f.write(json.dumps(obj) + "\n")
+
+    def stop(self):
+        self._f.close()
+
+
+class NullSink:
+    def set_value(self, key, value): ...
+
+    def append(self, series, value): ...
+
+    def stop(self): ...
+
+
+class NeptuneSink:
+    """Optional real Neptune sink; requires NEPTUNE_PROJECT/NEPTUNE_API_TOKEN
+    env vars (never hardcoded creds - see module docstring)."""
+
+    def __init__(self):
+        import neptune  # noqa: F401 - optional dependency
+
+        self._run = neptune.init_run()
+
+    def set_value(self, key, value):
+        self._run[key] = value
+
+    def append(self, series, value):
+        self._run[series].append(value)
+
+    def stop(self):
+        self._run.stop()
+
+
+def init_run(jsonl_path: str | None = None, neptune: bool = False) -> MetricsRun:
+    sinks = []
+    if jsonl_path:
+        sinks.append(JsonlSink(jsonl_path))
+    if neptune:
+        try:
+            sinks.append(NeptuneSink())
+        except Exception as e:  # lib missing / no creds: degrade, don't crash
+            print(f"(neptune sink unavailable: {e}; continuing with local sinks)")
+    if not sinks:
+        sinks.append(NullSink())
+    return MetricsRun(sinks)

@@ -1,0 +1,61 @@
+"""Phase-time instrumentation with proper device fencing.
+
+Parity with the reference's five module-global wall-clock accumulators
+(`data_parallelism_train.py:33-37`): data_loading, training, evaluation, and
+communication (parent/children merged - there is no parent process here).
+The reference's methodology flaw (report section 6.1: comm time measured
+around the pickle call, not the blocking wait) is fixed by fencing every
+phase with `jax.block_until_ready` on the phase's outputs before reading the
+clock - asynchronous dispatch otherwise attributes device time to whichever
+phase happens to block first.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+
+import jax
+
+# canonical phase names (reference globals, data_parallelism_train.py:33-37)
+DATA_LOADING = "data_loading"
+TRAINING = "training"
+EVALUATION = "evaluation"
+COMMUNICATION = "communication"
+
+
+class PhaseTimers:
+    """Accumulating wall-clock timers keyed by phase name."""
+
+    def __init__(self):
+        self.totals: dict[str, float] = defaultdict(float)
+
+    @contextmanager
+    def phase(self, name: str, fence=None):
+        """Time a block; `fence` (any pytree of arrays) is block_until_ready'd
+        before the clock stops, so device work is charged to this phase."""
+        start = time.perf_counter()
+        holder = _FenceHolder()
+        try:
+            yield holder
+        finally:
+            target = holder.value if holder.value is not None else fence
+            if target is not None:
+                jax.block_until_ready(target)
+            self.totals[name] += time.perf_counter() - start
+
+    def add(self, name: str, seconds: float) -> None:
+        self.totals[name] += seconds
+
+    def get(self, name: str) -> float:
+        return self.totals.get(name, 0.0)
+
+    def summary(self) -> dict[str, float]:
+        return dict(self.totals)
+
+
+class _FenceHolder:
+    """`with timers.phase(...) as t: t.value = outputs` registers the fence."""
+
+    value = None

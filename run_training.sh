@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Batch-size sweep - parity with the reference run_training.sh:1-4
+# (`mpiexec -n 4 data_parallelism_train.py --nb-proc 4 --batch-size $bs`).
+# No mpiexec: --nb-proc is the mesh device count. Extra args pass through
+# (e.g. ./run_training.sh --data synthetic --epochs 2 for a smoke sweep).
+set -euo pipefail
+for bs in 1 2 4 8 16 32 64; do
+  python data_parallelism_train.py --nb-proc 4 --batch-size "$bs" "$@"
+done

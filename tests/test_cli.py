@@ -1,0 +1,103 @@
+"""CLI integration tests: one run per entry point on tiny synthetic data.
+
+The analog of the reference's only verification path - actually running the
+scripts (SURVEY.md sec. 4) - but automated: each script runs in a subprocess
+on the 8-fake-device CPU platform, and we assert on its summary line, metric
+series, and phase-log artifacts.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_script(tmp_path, script, *extra):
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    args = [
+        sys.executable,
+        os.path.join(REPO, script),
+        "--data",
+        "synthetic",
+        "--synthetic-size",
+        "400",
+        "--epochs",
+        "2",
+        "--batch-size",
+        "16",
+        "--log-dir",
+        str(tmp_path / "log"),
+        "--metrics-jsonl",
+        str(tmp_path / "metrics.jsonl"),
+        *extra,
+    ]
+    proc = subprocess.run(
+        args, capture_output=True, text=True, cwd=REPO, env=env, timeout=600
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    summary = next(
+        line for line in proc.stdout.splitlines() if line.startswith("SUMMARY ")
+    )
+    return json.loads(summary[len("SUMMARY ") :]), proc.stdout, tmp_path
+
+
+@pytest.mark.parametrize(
+    "script,regime,extra",
+    [
+        ("single_proc_train.py", "single", ()),
+        ("model_replication_train.py", "replication", ("--nb-proc", "4")),
+        ("data_parallelism_train.py", "data_parallel", ("--nb-proc", "4")),
+    ],
+)
+def test_entry_point_runs(tmp_path, script, regime, extra):
+    summary, stdout, _ = _run_script(tmp_path, script, *extra)
+    assert summary["regime"] == regime
+    assert summary["epochs"] == 2
+    assert summary["final_val_acc"] is not None
+    assert summary["data_source"] == "synthetic"
+    # metrics series present with reference names
+    series = [
+        json.loads(line)["series"]
+        for line in open(tmp_path / "metrics.jsonl")
+    ]
+    for s in ("train/loss", "val/loss", "val/acc"):
+        assert series.count(s) == 2, (s, series)
+
+
+def test_dp_writes_reference_named_phase_logs(tmp_path):
+    _, _, path = _run_script(
+        tmp_path, "data_parallelism_train.py", "--nb-proc", "4"
+    )
+    parent = path / "log" / "bs16_log_epochs2_proc4_parent.txt"
+    children = path / "log" / "bs16_log_epochs2_proc4_children.txt"
+    assert parent.exists() and children.exists()
+    lines = parent.read_text().splitlines()
+    assert lines[0].startswith("Eval data loading time: ")
+    assert lines[1].startswith("Time spent on evaluation: ")
+    assert lines[2].startswith("Time spent on parent communication and param sync: ")
+    clines = children.read_text().splitlines()
+    assert clines[0].startswith("Train data loading time: ")
+    assert clines[1].startswith("Time spent on training: ")
+    assert clines[2].startswith("Time spent on children communication: ")
+
+
+def test_dp_fault_flags(tmp_path):
+    summary, stdout, _ = _run_script(
+        tmp_path,
+        "data_parallelism_train.py",
+        "--nb-proc",
+        "8",
+        "--failure-probability",
+        "0.9",
+        "--seed",
+        "5",
+    )
+    assert summary["final_val_acc"] is not None  # survived heavy failures

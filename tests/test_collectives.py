@@ -1,0 +1,94 @@
+"""Collective + fault-mask tests on the 8-device CPU mesh (SURVEY.md sec. 4)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from distributed_neural_network_tpu.parallel import collectives, fault
+from distributed_neural_network_tpu.parallel.mesh import DATA_AXIS, create_mesh
+
+
+def _run_sharded(n_devices, fn, *args_specs):
+    mesh = create_mesh(n_devices)
+    in_specs = tuple(s for _, s in args_specs)
+    args = tuple(a for a, _ in args_specs)
+    wrapped = functools.partial(
+        jax.shard_map, mesh=mesh, in_specs=in_specs, out_specs=P()
+    )(fn)
+    return jax.jit(wrapped)(*args)
+
+
+def test_pmean_tree_equals_hand_mean(n_devices):
+    vals = jnp.arange(8.0).reshape(8, 1)  # device d holds value d
+
+    def f(x):
+        tree = {"a": x[0]}
+        return collectives.pmean_tree(tree)["a"]
+
+    out = _run_sharded(8, f, (vals, P(DATA_AXIS)))
+    np.testing.assert_allclose(np.asarray(out), 3.5)
+
+
+def test_masked_pmean_drops_dead_devices(n_devices):
+    vals = jnp.arange(8.0).reshape(8, 1)
+    live = jnp.array([1, 1, 0, 1, 1, 1, 0, 1], jnp.float32).reshape(8, 1)
+
+    def f(x, m):
+        return collectives.masked_pmean_tree({"a": x[0]}, m[0])["a"]
+
+    out = _run_sharded(8, f, (vals, P(DATA_AXIS)), (live, P(DATA_AXIS)))
+    expect = (0 + 1 + 3 + 4 + 5 + 7) / 6.0  # devices 2 and 6 excluded
+    np.testing.assert_allclose(np.asarray(out), expect)
+
+
+def test_masked_pmean_all_dead_degrades_to_plain_mean(n_devices):
+    vals = jnp.arange(8.0).reshape(8, 1)
+    live = jnp.zeros((8, 1), jnp.float32)
+
+    def f(x, m):
+        return collectives.masked_pmean_tree({"a": x[0]}, m[0])["a"]
+
+    out = _run_sharded(8, f, (vals, P(DATA_AXIS)), (live, P(DATA_AXIS)))
+    np.testing.assert_allclose(np.asarray(out), 3.5)
+
+
+def test_weighted_mean_scalar_fixes_loss_scaling(n_devices):
+    # device d contributes loss_sum=d over d+1 batches; global mean must be
+    # sum(d)/sum(d+1), not the reference's key-count-scaled number
+    loss = jnp.arange(8.0).reshape(8, 1)
+    nb = jnp.arange(1.0, 9.0).reshape(8, 1)
+
+    def f(l, n):
+        return collectives.weighted_mean_scalar(l[0], n[0])
+
+    out = _run_sharded(8, f, (loss, P(DATA_AXIS)), (nb, P(DATA_AXIS)))
+    np.testing.assert_allclose(np.asarray(out), 28.0 / 36.0)
+
+
+def test_live_mask_seeded_and_prob_zero_fast_path():
+    m0 = fault.live_mask(fault.epoch_key(0, 0), 8, 0.0)
+    np.testing.assert_array_equal(np.asarray(m0), np.ones(8))
+    m1 = fault.live_mask(fault.epoch_key(0, 3), 8, 0.5)
+    m2 = fault.live_mask(fault.epoch_key(0, 3), 8, 0.5)
+    np.testing.assert_array_equal(np.asarray(m1), np.asarray(m2))  # deterministic
+    m3 = fault.live_mask(fault.epoch_key(0, 4), 8, 0.5)
+    assert not np.array_equal(np.asarray(m1), np.asarray(m3))  # varies by epoch
+
+
+def test_live_mask_probability_one_kills_all():
+    m = fault.live_mask(fault.epoch_key(1, 0), 8, 1.0)
+    np.testing.assert_array_equal(np.asarray(m), np.zeros(8))
+
+
+def test_straggler_sleep_logs(capsys):
+    logs = []
+    fault.straggler_sleep(np.array([1.0, 0.0, 1.0]), 0.01, log=logs.append)
+    assert logs == [
+        "Device 1 failed! Sleeping for 0.01 seconds.",
+        "Device 1 woke up!",
+    ]
+    fault.straggler_sleep(np.array([1.0, 1.0]), 0.01, log=logs.append)
+    assert len(logs) == 2  # no failures -> no logs

@@ -1,0 +1,115 @@
+"""Data source + pipeline tests (reference `data_parallelism_train.py:24-27,49-53,66-92`)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_neural_network_tpu.data import cifar10, pipeline
+from distributed_neural_network_tpu.parallel import partition
+
+
+def test_normalize_range_and_values():
+    x = np.array([[0, 128, 255]], dtype=np.uint8)
+    out = cifar10.normalize(x)
+    np.testing.assert_allclose(out, [[-1.0, 128 / 255 * 2 - 1, 1.0]], atol=1e-6)
+
+
+def test_synthetic_is_deterministic_and_classful():
+    x1, y1 = cifar10.make_synthetic(256, seed=7)
+    x2, y2 = cifar10.make_synthetic(256, seed=7)
+    np.testing.assert_array_equal(x1, x2)
+    np.testing.assert_array_equal(y1, y2)
+    assert x1.shape == (256, 32, 32, 3) and x1.dtype == np.uint8
+    assert set(np.unique(y1)) <= set(range(10))
+    # train/test disjoint streams but same class templates
+    xt, yt = cifar10.make_synthetic(256, seed=7, train=False)
+    assert not np.array_equal(x1, xt)
+
+
+def test_load_split_synthetic_fallback(tmp_path):
+    s = cifar10.load_split(True, root=str(tmp_path), synthetic_size=128)
+    assert s.source == "synthetic" and len(s) == 128
+    assert s.images.dtype == np.float32
+    assert -1.0 <= s.images.min() and s.images.max() <= 1.0
+
+
+def test_load_split_npz_roundtrip(tmp_path):
+    x = np.random.default_rng(0).integers(0, 255, (64, 32, 32, 3), dtype=np.uint8)
+    y = np.arange(64) % 10
+    np.savez(
+        tmp_path / "cifar10.npz",
+        x_train=x, y_train=y, x_test=x[:16], y_test=y[:16],
+    )
+    s = cifar10.load_split(True, root=str(tmp_path))
+    assert s.source == "npz" and len(s) == 64
+    t = cifar10.load_split(False, root=str(tmp_path))
+    assert len(t) == 16
+
+
+def test_load_split_pickle_batches(tmp_path):
+    import pickle
+
+    d = tmp_path / "cifar-10-batches-py"
+    d.mkdir()
+    rng = np.random.default_rng(1)
+    for name, n in [(f"data_batch_{i}", 20) for i in range(1, 6)] + [("test_batch", 10)]:
+        obj = {
+            b"data": rng.integers(0, 255, (n, 3072), dtype=np.uint8),
+            b"labels": list(rng.integers(0, 10, n)),
+        }
+        (d / name).write_bytes(pickle.dumps(obj))
+    s = cifar10.load_split(True, root=str(tmp_path))
+    assert s.source == "pickle" and len(s) == 100
+    t = cifar10.load_split(False, root=str(tmp_path))
+    assert len(t) == 10
+
+
+def test_epoch_plan_covers_all_rows_once():
+    idx, w = pipeline.epoch_plan(jax.random.key(0), n_rows=103, batch_size=16)
+    assert idx.shape == (7, 16) and w.shape == (7, 16)
+    valid = np.asarray(idx).ravel()[np.asarray(w).ravel() == 1]
+    assert sorted(valid.tolist()) == list(range(103))
+    assert float(np.asarray(w).sum()) == 103
+
+
+def test_epoch_plan_shuffles_differently_per_key():
+    i1, _ = pipeline.epoch_plan(jax.random.key(1), 64, 8)
+    i2, _ = pipeline.epoch_plan(jax.random.key(2), 64, 8)
+    assert not np.array_equal(np.asarray(i1), np.asarray(i2))
+
+
+def test_eval_plan_sequential():
+    idx, w = pipeline.eval_plan(10, 4)
+    np.testing.assert_array_equal(
+        np.asarray(idx), [[0, 1, 2, 3], [4, 5, 6, 7], [8, 9, 0, 0]]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(w), [[1, 1, 1, 1], [1, 1, 1, 1], [1, 1, 0, 0]]
+    )
+
+
+def test_gather_batch():
+    imgs = jnp.arange(12.0).reshape(6, 2)
+    labels = jnp.arange(6)
+    x, y = pipeline.gather_batch(imgs, labels, jnp.array([3, 1]))
+    np.testing.assert_array_equal(np.asarray(y), [3, 1])
+    np.testing.assert_array_equal(np.asarray(x), [[6.0, 7.0], [2.0, 3.0]])
+
+
+def test_partition_reference_semantics():
+    # total=103, 4 shards -> p=25, rows 0..99, remainder 100..102 dropped
+    # (reference partition_dataset drops remainder, data_parallelism_train.py:49-53)
+    rows = partition.shard_rows(103, 4)
+    assert rows.shape == (4, 25)
+    np.testing.assert_array_equal(rows[0], np.arange(25))
+    np.testing.assert_array_equal(rows[3], np.arange(75, 100))
+    bounds = partition.shard_bounds(103, 4)
+    assert bounds == [(0, 25), (25, 50), (50, 75), (75, 100)]
+
+
+def test_partition_replicated():
+    rows = partition.replicated_rows(10, 3)
+    assert rows.shape == (3, 10)
+    for d in range(3):
+        np.testing.assert_array_equal(rows[d], np.arange(10))

@@ -1,0 +1,199 @@
+"""End-to-end engine tests: three regimes on the 8-device CPU mesh.
+
+The TPU-native analog of the reference's empirical verification (SURVEY.md
+sec. 4): convergence on a small class-structured dataset, cross-regime
+equivalences, fault-mask semantics, and the local-SGD vs per-step sync modes.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_neural_network_tpu.data.cifar10 import Split, make_synthetic, normalize
+from distributed_neural_network_tpu.train.engine import Engine, TrainConfig
+
+
+def _splits(n_train=512, n_test=256, seed=3):
+    xt, yt = make_synthetic(n_train, seed=seed, train=True)
+    xv, yv = make_synthetic(n_test, seed=seed, train=False)
+    return (
+        Split(normalize(xt), yt, "synthetic"),
+        Split(normalize(xv), yv, "synthetic"),
+    )
+
+
+TRAIN, TEST = _splits()
+
+
+def _cfg(**kw):
+    base = dict(lr=0.01, momentum=0.9, batch_size=32, epochs=2, seed=0)
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def test_single_regime_trains_and_converges(n_devices):
+    eng = Engine(_cfg(regime="single", epochs=6), TRAIN, TEST)
+    hist = eng.run(log=lambda *_: None)
+    assert len(hist) == 6
+    assert hist[-1].train_loss < hist[0].train_loss
+    assert hist[-1].val_acc > 45.0  # way above 10% chance on class-structured data
+
+
+def test_data_parallel_regime_8dev(n_devices):
+    eng = Engine(
+        _cfg(regime="data_parallel", nb_proc=8, epochs=6, batch_size=8, lr=0.05),
+        TRAIN,
+        TEST,
+    )
+    hist = eng.run(log=lambda *_: None)
+    assert hist[-1].train_loss < hist[0].train_loss
+    assert hist[-1].val_acc > 60.0
+    # shard math: 512 rows / 8 devices = 64 local rows
+    assert eng.local_train_rows == 64
+
+
+def test_replication_regime_8dev(n_devices):
+    eng = Engine(
+        _cfg(regime="replication", nb_proc=8, epochs=4, batch_size=16), TRAIN, TEST
+    )
+    hist = eng.run(log=lambda *_: None)
+    assert eng.local_train_rows == 512  # full data on every device
+    assert hist[-1].val_acc > 60.0
+
+
+def test_reference_compat_uses_n_minus_1_workers(n_devices):
+    eng = Engine(
+        _cfg(regime="data_parallel", nb_proc=8, reference_compat=True), TRAIN, TEST
+    )
+    assert eng.n_workers == 7
+    assert eng.local_train_rows == 512 // 7
+
+
+def test_nb_proc_1_data_parallel_equals_single_regime(n_devices):
+    """With one device, sharded local SGD == the single-process baseline."""
+    e1 = Engine(_cfg(regime="single", epochs=2), TRAIN, TEST)
+    h1 = e1.run(log=lambda *_: None)
+    e2 = Engine(_cfg(regime="data_parallel", nb_proc=1, epochs=2), TRAIN, TEST)
+    h2 = e2.run(log=lambda *_: None)
+    assert h1[-1].train_loss == pytest.approx(h2[-1].train_loss, rel=1e-5)
+    assert h1[-1].val_acc == pytest.approx(h2[-1].val_acc, abs=1e-6)
+
+
+def test_param_averaging_equals_hand_computed_mean(n_devices):
+    """One epoch of DP: synced params == numpy mean of per-device params."""
+    eng = Engine(_cfg(regime="data_parallel", nb_proc=8, epochs=1), TRAIN, TEST)
+    params_stacked, mom, loss_sums, n_batches = eng._train_fn(
+        eng.params, eng.mom, eng.train_images, eng.train_labels, np.uint32(0)
+    )
+    stacked = jax.tree.map(np.asarray, params_stacked)
+    live = jax.device_put(np.ones(8, np.float32), eng._shard)
+    synced, _ = eng._sync_fn(params_stacked, live, loss_sums, n_batches)
+    hand = jax.tree.map(lambda x: x.mean(axis=0), stacked)
+    got = jax.tree.map(np.asarray, synced)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-5, atol=1e-6),
+        hand,
+        got,
+    )
+
+
+def test_fault_mask_excludes_dead_device(n_devices):
+    """With p=1 failure on every device the avg falls back to plain mean; with
+    a hand-injected mask the dead device's params are excluded."""
+    eng = Engine(_cfg(regime="data_parallel", nb_proc=8, epochs=1), TRAIN, TEST)
+    params_stacked, mom, loss_sums, n_batches = eng._train_fn(
+        eng.params, eng.mom, eng.train_images, eng.train_labels, np.uint32(0)
+    )
+    stacked = jax.tree.map(np.asarray, params_stacked)
+    mask = np.ones(8, np.float32)
+    mask[2] = 0.0
+    live = jax.device_put(mask, eng._shard)
+    synced, _ = eng._sync_fn(params_stacked, live, loss_sums, n_batches)
+    hand = jax.tree.map(
+        lambda x: x[mask.astype(bool)].mean(axis=0), stacked
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, np.asarray(b), rtol=2e-5, atol=1e-6),
+        hand,
+        jax.tree.map(np.asarray, synced),
+    )
+
+
+def test_fault_run_survives_failures(n_devices):
+    eng = Engine(
+        _cfg(
+            regime="data_parallel",
+            nb_proc=8,
+            epochs=4,
+            failure_probability=0.4,
+            seed=5,
+        ),
+        TRAIN,
+        TEST,
+    )
+    hist = eng.run(log=lambda *_: None)
+    assert all(np.isfinite(m.train_loss) for m in hist)
+    assert any(m.n_live < 8 for m in hist)  # failures actually happened
+    assert all(m.val_acc is not None for m in hist)
+
+
+def test_step_sync_mode(n_devices):
+    eng = Engine(
+        _cfg(
+            regime="data_parallel",
+            nb_proc=8,
+            sync_mode="step",
+            epochs=5,
+            batch_size=8,
+            lr=0.05,
+        ),
+        TRAIN,
+        TEST,
+    )
+    hist = eng.run(log=lambda *_: None)
+    assert hist[-1].train_loss < hist[0].train_loss
+    assert hist[-1].val_acc > 60.0
+
+
+def test_eval_handles_uneven_test_split(n_devices):
+    """255 test rows over 8 devices: padded rows must not distort accuracy."""
+    train, _ = _splits()
+    xv, yv = make_synthetic(255, seed=3, train=False)
+    test = Split(normalize(xv), yv, "synthetic")
+    eng = Engine(_cfg(regime="data_parallel", nb_proc=8, epochs=1), train, test)
+    hist = eng.run(log=lambda *_: None)
+    assert 0.0 <= hist[0].val_acc <= 100.0
+
+
+def test_determinism_same_seed_same_result(n_devices):
+    h1 = Engine(_cfg(regime="data_parallel", nb_proc=8, epochs=2), TRAIN, TEST).run(
+        log=lambda *_: None
+    )
+    h2 = Engine(_cfg(regime="data_parallel", nb_proc=8, epochs=2), TRAIN, TEST).run(
+        log=lambda *_: None
+    )
+    assert h1[-1].train_loss == h2[-1].train_loss
+    assert h1[-1].val_acc == h2[-1].val_acc
+
+
+def test_momentum_reset_vs_persistent(n_devices):
+    """reset_momentum=True (reference dynamics) differs from persistent."""
+    hr = Engine(_cfg(regime="single", epochs=3, reset_momentum=True), TRAIN, TEST).run(
+        log=lambda *_: None
+    )
+    hp = Engine(_cfg(regime="single", epochs=3, reset_momentum=False), TRAIN, TEST).run(
+        log=lambda *_: None
+    )
+    assert hr[-1].train_loss != hp[-1].train_loss
+
+
+def test_reset_state_reproduces_run(n_devices):
+    """Warm-up + reset_state (bench.py pattern) must not change the measured
+    training trajectory."""
+    eng = Engine(_cfg(regime="data_parallel", nb_proc=8, epochs=2), TRAIN, TEST)
+    h1 = [eng.run_epoch(e) for e in range(2)]
+    eng.reset_state()
+    eng.history = []
+    h2 = [eng.run_epoch(e) for e in range(2)]
+    assert h1[-1].train_loss == h2[-1].train_loss
+    assert h1[-1].val_acc == h2[-1].val_acc

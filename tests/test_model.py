@@ -1,0 +1,60 @@
+"""Model parity tests vs the reference `models/model.py:9-27`."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_neural_network_tpu.models.cnn import Network, param_count
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = Network()
+    return model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))["params"]
+
+
+def test_param_count_matches_reference(params):
+    # conv1: 5*5*3*6+6=456; conv2: 5*5*6*16+16=2416; fc1: 400*120+120=48120;
+    # fc2: 120*84+84=10164; fc3: 84*10+10=850  => 62,006 (reference Network)
+    assert param_count(params) == 62_006
+
+
+def test_layer_shapes(params):
+    assert params["conv1"]["kernel"].shape == (5, 5, 3, 6)
+    assert params["conv2"]["kernel"].shape == (5, 5, 6, 16)
+    assert params["fc1"]["kernel"].shape == (400, 120)
+    assert params["fc2"]["kernel"].shape == (120, 84)
+    assert params["fc3"]["kernel"].shape == (84, 10)
+
+
+def test_forward_shape_and_dtype(params):
+    model = Network()
+    x = jnp.zeros((7, 32, 32, 3))
+    logits = model.apply({"params": params}, x)
+    assert logits.shape == (7, 10)
+    assert logits.dtype == jnp.float32
+
+
+def test_forward_is_jittable(params):
+    model = Network()
+    f = jax.jit(lambda p, x: model.apply({"params": p}, x))
+    out = f(params, jnp.ones((4, 32, 32, 3)))
+    assert np.all(np.isfinite(np.asarray(out)))
+
+
+def test_bf16_compute_path(params):
+    model = Network(compute_dtype=jnp.bfloat16)
+    logits = model.apply({"params": params}, jnp.ones((4, 32, 32, 3)))
+    assert logits.dtype == jnp.float32  # logits promoted back for stable CE
+    ref = Network().apply({"params": params}, jnp.ones((4, 32, 32, 3)))
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref), atol=0.15)
+
+
+def test_torch_init_distribution(params):
+    # torch default init: U(-1/sqrt(fan_in), +1/sqrt(fan_in)) for w and b
+    k = np.asarray(params["fc1"]["kernel"])  # fan_in=400 -> bound 0.05
+    assert np.abs(k).max() <= 1 / np.sqrt(400) + 1e-6
+    assert np.abs(k).max() > 0.8 / np.sqrt(400)  # actually fills the range
+    b = np.asarray(params["conv1"]["bias"])  # fan_in=75 -> bound ~0.1155
+    assert np.abs(b).max() <= 1 / np.sqrt(75) + 1e-6
