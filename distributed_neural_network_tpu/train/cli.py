@@ -58,6 +58,27 @@ def add_common_flags(p: argparse.ArgumentParser, *, epochs: int, batch_size: int
         "--compute-dtype", choices=("float32", "bfloat16"), default="float32"
     )
     p.add_argument("--eval-every", type=int, default=1)
+    p.add_argument(
+        "--checkpoint-dir",
+        default=None,
+        help="save params+momentum+history at epoch edges (SURVEY.md sec. 5.4)",
+    )
+    p.add_argument("--checkpoint-every", type=int, default=1, help="epochs between saves")
+    p.add_argument("--checkpoint-keep", type=int, default=3, help="checkpoints retained")
+    p.add_argument(
+        "--checkpoint-backend", choices=("auto", "orbax", "npz"), default="auto"
+    )
+    p.add_argument(
+        "--resume",
+        action="store_true",
+        help="resume from the latest checkpoint in --checkpoint-dir",
+    )
+    p.add_argument(
+        "--profile-dir",
+        default=None,
+        help="capture a jax.profiler trace of the training run into this dir "
+        "(SURVEY.md sec. 5.1 - the reference had only wall-clock brackets)",
+    )
     return p
 
 
@@ -169,7 +190,53 @@ def run_training(args, regime: str, *, log=print) -> Engine:
 
     t0 = time.perf_counter()
     engine = Engine(cfg, train_split, test_split)
-    engine.run(timers=timers, run=run, log=log, eval_every=args.eval_every)
+
+    checkpointer = None
+    start_epoch = 0
+    if getattr(args, "checkpoint_dir", None):
+        from ..utils.checkpoint import Checkpointer
+
+        checkpointer = Checkpointer(
+            args.checkpoint_dir,
+            every=args.checkpoint_every,
+            keep=args.checkpoint_keep,
+            backend=args.checkpoint_backend,
+        )
+        if args.resume:
+            start_epoch = checkpointer.restore_latest(engine)
+            if start_epoch:
+                log(f"(Resumed from checkpoint: next epoch {start_epoch})")
+            else:
+                log(
+                    f"(WARNING: --resume found no checkpoint in "
+                    f"{args.checkpoint_dir} [backend={checkpointer.backend_name}]; "
+                    "starting from scratch - check the dir and "
+                    "--checkpoint-backend match the original run)"
+                )
+
+    profile_dir = getattr(args, "profile_dir", None)
+    if profile_dir:
+        import jax
+
+        jax.profiler.start_trace(profile_dir)
+    try:
+        engine.run(
+            timers=timers,
+            run=run,
+            log=log,
+            eval_every=args.eval_every,
+            checkpointer=checkpointer,
+            start_epoch=start_epoch,
+        )
+    finally:
+        if profile_dir:
+            import jax
+
+            jax.block_until_ready(engine.params)
+            jax.profiler.stop_trace()
+            log(f"(Profiler trace written to {profile_dir})")
+        if checkpointer is not None:
+            checkpointer.close()
     wall = time.perf_counter() - t0
     run.stop()
 

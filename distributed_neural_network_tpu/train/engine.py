@@ -201,6 +201,21 @@ class Engine:
         self._build_state()
         self.history = []
 
+    def state_tree(self):
+        """The sync-boundary state a checkpoint must capture: the averaged
+        (replicated) params and the per-device momentum stack. The reference's
+        analog is the parent's state dict after load_state_dict
+        (`data_parallelism_train.py:244`) - which lost the children's momentum;
+        here momentum survives resume, so `--no-momentum-reset` runs resume
+        exactly."""
+        return {"params": self.params, "mom": self.mom}
+
+    def load_state_tree(self, tree) -> None:
+        """Install a (host or device) state tree onto this engine's mesh
+        shardings; inverse of checkpointing `state_tree()`."""
+        self.params = jax.device_put(tree["params"], self._repl)
+        self.mom = jax.device_put(tree["mom"], self._shard)
+
     # --------------------------------------------------------------- steps
 
     def _build_steps(self):
@@ -370,9 +385,13 @@ class Engine:
         run=None,
         log=print,
         eval_every: int = 1,
+        checkpointer=None,
+        start_epoch: int = 0,
     ) -> list[EpochMetrics]:
-        """Full training run; `run` is a MetricsRun-like sink (utils.metrics)."""
-        for epoch in range(self.config.epochs):
+        """Full training run; `run` is a MetricsRun-like sink (utils.metrics);
+        `checkpointer` a utils.checkpoint.Checkpointer saving at epoch edges;
+        `start_epoch` > 0 resumes mid-run (state already restored)."""
+        for epoch in range(start_epoch, self.config.epochs):
             log(f"Starting epoch  {epoch}")
             do_eval = eval_every > 0 and (epoch + 1) % eval_every == 0
             m = self.run_epoch(epoch, timers=timers, do_eval=do_eval)
@@ -385,4 +404,6 @@ class Engine:
                 if run is not None:
                     run.append("val/loss", m.val_loss)
                     run.append("val/acc", m.val_acc)
+            if checkpointer is not None:
+                checkpointer.maybe_save(epoch, self)
         return self.history

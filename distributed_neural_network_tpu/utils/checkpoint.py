@@ -1,0 +1,223 @@
+"""Checkpoint / resume at the sync boundary (SURVEY.md section 5.4).
+
+The reference persists nothing: the parent's averaged state dict at an epoch
+edge (`data_parallelism_train.py:244`) is only an *implicit* checkpointable
+state, lost when the process exits. Here that state is explicit - after the
+sync phase the engine holds the averaged parameters (replicated over the
+mesh), the per-device momentum buffers, and the metric history - and this
+module persists it at a configurable epoch interval with retention and
+resume-from-latest.
+
+Backends:
+- ``orbax`` (default when importable): `orbax.checkpoint.CheckpointManager`
+  with a Standard (pytree) item for arrays and a JSON item for metadata -
+  the idiomatic JAX/TPU checkpoint stack.
+- ``npz``: a dependency-free fallback writing one `.npz` of tree leaves plus
+  a JSON sidecar per step, with the same retention semantics.
+
+Arrays are materialized to host numpy before save and re-placed onto the
+engine's mesh shardings on restore, so checkpoints are portable across
+platforms (TPU run -> CPU-mesh resume and vice versa). The two backends'
+on-disk formats are NOT cross-readable: resume with the same backend (and
+directory) the run was saved with.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+import shutil
+
+import jax
+import numpy as np
+
+try:  # pragma: no cover - exercised indirectly via backend selection
+    import orbax.checkpoint as ocp
+
+    _HAVE_ORBAX = True
+except Exception:  # pragma: no cover
+    ocp = None
+    _HAVE_ORBAX = False
+
+
+def _host_tree(tree):
+    return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
+
+
+class _OrbaxBackend:
+    def __init__(self, directory: str, keep: int):
+        self._mgr = ocp.CheckpointManager(
+            os.path.abspath(directory),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=keep if keep > 0 else None,
+                enable_async_checkpointing=False,
+            ),
+        )
+
+    def save(self, step: int, state, meta: dict) -> None:
+        self._mgr.save(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardSave(state),
+                meta=ocp.args.JsonSave(meta),
+            ),
+        )
+
+    def latest_step(self):
+        return self._mgr.latest_step()
+
+    def restore(self, step: int):
+        out = self._mgr.restore(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(),
+                meta=ocp.args.JsonRestore(),
+            ),
+        )
+        return out["state"], out["meta"]
+
+    def all_steps(self):
+        return sorted(self._mgr.all_steps())
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+
+class _NpzBackend:
+    """One `step_{N}/state.npz` + `meta.json` per checkpoint, keep-last-K."""
+
+    _STEP_RE = re.compile(r"^step_(\d+)$")
+
+    def __init__(self, directory: str, keep: int):
+        self.dir = os.path.abspath(directory)
+        self.keep = keep
+        os.makedirs(self.dir, exist_ok=True)
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.dir, f"step_{step}")
+
+    def save(self, step: int, state, meta: dict) -> None:
+        leaves = jax.tree.leaves(state)
+        d = self._step_dir(step)
+        tmp = d + ".tmp"
+        os.makedirs(tmp, exist_ok=True)
+        np.savez(
+            os.path.join(tmp, "state.npz"),
+            **{f"leaf_{i}": leaf for i, leaf in enumerate(leaves)},
+        )
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            json.dump(meta, f)
+        if os.path.isdir(d):
+            shutil.rmtree(d)
+        os.rename(tmp, d)  # atomic publish: partial writes never look live
+        if self.keep > 0:
+            for old in self.all_steps()[: -self.keep]:
+                shutil.rmtree(self._step_dir(old))
+
+    def all_steps(self):
+        steps = []
+        for name in os.listdir(self.dir):
+            m = self._STEP_RE.match(name)
+            if m:
+                steps.append(int(m.group(1)))
+        return sorted(steps)
+
+    def latest_step(self):
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def restore(self, step: int):
+        d = self._step_dir(step)
+        with np.load(os.path.join(d, "state.npz")) as z:
+            leaves = [z[f"leaf_{i}"] for i in range(len(z.files))]
+        with open(os.path.join(d, "meta.json")) as f:
+            meta = json.load(f)
+        return leaves, meta
+
+    def close(self) -> None:
+        pass
+
+
+class Checkpointer:
+    """Save/restore an Engine's sync-boundary state.
+
+    `maybe_save(epoch, engine)` after each epoch; `restore_latest(engine)`
+    before training to resume. Restore re-places arrays onto the engine's
+    own mesh shardings, so the checkpoint itself is platform-agnostic.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        every: int = 1,
+        keep: int = 3,
+        backend: str = "auto",
+    ):
+        if backend == "auto":
+            backend = "orbax" if _HAVE_ORBAX else "npz"
+        if backend == "orbax" and not _HAVE_ORBAX:
+            raise RuntimeError("orbax backend requested but orbax is not importable")
+        self.backend_name = backend
+        self.every = every
+        self._b = (_OrbaxBackend if backend == "orbax" else _NpzBackend)(
+            directory, keep
+        )
+
+    # ------------------------------------------------------------------ save
+
+    def maybe_save(self, epoch: int, engine) -> bool:
+        if self.every <= 0 or (epoch + 1) % self.every != 0:
+            return False
+        self.save(epoch, engine)
+        return True
+
+    def save(self, epoch: int, engine) -> None:
+        state = _host_tree(engine.state_tree())
+        meta = {
+            "epoch": epoch,
+            "n_workers": engine.n_workers,
+            "regime": engine.config.regime,
+            "history": [dataclasses.asdict(m) for m in engine.history],
+        }
+        if self.backend_name == "npz":
+            state = jax.tree.leaves(state)  # npz stores the flat leaves
+        self._b.save(epoch, state, meta)
+
+    # --------------------------------------------------------------- restore
+
+    def latest_epoch(self):
+        return self._b.latest_step()
+
+    def restore_latest(self, engine) -> int:
+        """Load the newest checkpoint into `engine`; returns the next epoch
+        to run (0 if no checkpoint exists)."""
+        step = self._b.latest_step()
+        if step is None:
+            return 0
+        state, meta = self._b.restore(step)
+        if meta["n_workers"] != engine.n_workers:
+            raise ValueError(
+                f"checkpoint was written with n_workers={meta['n_workers']}, "
+                f"engine has {engine.n_workers} - momentum buffers don't map"
+            )
+        if meta["regime"] != engine.config.regime:
+            raise ValueError(
+                f"checkpoint regime mismatch: written by a {meta['regime']!r} "
+                f"run, engine is {engine.config.regime!r} - resuming would "
+                "silently change the data-placement policy mid-trajectory"
+            )
+        template = engine.state_tree()
+        if self.backend_name == "npz":
+            state = jax.tree.unflatten(jax.tree.structure(template), state)
+        engine.load_state_tree(state)
+        from ..train.engine import EpochMetrics
+
+        engine.history = [EpochMetrics(**m) for m in meta["history"]]
+        return meta["epoch"] + 1
+
+    def close(self) -> None:
+        self._b.close()

@@ -1,0 +1,107 @@
+"""Checkpoint/resume tests (SURVEY.md section 5.4 - a capability the
+reference lacks entirely; verification is therefore semantic: a resumed run
+must be indistinguishable from an uninterrupted one)."""
+
+import numpy as np
+import pytest
+
+from distributed_neural_network_tpu.data.cifar10 import Split, make_synthetic, normalize
+from distributed_neural_network_tpu.train.engine import Engine, TrainConfig
+from distributed_neural_network_tpu.utils.checkpoint import Checkpointer
+
+
+def _splits(n_train=256, n_test=64, seed=5):
+    xt, yt = make_synthetic(n_train, seed=seed, train=True)
+    xv, yv = make_synthetic(n_test, seed=seed, train=False)
+    return (
+        Split(normalize(xt), yt, "synthetic"),
+        Split(normalize(xv), yv, "synthetic"),
+    )
+
+
+TRAIN, TEST = _splits()
+
+
+def _cfg(epochs):
+    # no momentum reset: resume must restore the momentum buffers exactly,
+    # not just the params, for the trajectories to match
+    return TrainConfig(
+        lr=0.01,
+        momentum=0.9,
+        batch_size=16,
+        epochs=epochs,
+        nb_proc=4,
+        regime="data_parallel",
+        reset_momentum=False,
+        seed=0,
+    )
+
+
+def _leaves(tree):
+    import jax
+
+    return [np.asarray(x) for x in jax.tree.leaves(tree)]
+
+
+@pytest.mark.parametrize("backend", ["orbax", "npz"])
+def test_resume_matches_uninterrupted_run(tmp_path, backend, n_devices):
+    straight = Engine(_cfg(4), TRAIN, TEST)
+    straight.run(log=lambda *_: None)
+
+    ck = Checkpointer(str(tmp_path / backend), every=1, keep=2, backend=backend)
+    first = Engine(_cfg(2), TRAIN, TEST)
+    first.run(log=lambda *_: None, checkpointer=ck)
+    ck.close()
+
+    ck2 = Checkpointer(str(tmp_path / backend), every=1, keep=2, backend=backend)
+    resumed = Engine(_cfg(4), TRAIN, TEST)
+    start = ck2.restore_latest(resumed)
+    assert start == 2
+    assert [m.epoch for m in resumed.history] == [0, 1]
+    resumed.run(log=lambda *_: None, checkpointer=ck2, start_epoch=start)
+    ck2.close()
+
+    for a, b in zip(_leaves(straight.state_tree()), _leaves(resumed.state_tree())):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6)
+    assert [m.epoch for m in resumed.history] == [0, 1, 2, 3]
+    assert resumed.history[-1].train_loss == pytest.approx(
+        straight.history[-1].train_loss, rel=1e-5
+    )
+
+
+def test_retention_keeps_last_k(tmp_path, n_devices):
+    ck = Checkpointer(str(tmp_path / "r"), every=1, keep=2, backend="npz")
+    eng = Engine(_cfg(5), TRAIN, None)
+    eng.run(log=lambda *_: None, checkpointer=ck)
+    ck.close()
+    assert ck._b.all_steps() == [3, 4]
+
+
+def test_worker_count_mismatch_raises(tmp_path, n_devices):
+    ck = Checkpointer(str(tmp_path / "m"), every=1, backend="npz")
+    eng = Engine(_cfg(1), TRAIN, None)
+    eng.run(log=lambda *_: None, checkpointer=ck)
+
+    cfg8 = _cfg(1)
+    cfg8.nb_proc = 8
+    other = Engine(cfg8, TRAIN, None)
+    with pytest.raises(ValueError, match="n_workers"):
+        ck.restore_latest(other)
+
+
+def test_restore_on_empty_dir_is_fresh_start(tmp_path, n_devices):
+    ck = Checkpointer(str(tmp_path / "e"), backend="npz")
+    eng = Engine(_cfg(1), TRAIN, None)
+    assert ck.restore_latest(eng) == 0
+
+
+def test_regime_mismatch_raises(tmp_path, n_devices):
+    ck = Checkpointer(str(tmp_path / "g"), every=1, backend="npz")
+    eng = Engine(_cfg(1), TRAIN, None)
+    eng.run(log=lambda *_: None, checkpointer=ck)
+
+    cfg = _cfg(1)
+    cfg.regime = "replication"
+    other = Engine(cfg, TRAIN, None)
+    with pytest.raises(ValueError, match="regime"):
+        ck.restore_latest(other)

@@ -101,3 +101,37 @@ def test_dp_fault_flags(tmp_path):
         "5",
     )
     assert summary["final_val_acc"] is not None  # survived heavy failures
+
+
+def test_dp_checkpoint_resume_and_profile(tmp_path):
+    ckdir = tmp_path / "ckpt"
+    profdir = tmp_path / "prof"
+    # interrupted run: 2 of 4 epochs, checkpointing each epoch + profiling
+    _run_script(
+        tmp_path,
+        "data_parallelism_train.py",
+        "--nb-proc",
+        "4",
+        "--checkpoint-dir",
+        str(ckdir),
+        "--profile-dir",
+        str(profdir),
+    )
+    assert any(ckdir.rglob("*")), "no checkpoint written"
+    assert any(profdir.rglob("*.pb")) or any(profdir.rglob("*trace*")), (
+        "no profiler trace under " + str(profdir)
+    )
+    # resumed run to 4 epochs picks up at epoch 2
+    summary, stdout, _ = _run_script(
+        tmp_path,
+        "data_parallelism_train.py",
+        "--nb-proc",
+        "4",
+        "--checkpoint-dir",
+        str(ckdir),
+        "--resume",
+        "--epochs",
+        "4",
+    )
+    assert "(Resumed from checkpoint: next epoch 2)" in stdout
+    assert summary["epochs"] == 4
