@@ -34,6 +34,12 @@ def main() -> None:
     p.add_argument("--compute-dtype", default="float32")
     p.add_argument("--data", default="auto")
     p.add_argument("--synthetic-size", type=int, default=None)
+    p.add_argument(
+        "--no-fused",
+        dest="fused",
+        action="store_false",
+        help="per-epoch dispatch instead of one fused multi-epoch span",
+    )
     args = p.parse_args()
 
     from distributed_neural_network_tpu.train.cli import honor_platform_env
@@ -65,17 +71,31 @@ def main() -> None:
     )
     timers = T.PhaseTimers()
     engine = Engine(cfg, train_split, test_split)
-    # warm-up epoch outside the timed region: XLA compilation is a one-time
-    # cost (cached for the remaining epochs), not a training-throughput cost;
+    # warm-up outside the timed region: XLA compilation is a one-time cost
+    # (cached for the measured run), not a training-throughput cost;
     # reset_state() then rewinds params so the measured run trains exactly
     # cfg.epochs epochs from the same init
-    engine.run_epoch(0, timers=T.PhaseTimers())
-    engine.reset_state()
-    for epoch in range(cfg.epochs):
-        engine.run_epoch(epoch, timers=timers)
+    if args.fused:
+        # fused fast path: the whole run is ONE dispatch (train + sync for
+        # all epochs); eval once at the end, outside the timed train region -
+        # mirroring the reference metric, whose 1642 s is child training time
+        # with eval accounted separately on the parent. compile_span AOT-warms
+        # without a throwaway training run.
+        engine.compile_span(cfg.epochs, eval_inside=False)
+        engine.run_span(0, cfg.epochs, eval_inside=False, timers=timers)
+        vl, va = engine._eval_fn(
+            engine.params, engine.test_images, engine.test_labels, engine.test_weights
+        )
+        final = engine.history[-1]
+        final.val_loss, final.val_acc = float(vl), float(va)
+    else:
+        engine.run_epoch(0, timers=T.PhaseTimers())
+        engine.reset_state()
+        for epoch in range(cfg.epochs):
+            engine.run_epoch(epoch, timers=timers)
+        final = engine.history[-1]
 
     train_s = timers.get(T.TRAINING) + timers.get(T.COMMUNICATION)
-    final = engine.history[-1]
     print(
         json.dumps(
             {

@@ -74,6 +74,14 @@ def add_common_flags(p: argparse.ArgumentParser, *, epochs: int, batch_size: int
         help="resume from the latest checkpoint in --checkpoint-dir",
     )
     p.add_argument(
+        "--fused",
+        action="store_true",
+        help="run multi-epoch compiled spans (one dispatch per span) instead "
+        "of one dispatch per phase per epoch - the fast path; phase timing "
+        "then reports train+sync(+eval at --eval-every 1) as one TRAINING "
+        "number",
+    )
+    p.add_argument(
         "--profile-dir",
         default=None,
         help="capture a jax.profiler trace of the training run into this dir "
@@ -193,6 +201,8 @@ def run_training(args, regime: str, *, log=print) -> Engine:
 
     checkpointer = None
     start_epoch = 0
+    if getattr(args, "resume", False) and not getattr(args, "checkpoint_dir", None):
+        raise SystemExit("--resume requires --checkpoint-dir")
     if getattr(args, "checkpoint_dir", None):
         from ..utils.checkpoint import Checkpointer
 
@@ -227,12 +237,19 @@ def run_training(args, regime: str, *, log=print) -> Engine:
             eval_every=args.eval_every,
             checkpointer=checkpointer,
             start_epoch=start_epoch,
+            fused=getattr(args, "fused", False),
         )
     finally:
         if profile_dir:
             import jax
 
-            jax.block_until_ready(engine.params)
+            try:
+                # a failed fused dispatch may have consumed (donated) params;
+                # never let the fence mask the original exception or skip
+                # stop_trace/close below
+                jax.block_until_ready(engine.params)
+            except Exception:
+                pass
             jax.profiler.stop_trace()
             log(f"(Profiler trace written to {profile_dir})")
         if checkpointer is not None:

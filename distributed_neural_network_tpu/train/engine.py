@@ -232,6 +232,9 @@ class Engine:
         )
         data_spec = self._train_data_spec
         seed = c.seed
+        self._local_epoch = local_epoch
+        self._span_cache = {}
+        self._span_compiled = {}
 
         def train_shard(params, mom, images, labels, epoch):
             # Mark params (and replicated data feeds) as device-varying before
@@ -301,6 +304,7 @@ class Engine:
             local_eval = make_eval_epoch(
                 apply_fn, n_rows=self.local_test_rows, batch_size=eval_bs
             )
+            self._local_eval = local_eval
 
             def eval_shard(params, images, labels, row_w):
                 loss_sum, n_batches, correct, n_valid = local_eval(
@@ -326,6 +330,191 @@ class Engine:
             )
         else:
             self._eval_fn = None
+            self._local_eval = None
+
+    # ---------------------------------------------------------- fused spans
+
+    def _get_span_fn(self, span: int, eval_inside: bool):
+        """Compiled multi-epoch span: `span` full epochs (train + fault-masked
+        sync + optional eval) as ONE `lax.scan` inside ONE `shard_map`
+        dispatch.
+
+        The per-epoch path (`run_epoch`) costs three host dispatches per
+        epoch, which dominates wall-clock for a 62K-param model; a fused span
+        is a single XLA program for the whole run, with per-epoch metrics
+        returned as stacked arrays. Semantics are identical to the unfused
+        path: same per-(seed, epoch, device) shuffle keys, same fault masks
+        (precomputed host-side and passed in as a (span, n) array), same
+        masked-pmean sync each epoch edge.
+        """
+        key = (span, eval_inside)
+        if key in self._span_cache:
+            return self._span_cache[key]
+        c, mesh = self.config, self.mesh
+        local_epoch = self._local_epoch
+        local_eval = self._local_eval if eval_inside else None
+        if eval_inside and local_eval is None:
+            raise ValueError("eval_inside=True but engine has no test split")
+        data_spec = self._train_data_spec
+        seed = c.seed
+
+        def span_shard(params, mom, images, labels, masks, epoch0, *eval_args):
+            # pvary rationale: see train_shard above
+            params = pvary_tree(params, DATA_AXIS)
+            images = pvary_tree(images, DATA_AXIS)
+            labels = pvary_tree(labels, DATA_AXIS)
+            mom_local = jax.tree.map(lambda m: m[0], mom)
+            my = jax.lax.axis_index(DATA_AXIS)
+            epochs = epoch0 + jnp.arange(span, dtype=jnp.uint32)
+
+            def body(carry, xs):
+                params, mom = carry
+                epoch, w = xs
+                k = jax.random.fold_in(
+                    jax.random.fold_in(jax.random.key(seed), epoch), my
+                )
+                p2, mom, loss_sum, n_batches = local_epoch(
+                    params, mom, images, labels, k
+                )
+                avg = masked_pmean_tree(p2, w, DATA_AXIS)
+                n_live = jax.lax.psum(w, DATA_AXIS)
+                w_eff = jnp.where(n_live > 0, w, 1.0)
+                train_loss = weighted_mean_scalar(
+                    loss_sum * w_eff, n_batches * w_eff, DATA_AXIS
+                )
+                if local_eval is not None:
+                    ls, nb, corr, nv = local_eval(avg, *eval_args)
+                    ls = jax.lax.psum(ls, DATA_AXIS)
+                    nb = jax.lax.psum(nb, DATA_AXIS)
+                    corr = jax.lax.psum(corr, DATA_AXIS)
+                    nv = jax.lax.psum(nv, DATA_AXIS)
+                    val_loss = ls / jnp.maximum(nb, 1.0)
+                    val_acc = 100.0 * corr / jnp.maximum(nv, 1.0)
+                    outs = (train_loss, val_loss, val_acc, n_live)
+                else:
+                    outs = (train_loss, n_live)
+                # re-vary the synced params so the scan carry type is stable
+                return (pvary_tree(avg, DATA_AXIS), mom), outs
+
+            (params, mom), outs = jax.lax.scan(
+                body, (params, mom_local), (epochs, masks[:, 0])
+            )
+            # params are identical across devices after the final sync; this
+            # pmean is a value-preserving cast back to replicated/invariant
+            # so the output can carry spec P()
+            params = jax.tree.map(lambda x: jax.lax.pmean(x, DATA_AXIS), params)
+            mom = jax.tree.map(lambda x: x[None], mom)
+            return (params, mom, *outs)
+
+        n_out = 4 if eval_inside else 2
+        in_specs = (P(), P(DATA_AXIS), data_spec, data_spec, P(None, DATA_AXIS), P())
+        if eval_inside:
+            in_specs = in_specs + (P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS))
+        fn = jax.jit(
+            jax.shard_map(
+                span_shard,
+                mesh=mesh,
+                in_specs=in_specs,
+                out_specs=(P(), P(DATA_AXIS)) + (P(),) * n_out,
+            ),
+            donate_argnums=(0, 1),
+        )
+        self._span_cache[key] = fn
+        return fn
+
+    def _masks_sharding(self):
+        return NamedSharding(self.mesh, P(None, DATA_AXIS))
+
+    def _span_args(self, epoch0: int, masks_dev, eval_inside: bool):
+        eval_args = (
+            (self.test_images, self.test_labels, self.test_weights)
+            if eval_inside
+            else ()
+        )
+        return (
+            self.params,
+            self.mom,
+            self.train_images,
+            self.train_labels,
+            masks_dev,
+            jnp.uint32(epoch0),
+            *eval_args,
+        )
+
+    def compile_span(self, span: int, *, eval_inside: bool = True) -> None:
+        """AOT-compile the fused span executable without executing it.
+
+        `jit.lower().compile()` does not populate jit's dispatch cache, so the
+        compiled executable is stored and used directly by `run_span` -
+        benchmarks warm compilation this way instead of paying a full
+        throwaway training run."""
+        eval_inside = eval_inside and self._local_eval is not None
+        key = (span, eval_inside)
+        if key in self._span_compiled:
+            return
+        fn = self._get_span_fn(span, eval_inside)
+        masks = jax.device_put(
+            np.ones((span, self.n_workers), np.float32), self._masks_sharding()
+        )
+        self._span_compiled[key] = fn.lower(
+            *self._span_args(0, masks, eval_inside)
+        ).compile()
+
+    def run_span(
+        self,
+        epoch0: int,
+        span: int,
+        *,
+        eval_inside: bool = True,
+        timers: T.PhaseTimers | None = None,
+    ) -> list[EpochMetrics]:
+        """Run `span` epochs starting at `epoch0` in one fused dispatch.
+
+        Per-epoch metrics come back as stacked arrays and are appended to
+        `history`. Fault masks are applied exactly as in `run_epoch`;
+        `failure_duration` straggler sleeps do not apply inside a fused span
+        (callers that need them use the per-epoch path). Timing is charged to
+        TRAINING (with eval folded in when `eval_inside`; the split phases of
+        the unfused path are the observability-parity mode).
+        """
+        c = self.config
+        timers = timers if timers is not None else T.PhaseTimers()
+        eval_inside = eval_inside and self._local_eval is not None
+        masks = np.stack(
+            [
+                np.asarray(
+                    live_mask(
+                        epoch_key(c.seed, e), self.n_workers, c.failure_probability
+                    )
+                )
+                for e in range(epoch0, epoch0 + span)
+            ]
+        )
+        fn = self._span_compiled.get((span, eval_inside)) or self._get_span_fn(
+            span, eval_inside
+        )
+        masks_dev = jax.device_put(masks, self._masks_sharding())
+        with timers.phase(T.TRAINING) as t:
+            out = fn(*self._span_args(epoch0, masks_dev, eval_inside))
+            self.params, self.mom = out[0], out[1]
+            t.value = out
+        if eval_inside:
+            tl, vl, va, nl = (np.asarray(x) for x in out[2:])
+        else:
+            tl, nl = (np.asarray(x) for x in out[2:])
+            vl = va = None
+        metrics = [
+            EpochMetrics(
+                epoch=epoch0 + i,
+                train_loss=float(tl[i]),
+                val_loss=float(vl[i]) if vl is not None else None,
+                val_acc=float(va[i]) if va is not None else None,
+                n_live=int(nl[i]),
+            )
+            for i in range(span)
+        ]
+        self.history.extend(metrics)
+        return metrics
 
     # ----------------------------------------------------------------- run
 
@@ -387,10 +576,31 @@ class Engine:
         eval_every: int = 1,
         checkpointer=None,
         start_epoch: int = 0,
+        fused: bool = False,
     ) -> list[EpochMetrics]:
         """Full training run; `run` is a MetricsRun-like sink (utils.metrics);
         `checkpointer` a utils.checkpoint.Checkpointer saving at epoch edges;
-        `start_epoch` > 0 resumes mid-run (state already restored)."""
+        `start_epoch` > 0 resumes mid-run (state already restored);
+        `fused=True` runs multi-epoch compiled spans (one dispatch per span,
+        split only at checkpoint/eval boundaries) instead of one dispatch per
+        phase per epoch - the fast path. Straggler sleeps (`failure_duration`)
+        force the per-epoch path, which is the only mode where they can
+        interleave with epochs."""
+        if fused and self.config.failure_duration > 0:
+            log(
+                "(fused mode does not support --failure-duration straggler "
+                "sleeps; using the per-epoch path)"
+            )
+            fused = False
+        if fused:
+            return self._run_fused(
+                timers=timers,
+                run=run,
+                log=log,
+                eval_every=eval_every,
+                checkpointer=checkpointer,
+                start_epoch=start_epoch,
+            )
         for epoch in range(start_epoch, self.config.epochs):
             log(f"Starting epoch  {epoch}")
             do_eval = eval_every > 0 and (epoch + 1) % eval_every == 0
@@ -406,4 +616,58 @@ class Engine:
                     run.append("val/acc", m.val_acc)
             if checkpointer is not None:
                 checkpointer.maybe_save(epoch, self)
+        return self.history
+
+    def _run_fused(
+        self,
+        *,
+        timers,
+        run,
+        log,
+        eval_every: int,
+        checkpointer,
+        start_epoch: int,
+    ) -> list[EpochMetrics]:
+        epochs = self.config.epochs
+        eval_in = eval_every == 1 and self._local_eval is not None
+        e = start_epoch
+        while e < epochs:
+            span = epochs - e
+            if checkpointer is not None and checkpointer.every > 0:
+                span = min(span, checkpointer.every - (e % checkpointer.every))
+            if eval_every > 1 and self._eval_fn is not None:
+                span = min(span, eval_every - (e % eval_every))
+            metrics = self.run_span(e, span, eval_inside=eval_in, timers=timers)
+            e += span
+            last = metrics[-1]
+            if (
+                not eval_in
+                and self._eval_fn is not None
+                and eval_every > 0
+                and e % eval_every == 0
+            ):
+                t = timers if timers is not None else T.PhaseTimers()
+                with t.phase(T.EVALUATION) as ph:
+                    vl, va = self._eval_fn(
+                        self.params,
+                        self.test_images,
+                        self.test_labels,
+                        self.test_weights,
+                    )
+                    ph.value = (vl, va)
+                last.val_loss = float(vl)
+                last.val_acc = float(va)
+            for m in metrics:
+                log(f"Starting epoch  {m.epoch}")
+                log(f"Global Average Training Loss: {m.train_loss}")
+                if run is not None:
+                    run.append("train/loss", m.train_loss)
+                if m.val_acc is not None:
+                    log(f"Validation loss of updated master model:  {m.val_loss}")
+                    log(f"Validation Accuracy: {m.val_acc:.2f} %")
+                    if run is not None:
+                        run.append("val/loss", m.val_loss)
+                        run.append("val/acc", m.val_acc)
+            if checkpointer is not None:
+                checkpointer.maybe_save(e - 1, self)
         return self.history

@@ -68,7 +68,7 @@ class _OrbaxBackend:
     def latest_step(self):
         return self._mgr.latest_step()
 
-    def restore(self, step: int):
+    def restore(self, step: int, template=None):
         out = self._mgr.restore(
             step,
             args=ocp.args.Composite(
@@ -129,13 +129,18 @@ class _NpzBackend:
         steps = self.all_steps()
         return steps[-1] if steps else None
 
-    def restore(self, step: int):
+    def restore(self, step: int, template=None):
         d = self._step_dir(step)
         with np.load(os.path.join(d, "state.npz")) as z:
             leaves = [z[f"leaf_{i}"] for i in range(len(z.files))]
         with open(os.path.join(d, "meta.json")) as f:
             meta = json.load(f)
-        return leaves, meta
+        state = (
+            jax.tree.unflatten(jax.tree.structure(template), leaves)
+            if template is not None
+            else leaves
+        )
+        return state, meta
 
     def close(self) -> None:
         pass
@@ -183,8 +188,6 @@ class Checkpointer:
             "regime": engine.config.regime,
             "history": [dataclasses.asdict(m) for m in engine.history],
         }
-        if self.backend_name == "npz":
-            state = jax.tree.leaves(state)  # npz stores the flat leaves
         self._b.save(epoch, state, meta)
 
     # --------------------------------------------------------------- restore
@@ -198,7 +201,7 @@ class Checkpointer:
         step = self._b.latest_step()
         if step is None:
             return 0
-        state, meta = self._b.restore(step)
+        state, meta = self._b.restore(step, engine.state_tree())
         if meta["n_workers"] != engine.n_workers:
             raise ValueError(
                 f"checkpoint was written with n_workers={meta['n_workers']}, "
@@ -210,9 +213,6 @@ class Checkpointer:
                 f"run, engine is {engine.config.regime!r} - resuming would "
                 "silently change the data-placement policy mid-trajectory"
             )
-        template = engine.state_tree()
-        if self.backend_name == "npz":
-            state = jax.tree.unflatten(jax.tree.structure(template), state)
         engine.load_state_tree(state)
         from ..train.engine import EpochMetrics
 

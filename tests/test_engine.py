@@ -187,6 +187,49 @@ def test_momentum_reset_vs_persistent(n_devices):
     assert hr[-1].train_loss != hp[-1].train_loss
 
 
+def test_fused_span_matches_per_epoch_path(n_devices):
+    """run_span (one compiled multi-epoch dispatch) must reproduce the
+    per-epoch path exactly: same losses, same eval, same fault masks, and
+    numerically-identical final parameters."""
+    cfg = _cfg(
+        regime="data_parallel", nb_proc=8, epochs=3, failure_probability=0.3, seed=5
+    )
+    e1 = Engine(cfg, TRAIN, TEST)
+    for ep in range(3):
+        e1.run_epoch(ep)
+    e2 = Engine(cfg, TRAIN, TEST)
+    e2.run_span(0, 3, eval_inside=True)
+    for m1, m2 in zip(e1.history, e2.history):
+        assert m1.train_loss == pytest.approx(m2.train_loss, rel=1e-5)
+        assert m1.val_loss == pytest.approx(m2.val_loss, rel=1e-5)
+        assert m1.val_acc == pytest.approx(m2.val_acc, abs=1e-3)
+        assert m1.n_live == m2.n_live
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+        ),
+        e1.params,
+        e2.params,
+    )
+
+
+def test_fused_run_chunks_at_eval_boundaries(n_devices):
+    """run(fused=True) with eval_every=2: spans split so eval lands exactly
+    on the reference's eval cadence; history covers every epoch."""
+    eng = Engine(_cfg(regime="data_parallel", nb_proc=8, epochs=4), TRAIN, TEST)
+    hist = eng.run(log=lambda *_: None, fused=True, eval_every=2)
+    assert [m.epoch for m in hist] == [0, 1, 2, 3]
+    assert [m.val_acc is not None for m in hist] == [False, True, False, True]
+
+
+def test_fused_span_without_eval(n_devices):
+    eng = Engine(_cfg(regime="single", epochs=2), TRAIN, TEST)
+    metrics = eng.run_span(0, 2, eval_inside=False)
+    assert len(metrics) == 2
+    assert all(m.val_acc is None for m in metrics)
+    assert all(np.isfinite(m.train_loss) for m in metrics)
+
+
 def test_reset_state_reproduces_run(n_devices):
     """Warm-up + reset_state (bench.py pattern) must not change the measured
     training trajectory."""
