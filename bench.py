@@ -32,6 +32,7 @@ def main() -> None:
     p.add_argument("--nb-proc", type=int, default=None, help="default: all devices")
     p.add_argument("--sync-mode", choices=("epoch", "step"), default="epoch")
     p.add_argument("--compute-dtype", default="float32")
+    p.add_argument("--kernels", choices=("xla", "pallas"), default="xla")
     p.add_argument("--data", default="auto")
     p.add_argument("--synthetic-size", type=int, default=None)
     p.add_argument(
@@ -68,6 +69,7 @@ def main() -> None:
         regime="data_parallel",
         sync_mode=args.sync_mode,
         compute_dtype=args.compute_dtype,
+        kernels=args.kernels,
     )
     timers = T.PhaseTimers()
     engine = Engine(cfg, train_split, test_split)

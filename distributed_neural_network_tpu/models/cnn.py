@@ -49,6 +49,26 @@ def torch_uniform_bias(fan_in: int):
     return init
 
 
+class _DenseParams(nn.Module):
+    """Declares a Dense layer's kernel/bias with nn.Dense's exact param tree
+    (kernel (in, out), bias (out,)) without computing the layer - the fused
+    Pallas head (ops/pallas_kernels.py) consumes the raw arrays, and
+    checkpoints/state trees stay interchangeable between head impls."""
+
+    features: int
+    fan_in: int
+
+    @nn.compact
+    def __call__(self):
+        kernel = self.param(
+            "kernel", torch_uniform_kernel, (self.fan_in, self.features)
+        )
+        bias = self.param(
+            "bias", torch_uniform_bias(self.fan_in), (self.features,)
+        )
+        return kernel, bias
+
+
 class Network(nn.Module):
     """The reference's 62K-param CIFAR-10 classifier, re-expressed for TPU.
 
@@ -57,10 +77,17 @@ class Network(nn.Module):
 
     `compute_dtype` lets the matmul/conv path run in bfloat16 on the MXU while
     params stay float32 (mixed precision); default float32 for strict parity.
+
+    `use_pallas_head=True` runs fc1..fc3 as ONE fused Pallas kernel (weights
+    VMEM-resident, h1/h2 intermediates never touch HBM; see
+    ops/pallas_kernels.py). The param tree is identical either way, so
+    checkpoints and sync collectives are oblivious to the choice. The fused
+    head computes in float32 regardless of compute_dtype.
     """
 
     num_classes: int = 10
     compute_dtype: jnp.dtype = jnp.float32
+    use_pallas_head: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -88,6 +115,13 @@ class Network(nn.Module):
         x = nn.relu(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = x.reshape((x.shape[0], -1))  # (N, 5*5*16=400), H,W,C order
+        if self.use_pallas_head:
+            from ..ops.pallas_kernels import fused_mlp3
+
+            w1, b1 = _DenseParams(120, 400, name="fc1")()
+            w2, b2 = _DenseParams(84, 120, name="fc2")()
+            w3, b3 = _DenseParams(self.num_classes, 84, name="fc3")()
+            return fused_mlp3(x, w1, b1, w2, b2, w3, b3)
         x = nn.Dense(
             120,
             kernel_init=torch_uniform_kernel,

@@ -1,0 +1,213 @@
+"""Decoder-only transformer LM with composable DP x SP x TP shardings.
+
+The reference framework has exactly one model family - the LeNet CNN
+(`/root/reference/models/model.py:9-27`) - and scales only the batch axis.
+This module is the framework's second model family and its long-context /
+multi-axis-parallel showcase: a GPT-style causal LM whose forward pass runs
+unchanged on a single device or inside `jax.shard_map` over any combination
+of
+
+- a **data** axis (batch-sharded tokens),
+- a **seq** axis (sequence/context parallelism: activations sharded along
+  the sequence, attention via `parallel/ring.py`'s ring or Ulysses
+  primitives, positions computed from the global offset),
+- a **model** axis (Megatron-style tensor parallelism: attention heads and
+  the MLP hidden dim column-sharded, row-sharded second projections
+  followed by a single psum per block).
+
+Design choices, TPU-first:
+- Pure-JAX parameter pytree (no Module class): inside shard_map every leaf
+  is the *local* shard, and the same `apply` code path serves all layouts -
+  the sharding lives entirely in `param_specs()` + the mesh, XLA inserts
+  the collectives.
+- Matmul-heavy, static shapes, `lax` control-flow free: everything tiles
+  onto the MXU; bf16-friendly (`cfg.dtype`).
+- Sinusoidal positions computed on the fly from global offsets, so sequence
+  shards need no position table and arbitrary context lengths cost nothing.
+- Grad synchronization falls out of shard_map's autodiff typing: replicated
+  (invariant) params get their gradient psum over data/seq automatically;
+  tensor-sharded params keep local gradients. No hand-written allreduce.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.ring import attention, ring_attention, ulysses_attention
+
+ATTN_IMPLS = ("full", "ring", "ulysses")
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 256
+    d_model: int = 128
+    n_heads: int = 8
+    n_layers: int = 2
+    d_ff: int = 512
+    dtype: jnp.dtype = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+def init_params(key: jax.Array, cfg: TransformerConfig):
+    """Replicated-layout parameter pytree (shard with `param_specs`)."""
+    d, f, v = cfg.d_model, cfg.d_ff, cfg.vocab_size
+    k_embed, k_layers, k_out = jax.random.split(key, 3)
+    scale = 1.0 / np.sqrt(d)
+
+    def dense(k, shape, s):
+        return (jax.random.normal(k, shape, jnp.float32) * s).astype(jnp.float32)
+
+    layers = []
+    for lk in jax.random.split(k_layers, cfg.n_layers):
+        ks = jax.random.split(lk, 6)
+        layers.append(
+            {
+                "ln1_scale": jnp.ones((d,), jnp.float32),
+                "ln1_bias": jnp.zeros((d,), jnp.float32),
+                "wq": dense(ks[0], (d, d), scale),
+                "wk": dense(ks[1], (d, d), scale),
+                "wv": dense(ks[2], (d, d), scale),
+                "wo": dense(ks[3], (d, d), scale / np.sqrt(2 * cfg.n_layers)),
+                "ln2_scale": jnp.ones((d,), jnp.float32),
+                "ln2_bias": jnp.zeros((d,), jnp.float32),
+                "w1": dense(ks[4], (d, f), scale),
+                "b1": jnp.zeros((f,), jnp.float32),
+                "w2": dense(ks[5], (f, d), 1.0 / np.sqrt(f) / np.sqrt(2 * cfg.n_layers)),
+                "b2": jnp.zeros((d,), jnp.float32),
+            }
+        )
+    return {
+        "embed": dense(k_embed, (v, d), 1.0),
+        "lnf_scale": jnp.ones((d,), jnp.float32),
+        "lnf_bias": jnp.zeros((d,), jnp.float32),
+        "head": dense(k_out, (d, v), scale),
+        "layers": _stack_layers(layers),
+    }
+
+
+def _stack_layers(layers):
+    """Stack per-layer dicts on a leading layer axis (scanned in apply)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
+
+
+def param_specs(cfg: TransformerConfig, tp_axis: str | None = None):
+    """PartitionSpec pytree for the param tree.
+
+    With `tp_axis`: wq/wk/wv and w1 column-sharded (heads / ff-hidden split),
+    wo and w2 row-sharded (psum after), b1 sharded with its columns;
+    everything else replicated. Without: fully replicated.
+    """
+    t = tp_axis
+    layer = {
+        "ln1_scale": P(),
+        "ln1_bias": P(),
+        "wq": P(None, None, t),
+        "wk": P(None, None, t),
+        "wv": P(None, None, t),
+        "wo": P(None, t, None),
+        "ln2_scale": P(),
+        "ln2_bias": P(),
+        "w1": P(None, None, t),
+        "b1": P(None, t),
+        "w2": P(None, t, None),
+        "b2": P(),
+    }
+    return {
+        "embed": P(),
+        "lnf_scale": P(),
+        "lnf_bias": P(),
+        "head": P(),
+        "layers": layer,
+    }
+
+
+def _layer_norm(x, scale, bias, eps=1e-5):
+    m = x.mean(-1, keepdims=True)
+    v = ((x - m) ** 2).mean(-1, keepdims=True)
+    return (x - m) * jax.lax.rsqrt(v + eps) * scale + bias
+
+
+def _positions(s_local: int, seq_axis: str | None):
+    if seq_axis is None:
+        return jnp.arange(s_local)
+    return jax.lax.axis_index(seq_axis) * s_local + jnp.arange(s_local)
+
+
+def _sinusoid_pe(pos, d_model, dtype):
+    half = d_model // 2
+    freqs = jnp.exp(-np.log(10000.0) * jnp.arange(half) / half)
+    ang = pos[:, None].astype(jnp.float32) * freqs[None, :]
+    return jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=-1).astype(dtype)
+
+
+def _attend(q, k, v, *, impl, seq_axis, s_local):
+    if seq_axis is None:
+        return attention(q, k, v, causal=True)
+    if impl == "ring":
+        return ring_attention(q, k, v, seq_axis, causal=True)
+    if impl == "ulysses":
+        return ulysses_attention(q, k, v, seq_axis, causal=True)
+    raise ValueError(
+        f"with a sequence axis, attn impl must be 'ring' or 'ulysses', got {impl!r}"
+    )
+
+
+def apply(
+    params,
+    tokens,
+    cfg: TransformerConfig,
+    *,
+    seq_axis: str | None = None,
+    tp_axis: str | None = None,
+    attn_impl: str = "ring",
+):
+    """tokens (B, S_local) int32 -> logits (B, S_local, vocab) float32.
+
+    Call directly for single-device, or inside shard_map with tokens sharded
+    (data/seq axes) and params placed per `param_specs`. With tp_axis, each
+    device holds H/tp heads and d_ff/tp hidden columns; one psum per
+    attention-out and MLP-out projection restores the full residual.
+    """
+    dt = cfg.dtype
+    b, s_local = tokens.shape
+    x = params["embed"][tokens].astype(dt)
+    x = x + _sinusoid_pe(_positions(s_local, seq_axis), cfg.d_model, dt)[None]
+
+    # local head count is inferred from the (possibly tp-sharded) wq leaf
+    def block(x, lp):
+        d_local_heads = lp["wq"].shape[-1] // cfg.head_dim
+        h = _layer_norm(x, lp["ln1_scale"], lp["ln1_bias"]).astype(dt)
+        q = (h @ lp["wq"].astype(dt)).reshape(b, s_local, d_local_heads, cfg.head_dim)
+        k = (h @ lp["wk"].astype(dt)).reshape(b, s_local, d_local_heads, cfg.head_dim)
+        v = (h @ lp["wv"].astype(dt)).reshape(b, s_local, d_local_heads, cfg.head_dim)
+        o = _attend(q, k, v, impl=attn_impl, seq_axis=seq_axis, s_local=s_local)
+        o = o.reshape(b, s_local, -1) @ lp["wo"].astype(dt)
+        if tp_axis is not None:
+            o = jax.lax.psum(o, tp_axis)
+        x = x + o
+
+        h = _layer_norm(x, lp["ln2_scale"], lp["ln2_bias"]).astype(dt)
+        h = jax.nn.gelu(h @ lp["w1"].astype(dt) + lp["b1"].astype(dt))
+        h = h @ lp["w2"].astype(dt)
+        if tp_axis is not None:
+            h = jax.lax.psum(h, tp_axis)
+        x = x + h + lp["b2"].astype(dt)
+        return x, None
+
+    x, _ = jax.lax.scan(block, x, params["layers"])
+    x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"]).astype(dt)
+    return (x @ params["head"].astype(dt)).astype(jnp.float32)
+
+
+def param_count(params) -> int:
+    return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))

@@ -1,0 +1,139 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference has no attention and no sequence axis at all (SURVEY.md
+section 5.7: its model is a 5-layer CNN on 32x32 images; it scales only along
+the batch axis). These primitives are the framework's long-context
+capability, built mesh-first so sequences longer than one device's HBM split
+across a `'seq'` mesh axis and scale over ICI:
+
+- **ring_attention**: each device holds one sequence shard of Q/K/V. K/V
+  blocks rotate around the ring via `jax.lax.ppermute` (XLA lowers the
+  static ring permutation to ICI neighbor exchanges); each device
+  accumulates its queries' attention over every block with the numerically
+  stable blockwise-softmax recurrence (running max / denominator /
+  numerator, the flash-attention update rule), so the full S x S score
+  matrix never materializes anywhere and per-device memory stays
+  O(S_local^2) compute / O(S_local) state per step.
+- **ulysses_attention**: `jax.lax.all_to_all` re-shards from
+  sequence-sharded to head-sharded (each device then owns H/n full-length
+  heads), runs ordinary full attention locally, and re-shards back. One
+  collective round-trip instead of n ring steps - better when H >= n and
+  the all-to-all fits ICI; ring wins at extreme sequence lengths.
+
+Both are pure functions designed to run inside `jax.shard_map` over the
+sequence axis and are exact (up to float reassociation) w.r.t. single-device
+attention - verified in tests/test_ring.py against the gathered reference.
+Causality is handled with *global* positions (device offset + local index),
+so a causal mask is consistent across shards.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+SEQ_AXIS = "seq"
+
+_NEG_BIG = -1e30  # large-negative mask value; avoids -inf NaN propagation
+
+
+def attention(q, k, v, *, causal: bool = False, q_offset=0, k_offset=0, scale=None):
+    """Plain full (single-device) attention; the local/reference kernel.
+
+    q: (B, Sq, H, D), k/v: (B, Sk, H, D) -> (B, Sq, H, D). Offsets give the
+    global position of row 0 for causal masking across shards.
+    """
+    d = q.shape[-1]
+    scale = (1.0 / jnp.sqrt(d)) if scale is None else scale
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        qpos = q_offset + jnp.arange(q.shape[1])
+        kpos = k_offset + jnp.arange(k.shape[1])
+        mask = qpos[:, None] >= kpos[None, :]
+        s = jnp.where(mask[None, None], s, _NEG_BIG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def ring_attention(q, k, v, axis_name: str = SEQ_AXIS, *, causal: bool = False, scale=None):
+    """Exact attention over a sequence sharded across `axis_name`.
+
+    Call inside shard_map; q/k/v are the local (B, S_local, H, D) shards in
+    ring order (shard i holds global positions [i*S_local, (i+1)*S_local)).
+    Returns the local output shard. K/V blocks travel the ring n-1 hops;
+    communication overlaps the next block's compute under XLA's latency
+    hiding scheduler.
+    """
+    n = jax.lax.axis_size(axis_name)
+    me = jax.lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+    scale_ = (1.0 / jnp.sqrt(d)) if scale is None else scale
+    qpos = me * s_local + jnp.arange(s_local)
+
+    def block(qv, kv, vv, src):
+        """One blockwise score/value contribution: returns (s, pv) with
+        s: (B,H,Sq,Sk) masked scores, against kv/vv from ring slot `src`."""
+        sc = jnp.einsum("bqhd,bkhd->bhqk", qv, kv) * scale_
+        if causal:
+            kpos = src * s_local + jnp.arange(s_local)
+            mask = qpos[:, None] >= kpos[None, :]
+            sc = jnp.where(mask[None, None], sc, _NEG_BIG)
+        return sc
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(i, carry):
+        m, l, acc, k_blk, v_blk = carry
+        src = (me - i) % n
+        sc = block(q, k_blk, v_blk, src)
+        m_new = jnp.maximum(m, sc.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(sc - m_new[..., None])
+        l = l * alpha + p.sum(axis=-1)
+        acc = acc * alpha[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, v_blk)
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return m_new, l, acc, k_blk, v_blk
+
+    def vary(x):
+        # initial accumulators are constants (vma-invariant) but the loop
+        # carries values as device-varying as q itself (which may vary over
+        # more mesh axes than the ring axis, e.g. a batch axis); align the
+        # carry types up front
+        try:
+            want = jax.typeof(q).vma
+            missing = tuple(a for a in want if a not in jax.typeof(x).vma)
+        except AttributeError:  # vma-less jax version
+            return x
+        return jax.lax.pcast(x, missing, to="varying") if missing else x
+
+    m0 = vary(jnp.full((b, h, s_local), _NEG_BIG, q.dtype))
+    l0 = vary(jnp.zeros((b, h, s_local), q.dtype))
+    acc0 = vary(jnp.zeros((b, h, s_local, d), q.dtype))
+    m, l, acc, _, _ = jax.lax.fori_loop(0, n, body, (m0, l0, acc0, k, v))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.transpose(0, 2, 1, 3)  # (B, Sq, H, D)
+
+
+def ulysses_attention(q, k, v, axis_name: str = SEQ_AXIS, *, causal: bool = False, scale=None):
+    """Sequence->head all-to-all attention (DeepSpeed-Ulysses pattern).
+
+    Requires the head count H to be divisible by the axis size n. Each device
+    trades its sequence shard of all heads for the full sequence of H/n
+    heads, computes ordinary attention locally, and trades back.
+    """
+    n = jax.lax.axis_size(axis_name)
+    h = q.shape[2]
+    if h % n != 0:
+        raise ValueError(f"ulysses needs heads ({h}) divisible by axis size ({n})")
+    a2a = functools.partial(
+        jax.lax.all_to_all, axis_name=axis_name, split_axis=2, concat_axis=1, tiled=True
+    )
+    back = functools.partial(
+        jax.lax.all_to_all, axis_name=axis_name, split_axis=1, concat_axis=2, tiled=True
+    )
+    qf, kf, vf = a2a(q), a2a(k), a2a(v)  # (B, S_full, H/n, D)
+    out = attention(qf, kf, vf, causal=causal, scale=scale)
+    return back(out)

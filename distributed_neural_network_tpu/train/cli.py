@@ -57,6 +57,13 @@ def add_common_flags(p: argparse.ArgumentParser, *, epochs: int, batch_size: int
     p.add_argument(
         "--compute-dtype", choices=("float32", "bfloat16"), default="float32"
     )
+    p.add_argument(
+        "--kernels",
+        choices=("xla", "pallas"),
+        default="xla",
+        help="pallas = fused Pallas classifier-head kernel (VMEM-resident "
+        "weights; interpreter fallback off-TPU)",
+    )
     p.add_argument("--eval-every", type=int, default=1)
     p.add_argument(
         "--checkpoint-dir",
@@ -136,6 +143,7 @@ def config_from_args(args, regime: str) -> TrainConfig:
         seed=args.seed,
         eval_batch_size=args.eval_batch_size,
         compute_dtype=args.compute_dtype,
+        kernels=getattr(args, "kernels", "xla"),
         reference_compat=getattr(args, "reference_compat", False),
     )
 

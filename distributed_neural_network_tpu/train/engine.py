@@ -81,6 +81,7 @@ class TrainConfig:
     seed: int = 0
     eval_batch_size: int | None = None
     compute_dtype: str = "float32"  # "bfloat16" for MXU-native mixed precision
+    kernels: str = "xla"  # "pallas" = fused Pallas classifier head
     reference_compat: bool = False  # True: N-1 workers as in the reference
 
     def __post_init__(self):
@@ -90,6 +91,8 @@ class TrainConfig:
             raise ValueError(
                 f"sync_mode must be one of {SYNC_MODES}, got {self.sync_mode}"
             )
+        if self.kernels not in ("xla", "pallas"):
+            raise ValueError(f"kernels must be 'xla' or 'pallas', got {self.kernels}")
 
 
 @dataclass
@@ -129,7 +132,8 @@ class Engine:
         self.model = Network(
             compute_dtype=jnp.bfloat16
             if c.compute_dtype == "bfloat16"
-            else jnp.float32
+            else jnp.float32,
+            use_pallas_head=c.kernels == "pallas",
         )
         self._place_data(train_split, test_split)
         self._build_state()

@@ -1,0 +1,105 @@
+"""Fused Pallas classifier-head kernel (ops/pallas_kernels.py).
+
+On the CPU test mesh the kernels run through the Pallas interpreter
+(auto-detected), which executes the identical kernel code path that Mosaic
+compiles on TPU. Correctness bar: forward and every gradient match a plain
+jnp reference implementation to f32-accumulation tolerance, including batch
+sizes that are not a multiple of the kernel's batch tile (padding path).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_neural_network_tpu.ops.pallas_kernels import fused_mlp3
+
+
+def _make(B, seed=0):
+    rng = np.random.default_rng(seed)
+    f32 = lambda a: jnp.asarray(a, jnp.float32)
+    return (
+        f32(rng.normal(size=(B, 400))),
+        f32(rng.normal(size=(400, 120)) * 0.05),
+        f32(rng.normal(size=(120,))),
+        f32(rng.normal(size=(120, 84)) * 0.05),
+        f32(rng.normal(size=(84,))),
+        f32(rng.normal(size=(84, 10)) * 0.05),
+        f32(rng.normal(size=(10,))),
+    )
+
+
+def _ref(x, w1, b1, w2, b2, w3, b3):
+    h1 = jnp.maximum(x @ w1 + b1, 0)
+    h2 = jnp.maximum(h1 @ w2 + b2, 0)
+    return h2 @ w3 + b3
+
+
+@pytest.mark.parametrize("batch", [128, 200, 8, 1])
+def test_forward_matches_reference(n_devices, batch):
+    args = _make(batch)
+    np.testing.assert_allclose(
+        np.asarray(fused_mlp3(*args, interpret=True)),
+        np.asarray(_ref(*args)),
+        rtol=1e-5,
+        atol=1e-5,
+    )
+
+
+def test_gradients_match_reference(n_devices):
+    args = _make(200)  # not a tile multiple: padded rows must not leak grads
+
+    def lp(*a):
+        return (fused_mlp3(*a, interpret=True) ** 2).sum()
+
+    def lr(*a):
+        return (_ref(*a) ** 2).sum()
+
+    gp = jax.grad(lp, argnums=tuple(range(7)))(*args)
+    gr = jax.grad(lr, argnums=tuple(range(7)))(*args)
+    for p, r in zip(gp, gr):
+        scale = max(float(jnp.max(jnp.abs(r))), 1.0)
+        np.testing.assert_allclose(
+            np.asarray(p) / scale, np.asarray(r) / scale, rtol=1e-5, atol=1e-5
+        )
+
+
+def test_network_pallas_head_matches_xla_head(n_devices):
+    """Same params, same input: the two head implementations agree."""
+    from distributed_neural_network_tpu.models.cnn import Network
+
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(32, 32, 32, 3)), jnp.float32)
+    m_xla = Network()
+    m_pal = Network(use_pallas_head=True)
+    params = m_xla.init(jax.random.key(0), x[:1])["params"]
+    # identical param trees -> params are interchangeable
+    chex_tree = jax.tree.structure(params)
+    assert chex_tree == jax.tree.structure(m_pal.init(jax.random.key(0), x[:1])["params"])
+    out_x = m_xla.apply({"params": params}, x)
+    out_p = m_pal.apply({"params": params}, x)
+    np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_x), rtol=1e-5, atol=1e-5)
+
+
+def test_engine_trains_with_pallas_kernels(n_devices):
+    """Full sharded training epoch with the fused head on the 8-device mesh."""
+    from distributed_neural_network_tpu.data.cifar10 import (
+        Split,
+        make_synthetic,
+        normalize,
+    )
+    from distributed_neural_network_tpu.train.engine import Engine, TrainConfig
+
+    xt, yt = make_synthetic(256, seed=0, train=True)
+    xv, yv = make_synthetic(64, seed=0, train=False)
+    cfg = TrainConfig(
+        batch_size=8,
+        epochs=2,
+        nb_proc=8,
+        regime="data_parallel",
+        kernels="pallas",
+        lr=0.05,
+    )
+    eng = Engine(cfg, Split(normalize(xt), yt, "syn"), Split(normalize(xv), yv, "syn"))
+    hist = eng.run(log=lambda *_: None)
+    assert all(np.isfinite(m.train_loss) for m in hist)
+    assert hist[-1].train_loss < hist[0].train_loss
