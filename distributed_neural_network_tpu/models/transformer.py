@@ -13,7 +13,10 @@ of
   primitives, positions computed from the global offset),
 - a **model** axis (Megatron-style tensor parallelism: attention heads and
   the MLP hidden dim column-sharded, row-sharded second projections
-  followed by a single psum per block).
+  followed by a single psum per block),
+- an **expert** dimension (`cfg.n_experts > 0`): the dense FFN becomes a
+  mixture-of-experts (`parallel/moe.py`), experts sharded over the data
+  axis GShard-style with one all_to_all each way (`ep_axis`).
 
 Design choices, TPU-first:
 - Pure-JAX parameter pytree (no Module class): inside shard_map every leaf
@@ -38,6 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from ..parallel.moe import expert_capacity, moe_ffn
 from ..parallel.ring import attention, ring_attention, ulysses_attention
 
 ATTN_IMPLS = ("full", "ring", "ulysses")
@@ -51,6 +55,11 @@ class TransformerConfig:
     n_layers: int = 2
     d_ff: int = 512
     dtype: jnp.dtype = jnp.float32
+    # Mixture-of-experts FFN (0 = dense). Experts replace the MLP in every
+    # block; capacity_factor sizes the static per-expert slot count.
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 2.0
 
     @property
     def head_dim(self) -> int:
@@ -67,25 +76,41 @@ def init_params(key: jax.Array, cfg: TransformerConfig):
     def dense(k, shape, s):
         return (jax.random.normal(k, shape, jnp.float32) * s).astype(jnp.float32)
 
+    e = cfg.n_experts
     layers = []
     for lk in jax.random.split(k_layers, cfg.n_layers):
-        ks = jax.random.split(lk, 6)
-        layers.append(
-            {
-                "ln1_scale": jnp.ones((d,), jnp.float32),
-                "ln1_bias": jnp.zeros((d,), jnp.float32),
-                "wq": dense(ks[0], (d, d), scale),
-                "wk": dense(ks[1], (d, d), scale),
-                "wv": dense(ks[2], (d, d), scale),
-                "wo": dense(ks[3], (d, d), scale / np.sqrt(2 * cfg.n_layers)),
-                "ln2_scale": jnp.ones((d,), jnp.float32),
-                "ln2_bias": jnp.zeros((d,), jnp.float32),
-                "w1": dense(ks[4], (d, f), scale),
-                "b1": jnp.zeros((f,), jnp.float32),
-                "w2": dense(ks[5], (f, d), 1.0 / np.sqrt(f) / np.sqrt(2 * cfg.n_layers)),
-                "b2": jnp.zeros((d,), jnp.float32),
-            }
-        )
+        ks = jax.random.split(lk, 7)
+        layer = {
+            "ln1_scale": jnp.ones((d,), jnp.float32),
+            "ln1_bias": jnp.zeros((d,), jnp.float32),
+            "wq": dense(ks[0], (d, d), scale),
+            "wk": dense(ks[1], (d, d), scale),
+            "wv": dense(ks[2], (d, d), scale),
+            "wo": dense(ks[3], (d, d), scale / np.sqrt(2 * cfg.n_layers)),
+            "ln2_scale": jnp.ones((d,), jnp.float32),
+            "ln2_bias": jnp.zeros((d,), jnp.float32),
+        }
+        w2_scale = 1.0 / np.sqrt(f) / np.sqrt(2 * cfg.n_layers)
+        if e:
+            layer.update(
+                {
+                    "wr": dense(ks[6], (d, e), scale),
+                    "w1": dense(ks[4], (e, d, f), scale),
+                    "b1": jnp.zeros((e, f), jnp.float32),
+                    "w2": dense(ks[5], (e, f, d), w2_scale),
+                    "b2": jnp.zeros((e, d), jnp.float32),
+                }
+            )
+        else:
+            layer.update(
+                {
+                    "w1": dense(ks[4], (d, f), scale),
+                    "b1": jnp.zeros((f,), jnp.float32),
+                    "w2": dense(ks[5], (f, d), w2_scale),
+                    "b2": jnp.zeros((d,), jnp.float32),
+                }
+            )
+        layers.append(layer)
     return {
         "embed": dense(k_embed, (v, d), 1.0),
         "lnf_scale": jnp.ones((d,), jnp.float32),
@@ -100,12 +125,18 @@ def _stack_layers(layers):
     return jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
 
 
-def param_specs(cfg: TransformerConfig, tp_axis: str | None = None):
+def param_specs(
+    cfg: TransformerConfig,
+    tp_axis: str | None = None,
+    ep_axis: str | None = None,
+):
     """PartitionSpec pytree for the param tree.
 
     With `tp_axis`: wq/wk/wv and w1 column-sharded (heads / ff-hidden split),
     wo and w2 row-sharded (psum after), b1 sharded with its columns;
-    everything else replicated. Without: fully replicated.
+    everything else replicated. Without: fully replicated. With
+    `cfg.n_experts > 0` and `ep_axis`: expert tensors additionally sharded
+    over the expert dimension (router replicated).
     """
     t = tp_axis
     layer = {
@@ -117,11 +148,27 @@ def param_specs(cfg: TransformerConfig, tp_axis: str | None = None):
         "wo": P(None, t, None),
         "ln2_scale": P(),
         "ln2_bias": P(),
-        "w1": P(None, None, t),
-        "b1": P(None, t),
-        "w2": P(None, t, None),
-        "b2": P(),
     }
+    if cfg.n_experts:
+        ep = ep_axis
+        layer.update(
+            {
+                "wr": P(),
+                "w1": P(None, ep, None, t),
+                "b1": P(None, ep, t),
+                "w2": P(None, ep, t, None),
+                "b2": P(None, ep, None),
+            }
+        )
+    else:
+        layer.update(
+            {
+                "w1": P(None, None, t),
+                "b1": P(None, t),
+                "w2": P(None, t, None),
+                "b2": P(),
+            }
+        )
     return {
         "embed": P(),
         "lnf_scale": P(),
@@ -162,26 +209,34 @@ def _attend(q, k, v, *, impl, seq_axis, s_local):
     )
 
 
-def apply(
+def apply_with_aux(
     params,
     tokens,
     cfg: TransformerConfig,
     *,
     seq_axis: str | None = None,
     tp_axis: str | None = None,
+    ep_axis: str | None = None,
     attn_impl: str = "ring",
 ):
-    """tokens (B, S_local) int32 -> logits (B, S_local, vocab) float32.
+    """tokens (B, S_local) int32 -> (logits (B, S_local, vocab) f32, aux).
 
     Call directly for single-device, or inside shard_map with tokens sharded
     (data/seq axes) and params placed per `param_specs`. With tp_axis, each
     device holds H/tp heads and d_ff/tp hidden columns; one psum per
-    attention-out and MLP-out projection restores the full residual.
+    attention-out and MLP-out projection restores the full residual. With
+    cfg.n_experts, the MLP is a mixture-of-experts (experts sharded over
+    `ep_axis` when given) and `aux` is the mean Switch load-balancing loss
+    over layers (0.0 for dense).
     """
     dt = cfg.dtype
     b, s_local = tokens.shape
     x = params["embed"][tokens].astype(dt)
     x = x + _sinusoid_pe(_positions(s_local, seq_axis), cfg.d_model, dt)[None]
+    if cfg.n_experts:
+        cap = expert_capacity(
+            b * s_local, cfg.n_experts, cfg.moe_top_k, cfg.moe_capacity_factor
+        )
 
     # local head count is inferred from the (possibly tp-sharded) wq leaf
     def block(x, lp):
@@ -197,16 +252,38 @@ def apply(
         x = x + o
 
         h = _layer_norm(x, lp["ln2_scale"], lp["ln2_bias"]).astype(dt)
-        h = jax.nn.gelu(h @ lp["w1"].astype(dt) + lp["b1"].astype(dt))
-        h = h @ lp["w2"].astype(dt)
-        if tp_axis is not None:
-            h = jax.lax.psum(h, tp_axis)
-        x = x + h + lp["b2"].astype(dt)
-        return x, None
+        if cfg.n_experts:
+            y, aux = moe_ffn(
+                h.reshape(b * s_local, cfg.d_model),
+                lp["wr"],
+                lp["w1"],
+                lp["b1"],
+                lp["w2"],
+                lp["b2"],
+                top_k=cfg.moe_top_k,
+                capacity=cap,
+                ep_axis=ep_axis,
+                tp_axis=tp_axis,
+            )
+            x = x + y.reshape(b, s_local, cfg.d_model)
+        else:
+            h = jax.nn.gelu(h @ lp["w1"].astype(dt) + lp["b1"].astype(dt))
+            h = h @ lp["w2"].astype(dt)
+            if tp_axis is not None:
+                h = jax.lax.psum(h, tp_axis)
+            x = x + h + lp["b2"].astype(dt)
+            aux = jnp.float32(0.0)
+        return x, aux
 
-    x, _ = jax.lax.scan(block, x, params["layers"])
+    x, aux = jax.lax.scan(block, x, params["layers"])
     x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"]).astype(dt)
-    return (x @ params["head"].astype(dt)).astype(jnp.float32)
+    logits = (x @ params["head"].astype(dt)).astype(jnp.float32)
+    return logits, aux.mean()
+
+
+def apply(params, tokens, cfg: TransformerConfig, **kw):
+    """Logits-only wrapper over `apply_with_aux` (same signature)."""
+    return apply_with_aux(params, tokens, cfg, **kw)[0]
 
 
 def param_count(params) -> int:

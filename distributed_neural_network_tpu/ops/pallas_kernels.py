@@ -25,8 +25,11 @@ Design notes (per the Pallas TPU guide):
   - TPU grid execution is sequential, so this is well-defined.
 - All matmuls request `preferred_element_type=float32` so the MXU accumulates
   in f32 regardless of input dtype.
-- `interpret=True` (auto-detected off-TPU) runs the same kernels through the
-  Pallas interpreter, so the CPU test mesh exercises identical code paths.
+- Off-TPU execution: `interpret=True` runs the kernel code through the Pallas
+  interpreter and is how the kernel unit tests exercise it on CPU - but the
+  interpreter is not shard_map-compatible (vma typing), so *inside the
+  sharded engine* the off-TPU path is the plain-jnp `mlp3_reference` math,
+  not the kernel. Mosaic-compiled behavior is only truly covered on TPU.
 """
 
 from __future__ import annotations

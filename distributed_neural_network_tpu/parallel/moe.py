@@ -1,0 +1,124 @@
+"""Expert parallelism: mixture-of-experts dispatch/combine over a mesh axis.
+
+The reference has no MoE or expert parallelism anywhere (SURVEY.md section 2:
+expert parallelism explicitly absent; its only model is the 62K-param CNN at
+`/root/reference/models/model.py:9-27`). This module is the framework's
+expert-parallel capability, built TPU-first in the GShard/Switch style:
+
+- **Static shapes everywhere.** Routing is expressed as dense one-hot
+  dispatch/combine tensors with a fixed per-expert *capacity*; tokens that
+  overflow an expert's capacity are dropped (their FFN contribution is zero,
+  the residual stream passes them through). No gather/scatter with
+  data-dependent shapes - everything is einsum, so XLA tiles it onto the MXU
+  and the program never retraces.
+- **Expert parallelism = one all_to_all each way.** Experts are sharded over
+  a mesh axis (conventionally the data axis, as in GShard); each device
+  routes its local tokens, materializes per-expert capacity slots
+  (E, C, d), and a single `jax.lax.all_to_all` re-shards slot tensors from
+  token-major to expert-major: afterwards each device holds E/n experts'
+  slots from *every* source device, runs its local expert FFNs as one
+  batched einsum, and a second all_to_all sends results home.
+- **Load balancing** via the Switch-Transformer auxiliary loss
+  (E * sum_i fraction_routed_i * mean_router_prob_i), returned to the caller
+  to be weighted into the training loss.
+
+Pure functions designed for use inside `jax.shard_map`; with `ep_axis=None`
+they run the identical math on one device (the parity oracle in
+tests/test_moe.py).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def expert_capacity(n_tokens: int, n_experts: int, top_k: int, factor: float) -> int:
+    """Per-source-device capacity slots per expert (static)."""
+    return max(1, math.ceil(factor * top_k * n_tokens / n_experts))
+
+
+def topk_dispatch(probs, top_k: int, capacity: int):
+    """Greedy top-k routing with per-expert capacity.
+
+    probs: (T, E) router probabilities. Returns (combine, dispatch, aux):
+    combine (T, E, C) float weights, dispatch (T, E, C) 0/1 slot assignment,
+    aux the Switch load-balancing loss. Position within each expert's
+    capacity is assigned in token order (cumsum over the one-hot), the
+    standard static-shape formulation. For top_k > 1 the k gates of each
+    token are renormalized to sum to 1 over the *selected* experts.
+    """
+    t, e = probs.shape
+    combine = jnp.zeros((t, e, capacity), probs.dtype)
+    dispatch = jnp.zeros((t, e, capacity), probs.dtype)
+    fill = jnp.zeros((e,), jnp.int32)
+    masked = probs
+    gate_sum = jnp.zeros((t,), probs.dtype)
+    chosen = []  # per-round (onehot, gate, ok)
+    for _ in range(top_k):
+        idx = jnp.argmax(masked, axis=-1)
+        onehot = jax.nn.one_hot(idx, e, dtype=probs.dtype)
+        pos = jnp.cumsum(onehot, axis=0) - 1.0 + fill[None, :].astype(probs.dtype)
+        pos_tok = (pos * onehot).sum(-1)
+        ok = (pos_tok < capacity).astype(probs.dtype)
+        gate = (probs * onehot).sum(-1)
+        chosen.append((onehot, pos_tok, gate, ok))
+        gate_sum = gate_sum + gate * ok
+        fill = fill + (onehot * ok[:, None]).sum(0).astype(jnp.int32)
+        masked = masked - 2.0 * onehot  # exclude chosen expert in later rounds
+    denom = jnp.maximum(gate_sum, 1e-9)
+    for onehot, pos_tok, gate, ok in chosen:
+        slot = onehot[:, :, None] * jax.nn.one_hot(
+            pos_tok.astype(jnp.int32), capacity, dtype=probs.dtype
+        )[:, None, :] * ok[:, None, None]
+        dispatch = dispatch + slot
+        combine = combine + (gate / denom)[:, None, None] * slot
+
+    # Switch aux loss from first-choice assignment: E * sum_i f_i * P_i
+    first_onehot = chosen[0][0]
+    frac = first_onehot.mean(0)
+    mean_prob = probs.mean(0)
+    aux = jnp.float32(e) * jnp.sum(frac * mean_prob)
+    return combine, dispatch, aux
+
+
+def moe_ffn(
+    x,
+    wr,
+    w1,
+    b1,
+    w2,
+    b2,
+    *,
+    top_k: int = 2,
+    capacity: int,
+    ep_axis: str | None = None,
+    tp_axis: str | None = None,
+):
+    """Mixture-of-experts gelu FFN on a flat token batch.
+
+    x: (T, d) local tokens. wr: (d, E) router (E = global expert count).
+    w1 (E_local, d, F_local), b1 (E_local, F_local), w2 (E_local, F_local, d),
+    b2 (E_local, d) - the local expert shard (E_local = E/|ep|, F_local =
+    F/|tp|). Returns (y, aux) with y (T, d) in x.dtype.
+    """
+    dt = x.dtype
+    probs = jax.nn.softmax(x.astype(jnp.float32) @ wr.astype(jnp.float32), axis=-1)
+    combine, dispatch, aux = topk_dispatch(probs, top_k, capacity)
+    xe = jnp.einsum("tec,td->ecd", dispatch.astype(dt), x)  # (E, C, d)
+    if ep_axis is not None:
+        # token-major -> expert-major: device p gets slots for its E_local
+        # experts from every source; (E, C, d) -> (E_local, n*C, d)
+        xe = jax.lax.all_to_all(xe, ep_axis, split_axis=0, concat_axis=1, tiled=True)
+    h = jnp.einsum("ecd,edf->ecf", xe, w1.astype(dt)) + b1.astype(dt)[:, None]
+    h = jax.nn.gelu(h)
+    y = jnp.einsum("ecf,efd->ecd", h, w2.astype(dt))
+    if tp_axis is not None:
+        y = jax.lax.psum(y, tp_axis)
+    y = y + b2.astype(dt)[:, None]
+    if ep_axis is not None:
+        y = jax.lax.all_to_all(y, ep_axis, split_axis=1, concat_axis=0, tiled=True)
+    out = jnp.einsum("tec,ecd->td", combine.astype(dt), y)
+    return out, aux

@@ -84,8 +84,7 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS, *, causal: bool = False, 
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def body(i, carry):
-        m, l, acc, k_blk, v_blk = carry
+    def update(i, m, l, acc, k_blk, v_blk):
         src = (me - i) % n
         sc = block(q, k_blk, v_blk, src)
         m_new = jnp.maximum(m, sc.max(axis=-1))
@@ -93,9 +92,14 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS, *, causal: bool = False, 
         p = jnp.exp(sc - m_new[..., None])
         l = l * alpha + p.sum(axis=-1)
         acc = acc * alpha[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, v_blk)
+        return m_new, l, acc
+
+    def body(i, carry):
+        m, l, acc, k_blk, v_blk = carry
+        m, l, acc = update(i, m, l, acc, k_blk, v_blk)
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
-        return m_new, l, acc, k_blk, v_blk
+        return m, l, acc, k_blk, v_blk
 
     def vary(x):
         # initial accumulators are constants (vma-invariant) but the loop
@@ -112,7 +116,11 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS, *, causal: bool = False, 
     m0 = vary(jnp.full((b, h, s_local), _NEG_BIG, q.dtype))
     l0 = vary(jnp.zeros((b, h, s_local), q.dtype))
     acc0 = vary(jnp.zeros((b, h, s_local, d), q.dtype))
-    m, l, acc, _, _ = jax.lax.fori_loop(0, n, body, (m0, l0, acc0, k, v))
+    # n-1 rotate-and-accumulate hops, then the final block without the
+    # rotation (whose result nobody would consume - a full K/V shard of ICI
+    # traffic per layer saved)
+    m, l, acc, k_blk, v_blk = jax.lax.fori_loop(0, n - 1, body, (m0, l0, acc0, k, v))
+    m, l, acc = update(n - 1, m, l, acc, k_blk, v_blk)
     out = acc / jnp.maximum(l, 1e-30)[..., None]
     return out.transpose(0, 2, 1, 3)  # (B, Sq, H, D)
 

@@ -62,7 +62,7 @@ def add_common_flags(p: argparse.ArgumentParser, *, epochs: int, batch_size: int
         choices=("xla", "pallas"),
         default="xla",
         help="pallas = fused Pallas classifier-head kernel (VMEM-resident "
-        "weights; interpreter fallback off-TPU)",
+        "weights; equivalent plain-jnp math off-TPU)",
     )
     p.add_argument("--eval-every", type=int, default=1)
     p.add_argument(

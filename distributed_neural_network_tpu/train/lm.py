@@ -49,19 +49,52 @@ def create_lm_mesh(dp: int, sp: int, tp: int = 1) -> Mesh:
     return Mesh(arr, (DATA_AXIS, SEQ_AXIS, TP_AXIS))
 
 
+def _ep_axis(cfg, mesh: Mesh) -> str | None:
+    """Experts shard over the data axis (GShard convention) when present."""
+    dp = mesh.shape.get(DATA_AXIS, 1)
+    if cfg.n_experts and dp > 1:
+        if cfg.n_experts % dp:
+            raise ValueError(
+                f"n_experts ({cfg.n_experts}) must be divisible by the data-"
+                f"axis size ({dp}) for expert parallelism - use a multiple "
+                f"of {dp} experts or a dp that divides {cfg.n_experts}"
+            )
+        return DATA_AXIS
+    return None
+
+
 def shard_params(params, cfg, mesh: Mesh):
     """Place a replicated-layout param tree onto the mesh per param_specs."""
     tp = TP_AXIS if mesh.shape.get(TP_AXIS, 1) > 1 else None
-    specs = tfm.param_specs(cfg, tp_axis=tp)
+    specs = tfm.param_specs(cfg, tp_axis=tp, ep_axis=_ep_axis(cfg, mesh))
     return jax.tree.map(
         lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), params, specs
     ), specs
 
 
-def lm_loss(params, tokens, targets, cfg, *, seq_axis, tp_axis, attn_impl, axes):
-    """Mean next-token cross-entropy over the *global* token count."""
-    logits = tfm.apply(
-        params, tokens, cfg, seq_axis=seq_axis, tp_axis=tp_axis, attn_impl=attn_impl
+def lm_loss(
+    params,
+    tokens,
+    targets,
+    cfg,
+    *,
+    seq_axis,
+    tp_axis,
+    attn_impl,
+    axes,
+    ep_axis=None,
+    aux_weight: float = 0.01,
+):
+    """Mean next-token cross-entropy over the *global* token count (plus the
+    weighted MoE load-balancing aux when cfg.n_experts)."""
+    logits, aux = tfm.apply_with_aux(
+        params,
+        tokens,
+        cfg,
+        seq_axis=seq_axis,
+        tp_axis=tp_axis,
+        ep_axis=ep_axis,
+        attn_impl=attn_impl,
     )
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
@@ -70,9 +103,13 @@ def lm_loss(params, tokens, targets, cfg, *, seq_axis, tp_axis, attn_impl, axes)
     if axes:
         total = jax.lax.psum(local_sum, axes)
         n = jax.lax.psum(local_n, axes)
+        aux = jax.lax.pmean(aux, axes)
     else:
         total, n = local_sum, local_n
-    return total / n
+    loss = total / n
+    if cfg.n_experts:
+        loss = loss + aux_weight * aux
+    return loss
 
 
 def make_lm_train_step(
@@ -90,8 +127,9 @@ def make_lm_train_step(
     """
     sp = SEQ_AXIS if mesh.shape.get(SEQ_AXIS, 1) > 1 else None
     tp = TP_AXIS if mesh.shape.get(TP_AXIS, 1) > 1 else None
+    ep = _ep_axis(cfg, mesh)
     sync_axes = tuple(a for a in (DATA_AXIS, SEQ_AXIS) if a in mesh.axis_names)
-    specs = tfm.param_specs(cfg, tp_axis=tp)
+    specs = tfm.param_specs(cfg, tp_axis=tp, ep_axis=ep)
     data_spec = P(DATA_AXIS, SEQ_AXIS)
 
     def step(params, mom, tokens, targets):
@@ -102,6 +140,7 @@ def make_lm_train_step(
             cfg,
             seq_axis=sp,
             tp_axis=tp,
+            ep_axis=ep,
             attn_impl=attn_impl,
             axes=sync_axes,
         )

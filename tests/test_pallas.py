@@ -1,10 +1,13 @@
 """Fused Pallas classifier-head kernel (ops/pallas_kernels.py).
 
-On the CPU test mesh the kernels run through the Pallas interpreter
-(auto-detected), which executes the identical kernel code path that Mosaic
-compiles on TPU. Correctness bar: forward and every gradient match a plain
-jnp reference implementation to f32-accumulation tolerance, including batch
-sizes that are not a multiple of the kernel's batch tile (padding path).
+The kernel unit tests force `interpret=True`, executing the kernel body
+through the Pallas interpreter on CPU. The engine-level test runs the
+engine's off-TPU path, which is the plain-jnp reference math (the
+interpreter is not shard_map-compatible) - so it covers the wiring, not the
+kernel; Mosaic-compiled behavior is only truly exercised on TPU. Correctness
+bar: forward and every gradient match a plain jnp reference to
+f32-accumulation tolerance, including batch sizes that are not a multiple of
+the kernel's batch tile (padding path).
 """
 
 import jax
