@@ -209,6 +209,55 @@ def _attend(q, k, v, *, impl, seq_axis, s_local):
     )
 
 
+def transformer_block(x, lp, cfg: TransformerConfig, *, attend, tp_axis=None,
+                      ep_axis=None, capacity=None):
+    """One pre-norm block on x (B, S_local, d) with layer params lp.
+
+    `attend`: (q, k, v) -> output, each (B, S_local, H_local, head_dim) -
+    the caller chooses full/ring/Ulysses and the causal offset convention.
+    Returns (x, aux) where aux is the MoE load-balancing loss (0.0 dense).
+    Shared by `apply_with_aux` (flat or dp/sp/tp-sharded execution) and the
+    pipeline schedule (`parallel/pipeline.py`), so the block math lives in
+    exactly one place.
+    """
+    dt = cfg.dtype
+    b, s_local = x.shape[:2]
+    d_local_heads = lp["wq"].shape[-1] // cfg.head_dim
+    h = _layer_norm(x, lp["ln1_scale"], lp["ln1_bias"]).astype(dt)
+    q = (h @ lp["wq"].astype(dt)).reshape(b, s_local, d_local_heads, cfg.head_dim)
+    k = (h @ lp["wk"].astype(dt)).reshape(b, s_local, d_local_heads, cfg.head_dim)
+    v = (h @ lp["wv"].astype(dt)).reshape(b, s_local, d_local_heads, cfg.head_dim)
+    o = attend(q, k, v)
+    o = o.reshape(b, s_local, -1) @ lp["wo"].astype(dt)
+    if tp_axis is not None:
+        o = jax.lax.psum(o, tp_axis)
+    x = x + o
+
+    h = _layer_norm(x, lp["ln2_scale"], lp["ln2_bias"]).astype(dt)
+    if cfg.n_experts:
+        y, aux = moe_ffn(
+            h.reshape(b * s_local, cfg.d_model),
+            lp["wr"],
+            lp["w1"],
+            lp["b1"],
+            lp["w2"],
+            lp["b2"],
+            top_k=cfg.moe_top_k,
+            capacity=capacity,
+            ep_axis=ep_axis,
+            tp_axis=tp_axis,
+        )
+        x = x + y.reshape(b, s_local, cfg.d_model)
+    else:
+        h = jax.nn.gelu(h @ lp["w1"].astype(dt) + lp["b1"].astype(dt))
+        h = h @ lp["w2"].astype(dt)
+        if tp_axis is not None:
+            h = jax.lax.psum(h, tp_axis)
+        x = x + h + lp["b2"].astype(dt)
+        aux = jnp.float32(0.0)
+    return x, aux
+
+
 def apply_with_aux(
     params,
     tokens,
@@ -233,47 +282,22 @@ def apply_with_aux(
     b, s_local = tokens.shape
     x = params["embed"][tokens].astype(dt)
     x = x + _sinusoid_pe(_positions(s_local, seq_axis), cfg.d_model, dt)[None]
-    if cfg.n_experts:
-        cap = expert_capacity(
-            b * s_local, cfg.n_experts, cfg.moe_top_k, cfg.moe_capacity_factor
-        )
+    cap = expert_capacity(
+        b * s_local, cfg.n_experts, cfg.moe_top_k, cfg.moe_capacity_factor
+    ) if cfg.n_experts else None
 
-    # local head count is inferred from the (possibly tp-sharded) wq leaf
     def block(x, lp):
-        d_local_heads = lp["wq"].shape[-1] // cfg.head_dim
-        h = _layer_norm(x, lp["ln1_scale"], lp["ln1_bias"]).astype(dt)
-        q = (h @ lp["wq"].astype(dt)).reshape(b, s_local, d_local_heads, cfg.head_dim)
-        k = (h @ lp["wk"].astype(dt)).reshape(b, s_local, d_local_heads, cfg.head_dim)
-        v = (h @ lp["wv"].astype(dt)).reshape(b, s_local, d_local_heads, cfg.head_dim)
-        o = _attend(q, k, v, impl=attn_impl, seq_axis=seq_axis, s_local=s_local)
-        o = o.reshape(b, s_local, -1) @ lp["wo"].astype(dt)
-        if tp_axis is not None:
-            o = jax.lax.psum(o, tp_axis)
-        x = x + o
-
-        h = _layer_norm(x, lp["ln2_scale"], lp["ln2_bias"]).astype(dt)
-        if cfg.n_experts:
-            y, aux = moe_ffn(
-                h.reshape(b * s_local, cfg.d_model),
-                lp["wr"],
-                lp["w1"],
-                lp["b1"],
-                lp["w2"],
-                lp["b2"],
-                top_k=cfg.moe_top_k,
-                capacity=cap,
-                ep_axis=ep_axis,
-                tp_axis=tp_axis,
-            )
-            x = x + y.reshape(b, s_local, cfg.d_model)
-        else:
-            h = jax.nn.gelu(h @ lp["w1"].astype(dt) + lp["b1"].astype(dt))
-            h = h @ lp["w2"].astype(dt)
-            if tp_axis is not None:
-                h = jax.lax.psum(h, tp_axis)
-            x = x + h + lp["b2"].astype(dt)
-            aux = jnp.float32(0.0)
-        return x, aux
+        return transformer_block(
+            x,
+            lp,
+            cfg,
+            attend=lambda q, k, v: _attend(
+                q, k, v, impl=attn_impl, seq_axis=seq_axis, s_local=s_local
+            ),
+            tp_axis=tp_axis,
+            ep_axis=ep_axis,
+            capacity=cap,
+        )
 
     x, aux = jax.lax.scan(block, x, params["layers"])
     x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"]).astype(dt)

@@ -1,0 +1,232 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a mesh axis.
+
+The reference has no pipeline parallelism (SURVEY.md section 2: explicitly
+absent - its model is a 5-layer CNN trained data-parallel only). This module
+is the framework's pipeline capability for the transformer family
+(`models/transformer.py`), built the TPU way rather than the
+point-to-point-send way:
+
+- **Stages are a mesh axis.** The transformer's scanned layer stack
+  (leaves shaped (L, ...)) is sharded over a `'pipe'` axis: each device
+  holds L/P contiguous layers. No per-stage module objects, no rank
+  branching - one shard_map'd program, SPMD over stages.
+- **The schedule is a dense scan.** The classic GPipe timeline of
+  T = M + P - 1 ticks (M microbatches through P stages) is a
+  `jax.lax.scan`; each tick every stage applies its local layers to its
+  current activation block and the blocks rotate one hop along the ring via
+  `jax.lax.ppermute` (XLA lowers to ICI neighbor exchange). Stage 0 feeds a
+  fresh microbatch each tick; the last stage applies the LM head and
+  accumulates loss for ticks that carry a valid microbatch. Pipeline-bubble
+  ticks compute on garbage and are masked out - the standard static-shape
+  trade.
+- **Autodiff does the backward pipeline.** The whole schedule is
+  differentiable (scan + ppermute + where-masks), so reverse-mode AD yields
+  the reverse-order backward pipeline automatically; stage-sharded layer
+  params (device-varying over 'pipe') get local gradients, while embed/head
+  (replicated over 'pipe') get their cross-stage gradient psum from
+  shard_map's typing - no hand-written send/recv of activation grads.
+- Composes with a 'data' axis (batch sharded, grad pmean automatic) and the
+  tensor-parallel 'model' axis (per-block psums inside each stage).
+
+Known simplicity trade: every stage computes the (cheap) embedding and LM
+head every tick, with `where`-selection keeping only the boundary stages'
+results - wasted VPU work proportional to vocab, in exchange for a fully
+uniform SPMD program with zero stage branching.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models import transformer as tfm
+from ..ops.sgd import sgd_step
+
+DATA_AXIS = "data"
+PIPE_AXIS = "pipe"
+TP_AXIS = "model"
+
+
+def create_pp_mesh(dp: int, pp: int, tp: int = 1) -> Mesh:
+    """(data, pipe, model) mesh; pipe/model innermost for ICI adjacency."""
+    n = dp * pp * tp
+    devices = jax.devices()
+    if n > len(devices):
+        raise ValueError(f"mesh {dp}x{pp}x{tp} needs {n} devices, have {len(devices)}")
+    arr = np.asarray(devices[:n]).reshape(dp, pp, tp)
+    return Mesh(arr, (DATA_AXIS, PIPE_AXIS, TP_AXIS))
+
+
+def pp_param_specs(cfg: tfm.TransformerConfig, tp_axis: str | None = None):
+    """param_specs with every layer-stack leaf stage-sharded over 'pipe'.
+
+    The layer dimension (leading axis of every `layers` leaf) is split
+    across stages; embed/head/final-norm stay replicated over 'pipe'.
+    """
+    specs = tfm.param_specs(cfg, tp_axis=tp_axis)
+
+    def stage_shard(spec: P) -> P:
+        rest = tuple(spec)[1:]  # drop the layer-dim entry (None) if present
+        return P(PIPE_AXIS, *rest)
+
+    specs["layers"] = {k: stage_shard(s) for k, s in specs["layers"].items()}
+    return specs
+
+
+def pipeline_lm_loss(
+    params,
+    tokens,
+    targets,
+    cfg: tfm.TransformerConfig,
+    *,
+    pipe_axis: str = PIPE_AXIS,
+    n_microbatches: int,
+    tp_axis: str | None = None,
+    sync_axes=(),
+):
+    """Mean next-token cross-entropy via the microbatch pipeline schedule.
+
+    Call inside shard_map. tokens/targets: (B_local, S) int32; params: the
+    local stage shard (layers leaves (L/P, ...), embed/head replicated).
+    Returns the replicated global mean loss (psum over pipe + sync_axes).
+    """
+    n_pipe = jax.lax.axis_size(pipe_axis)
+    stage = jax.lax.axis_index(pipe_axis)
+    m = n_microbatches
+    b_local, s = tokens.shape
+    assert b_local % m == 0, (b_local, m)
+    mb = b_local // m
+    dt = cfg.dtype
+    tok_mb = tokens.reshape(m, mb, s)
+    tgt_mb = targets.reshape(m, mb, s)
+    pe = tfm._sinusoid_pe(jnp.arange(s), cfg.d_model, dt)[None]
+
+    def local_blocks(x):
+        def block(x, lp):
+            x, _ = tfm.transformer_block(
+                x,
+                lp,
+                cfg,
+                attend=lambda q, k, v: tfm.attention(q, k, v, causal=True),
+                tp_axis=tp_axis,
+            )
+            return x, None
+
+        x, _ = jax.lax.scan(block, x, params["layers"])
+        return x
+
+    perm = [(i, (i + 1) % n_pipe) for i in range(n_pipe)]
+    is_last = stage == n_pipe - 1
+
+    def tick(carry, t):
+        x_in, loss_sum = carry
+        t_feed = jnp.clip(t, 0, m - 1)
+        fresh = params["embed"][jax.lax.dynamic_index_in_dim(
+            tok_mb, t_feed, keepdims=False
+        )].astype(dt) + pe
+        x = jnp.where(stage == 0, fresh, x_in)
+        out = local_blocks(x)
+
+        # last stage: head + loss for microbatch t - (P-1), when valid
+        h = tfm._layer_norm(out, params["lnf_scale"], params["lnf_bias"]).astype(dt)
+        logits = (h @ params["head"].astype(dt)).astype(jnp.float32)
+        t_out = jnp.clip(t - (n_pipe - 1), 0, m - 1)
+        tgt = jax.lax.dynamic_index_in_dim(tgt_mb, t_out, keepdims=False)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+        valid = jnp.logical_and(is_last, t >= n_pipe - 1)
+        loss_sum = loss_sum + jnp.where(valid, -ll.sum(), 0.0)
+
+        x_out = jax.lax.ppermute(out, pipe_axis, perm)
+        return (x_out, loss_sum), None
+
+    def vary(x):
+        # activations vary over the pipe axis (stage-dependent) and whatever
+        # the tokens vary over (data), but stay invariant over 'model': the
+        # per-block tp psums close every model-varying intermediate
+        try:
+            want = {pipe_axis} | set(jax.typeof(tokens).vma)
+            missing = tuple(a for a in want if a not in jax.typeof(x).vma)
+        except AttributeError:
+            return x
+        return jax.lax.pcast(x, missing, to="varying") if missing else x
+
+    x0 = vary(jnp.zeros((mb, s, cfg.d_model), dt))
+    loss0 = vary(jnp.float32(0.0))
+    (_, loss_sum), _ = jax.lax.scan(
+        tick, (x0, loss0), jnp.arange(m + n_pipe - 1)
+    )
+    axes = (pipe_axis,) + tuple(sync_axes)
+    total = jax.lax.psum(loss_sum, axes)
+    # global token count is static: every data-shard holds tokens.size tokens
+    n_tokens = tokens.size
+    for a in sync_axes:
+        n_tokens = n_tokens * jax.lax.axis_size(a)
+    return total / jnp.float32(n_tokens)
+
+
+def make_pp_train_step(
+    cfg: tfm.TransformerConfig,
+    mesh: Mesh,
+    *,
+    n_microbatches: int = 2,
+    lr: float = 0.1,
+    momentum: float = 0.9,
+):
+    """Compiled pipeline-parallel (params, mom, tokens, targets) ->
+    (params, mom, loss) over a (data, pipe, model) mesh.
+
+    tokens/targets: (B, S) int32 with B divisible by dp * n_microbatches.
+    Layer-stack params must be placed per `pp_param_specs` (use
+    `shard_pp_params`).
+    """
+    pp = mesh.shape.get(PIPE_AXIS, 1)
+    if cfg.n_layers % pp:
+        raise ValueError(
+            f"n_layers ({cfg.n_layers}) must be divisible by pipeline size ({pp})"
+        )
+    if cfg.n_experts:
+        raise ValueError(
+            "pipeline parallelism currently supports dense blocks only "
+            f"(cfg.n_experts={cfg.n_experts}); use the dp/ep path in train/lm.py "
+            "for MoE models"
+        )
+    tp = TP_AXIS if mesh.shape.get(TP_AXIS, 1) > 1 else None
+    sync = tuple(a for a in (DATA_AXIS,) if a in mesh.axis_names)
+    specs = pp_param_specs(cfg, tp_axis=tp)
+    data_spec = P(DATA_AXIS)
+
+    def step(params, mom, tokens, targets):
+        loss, grads = jax.value_and_grad(pipeline_lm_loss)(
+            params,
+            tokens,
+            targets,
+            cfg,
+            pipe_axis=PIPE_AXIS,
+            n_microbatches=n_microbatches,
+            tp_axis=tp,
+            sync_axes=sync,
+        )
+        params, mom = sgd_step(params, mom, grads, lr, momentum)
+        return params, mom, loss
+
+    return jax.jit(
+        jax.shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(specs, specs, data_spec, data_spec),
+            out_specs=(specs, specs, P()),
+        ),
+        donate_argnums=(0, 1),
+    )
+
+
+def shard_pp_params(params, cfg, mesh: Mesh):
+    """Place a replicated-layout param tree per pp_param_specs."""
+    tp = TP_AXIS if mesh.shape.get(TP_AXIS, 1) > 1 else None
+    specs = pp_param_specs(cfg, tp_axis=tp)
+    return jax.tree.map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), params, specs
+    ), specs
