@@ -25,7 +25,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models import transformer as tfm
-from ..ops.sgd import sgd_step
+from ..ops.sgd import init_momentum, sgd_step
+from ..parallel import zero
 
 DATA_AXIS = "data"
 SEQ_AXIS = "seq"
@@ -112,6 +113,21 @@ def lm_loss(
     return loss
 
 
+def init_lm_momentum(params, cfg, mesh: Mesh, optimizer: str = "sgd"):
+    """Optimizer-state init matching `make_lm_train_step(optimizer=...)`:
+    'sgd' -> a replicated zero tree; 'zero' -> the flat ZeRO-1 momentum
+    buffer sharded over the data axis (each device holds 1/dp of it)."""
+    if optimizer == "sgd":
+        return init_momentum(params)
+    if optimizer == "zero":
+        dp = mesh.shape.get(DATA_AXIS, 1)
+        return jax.device_put(
+            zero.init_zero_momentum(params, dp),
+            NamedSharding(mesh, P(DATA_AXIS)),
+        )
+    raise ValueError(f"unknown optimizer {optimizer!r} (use 'sgd' or 'zero')")
+
+
 def make_lm_train_step(
     cfg: tfm.TransformerConfig,
     mesh: Mesh,
@@ -119,11 +135,14 @@ def make_lm_train_step(
     lr: float = 0.1,
     momentum: float = 0.9,
     attn_impl: str = "ring",
+    optimizer: str = "sgd",
 ):
     """Compiled (params, mom, tokens, targets) -> (params, mom, loss).
 
     tokens/targets: (B, S) int32, B divisible by dp, S by sp. Loss returns
-    replicated. The step is donate-safe on params/mom.
+    replicated. The step is donate-safe on params/mom. optimizer='zero'
+    shards the momentum buffer over the data axis (ZeRO-1,
+    parallel/zero.py); init mom with `init_lm_momentum`.
     """
     sp = SEQ_AXIS if mesh.shape.get(SEQ_AXIS, 1) > 1 else None
     tp = TP_AXIS if mesh.shape.get(TP_AXIS, 1) > 1 else None
@@ -131,6 +150,16 @@ def make_lm_train_step(
     sync_axes = tuple(a for a in (DATA_AXIS, SEQ_AXIS) if a in mesh.axis_names)
     specs = tfm.param_specs(cfg, tp_axis=tp, ep_axis=ep)
     data_spec = P(DATA_AXIS, SEQ_AXIS)
+    if optimizer not in ("sgd", "zero"):
+        raise ValueError(f"unknown optimizer {optimizer!r} (use 'sgd' or 'zero')")
+    if optimizer == "zero" and (tp or ep):
+        raise ValueError(
+            "optimizer='zero' shards the flat param vector over the data "
+            "axis, which requires params replicated across the mesh - not "
+            f"compatible with tp_axis={tp!r} / ep_axis={ep!r}; use "
+            "optimizer='sgd' for tensor/expert-sharded configs"
+        )
+    mom_spec = specs if optimizer == "sgd" else P(DATA_AXIS)
 
     def step(params, mom, tokens, targets):
         loss, grads = jax.value_and_grad(lm_loss)(
@@ -144,15 +173,21 @@ def make_lm_train_step(
             attn_impl=attn_impl,
             axes=sync_axes,
         )
-        params, mom = sgd_step(params, mom, grads, lr, momentum)
+        if optimizer == "zero":
+            params, mom = zero.zero_sgd_step(
+                params, mom, grads, lr, momentum,
+                axis_name=DATA_AXIS, grads_presummed=True,
+            )
+        else:
+            params, mom = sgd_step(params, mom, grads, lr, momentum)
         return params, mom, loss
 
     return jax.jit(
         jax.shard_map(
             step,
             mesh=mesh,
-            in_specs=(specs, specs, data_spec, data_spec),
-            out_specs=(specs, specs, P()),
+            in_specs=(specs, mom_spec, data_spec, data_spec),
+            out_specs=(specs, mom_spec, P()),
         ),
         donate_argnums=(0, 1),
     )
