@@ -59,22 +59,37 @@ def normalize(images_u8: np.ndarray) -> np.ndarray:
     """uint8 [0,255] -> float32 in [-1,1]: (x/255 - 0.5)/0.5.
 
     Parity: reference transforms.Normalize((0.5,)*3, (0.5,)*3)
-    (`data_parallelism_train.py:24-27`).
+    (`data_parallelism_train.py:24-27`). uint8 input runs through the
+    native C++ kernel when available (single fused pass); any other
+    numeric dtype (e.g. a float-typed npz) keeps the plain numpy math.
     """
+    images_u8 = np.asarray(images_u8)
+    if images_u8.dtype == np.uint8:
+        from .. import native
+
+        return native.normalize_u8(images_u8, CIFAR10_MEAN, CIFAR10_STD)
     x = images_u8.astype(np.float32) / 255.0
     return (x - CIFAR10_MEAN) / CIFAR10_STD
 
 
-def _load_pickle_batches(batch_dir: str, train: bool):
+def _load_pickle_batches_normalized(batch_dir: str, train: bool):
+    """Decode python batches straight to normalized NHWC float32.
+
+    The (N, 3072) plane-major rows go through ONE fused native pass
+    (layout change + dtype + normalize; numpy chain as fallback) instead of
+    reshape/transpose/astype/affine with an intermediate per step.
+    """
+    from .. import native
+
     names = [f"data_batch_{i}" for i in range(1, 6)] if train else ["test_batch"]
     imgs, labels = [], []
     for name in names:
         path = os.path.join(batch_dir, name)
         with open(path, "rb") as f:
             d = pickle.load(f, encoding="bytes")
-        # (N, 3072) R-plane,G-plane,B-plane -> (N, 32, 32, 3) NHWC
-        x = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
-        imgs.append(x)
+        imgs.append(
+            native.cifar_decode_normalize(d[b"data"], CIFAR10_MEAN, CIFAR10_STD)
+        )
         labels.append(np.asarray(d[b"labels"], dtype=np.int32))
     return np.concatenate(imgs), np.concatenate(labels)
 
@@ -130,8 +145,8 @@ def load_split(
         _maybe_extract_tarball(root) if os.path.isdir(root) else None
         batch_dir = os.path.join(root, "cifar-10-batches-py")
         if os.path.isdir(batch_dir):
-            x, y = _load_pickle_batches(batch_dir, train)
-            return Split(normalize(x), y, "pickle")
+            x, y = _load_pickle_batches_normalized(batch_dir, train)
+            return Split(x, y, "pickle")
         if source == "pickle":
             raise FileNotFoundError(f"no cifar-10-batches-py under {root}")
     if source in ("auto", "npz"):

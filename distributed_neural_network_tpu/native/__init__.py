@@ -1,0 +1,194 @@
+"""Native (C++) host-side data kernels: build-on-import + ctypes bindings.
+
+The TPU compute path is JAX/XLA/Pallas; this package is the native layer of
+the *runtime around it* - the host input pipeline (see batcher.cpp for what
+and why). `batcher.cpp` is compiled once per source change with g++ into a
+shared library cached under `_cache/`, loaded via ctypes (no pybind11
+dependency), and exposed as numpy-typed wrappers. Every entry point has a
+pure-numpy fallback selected automatically when no C++ toolchain is
+available, so the framework never *requires* the native layer - it only
+gets faster with it. `DNN_TPU_NO_NATIVE=1` forces the fallback (used by the
+parity tests).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "batcher.cpp")
+_CACHE = os.path.join(os.path.dirname(__file__), "_cache")
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _disabled() -> bool:
+    return os.environ.get("DNN_TPU_NO_NATIVE", "") not in ("", "0")
+
+
+def _build() -> str | None:
+    with open(_SRC, "rb") as f:
+        tag = hashlib.sha256(f.read()).hexdigest()[:16]
+    so = os.path.join(_CACHE, f"batcher-{tag}.so")
+    if os.path.exists(so):
+        return so
+    os.makedirs(_CACHE, exist_ok=True)
+    # per-process tmp name: concurrent first builds (e.g. pytest-xdist) must
+    # not interleave compiler output into one file; os.replace is atomic
+    tmp = f"{so}.{os.getpid()}.tmp"
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        _SRC, "-o", tmp,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, so)
+    except (OSError, subprocess.SubprocessError) as e:
+        print(f"[native] build failed, using numpy fallback: {e}", file=sys.stderr)
+        return None
+    return so
+
+
+def _load():
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if _disabled():
+            return None
+        so = _build()
+        if so is None:
+            return None
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError as e:  # corrupted/incompatible cached .so
+            print(f"[native] load failed, using numpy fallback: {e}",
+                  file=sys.stderr)
+            return None
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        f32p = ctypes.POINTER(ctypes.c_float)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.cifar_decode_chw_to_nhwc.argtypes = [
+            u8p, ctypes.c_int64, ctypes.c_float, ctypes.c_float, f32p,
+            ctypes.c_int32,
+        ]
+        lib.affine_u8_to_f32.argtypes = [
+            u8p, ctypes.c_int64, ctypes.c_float, ctypes.c_float, f32p,
+            ctypes.c_int32,
+        ]
+        lib.gather_affine_u8.argtypes = [
+            u8p, i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_float,
+            ctypes.c_float, f32p, ctypes.c_int32,
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    """True when the compiled native library is loadable."""
+    return _load() is not None
+
+
+def _affine_coeffs(mean: float, std: float) -> tuple[float, float]:
+    # out = (x/255 - mean)/std = x * 1/(255*std) - mean/std
+    return 1.0 / (255.0 * std), -mean / std
+
+
+def _as_u8(a) -> np.ndarray:
+    a = np.asarray(a)
+    if a.dtype != np.uint8:
+        raise TypeError(
+            f"native data kernels take uint8 input, got {a.dtype}; "
+            "normalize non-uint8 arrays with plain numpy math"
+        )
+    return np.ascontiguousarray(a)
+
+
+def _u8ptr(a):  # contiguous views for ctypes
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def _f32ptr(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def cifar_decode_normalize(
+    rows_u8: np.ndarray, mean: float, std: float, *, nthreads: int = 0
+) -> np.ndarray:
+    """(N, 3072) plane-major uint8 -> (N, 32, 32, 3) normalized float32.
+
+    One fused pass (native) or the equivalent numpy chain (fallback).
+    """
+    rows_u8 = _as_u8(rows_u8)
+    n = rows_u8.shape[0]
+    assert rows_u8.ndim == 2 and rows_u8.shape[1] == 3072, rows_u8.shape
+    a, b = _affine_coeffs(mean, std)
+    lib = _load()
+    if lib is None:
+        x = rows_u8.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        return (x.astype(np.float32) / 255.0 - mean) / std
+    out = np.empty((n, 32, 32, 3), np.float32)
+    lib.cifar_decode_chw_to_nhwc(
+        _u8ptr(rows_u8), n, a, b, _f32ptr(out), nthreads
+    )
+    return out
+
+
+def normalize_u8(
+    images_u8: np.ndarray, mean: float, std: float, *, nthreads: int = 0
+) -> np.ndarray:
+    """Layout-preserving uint8 -> normalized float32 (any shape)."""
+    images_u8 = _as_u8(images_u8)
+    a, b = _affine_coeffs(mean, std)
+    lib = _load()
+    if lib is None:
+        return (images_u8.astype(np.float32) / 255.0 - mean) / std
+    out = np.empty(images_u8.shape, np.float32)
+    lib.affine_u8_to_f32(
+        _u8ptr(images_u8), images_u8.size, a, b, _f32ptr(out), nthreads
+    )
+    return out
+
+
+def gather_normalize_u8(
+    images_u8: np.ndarray,
+    indices: np.ndarray,
+    mean: float,
+    std: float,
+    *,
+    nthreads: int = 0,
+) -> np.ndarray:
+    """Batch assembly: images_u8[indices] normalized, in one fused pass.
+
+    images_u8: (N, ...) uint8; indices: (B,) integer. Returns (B, ...)
+    float32. The host-streaming path's gather+convert+normalize.
+    """
+    images_u8 = _as_u8(images_u8)
+    idx = np.ascontiguousarray(indices, dtype=np.int64)
+    if idx.size and (idx.min() < 0 or idx.max() >= images_u8.shape[0]):
+        raise IndexError(
+            f"indices out of range [0, {images_u8.shape[0]}): "
+            f"[{idx.min()}, {idx.max()}]"
+        )
+    a, b = _affine_coeffs(mean, std)
+    lib = _load()
+    if lib is None:
+        return (images_u8[idx].astype(np.float32) / 255.0 - mean) / std
+    row = int(np.prod(images_u8.shape[1:], dtype=np.int64))
+    out = np.empty((idx.shape[0], *images_u8.shape[1:]), np.float32)
+    lib.gather_affine_u8(
+        _u8ptr(images_u8),
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        idx.shape[0], row, a, b, _f32ptr(out), nthreads,
+    )
+    return out

@@ -113,7 +113,7 @@ def lm_loss(
     return loss
 
 
-def init_lm_momentum(params, cfg, mesh: Mesh, optimizer: str = "sgd"):
+def init_lm_momentum(params, mesh: Mesh, optimizer: str = "sgd"):
     """Optimizer-state init matching `make_lm_train_step(optimizer=...)`:
     'sgd' -> a replicated zero tree; 'zero' -> the flat ZeRO-1 momentum
     buffer sharded over the data axis (each device holds 1/dp of it)."""

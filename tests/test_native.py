@@ -1,0 +1,106 @@
+"""Native C++ data kernels (distributed_neural_network_tpu/native).
+
+g++ is part of the build environment, so these tests hard-require the
+compiled library (available() must be True) and check it against
+independent numpy math: fused CIFAR plane-major decode+normalize,
+layout-preserving normalize, fused gather+normalize, thread-count
+robustness, and the pickle-directory integration path.
+"""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from distributed_neural_network_tpu import native
+from distributed_neural_network_tpu.data import cifar10
+
+
+def _np_norm(x_u8):
+    return (x_u8.astype(np.float32) / 255.0 - 0.5) / 0.5
+
+
+def test_native_library_builds():
+    assert native.available(), "g++ is baked into this image; build must work"
+
+
+@pytest.mark.parametrize("n", [1, 7, 256])
+@pytest.mark.parametrize("threads", [0, 1, 3])
+def test_cifar_decode_matches_numpy(n, threads):
+    rng = np.random.default_rng(n)
+    rows = rng.integers(0, 256, size=(n, 3072), dtype=np.uint8)
+    got = native.cifar_decode_normalize(rows, 0.5, 0.5, nthreads=threads)
+    want = _np_norm(rows.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
+    assert got.shape == (n, 32, 32, 3) and got.dtype == np.float32
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("shape", [(5, 32, 32, 3), (3, 7), (11,)])
+def test_normalize_matches_numpy(shape):
+    rng = np.random.default_rng(1)
+    x = rng.integers(0, 256, size=shape, dtype=np.uint8)
+    np.testing.assert_allclose(
+        native.normalize_u8(x, 0.5, 0.5), _np_norm(x), rtol=1e-6, atol=1e-6
+    )
+
+
+def test_gather_normalize_matches_numpy():
+    rng = np.random.default_rng(2)
+    x = rng.integers(0, 256, size=(64, 32, 32, 3), dtype=np.uint8)
+    idx = rng.integers(0, 64, size=37)
+    got = native.gather_normalize_u8(x, idx, 0.5, 0.5, nthreads=2)
+    np.testing.assert_allclose(got, _np_norm(x[idx]), rtol=1e-6, atol=1e-6)
+
+
+def test_gather_rejects_out_of_range():
+    x = np.zeros((4, 2), np.uint8)
+    with pytest.raises(IndexError, match="out of range"):
+        native.gather_normalize_u8(x, np.array([0, 4]), 0.5, 0.5)
+
+
+def test_pickle_dir_loads_through_native(tmp_path):
+    """A torchvision-format batch dir decodes to the same arrays the
+    numpy chain produces, through load_split's pickle branch."""
+    rng = np.random.default_rng(3)
+    batch_dir = tmp_path / "cifar-10-batches-py"
+    batch_dir.mkdir()
+    all_rows, all_labels = [], []
+    for i in range(1, 6):
+        rows = rng.integers(0, 256, size=(8, 3072), dtype=np.uint8)
+        labels = rng.integers(0, 10, size=8).tolist()
+        with open(batch_dir / f"data_batch_{i}", "wb") as f:
+            pickle.dump({b"data": rows, b"labels": labels}, f)
+        all_rows.append(rows)
+        all_labels.append(labels)
+    split = cifar10.load_split(True, root=str(tmp_path), source="pickle")
+    assert split.source == "pickle" and len(split) == 40
+    rows = np.concatenate(all_rows)
+    want = _np_norm(rows.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
+    np.testing.assert_allclose(split.images, want, rtol=1e-6, atol=1e-6)
+    np.testing.assert_array_equal(
+        split.labels, np.concatenate(all_labels).astype(np.int32)
+    )
+
+
+def test_native_rejects_non_uint8():
+    with pytest.raises(TypeError, match="uint8"):
+        native.normalize_u8(np.zeros((2, 2), np.float32), 0.5, 0.5)
+
+
+def test_normalize_handles_float_input():
+    """Float-typed datasets (e.g. a float npz) keep the numpy math path."""
+    x = np.array([[0.0, 127.5, 255.0]], np.float32)
+    np.testing.assert_allclose(
+        cifar10.normalize(x), np.array([[-1.0, 0.0, 1.0]]), atol=1e-6
+    )
+
+
+def test_fallback_matches_native(monkeypatch):
+    """The documented numpy fallback produces identical results."""
+    rng = np.random.default_rng(4)
+    rows = rng.integers(0, 256, size=(16, 3072), dtype=np.uint8)
+    want = native.cifar_decode_normalize(rows, 0.5, 0.5)
+    monkeypatch.setattr(native, "_load", lambda: None)
+    got = native.cifar_decode_normalize(rows, 0.5, 0.5)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)

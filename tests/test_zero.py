@@ -99,7 +99,7 @@ def test_lm_zero_optimizer_matches_sgd_and_learns(n_devices):
         params, _ = lmtrain.shard_params(
             jax.tree.map(jnp.array, params0), cfg, mesh
         )
-        mom = lmtrain.init_lm_momentum(params, cfg, mesh, opt)
+        mom = lmtrain.init_lm_momentum(params, mesh, opt)
         step = lmtrain.make_lm_train_step(
             cfg, mesh, lr=0.3, momentum=0.9, optimizer=opt
         )
