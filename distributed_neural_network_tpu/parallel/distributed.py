@@ -1,0 +1,176 @@
+"""Multi-host bootstrap and hybrid ICI x DCN meshes.
+
+The reference's multi-process story is `mpiexec -n N` on ONE machine
+(`README.md:28`; SURVEY.md L0) - world discovery via MPI.COMM_WORLD and all
+traffic through rank 0's pickle sends. The TPU-native equivalents:
+
+- **Process bootstrap**: `initialize()` wraps `jax.distributed.initialize`,
+  the JAX runtime's coordinator handshake that makes every host see the
+  global device set (the `mpiexec` replacement). On single-host runs - and
+  on TPU environments where the runtime auto-detects cluster config - it is
+  a safe no-op. After it, the same SPMD program runs on every host; there
+  is no rank-0 data plane.
+- **Mesh topology**: within one TPU slice, devices talk over ICI;
+  across slices (multislice) they talk over DCN, which is orders of
+  magnitude thinner. `create_hybrid_mesh` builds a mesh whose *outer* axes
+  map to DCN (put your lowest-frequency collective there - e.g. the
+  once-per-epoch parameter pmean of this framework's regimes, or plain
+  data parallelism) and whose *inner* axes stay inside a slice's ICI
+  (tensor/sequence/pipeline axes, per-step collectives) - the standard
+  multislice recipe, built directly from the devices' slice_index so the
+  slice->dcn-position mapping is explicit and unit-testable.
+- **Data feeding**: with multiple processes, each host holds only its local
+  shard of a batch; `distribute_host_data` wraps
+  `jax.make_array_from_process_local_data` to assemble the global sharded
+  array the compiled step expects.
+
+Everything degrades gracefully to single-process: the CI/test environment
+exercises the single-slice paths on the 8-device CPU mesh, and the
+multislice branch is validated by the mesh-shape/axis-order contract (real
+DCN requires actual multi-host hardware).
+"""
+
+from __future__ import annotations
+
+import os
+from collections.abc import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Join the multi-host JAX runtime; returns True if it initialized.
+
+    Safe to call unconditionally at program start (the CLI entry points
+    do): explicit args > standard env vars (JAX_COORDINATOR_ADDRESS /
+    JAX_NUM_PROCESSES / JAX_PROCESS_ID) > single-process no-op. Idempotent.
+
+    Must run before anything touches a JAX backend (jax.devices(),
+    jax.process_count(), any computation): the runtime refuses to go
+    multi-host once the single-process backend exists - which is also why
+    this function decides the no-op case from the env alone instead of
+    asking JAX.
+    """
+    already = _already_initialized()
+    if already is not None:
+        return already
+    addr = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    num = num_processes if num_processes is not None else _env_int("JAX_NUM_PROCESSES")
+    pid = process_id if process_id is not None else _env_int("JAX_PROCESS_ID")
+    if addr is None or num is None or num <= 1:
+        return False
+    if pid is None:
+        raise ValueError(
+            "JAX_COORDINATOR_ADDRESS/JAX_NUM_PROCESSES are set but "
+            "JAX_PROCESS_ID is not; set it to this host's rank in "
+            "[0, num_processes) (auto-detection only works on cloud "
+            "TPU/Slurm/OpenMPI environments)"
+        )
+    jax.distributed.initialize(
+        coordinator_address=addr, num_processes=num, process_id=pid
+    )
+    return True
+
+
+def _already_initialized() -> bool | None:
+    """True if the distributed client exists, None if undetermined."""
+    try:
+        from jax._src import distributed as _jd
+
+        return True if _jd.global_state.client is not None else None
+    except (ImportError, AttributeError):
+        return None
+
+
+def _env_int(name: str) -> int | None:
+    v = os.environ.get(name)
+    return int(v) if v else None
+
+
+def create_hybrid_mesh(
+    ici_axes: dict[str, int],
+    dcn_axes: dict[str, int] | None = None,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Mesh with DCN-parallel axes outermost and ICI axes inner.
+
+    ici_axes/dcn_axes: ordered {axis_name: size}. The resulting mesh's axis
+    order is (*dcn, *ici), so per-step collectives (tp/sp/pp - put them in
+    ici_axes) ride intra-slice ICI while low-frequency collectives (the
+    epoch-edge parameter averaging of the dp regimes) cross DCN. With one
+    slice (or on CPU), the same axis names/sizes are laid out over the flat
+    device list, so calling code is portable between single- and
+    multi-slice environments.
+    """
+    dcn_axes = dcn_axes or {}
+    names = (*dcn_axes, *ici_axes)
+    sizes = (*dcn_axes.values(), *ici_axes.values())
+    if any(s <= 0 for s in sizes):
+        raise ValueError(f"axis sizes must be positive: {dict(zip(names, sizes))}")
+    devs = list(devices) if devices is not None else jax.devices()
+    total = int(np.prod(sizes))
+    if total > len(devs):
+        raise ValueError(
+            f"mesh {dict(zip(names, sizes))} needs {total} devices, "
+            f"have {len(devs)}"
+        )
+    arr = _hybrid_device_array(
+        devs[:total], tuple(dcn_axes.values()), tuple(ici_axes.values())
+    )
+    return Mesh(arr, names)
+
+
+def _hybrid_device_array(devices, dcn_sizes: tuple, ici_sizes: tuple) -> np.ndarray:
+    """(*dcn, *ici)-shaped device array with slice boundaries on dcn axes.
+
+    Multislice: devices are grouped by `slice_index` and each slice fills
+    one dcn position, so every dcn-axis hop crosses DCN and every ici-axis
+    hop stays inside a slice. Single slice (or CPU): the flat device order
+    is used. Pure numpy over device objects - unit-testable with stubs.
+    """
+    n_slices = len({getattr(d, "slice_index", 0) for d in devices})
+    dcn_total = int(np.prod(dcn_sizes)) if dcn_sizes else 1
+    shape = (*dcn_sizes, *ici_sizes)
+    if n_slices <= 1 or dcn_total != n_slices:
+        if n_slices > 1:
+            raise ValueError(
+                f"{n_slices} slices present but dcn axes {dcn_sizes} "
+                f"multiply to {dcn_total}; the dcn axes must exactly cover "
+                "the slice count so per-step collectives stay on ICI"
+            )
+        return np.asarray(devices).reshape(shape)
+    groups: dict[int, list] = {}
+    for d in devices:
+        groups.setdefault(getattr(d, "slice_index", 0), []).append(d)
+    per = len(devices) // n_slices
+    ordered = []
+    for si in sorted(groups):
+        g = groups[si]
+        if len(g) != per:
+            raise ValueError(
+                f"slice {si} has {len(g)} devices, expected {per} "
+                "(uneven slices cannot form a regular dcn x ici mesh)"
+            )
+        ordered.append(np.asarray(g).reshape(ici_sizes))
+    return np.stack(ordered).reshape(shape)
+
+
+def distribute_host_data(local_batch, mesh: Mesh, spec: P):
+    """Assemble the global sharded array from each host's local shard.
+
+    local_batch: numpy array holding THIS process's rows. Single-process
+    this is just device_put with the sharding; multi-process it stitches
+    the per-host shards into one global jax.Array without any host ever
+    materializing the full batch.
+    """
+    sharding = NamedSharding(mesh, spec)
+    if jax.process_count() == 1:
+        return jax.device_put(local_batch, sharding)
+    return jax.make_array_from_process_local_data(sharding, local_batch)

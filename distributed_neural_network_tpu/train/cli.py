@@ -167,6 +167,15 @@ def honor_platform_env() -> None:
 def run_training(args, regime: str, *, log=print) -> Engine:
     """Load data, train, write phase logs - the shared main() body."""
     honor_platform_env()
+    from ..parallel.distributed import initialize as distributed_initialize
+
+    if distributed_initialize():
+        import jax
+
+        log(
+            f"(Multi-host: process {jax.process_index()}/{jax.process_count()}, "
+            f"{jax.device_count()} global devices)"
+        )
     cfg = config_from_args(args, regime)
     timers = T.PhaseTimers()
 

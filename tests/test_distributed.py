@@ -1,0 +1,106 @@
+"""Multi-host utilities (parallel/distributed.py) on the 8-device CPU mesh.
+
+Real DCN needs real multi-host hardware; what is testable here is the
+contract: bootstrap no-op safety and idempotence, hybrid-mesh axis
+order/shapes (single-slice branch), error paths, and host-data
+distribution producing correctly sharded global arrays.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distributed_neural_network_tpu.parallel import distributed as dist
+
+
+def test_initialize_single_process_noop(n_devices, monkeypatch):
+    for v in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES", "JAX_PROCESS_ID"):
+        monkeypatch.delenv(v, raising=False)
+    assert dist.initialize() is False
+    assert dist.initialize() is False  # idempotent
+    assert jax.process_count() == 1
+
+
+def test_hybrid_mesh_axis_order(n_devices):
+    mesh = dist.create_hybrid_mesh({"seq": 2, "model": 2}, {"data": 2})
+    assert mesh.axis_names == ("data", "seq", "model")
+    assert dict(mesh.shape) == {"data": 2, "seq": 2, "model": 2}
+    # DCN axis outermost: adjacent devices differ along the innermost axis
+    flat = np.asarray(jax.devices()[:8]).reshape(2, 2, 2)
+    assert (np.asarray(mesh.devices) == flat).all()
+
+
+def test_hybrid_mesh_single_slice_default(n_devices):
+    mesh = dist.create_hybrid_mesh({"data": 8})
+    assert mesh.axis_names == ("data",)
+    assert mesh.shape["data"] == 8
+
+
+def test_hybrid_mesh_errors(n_devices):
+    with pytest.raises(ValueError, match="needs 16 devices"):
+        dist.create_hybrid_mesh({"data": 16})
+    with pytest.raises(ValueError, match="positive"):
+        dist.create_hybrid_mesh({"data": 0})
+
+
+class _StubDev:
+    def __init__(self, i, slice_index):
+        self.id = i
+        self.slice_index = slice_index
+
+    def __repr__(self):
+        return f"d{self.id}@s{self.slice_index}"
+
+
+def test_hybrid_device_array_groups_by_slice():
+    """Multislice: each dcn position is exactly one slice, ici axes stay
+    inside a slice - the property the mesh docstring promises."""
+    devs = [_StubDev(i, i // 4) for i in range(8)]
+    arr = dist._hybrid_device_array(devs, (2,), (2, 2))
+    assert arr.shape == (2, 2, 2)
+    for dcn_i in range(2):
+        slices = {d.slice_index for d in arr[dcn_i].ravel()}
+        assert slices == {dcn_i}, arr
+
+
+def test_hybrid_device_array_slice_count_mismatch():
+    devs = [_StubDev(i, i // 4) for i in range(8)]  # 2 slices
+    with pytest.raises(ValueError, match="slice count"):
+        dist._hybrid_device_array(devs, (1,), (2, 4))  # dcn=1 != 2 slices
+
+
+def test_hybrid_device_array_uneven_slices():
+    devs = [_StubDev(i, 0 if i < 5 else 1) for i in range(8)]
+    with pytest.raises(ValueError, match="uneven slices"):
+        dist._hybrid_device_array(devs, (2,), (2, 2))
+
+
+def test_initialize_missing_process_id(monkeypatch):
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "127.0.0.1:1234")
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "4")
+    monkeypatch.delenv("JAX_PROCESS_ID", raising=False)
+    with pytest.raises(ValueError, match="JAX_PROCESS_ID"):
+        dist.initialize()
+
+
+def test_distribute_host_data_shards_rows(n_devices):
+    mesh = dist.create_hybrid_mesh({"data": 8})
+    x = np.arange(32, dtype=np.float32).reshape(16, 2)
+    arr = dist.distribute_host_data(x, mesh, P("data"))
+    assert arr.shape == (16, 2)
+    assert len(arr.sharding.device_set) == 8
+    np.testing.assert_array_equal(np.asarray(arr), x)
+    # each device holds a contiguous 2-row shard
+    shard = next(iter(arr.addressable_shards))
+    assert shard.data.shape == (2, 2)
+
+
+def test_distribute_then_compute(n_devices):
+    """The distributed array feeds a sharded computation end to end."""
+    mesh = dist.create_hybrid_mesh({"data": 8})
+    x = np.ones((8, 4), np.float32)
+    arr = dist.distribute_host_data(x, mesh, P("data"))
+    out = jax.jit(lambda a: (a * 2).sum())(arr)
+    assert float(out) == 64.0
