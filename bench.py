@@ -47,64 +47,27 @@ def main() -> None:
 
     honor_platform_env()
 
-    import jax
+    from distributed_neural_network_tpu.train.measure import measure_dp_training
 
-    from distributed_neural_network_tpu.data.cifar10 import load_split
-    from distributed_neural_network_tpu.train.engine import Engine, TrainConfig
-    from distributed_neural_network_tpu.utils import timers as T
-
-    n = args.nb_proc or jax.device_count()
-    train_split = load_split(True, source=args.data, synthetic_size=args.synthetic_size)
-    test_split = load_split(
-        False,
-        source=args.data,
-        synthetic_size=max(1, args.synthetic_size // 5)
-        if args.synthetic_size
-        else None,
-    )
-    cfg = TrainConfig(
+    r = measure_dp_training(
+        nb_proc=args.nb_proc,
         batch_size=args.batch_size,
         epochs=args.epochs,
-        nb_proc=n,
-        regime="data_parallel",
+        data=args.data,
+        synthetic_size=args.synthetic_size,
         sync_mode=args.sync_mode,
         compute_dtype=args.compute_dtype,
         kernels=args.kernels,
+        fused=args.fused,
     )
-    timers = T.PhaseTimers()
-    engine = Engine(cfg, train_split, test_split)
-    # warm-up outside the timed region: XLA compilation is a one-time cost
-    # (cached for the measured run), not a training-throughput cost;
-    # reset_state() then rewinds params so the measured run trains exactly
-    # cfg.epochs epochs from the same init
-    if args.fused:
-        # fused fast path: the whole run is ONE dispatch (train + sync for
-        # all epochs); eval once at the end, outside the timed train region -
-        # mirroring the reference metric, whose 1642 s is child training time
-        # with eval accounted separately on the parent. compile_span AOT-warms
-        # without a throwaway training run.
-        engine.compile_span(cfg.epochs, eval_inside=False)
-        engine.run_span(0, cfg.epochs, eval_inside=False, timers=timers)
-        vl, va = engine._eval_fn(
-            engine.params, engine.test_images, engine.test_labels, engine.test_weights
-        )
-        final = engine.history[-1]
-        final.val_loss, final.val_acc = float(vl), float(va)
-    else:
-        engine.run_epoch(0, timers=T.PhaseTimers())
-        engine.reset_state()
-        for epoch in range(cfg.epochs):
-            engine.run_epoch(epoch, timers=timers)
-        final = engine.history[-1]
-
-    train_s = timers.get(T.TRAINING) + timers.get(T.COMMUNICATION)
+    train_s = r["train_s"]
     print(
         json.dumps(
             {
                 "metric": (
-                    f"cifar10_dp_train_s_{cfg.epochs}ep_bs{cfg.batch_size}"
-                    f"_dev{n}_{train_split.source}"
-                    f"_acc{final.val_acc:.2f}"
+                    f"cifar10_dp_train_s_{r['epochs']}ep_bs{r['batch_size']}"
+                    f"_dev{r['devices']}_{r['source']}"
+                    f"_acc{r['val_acc']:.2f}"
                 ),
                 "value": round(train_s, 3),
                 "unit": "s",

@@ -1,0 +1,64 @@
+"""Host-streaming input pipeline for datasets larger than device HBM.
+
+The framework's default data path uploads the whole normalized split to HBM
+once and batches on device (`pipeline.py`) - ideal for CIFAR-scale data.
+When the dataset does not fit in HBM (or should stay uint8 in host RAM at
+1/4 the footprint), this module streams instead: the split is kept as raw
+uint8 on the host and each batch is assembled by the native fused
+gather+convert+normalize kernel (`native.gather_normalize_u8`, C++
+multithreaded; numpy fallback) and shipped to the device(s) per step.
+
+This is the moral equivalent of the reference's torch DataLoader loop
+(`data_parallelism_train.py:73-79`: shuffle + batch + normalize on the
+host, copy per batch), rebuilt with a fused native kernel and jax
+device_put against a mesh sharding instead of pickle sends.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import native
+from .pipeline import plan_shape
+
+
+class HostStream:
+    """Shuffled host-side batch stream over a uint8 image split.
+
+    images_u8: (N, ...) uint8, labels: (N,) int. Each epoch yields
+    (images_f32, labels, weight) batches of exactly batch_size rows - the
+    final partial batch is padded with repeated row 0 and masked by weight
+    0, matching the on-device plan semantics (`pipeline.py`).
+    """
+
+    def __init__(self, images_u8, labels, batch_size: int, *,
+                 mean: float = 0.5, std: float = 0.5, seed: int = 0):
+        self.images = np.ascontiguousarray(images_u8)
+        if self.images.dtype != np.uint8:
+            raise TypeError(
+                f"HostStream keeps the split as uint8; got {self.images.dtype}"
+            )
+        self.labels = np.asarray(labels)
+        if len(self.images) != len(self.labels):
+            raise ValueError(
+                f"{len(self.images)} images vs {len(self.labels)} labels"
+            )
+        self.batch_size = batch_size
+        self.mean, self.std = mean, std
+        self._rng = np.random.default_rng(seed)
+        self.steps, _ = plan_shape(len(self.images), batch_size)
+
+    def epoch(self, *, shuffle: bool = True):
+        """Yield (images (B,...) f32 normalized, labels (B,), w (B,) f32)."""
+        n, bs = len(self.images), self.batch_size
+        order = self._rng.permutation(n) if shuffle else np.arange(n)
+        for step in range(self.steps):
+            idx = order[step * bs:(step + 1) * bs]
+            w = np.ones(bs, np.float32)
+            if len(idx) < bs:
+                w[len(idx):] = 0.0
+                idx = np.concatenate([idx, np.zeros(bs - len(idx), np.int64)])
+            x = native.gather_normalize_u8(
+                self.images, idx, self.mean, self.std
+            )
+            yield x, self.labels[idx].astype(np.int32), w

@@ -63,7 +63,18 @@ def initialize(
     addr = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
     num = num_processes if num_processes is not None else _env_int("JAX_NUM_PROCESSES")
     pid = process_id if process_id is not None else _env_int("JAX_PROCESS_ID")
-    if addr is None or num is None or num <= 1:
+    if addr is None:
+        return False
+    # a coordinator address means the operator intends multi-host: partial
+    # config must fail loudly, not silently degrade to N independent runs
+    if num is None:
+        raise ValueError(
+            "JAX_COORDINATOR_ADDRESS is set but JAX_NUM_PROCESSES is not; "
+            "set it to the total host count"
+        )
+    if num <= 0:
+        raise ValueError(f"JAX_NUM_PROCESSES must be positive, got {num}")
+    if num == 1:
         return False
     if pid is None:
         raise ValueError(
@@ -122,7 +133,7 @@ def create_hybrid_mesh(
             f"have {len(devs)}"
         )
     arr = _hybrid_device_array(
-        devs[:total], tuple(dcn_axes.values()), tuple(ici_axes.values())
+        devs, tuple(dcn_axes.values()), tuple(ici_axes.values())
     )
     return Mesh(arr, names)
 
@@ -130,47 +141,56 @@ def create_hybrid_mesh(
 def _hybrid_device_array(devices, dcn_sizes: tuple, ici_sizes: tuple) -> np.ndarray:
     """(*dcn, *ici)-shaped device array with slice boundaries on dcn axes.
 
-    Multislice: devices are grouped by `slice_index` and each slice fills
-    one dcn position, so every dcn-axis hop crosses DCN and every ici-axis
-    hop stays inside a slice. Single slice (or CPU): the flat device order
+    Multislice: devices are grouped by `slice_index`, the first dcn-total
+    slices each contribute their first ici-total devices, so every dcn-axis
+    hop crosses DCN and every ici-axis hop stays inside a slice. Selection
+    happens per-slice (never by truncating the flat list, which would pull
+    an uneven mix of slices). Single slice (or CPU): the flat device order
     is used. Pure numpy over device objects - unit-testable with stubs.
     """
-    n_slices = len({getattr(d, "slice_index", 0) for d in devices})
     dcn_total = int(np.prod(dcn_sizes)) if dcn_sizes else 1
+    ici_total = int(np.prod(ici_sizes)) if ici_sizes else 1
     shape = (*dcn_sizes, *ici_sizes)
-    if n_slices <= 1 or dcn_total != n_slices:
-        if n_slices > 1:
-            raise ValueError(
-                f"{n_slices} slices present but dcn axes {dcn_sizes} "
-                f"multiply to {dcn_total}; the dcn axes must exactly cover "
-                "the slice count so per-step collectives stay on ICI"
-            )
-        return np.asarray(devices).reshape(shape)
     groups: dict[int, list] = {}
     for d in devices:
         groups.setdefault(getattr(d, "slice_index", 0), []).append(d)
-    per = len(devices) // n_slices
+    if len(groups) <= 1:
+        return np.asarray(devices[: dcn_total * ici_total]).reshape(shape)
+    if len(groups) < dcn_total:
+        raise ValueError(
+            f"dcn axes {dcn_sizes} need {dcn_total} slices but only "
+            f"{len(groups)} are present (slice count mismatch)"
+        )
     ordered = []
-    for si in sorted(groups):
+    for si in sorted(groups)[:dcn_total]:
         g = groups[si]
-        if len(g) != per:
+        if len(g) < ici_total:
             raise ValueError(
-                f"slice {si} has {len(g)} devices, expected {per} "
-                "(uneven slices cannot form a regular dcn x ici mesh)"
+                f"slice {si} has {len(g)} devices, ici axes {ici_sizes} "
+                f"need {ici_total} (uneven slices cannot form this mesh)"
             )
-        ordered.append(np.asarray(g).reshape(ici_sizes))
+        ordered.append(np.asarray(g[:ici_total]).reshape(ici_sizes))
     return np.stack(ordered).reshape(shape)
 
 
-def distribute_host_data(local_batch, mesh: Mesh, spec: P):
-    """Assemble the global sharded array from each host's local shard.
+def distribute_host_data(host_array, mesh: Mesh, spec: P, *, full_copy: bool = True):
+    """Place host data onto a (possibly multi-host) mesh sharding.
 
-    local_batch: numpy array holding THIS process's rows. Single-process
-    this is just device_put with the sharding; multi-process it stitches
-    the per-host shards into one global jax.Array without any host ever
-    materializing the full batch.
+    Single-process: plain device_put. Multi-process with
+    `full_copy=True` (the engine's mode - every host loaded the whole
+    split): each host uploads only the pieces addressable to it, sliced
+    from its full copy via `jax.make_array_from_callback`. With
+    `full_copy=False`, `host_array` is this process's local rows only and
+    the global array is stitched with
+    `jax.make_array_from_process_local_data` - no host ever materializes
+    the full batch (the >HBM streaming mode).
     """
     sharding = NamedSharding(mesh, spec)
     if jax.process_count() == 1:
-        return jax.device_put(local_batch, sharding)
-    return jax.make_array_from_process_local_data(sharding, local_batch)
+        return jax.device_put(host_array, sharding)
+    if full_copy:
+        host_array = np.asarray(host_array)
+        return jax.make_array_from_callback(
+            host_array.shape, sharding, lambda idx: host_array[idx]
+        )
+    return jax.make_array_from_process_local_data(sharding, host_array)

@@ -55,6 +55,7 @@ from ..parallel.collectives import (
     pvary_tree,
     weighted_mean_scalar,
 )
+from ..parallel.distributed import distribute_host_data
 from ..parallel.fault import epoch_key, live_mask, straggler_sleep
 from ..parallel.mesh import DATA_AXIS, create_mesh
 from ..parallel.partition import shard_size
@@ -153,13 +154,17 @@ class Engine:
                 )
             imgs = train_split.images[: n * p]
             labels = train_split.labels[: n * p]
-            self.train_images = jax.device_put(imgs, self._shard)
-            self.train_labels = jax.device_put(labels, self._shard)
+            self.train_images = distribute_host_data(imgs, self.mesh, P(DATA_AXIS))
+            self.train_labels = distribute_host_data(labels, self.mesh, P(DATA_AXIS))
             self.local_train_rows = p
             self._train_data_spec = P(DATA_AXIS)
         else:  # single / replication: every device sees the full dataset
-            self.train_images = jax.device_put(train_split.images, self._repl)
-            self.train_labels = jax.device_put(train_split.labels, self._repl)
+            self.train_images = distribute_host_data(
+                train_split.images, self.mesh, P()
+            )
+            self.train_labels = distribute_host_data(
+                train_split.labels, self.mesh, P()
+            )
             self.local_train_rows = len(train_split)
             self._train_data_spec = P()
 
@@ -175,9 +180,9 @@ class Engine:
             weights = np.concatenate(
                 [np.ones(total, np.float32), np.zeros(pad, np.float32)]
             )
-            self.test_images = jax.device_put(imgs, self._shard)
-            self.test_labels = jax.device_put(labels, self._shard)
-            self.test_weights = jax.device_put(weights, self._shard)
+            self.test_images = distribute_host_data(imgs, self.mesh, P(DATA_AXIS))
+            self.test_labels = distribute_host_data(labels, self.mesh, P(DATA_AXIS))
+            self.test_weights = distribute_host_data(weights, self.mesh, P(DATA_AXIS))
             self.local_test_rows = q
         else:
             self.test_images = None

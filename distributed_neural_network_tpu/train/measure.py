@@ -1,0 +1,76 @@
+"""Shared benchmark harness for bench.py and report.py.
+
+One implementation of "train the data-parallel CIFAR workload and time the
+train+sync phases" so the two entry points cannot drift: split loading,
+warm-up policy, the fused-span fast path with its outside-the-timer final
+eval (mirroring the reference's child train-time metric, which excludes the
+parent's eval - SURVEY.md section 6), and the phase accounting.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ..data.cifar10 import load_split
+from ..utils import timers as T
+from .engine import Engine, TrainConfig
+
+
+def measure_dp_training(
+    *,
+    nb_proc: int | None = None,
+    batch_size: int = 16,
+    epochs: int = 25,
+    data: str = "auto",
+    synthetic_size: int | None = None,
+    sync_mode: str = "epoch",
+    compute_dtype: str = "float32",
+    kernels: str = "xla",
+    fused: bool = True,
+) -> dict:
+    """Run the data-parallel regime and return measured results.
+
+    Returns {devices, batch_size, epochs, val_acc, val_loss, train_s,
+    source}. train_s = training + parameter-sync wall-clock (compile time
+    excluded via AOT warm-up; eval outside), the reference-comparable
+    metric.
+    """
+    n = min(nb_proc, jax.device_count()) if nb_proc else jax.device_count()
+    train_split = load_split(True, source=data, synthetic_size=synthetic_size)
+    test_split = load_split(
+        False, source=data,
+        synthetic_size=max(1, synthetic_size // 5) if synthetic_size else None,
+    )
+    cfg = TrainConfig(
+        batch_size=batch_size, epochs=epochs, nb_proc=n,
+        regime="data_parallel", sync_mode=sync_mode,
+        compute_dtype=compute_dtype, kernels=kernels,
+    )
+    timers = T.PhaseTimers()
+    engine = Engine(cfg, train_split, test_split)
+    if fused:
+        # one dispatch for the whole run; AOT compile, then measure
+        engine.compile_span(epochs, eval_inside=False)
+        engine.run_span(0, epochs, eval_inside=False, timers=timers)
+        vl, va = engine._eval_fn(
+            engine.params, engine.test_images, engine.test_labels,
+            engine.test_weights,
+        )
+        final = engine.history[-1]
+        final.val_loss, final.val_acc = float(vl), float(va)
+    else:
+        # per-epoch dispatch: warm up one epoch, rewind, measure
+        engine.run_epoch(0, timers=T.PhaseTimers())
+        engine.reset_state()
+        for epoch in range(epochs):
+            engine.run_epoch(epoch, timers=timers)
+        final = engine.history[-1]
+    return {
+        "devices": n,
+        "batch_size": batch_size,
+        "epochs": epochs,
+        "val_acc": final.val_acc,
+        "val_loss": final.val_loss,
+        "train_s": timers.get(T.TRAINING) + timers.get(T.COMMUNICATION),
+        "source": train_split.source,
+    }

@@ -65,10 +65,10 @@ def test_hybrid_device_array_groups_by_slice():
         assert slices == {dcn_i}, arr
 
 
-def test_hybrid_device_array_slice_count_mismatch():
+def test_hybrid_device_array_too_few_slices():
     devs = [_StubDev(i, i // 4) for i in range(8)]  # 2 slices
-    with pytest.raises(ValueError, match="slice count"):
-        dist._hybrid_device_array(devs, (1,), (2, 4))  # dcn=1 != 2 slices
+    with pytest.raises(ValueError, match="slice count mismatch"):
+        dist._hybrid_device_array(devs, (3,), (2,))  # dcn=3 > 2 slices
 
 
 def test_hybrid_device_array_uneven_slices():
@@ -77,11 +77,31 @@ def test_hybrid_device_array_uneven_slices():
         dist._hybrid_device_array(devs, (2,), (2, 2))
 
 
+def test_hybrid_device_array_partial_devices_selected_per_slice():
+    """Using fewer than all devices still picks per-slice, never by flat
+    truncation (which would land both dcn positions inside slice 0)."""
+    devs = [_StubDev(i, i // 4) for i in range(8)]  # 2 slices x 4
+    arr = dist._hybrid_device_array(devs, (2,), (2,))
+    assert arr.shape == (2, 2)
+    assert {d.slice_index for d in arr[0]} == {0}
+    assert {d.slice_index for d in arr[1]} == {1}
+
+
 def test_initialize_missing_process_id(monkeypatch):
     monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "127.0.0.1:1234")
     monkeypatch.setenv("JAX_NUM_PROCESSES", "4")
     monkeypatch.delenv("JAX_PROCESS_ID", raising=False)
     with pytest.raises(ValueError, match="JAX_PROCESS_ID"):
+        dist.initialize()
+
+
+def test_initialize_missing_num_processes(monkeypatch):
+    """Address set but host count missing must fail loudly, not silently
+    run N independent single-host jobs."""
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "127.0.0.1:1234")
+    monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
+    monkeypatch.setenv("JAX_PROCESS_ID", "0")
+    with pytest.raises(ValueError, match="JAX_NUM_PROCESSES"):
         dist.initialize()
 
 
