@@ -45,9 +45,13 @@ TEST_SIZE = 10_000
 
 @dataclass(frozen=True)
 class Split:
-    """One split as host numpy arrays (images normalized float32 NHWC)."""
+    """One split as host numpy arrays, NHWC.
 
-    images: np.ndarray  # (N, 32, 32, 3) float32 in [-1, 1]
+    images are normalized float32 in [-1, 1] by default; with
+    `load_split(normalize_images=False)` they stay raw uint8 (the
+    host-streaming mode's storage form)."""
+
+    images: np.ndarray  # (N, 32, 32, 3) float32 in [-1, 1] (or uint8 raw)
     labels: np.ndarray  # (N,) int32
     source: str  # "pickle", "npz", or "synthetic"
 
@@ -70,6 +74,18 @@ def normalize(images_u8: np.ndarray) -> np.ndarray:
         return native.normalize_u8(images_u8, CIFAR10_MEAN, CIFAR10_STD)
     x = images_u8.astype(np.float32) / 255.0
     return (x - CIFAR10_MEAN) / CIFAR10_STD
+
+
+def _load_pickle_batches_u8(batch_dir: str, train: bool):
+    """Decode python batches to raw uint8 NHWC (streaming-mode storage)."""
+    names = [f"data_batch_{i}" for i in range(1, 6)] if train else ["test_batch"]
+    imgs, labels = [], []
+    for name in names:
+        with open(os.path.join(batch_dir, name), "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        imgs.append(d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
+        labels.append(np.asarray(d[b"labels"], dtype=np.int32))
+    return np.ascontiguousarray(np.concatenate(imgs)), np.concatenate(labels)
 
 
 def _load_pickle_batches_normalized(batch_dir: str, train: bool):
@@ -134,18 +150,25 @@ def load_split(
     source: str = "auto",
     synthetic_size: int | None = None,
     seed: int = 0,
+    normalize_images: bool = True,
 ) -> Split:
     """Load one CIFAR-10 split.
 
     source: "auto" (real data if present, else synthetic), "pickle", "npz",
-    or "synthetic".
+    or "synthetic". `normalize_images=False` keeps uint8 pixel data where
+    the source provides it (the host-streaming input mode normalizes
+    per-batch in the native kernel, and u8 host storage is 1/4 the RAM);
+    float-typed npz sources are normalized regardless.
     """
     root = root or default_root()
     if source in ("auto", "pickle"):
         _maybe_extract_tarball(root) if os.path.isdir(root) else None
         batch_dir = os.path.join(root, "cifar-10-batches-py")
         if os.path.isdir(batch_dir):
-            x, y = _load_pickle_batches_normalized(batch_dir, train)
+            if normalize_images:
+                x, y = _load_pickle_batches_normalized(batch_dir, train)
+            else:
+                x, y = _load_pickle_batches_u8(batch_dir, train)
             return Split(x, y, "pickle")
         if source == "pickle":
             raise FileNotFoundError(f"no cifar-10-batches-py under {root}")
@@ -155,10 +178,12 @@ def load_split(
             d = np.load(npz)
             x = d["x_train"] if train else d["x_test"]
             y = d["y_train"] if train else d["y_test"]
-            return Split(normalize(x), y.reshape(-1).astype(np.int32), "npz")
+            if normalize_images or x.dtype != np.uint8:
+                x = normalize(x)
+            return Split(x, y.reshape(-1).astype(np.int32), "npz")
         if source == "npz":
             raise FileNotFoundError(f"no cifar10.npz under {root}")
     # synthetic fallback
     n = synthetic_size or (TRAIN_SIZE if train else TEST_SIZE)
     x, y = make_synthetic(n, seed=seed, train=train)
-    return Split(normalize(x), y, "synthetic")
+    return Split(normalize(x) if normalize_images else x, y, "synthetic")

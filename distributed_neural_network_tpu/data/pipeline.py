@@ -57,5 +57,15 @@ def _pad_and_reshape(order: jax.Array, n_rows: int, steps: int, bs: int):
 
 
 def gather_batch(images: jax.Array, labels: jax.Array, idx: jax.Array):
-    """Form one batch on device by row gather (jnp.take along axis 0)."""
-    return jnp.take(images, idx, axis=0), jnp.take(labels, idx, axis=0)
+    """Form one batch on device by row gather (jnp.take along axis 0).
+
+    The optimization barrier pins the layout boundary at the *batch*: without
+    it, XLA's layout assignment hoists the conv-friendly relayout of the
+    gather operand out of the epoch scan and materializes the ENTIRE dataset
+    in conv layout - which pads the channel dim 3->128 on TPU (42x memory,
+    e.g. 26 GB for CIFAR-10 train at batch_size 1, an HBM OOM at compile
+    time). With the barrier, only the (batch, ...) slice is relaid per step.
+    """
+    x = jnp.take(images, idx, axis=0)
+    y = jnp.take(labels, idx, axis=0)
+    return jax.lax.optimization_barrier((x, y))

@@ -23,20 +23,23 @@ from .pipeline import plan_shape
 
 
 class HostStream:
-    """Shuffled host-side batch stream over a uint8 image split.
+    """Shuffled host-side batch stream over an image split.
 
-    images_u8: (N, ...) uint8, labels: (N,) int. Each epoch yields
+    images: (N, ...) uint8 (the preferred form - 1/4 host RAM, per-batch
+    fused native gather+normalize) or float32 already normalized (plain
+    gather passthrough). labels: (N,) int. Each epoch yields
     (images_f32, labels, weight) batches of exactly batch_size rows - the
     final partial batch is padded with repeated row 0 and masked by weight
     0, matching the on-device plan semantics (`pipeline.py`).
     """
 
-    def __init__(self, images_u8, labels, batch_size: int, *,
+    def __init__(self, images, labels, batch_size: int, *,
                  mean: float = 0.5, std: float = 0.5, seed: int = 0):
-        self.images = np.ascontiguousarray(images_u8)
-        if self.images.dtype != np.uint8:
+        self.images = np.ascontiguousarray(images)
+        if self.images.dtype not in (np.uint8, np.float32):
             raise TypeError(
-                f"HostStream keeps the split as uint8; got {self.images.dtype}"
+                f"HostStream takes uint8 (raw) or float32 (pre-normalized) "
+                f"images; got {self.images.dtype}"
             )
         self.labels = np.asarray(labels)
         if len(self.images) != len(self.labels):
@@ -58,7 +61,10 @@ class HostStream:
             if len(idx) < bs:
                 w[len(idx):] = 0.0
                 idx = np.concatenate([idx, np.zeros(bs - len(idx), np.int64)])
-            x = native.gather_normalize_u8(
-                self.images, idx, self.mean, self.std
-            )
+            if self.images.dtype == np.uint8:
+                x = native.gather_normalize_u8(
+                    self.images, idx, self.mean, self.std
+                )
+            else:  # pre-normalized float32: gather only
+                x = self.images[idx]
             yield x, self.labels[idx].astype(np.int32), w

@@ -64,9 +64,15 @@ def initialize(
     num = num_processes if num_processes is not None else _env_int("JAX_NUM_PROCESSES")
     pid = process_id if process_id is not None else _env_int("JAX_PROCESS_ID")
     if addr is None:
+        # partial config must fail loudly, not silently degrade to N
+        # independent runs - from either direction
+        if num is not None and num > 1:
+            raise ValueError(
+                f"JAX_NUM_PROCESSES={num} is set but "
+                "JAX_COORDINATOR_ADDRESS is not; set it to host0:port"
+            )
         return False
-    # a coordinator address means the operator intends multi-host: partial
-    # config must fail loudly, not silently degrade to N independent runs
+    # a coordinator address means the operator intends multi-host
     if num is None:
         raise ValueError(
             "JAX_COORDINATOR_ADDRESS is set but JAX_NUM_PROCESSES is not; "
@@ -141,12 +147,14 @@ def create_hybrid_mesh(
 def _hybrid_device_array(devices, dcn_sizes: tuple, ici_sizes: tuple) -> np.ndarray:
     """(*dcn, *ici)-shaped device array with slice boundaries on dcn axes.
 
-    Multislice: devices are grouped by `slice_index`, the first dcn-total
-    slices each contribute their first ici-total devices, so every dcn-axis
-    hop crosses DCN and every ici-axis hop stays inside a slice. Selection
-    happens per-slice (never by truncating the flat list, which would pull
-    an uneven mix of slices). Single slice (or CPU): the flat device order
-    is used. Pure numpy over device objects - unit-testable with stubs.
+    Multislice: devices are grouped by `slice_index`; the dcn axes must
+    exactly cover the slice count, and each slice contributes its first
+    ici-total devices - so every dcn-axis hop crosses DCN and every
+    ici-axis hop stays inside a slice. Device selection happens per-slice
+    (never by truncating the flat list, which would pull an uneven mix of
+    slices); using a *subset* of slices requires an explicit `devices=`.
+    Single slice (or CPU): the flat device order is used. Pure numpy over
+    device objects - unit-testable with stubs.
     """
     dcn_total = int(np.prod(dcn_sizes)) if dcn_sizes else 1
     ici_total = int(np.prod(ici_sizes)) if ici_sizes else 1
@@ -156,13 +164,15 @@ def _hybrid_device_array(devices, dcn_sizes: tuple, ici_sizes: tuple) -> np.ndar
         groups.setdefault(getattr(d, "slice_index", 0), []).append(d)
     if len(groups) <= 1:
         return np.asarray(devices[: dcn_total * ici_total]).reshape(shape)
-    if len(groups) < dcn_total:
+    if len(groups) != dcn_total:
         raise ValueError(
-            f"dcn axes {dcn_sizes} need {dcn_total} slices but only "
-            f"{len(groups)} are present (slice count mismatch)"
+            f"dcn axes {dcn_sizes} multiply to {dcn_total} but "
+            f"{len(groups)} slices are present (slice count mismatch): the "
+            "dcn axes must exactly cover the slices, or pass an explicit "
+            "`devices=` subset to deliberately leave slices idle"
         )
     ordered = []
-    for si in sorted(groups)[:dcn_total]:
+    for si in sorted(groups):
         g = groups[si]
         if len(g) < ici_total:
             raise ValueError(

@@ -42,6 +42,15 @@ def add_common_flags(p: argparse.ArgumentParser, *, epochs: int, batch_size: int
         action="store_true",
         help="keep momentum across epochs (reference re-creates SGD per epoch)",
     )
+    p.add_argument(
+        "--input-mode",
+        choices=("hbm", "stream"),
+        default="hbm",
+        help="hbm = dataset uploaded to device memory once, whole epochs "
+        "compiled (default); stream = dataset stays in host RAM (uint8), "
+        "batches assembled per step by the native C++ kernel - for "
+        "datasets larger than HBM",
+    )
     p.add_argument("--data", choices=("auto", "pickle", "npz", "synthetic"), default="auto")
     p.add_argument("--data-root", default=None, help="dataset dir (default ./data)")
     p.add_argument(
@@ -145,6 +154,7 @@ def config_from_args(args, regime: str) -> TrainConfig:
         compute_dtype=args.compute_dtype,
         kernels=getattr(args, "kernels", "xla"),
         reference_compat=getattr(args, "reference_compat", False),
+        input_mode=getattr(args, "input_mode", "hbm"),
     )
 
 
@@ -187,6 +197,9 @@ def run_training(args, regime: str, *, log=print) -> Engine:
             source=args.data,
             seed=args.seed,
             synthetic_size=syn,
+            # streaming keeps the train split as uint8 in host RAM; the
+            # native kernel normalizes per batch
+            normalize_images=cfg.input_mode != "stream",
         )
         test_split = load_split(
             False,

@@ -49,7 +49,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..data.cifar10 import Split
 from ..models.cnn import Network
-from ..ops.train import make_eval_epoch, make_train_epoch
+from ..ops.sgd import sgd_step
+from ..ops.train import make_batch_loss, make_eval_epoch, make_train_epoch
 from ..parallel.collectives import (
     masked_pmean_tree,
     pvary_tree,
@@ -84,6 +85,11 @@ class TrainConfig:
     compute_dtype: str = "float32"  # "bfloat16" for MXU-native mixed precision
     kernels: str = "xla"  # "pallas" = fused Pallas classifier head
     reference_compat: bool = False  # True: N-1 workers as in the reference
+    # "hbm": whole split uploaded once, epochs fully on-device (default).
+    # "stream": split stays in host RAM (uint8 when the source allows),
+    # batches assembled per step by the native gather+normalize kernel and
+    # shipped to the mesh - for datasets larger than HBM (data/stream.py).
+    input_mode: str = "hbm"
 
     def __post_init__(self):
         if self.regime not in REGIMES:
@@ -94,6 +100,10 @@ class TrainConfig:
             )
         if self.kernels not in ("xla", "pallas"):
             raise ValueError(f"kernels must be 'xla' or 'pallas', got {self.kernels}")
+        if self.input_mode not in ("hbm", "stream"):
+            raise ValueError(
+                f"input_mode must be 'hbm' or 'stream', got {self.input_mode}"
+            )
 
 
 @dataclass
@@ -145,6 +155,27 @@ class Engine:
 
     def _place_data(self, train_split: Split, test_split: Split | None):
         c, n = self.config, self.n_workers
+        if c.input_mode == "stream":
+            # train data stays in host RAM (uint8 if the loader kept it);
+            # per-device row ranges mirror the hbm placement exactly
+            if c.regime == "data_parallel":
+                p = shard_size(len(train_split), n)
+                if p < 1:
+                    raise ValueError(
+                        f"{len(train_split)} rows cannot shard over {n} devices"
+                    )
+                bounds = [(d * p, (d + 1) * p) for d in range(n)]
+                self.local_train_rows = p
+                self._train_data_spec = P(DATA_AXIS)
+            else:
+                bounds = [(0, len(train_split))] * n
+                self.local_train_rows = len(train_split)
+                self._train_data_spec = P()
+            self._host_train = (train_split.images, train_split.labels, bounds)
+            self.train_images = self.train_labels = None
+            self._place_test(test_split)
+            return
+        self._host_train = None
         if c.regime == "data_parallel":
             # contiguous 1/N shards, remainder dropped (partition.py parity)
             p = shard_size(len(train_split), n)
@@ -167,7 +198,10 @@ class Engine:
             )
             self.local_train_rows = len(train_split)
             self._train_data_spec = P()
+        self._place_test(test_split)
 
+    def _place_test(self, test_split: Split | None):
+        n = self.n_workers
         if test_split is not None:
             # pad to equal per-device sizes; padded rows carry weight 0
             total = len(test_split)
@@ -282,6 +316,41 @@ class Engine:
                 out_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
             ),
             donate_argnums=(1,),
+        )
+
+        # streaming-mode per-batch step + the replicated->per-device spread
+        batch_loss = make_batch_loss(apply_fn)
+        batch_grad = jax.value_and_grad(batch_loss)
+        step_sync = c.sync_mode == "step"
+
+        def stream_batch_shard(params_stacked, mom, x, y, w):
+            params = jax.tree.map(lambda p: p[0], params_stacked)
+            mom_l = jax.tree.map(lambda m: m[0], mom)
+            loss, grads = batch_grad(params, x, y, w)
+            if step_sync:
+                grads = jax.tree.map(lambda g: jax.lax.pmean(g, DATA_AXIS), grads)
+            params, mom_l = sgd_step(params, mom_l, grads, c.lr, c.momentum)
+            stack = lambda t: jax.tree.map(lambda v: v[None], t)
+            return stack(params), stack(mom_l), loss[None]
+
+        self._stream_fn = jax.jit(
+            jax.shard_map(
+                stream_batch_shard,
+                mesh=mesh,
+                in_specs=(P(DATA_AXIS),) * 5,
+                out_specs=(P(DATA_AXIS),) * 3,
+            ),
+            donate_argnums=(0, 1),
+        )
+
+        def spread_shard(params):
+            params = pvary_tree(params, DATA_AXIS)
+            return jax.tree.map(lambda p: p[None], params)
+
+        self._spread_fn = jax.jit(
+            jax.shard_map(
+                spread_shard, mesh=mesh, in_specs=(P(),), out_specs=P(DATA_AXIS)
+            )
         )
 
         def sync_shard(params_stacked, live, loss_sums, n_batches):
@@ -457,6 +526,11 @@ class Engine:
         compiled executable is stored and used directly by `run_span` -
         benchmarks warm compilation this way instead of paying a full
         throwaway training run."""
+        if self.config.input_mode == "stream":
+            raise ValueError(
+                "fused spans need the dataset resident in HBM; "
+                "input_mode='stream' supports the per-epoch path only"
+            )
         eval_inside = eval_inside and self._local_eval is not None
         key = (span, eval_inside)
         if key in self._span_compiled:
@@ -527,6 +601,55 @@ class Engine:
 
     # ----------------------------------------------------------------- run
 
+    def _stream_epoch(self, epoch: int):
+        """One epoch in host-streaming mode (data/stream.py).
+
+        The split lives in host RAM; each device consumes its own
+        independently shuffled stream over its row range, the n per-device
+        batches are assembled host-side (fused native gather+normalize for
+        uint8 storage) and shipped as one sharded global batch per step.
+        Local-SGD semantics match the hbm path: per-device training with
+        sync only at the epoch edge (or per-step grad pmean in 'step' mode).
+        Returns (params_stacked, loss_sums, n_batches) for `_sync_fn`.
+        """
+        from ..data.stream import HostStream
+
+        c, n = self.config, self.n_workers
+        images, labels, bounds = self._host_train
+        params_stacked = self._spread_fn(self.params)
+        if c.reset_momentum:
+            self.mom = jax.tree.map(jnp.zeros_like, self.mom)
+        streams = [
+            HostStream(
+                images[lo:hi], labels[lo:hi], c.batch_size,
+                seed=(c.seed, epoch, d),
+            )
+            for d, (lo, hi) in enumerate(bounds)
+        ]
+        step_losses = []  # device arrays; converted once after the loop so
+        # the host can assemble/upload batch k+1 while step k executes
+        for batches in zip(*(s.epoch() for s in streams)):
+            x = np.concatenate([b[0] for b in batches])
+            y = np.concatenate([b[1] for b in batches])
+            w = np.concatenate([b[2] for b in batches])
+            params_stacked, self.mom, losses = self._stream_fn(
+                params_stacked,
+                self.mom,
+                distribute_host_data(x, self.mesh, P(DATA_AXIS)),
+                distribute_host_data(y, self.mesh, P(DATA_AXIS)),
+                distribute_host_data(w, self.mesh, P(DATA_AXIS)),
+            )
+            step_losses.append(losses)
+        loss_np = np.sum([np.asarray(v) for v in step_losses], axis=0).astype(
+            np.float32
+        )
+        steps = len(step_losses)
+        loss_sums = distribute_host_data(loss_np, self.mesh, P(DATA_AXIS))
+        n_batches = distribute_host_data(
+            np.full(n, float(steps), np.float32), self.mesh, P(DATA_AXIS)
+        )
+        return params_stacked, loss_sums, n_batches
+
     def run_epoch(
         self, epoch: int, *, timers: T.PhaseTimers | None = None, do_eval: bool = True
     ) -> EpochMetrics:
@@ -540,17 +663,20 @@ class Engine:
         straggler_sleep(mask_host, c.failure_duration)
 
         with timers.phase(T.TRAINING) as t:
-            params_stacked, self.mom, loss_sums, n_batches = self._train_fn(
-                self.params,
-                self.mom,
-                self.train_images,
-                self.train_labels,
-                jnp.uint32(epoch),
-            )
+            if c.input_mode == "stream":
+                params_stacked, loss_sums, n_batches = self._stream_epoch(epoch)
+            else:
+                params_stacked, self.mom, loss_sums, n_batches = self._train_fn(
+                    self.params,
+                    self.mom,
+                    self.train_images,
+                    self.train_labels,
+                    jnp.uint32(epoch),
+                )
             t.value = params_stacked
 
         with timers.phase(T.COMMUNICATION) as t:
-            mask_dev = jax.device_put(mask_host, self._shard)
+            mask_dev = distribute_host_data(mask_host, self.mesh, P(DATA_AXIS))
             self.params, train_loss = self._sync_fn(
                 params_stacked, mask_dev, loss_sums, n_batches
             )
@@ -595,6 +721,12 @@ class Engine:
         phase per epoch - the fast path. Straggler sleeps (`failure_duration`)
         force the per-epoch path, which is the only mode where they can
         interleave with epochs."""
+        if fused and self.config.input_mode == "stream":
+            log(
+                "(fused mode needs HBM-resident data; input_mode=stream "
+                "uses the per-epoch path)"
+            )
+            fused = False
         if fused and self.config.failure_duration > 0:
             log(
                 "(fused mode does not support --failure-duration straggler "

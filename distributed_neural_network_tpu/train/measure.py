@@ -35,7 +35,9 @@ def measure_dp_training(
     excluded via AOT warm-up; eval outside), the reference-comparable
     metric.
     """
-    n = min(nb_proc, jax.device_count()) if nb_proc else jax.device_count()
+    # requested size passes through; the engine rejects infeasible counts
+    # with a clear error rather than silently measuring a smaller mesh
+    n = nb_proc if nb_proc else jax.device_count()
     train_split = load_split(True, source=data, synthetic_size=synthetic_size)
     test_split = load_split(
         False, source=data,

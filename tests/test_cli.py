@@ -135,3 +135,58 @@ def test_dp_checkpoint_resume_and_profile(tmp_path):
     )
     assert "(Resumed from checkpoint: next epoch 2)" in stdout
     assert summary["epochs"] == 4
+
+
+@pytest.mark.parametrize(
+    "extra,mesh",
+    [
+        (("--dp", "2", "--sp", "2", "--tp", "2"), "data2xseq2xmodel2"),
+        (("--pp", "2", "--dp", "2", "--tp", "2", "--n-layers", "2"),
+         "data2xpipe2xmodel2"),
+        (("--dp", "4", "--experts", "4", "--optimizer", "sgd"), "data4"),
+        (("--dp", "8", "--optimizer", "zero"), "data8"),
+    ],
+)
+def test_lm_train_entry_point(tmp_path, extra, mesh):
+    """lm_train.py exposes every parallel axis from the CLI and learns."""
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    args = [
+        sys.executable, os.path.join(REPO, "lm_train.py"),
+        "--steps", "25", "--batch-size", "16", "--seq-len", "16",
+        "--d-model", "32", "--n-heads", "4", "--d-ff", "64",
+        "--vocab", "32", "--lr", "0.3", *extra,
+    ]
+    proc = subprocess.run(
+        args, capture_output=True, text=True, cwd=REPO, env=env, timeout=600
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    summary = json.loads(next(
+        line for line in proc.stdout.splitlines() if line.startswith("SUMMARY ")
+    )[len("SUMMARY "):])
+    assert summary["mesh"] == mesh
+    assert summary["final_loss"] < summary["first_loss"] - 1.0, summary
+
+
+def test_lm_train_rejects_pp_with_sp(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "lm_train.py"),
+         "--pp", "2", "--sp", "2", "--steps", "1"],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert proc.returncode != 0
+    assert "--pp composes with" in proc.stderr
+
+
+def test_dp_stream_input_mode(tmp_path):
+    """--input-mode stream trains from host RAM via the native kernel."""
+    summary, stdout, _ = _run_script(
+        tmp_path, "data_parallelism_train.py",
+        "--nb-proc", "4", "--input-mode", "stream",
+    )
+    assert summary["regime"] == "data_parallel"
+    assert summary["final_val_acc"] is not None
+    assert summary["data_source"] == "synthetic"
