@@ -323,7 +323,7 @@ class Engine:
         batch_grad = jax.value_and_grad(batch_loss)
         step_sync = c.sync_mode == "step"
 
-        def stream_batch_shard(params_stacked, mom, x, y, w):
+        def stream_batch_shard(params_stacked, mom, loss_acc, x, y, w):
             params = jax.tree.map(lambda p: p[0], params_stacked)
             mom_l = jax.tree.map(lambda m: m[0], mom)
             loss, grads = batch_grad(params, x, y, w)
@@ -331,16 +331,19 @@ class Engine:
                 grads = jax.tree.map(lambda g: jax.lax.pmean(g, DATA_AXIS), grads)
             params, mom_l = sgd_step(params, mom_l, grads, c.lr, c.momentum)
             stack = lambda t: jax.tree.map(lambda v: v[None], t)
-            return stack(params), stack(mom_l), loss[None]
+            # loss accumulates ON DEVICE across the epoch's steps: no
+            # per-step host readback (which would also be illegal on
+            # multi-process meshes - the (n,) array spans hosts)
+            return stack(params), stack(mom_l), loss_acc + loss[None]
 
         self._stream_fn = jax.jit(
             jax.shard_map(
                 stream_batch_shard,
                 mesh=mesh,
-                in_specs=(P(DATA_AXIS),) * 5,
+                in_specs=(P(DATA_AXIS),) * 6,
                 out_specs=(P(DATA_AXIS),) * 3,
             ),
-            donate_argnums=(0, 1),
+            donate_argnums=(0, 1, 2),
         )
 
         def spread_shard(params):
@@ -626,25 +629,23 @@ class Engine:
             )
             for d, (lo, hi) in enumerate(bounds)
         ]
-        step_losses = []  # device arrays; converted once after the loop so
-        # the host can assemble/upload batch k+1 while step k executes
+        loss_sums = distribute_host_data(
+            np.zeros(n, np.float32), self.mesh, P(DATA_AXIS)
+        )
+        steps = 0
         for batches in zip(*(s.epoch() for s in streams)):
             x = np.concatenate([b[0] for b in batches])
             y = np.concatenate([b[1] for b in batches])
             w = np.concatenate([b[2] for b in batches])
-            params_stacked, self.mom, losses = self._stream_fn(
+            params_stacked, self.mom, loss_sums = self._stream_fn(
                 params_stacked,
                 self.mom,
+                loss_sums,
                 distribute_host_data(x, self.mesh, P(DATA_AXIS)),
                 distribute_host_data(y, self.mesh, P(DATA_AXIS)),
                 distribute_host_data(w, self.mesh, P(DATA_AXIS)),
             )
-            step_losses.append(losses)
-        loss_np = np.sum([np.asarray(v) for v in step_losses], axis=0).astype(
-            np.float32
-        )
-        steps = len(step_losses)
-        loss_sums = distribute_host_data(loss_np, self.mesh, P(DATA_AXIS))
+            steps += 1
         n_batches = distribute_host_data(
             np.full(n, float(steps), np.float32), self.mesh, P(DATA_AXIS)
         )

@@ -146,6 +146,50 @@ class _NpzBackend:
         pass
 
 
+class TreeCheckpointer:
+    """Save/restore an arbitrary pytree + metadata (same backends).
+
+    The Engine-agnostic sibling of `Checkpointer`, used by the LM trainer
+    (`lm_train.py`): state is any pytree of arrays (params/momentum under
+    whatever mesh sharding), `meta` any JSON-serializable dict. On restore,
+    pass `shardings` (a matching pytree of jax.sharding.Sharding, or None)
+    to re-place leaves onto the run's mesh.
+    """
+
+    def __init__(self, directory: str, *, keep: int = 3, backend: str = "auto"):
+        if backend == "auto":
+            backend = "orbax" if _HAVE_ORBAX else "npz"
+        if backend == "orbax" and not _HAVE_ORBAX:
+            raise RuntimeError("orbax backend requested but orbax is not importable")
+        self.backend_name = backend
+        self._b = (_OrbaxBackend if backend == "orbax" else _NpzBackend)(
+            directory, keep
+        )
+
+    def save(self, step: int, state, meta: dict | None = None) -> None:
+        self._b.save(step, _host_tree(state), meta or {})
+
+    def latest_step(self):
+        return self._b.latest_step()
+
+    def restore_latest(self, template, shardings=None):
+        """(state, meta, step) from the newest checkpoint, or None.
+
+        `template` supplies the tree structure (its leaf values are unused);
+        `shardings` re-places each restored leaf via device_put.
+        """
+        step = self._b.latest_step()
+        if step is None:
+            return None
+        state, meta = self._b.restore(step, template)
+        if shardings is not None:
+            state = jax.tree.map(jax.device_put, state, shardings)
+        return state, meta, step
+
+    def close(self) -> None:
+        self._b.close()
+
+
 class Checkpointer:
     """Save/restore an Engine's sync-boundary state.
 

@@ -53,7 +53,14 @@ def main() -> int:
     p.add_argument("--momentum", type=float, default=0.9)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="save params+momentum every --checkpoint-every steps")
+    p.add_argument("--checkpoint-every", type=int, default=50)
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the latest checkpoint in --checkpoint-dir")
     args = p.parse_args()
+    if args.steps < 1:
+        p.error("--steps must be >= 1")
 
     from distributed_neural_network_tpu.train.cli import honor_platform_env
 
@@ -79,6 +86,8 @@ def main() -> int:
     if args.n_heads % max(args.tp, 1):
         raise SystemExit(f"--n-heads {args.n_heads} must divide by --tp {args.tp}")
 
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
     params = tfm.init_params(jax.random.key(args.seed), cfg)
     pipe = args.pp > 1
     if pipe:
@@ -88,30 +97,66 @@ def main() -> int:
                 "zero run on the dp x sp x tp mesh (drop --pp)"
             )
         mesh = ppl.create_pp_mesh(args.dp, args.pp, args.tp)
-        params, _ = ppl.shard_pp_params(params, cfg, mesh)
+        params, specs = ppl.shard_pp_params(params, cfg, mesh)
         from distributed_neural_network_tpu.ops.sgd import init_momentum
 
         mom = init_momentum(params)
+        mom_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
         step = ppl.make_pp_train_step(
             cfg, mesh, n_microbatches=args.microbatches,
             lr=args.lr, momentum=args.momentum,
         )
     else:
         mesh = lmtrain.create_lm_mesh(args.dp, args.sp, args.tp)
-        params, _ = lmtrain.shard_params(params, cfg, mesh)
+        params, specs = lmtrain.shard_params(params, cfg, mesh)
         mom = lmtrain.init_lm_momentum(params, mesh, args.optimizer)
+        mom_shardings = (
+            NamedSharding(mesh, P(lmtrain.DATA_AXIS))
+            if args.optimizer == "zero"
+            else jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
+        )
         step = lmtrain.make_lm_train_step(
             cfg, mesh, lr=args.lr, momentum=args.momentum,
             attn_impl=args.attn, optimizer=args.optimizer,
         )
+    param_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
+
+    mesh_desc = "x".join(
+        f"{k}{v}" for k, v in mesh.shape.items() if v > 1
+    ) or "single"
+
+    ck = None
+    step0 = 0
+    if args.checkpoint_dir:
+        from distributed_neural_network_tpu.utils.checkpoint import (
+            TreeCheckpointer,
+        )
+
+        ck = TreeCheckpointer(args.checkpoint_dir)
+        if args.resume:
+            restored = ck.restore_latest(
+                {"params": params, "mom": mom},
+                {"params": param_shardings, "mom": mom_shardings},
+            )
+            if restored is not None:
+                state, meta, last = restored
+                for key_, want in (("mesh", mesh_desc),
+                                   ("optimizer", args.optimizer)):
+                    if meta.get(key_) != want:
+                        raise SystemExit(
+                            f"checkpoint was written with {key_}="
+                            f"{meta.get(key_)!r}, this run has {want!r} - "
+                            "momentum/param shards don't map across layouts; "
+                            "resume with the original flags"
+                        )
+                params, mom = state["params"], state["mom"]
+                step0 = last + 1
+                print(f"(Resumed from step {last}; continuing at {step0})")
 
     tokens, targets = lmtrain.make_copy_task(
         jax.random.key(args.seed + 1),
         batch=args.batch_size, seq_len=args.seq_len, vocab=args.vocab,
     )
-    mesh_desc = "x".join(
-        f"{k}{v}" for k, v in mesh.shape.items() if v > 1
-    ) or "single"
     print(
         f"(LM {tfm.param_count(params):,} params, mesh {mesh_desc}, "
         f"attn={args.attn if args.sp > 1 else 'full'}, "
@@ -121,21 +166,31 @@ def main() -> int:
     first_loss = None
     t_compile = time.perf_counter()
     t0 = None
-    for i in range(args.steps):
+    steps_run = range(step0, step0 + args.steps)
+    for i in steps_run:
         params, mom, loss = step(params, mom, tokens, targets)
-        if i == 0:
+        if i == step0:
             jax.block_until_ready(loss)
             first_loss = float(loss)
             print(f"(first step incl. compile: "
                   f"{time.perf_counter() - t_compile:.1f}s)")
             t0 = time.perf_counter()
-        if i % args.log_every == 0 or i == args.steps - 1:
+        if (i - step0) % args.log_every == 0 or i == steps_run[-1]:
             print(f"step {i:>5}  loss {float(loss):.4f}")
+        if ck is not None and (i + 1) % args.checkpoint_every == 0:
+            ck.save(i, {"params": params, "mom": mom},
+                    {"mesh": mesh_desc, "optimizer": args.optimizer,
+                     "loss": float(loss)})
     jax.block_until_ready(loss)
+    if ck is not None:
+        ck.save(steps_run[-1], {"params": params, "mom": mom},
+                {"mesh": mesh_desc, "optimizer": args.optimizer,
+                 "loss": float(loss)})
+        ck.close()
     dt = time.perf_counter() - t0 if args.steps > 1 else 0.0
     tok_s = args.batch_size * args.seq_len * (args.steps - 1) / dt if dt else 0.0
     print("SUMMARY " + json.dumps({
-        "mesh": mesh_desc, "steps": args.steps,
+        "mesh": mesh_desc, "steps": args.steps, "start_step": step0,
         "first_loss": first_loss, "final_loss": float(loss),
         "tokens_per_s": round(tok_s), "wall_s_post_compile": round(dt, 3),
     }))

@@ -172,10 +172,15 @@ def test_lm_train_entry_point(tmp_path, extra, mesh):
 
 
 def test_lm_train_rejects_pp_with_sp(tmp_path):
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    env["JAX_PLATFORMS"] = "cpu"
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "lm_train.py"),
          "--pp", "2", "--sp", "2", "--steps", "1"],
-        capture_output=True, text=True, cwd=REPO, timeout=120,
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=120,
     )
     assert proc.returncode != 0
     assert "--pp composes with" in proc.stderr
@@ -190,3 +195,36 @@ def test_dp_stream_input_mode(tmp_path):
     assert summary["regime"] == "data_parallel"
     assert summary["final_val_acc"] is not None
     assert summary["data_source"] == "synthetic"
+
+
+def test_lm_train_checkpoint_resume(tmp_path):
+    """Checkpointed LM run resumes at the next step with continuous loss."""
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    base = [
+        sys.executable, os.path.join(REPO, "lm_train.py"),
+        "--dp", "4", "--batch-size", "16", "--seq-len", "16",
+        "--d-model", "32", "--n-heads", "4", "--d-ff", "64",
+        "--vocab", "32", "--lr", "0.3",
+        "--checkpoint-dir", str(tmp_path / "ck"),
+    ]
+
+    def run(*extra):
+        proc = subprocess.run(
+            [*base, *extra], capture_output=True, text=True, cwd=REPO,
+            env=env, timeout=600,
+        )
+        assert proc.returncode == 0, proc.stderr[-3000:]
+        return json.loads(next(
+            l for l in proc.stdout.splitlines() if l.startswith("SUMMARY ")
+        )[len("SUMMARY "):])
+
+    first = run("--steps", "20")
+    second = run("--steps", "10", "--resume")
+    assert second["start_step"] == 20
+    # resumed loss continues from the trained state, not from scratch
+    assert second["first_loss"] < first["first_loss"] / 2
+    assert second["final_loss"] <= second["first_loss"] + 1e-3
