@@ -42,9 +42,18 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.moe import expert_capacity, moe_ffn
-from ..parallel.ring import attention, ring_attention, ulysses_attention
+from ..parallel.ring import (
+    attention,
+    ring_attention,
+    ulysses_attention,
+    zigzag_positions,
+    zigzag_ring_attention,
+)
 
-ATTN_IMPLS = ("full", "ring", "ulysses")
+# "zigzag" = load-balanced causal ring attention; tokens must be fed in
+# zigzag shard order (parallel/ring.py zigzag_order) - ~2x the causal
+# throughput of "ring" at scale
+ATTN_IMPLS = ("full", "ring", "ulysses", "zigzag")
 
 
 @dataclass(frozen=True)
@@ -184,9 +193,11 @@ def _layer_norm(x, scale, bias, eps=1e-5):
     return (x - m) * jax.lax.rsqrt(v + eps) * scale + bias
 
 
-def _positions(s_local: int, seq_axis: str | None):
+def _positions(s_local: int, seq_axis: str | None, attn_impl: str = "ring"):
     if seq_axis is None:
         return jnp.arange(s_local)
+    if attn_impl == "zigzag":
+        return zigzag_positions(s_local, seq_axis)
     return jax.lax.axis_index(seq_axis) * s_local + jnp.arange(s_local)
 
 
@@ -204,8 +215,11 @@ def _attend(q, k, v, *, impl, seq_axis, s_local):
         return ring_attention(q, k, v, seq_axis, causal=True)
     if impl == "ulysses":
         return ulysses_attention(q, k, v, seq_axis, causal=True)
+    if impl == "zigzag":
+        return zigzag_ring_attention(q, k, v, seq_axis)
     raise ValueError(
-        f"with a sequence axis, attn impl must be 'ring' or 'ulysses', got {impl!r}"
+        f"with a sequence axis, attn impl must be 'ring', 'ulysses' or "
+        f"'zigzag', got {impl!r}"
     )
 
 
@@ -281,7 +295,9 @@ def apply_with_aux(
     dt = cfg.dtype
     b, s_local = tokens.shape
     x = params["embed"][tokens].astype(dt)
-    x = x + _sinusoid_pe(_positions(s_local, seq_axis), cfg.d_model, dt)[None]
+    x = x + _sinusoid_pe(
+        _positions(s_local, seq_axis, attn_impl), cfg.d_model, dt
+    )[None]
     cap = expert_capacity(
         b * s_local, cfg.n_experts, cfg.moe_top_k, cfg.moe_capacity_factor
     ) if cfg.n_experts else None

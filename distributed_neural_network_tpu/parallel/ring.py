@@ -33,6 +33,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 SEQ_AXIS = "seq"
 
@@ -123,6 +124,137 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS, *, causal: bool = False, 
     m, l, acc = update(n - 1, m, l, acc, k_blk, v_blk)
     out = acc / jnp.maximum(l, 1e-30)[..., None]
     return out.transpose(0, 2, 1, 3)  # (B, Sq, H, D)
+
+
+def zigzag_order(s: int, n: int):
+    """Permutation putting a length-s sequence into zigzag shard layout.
+
+    The sequence is cut into 2n equal chunks; device i's contiguous shard
+    becomes [chunk_i, chunk_{2n-1-i}] - so under a plain P('seq') sharding
+    each device holds one "early" and one "late" chunk and causal work is
+    balanced across the ring (`zigzag_ring_attention`). Returns int32
+    indices `perm` with x_zigzag = x[..., perm, :]; invert with
+    `zigzag_inverse`.
+    """
+    if s % (2 * n):
+        raise ValueError(f"seq len {s} must divide by 2*n ({2 * n})")
+    h = s // (2 * n)
+    chunks = np.arange(s).reshape(2 * n, h)
+    order = []
+    for i in range(n):
+        order.append(chunks[i])
+        order.append(chunks[2 * n - 1 - i])
+    return np.concatenate(order).astype(np.int32)
+
+
+def zigzag_inverse(s: int, n: int):
+    """Inverse permutation of `zigzag_order` (zigzag -> natural)."""
+    perm = zigzag_order(s, n)
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(s, dtype=np.int32)
+    return inv
+
+
+def zigzag_positions(s_local: int, axis_name: str = SEQ_AXIS):
+    """Global positions of the local rows under the zigzag layout."""
+    n = jax.lax.axis_size(axis_name)
+    i = jax.lax.axis_index(axis_name)
+    h = s_local // 2
+    lo = i * h + jnp.arange(h)
+    hi = (2 * n - 1 - i) * h + jnp.arange(h)
+    return jnp.concatenate([lo, hi])
+
+
+def zigzag_ring_attention(q, k, v, axis_name: str = SEQ_AXIS, *, scale=None):
+    """Load-balanced CAUSAL ring attention over zigzag-sharded sequences.
+
+    Plain causal ring attention computes every block and masks future ones
+    away: device 0 does 1 useful block of n, device n-1 does n of n, and
+    because the ring is lock-step the wasted blocks cost real wall-clock.
+    With the zigzag layout (`zigzag_order`: device i holds chunks i and
+    2n-1-i) every non-diagonal ring step needs exactly HALF a block and the
+    need is identical on every device, so causal attention runs in ~half
+    the FLOPs/wall-clock of the masked ring at scale.
+
+    Per ring step with kv from chunk-pair j: if j < i both local query
+    chunks attend k's early chunk fully; if j > i the local late query
+    chunk attends both of k's chunks fully - either way two
+    (S/2n x S/2n) unmasked products, selected by predicate, accumulated
+    into the right query rows with a dynamic row offset. The diagonal step
+    (t=0) is ordinary local causal attention under zigzag global positions.
+
+    q/k/v: local zigzag shards (B, S_local, H, D) inside shard_map over
+    `axis_name`. Exact (up to float reassociation) w.r.t. full causal
+    attention on the unpermuted sequence - tests/test_ring.py.
+    """
+    n = jax.lax.axis_size(axis_name)
+    me = jax.lax.axis_index(axis_name)
+    b, s_local, h_heads, d = q.shape
+    if s_local % 2:
+        raise ValueError(f"zigzag needs even local length, got {s_local}")
+    half = s_local // 2
+    scale_ = (1.0 / jnp.sqrt(d)) if scale is None else scale
+    pos = zigzag_positions(s_local, axis_name)
+
+    # (B, S, H, D) -> bhqd once; halves sliced as needed
+    qT = q.transpose(0, 2, 1, 3)  # (B, Hh, S, D)
+
+    def flash_update(m, l, acc, sc, v_blk, row0):
+        """Online-softmax update of rows [row0, row0+rows) of the state.
+
+        sc: (B, Hh, rows, cols) scores; v_blk: (B, cols, Hh, D).
+        row0 is traced (device-dependent case selection).
+        """
+        rows = sc.shape[2]
+        m_h = jax.lax.dynamic_slice_in_dim(m, row0, rows, axis=2)
+        l_h = jax.lax.dynamic_slice_in_dim(l, row0, rows, axis=2)
+        a_h = jax.lax.dynamic_slice_in_dim(acc, row0, rows, axis=2)
+        m_new = jnp.maximum(m_h, sc.max(axis=-1))
+        alpha = jnp.exp(m_h - m_new)
+        p = jnp.exp(sc - m_new[..., None])
+        l_h = l_h * alpha + p.sum(axis=-1)
+        a_h = a_h * alpha[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, v_blk)
+        return (
+            jax.lax.dynamic_update_slice_in_dim(m, m_new, row0, axis=2),
+            jax.lax.dynamic_update_slice_in_dim(l, l_h, row0, axis=2),
+            jax.lax.dynamic_update_slice_in_dim(acc, a_h, row0, axis=2),
+        )
+
+    # --- diagonal step (t=0): local causal under zigzag positions
+    sc = jnp.einsum("bhqd,bkhd->bhqk", qT, k) * scale_
+    mask = pos[:, None] >= pos[None, :]
+    sc = jnp.where(mask[None, None], sc, _NEG_BIG)
+    m = sc.max(axis=-1)
+    p = jnp.exp(sc - m[..., None])
+    l = p.sum(axis=-1)
+    acc = jnp.einsum("bhqk,bkhd->bhqd", p, v)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(t, carry):
+        m, l, acc, k_blk, v_blk = carry
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        src = (me - t) % n
+        early = src < me  # chunk indices decide causality, not ring distance
+        # product 1: rows = early ? q_lo : q_hi ; cols = k_lo
+        q1 = jnp.where(early, 0, half)
+        sc1_q = jax.lax.dynamic_slice_in_dim(qT, q1, half, axis=2)
+        sc1 = jnp.einsum(
+            "bhqd,bkhd->bhqk", sc1_q, k_blk[:, :half]
+        ) * scale_
+        m, l, acc = flash_update(m, l, acc, sc1, v_blk[:, :half], q1)
+        # product 2: rows = q_hi ; cols = early ? k_lo : k_hi
+        k2 = jnp.where(early, 0, half)
+        k2_blk = jax.lax.dynamic_slice_in_dim(k_blk, k2, half, axis=1)
+        v2_blk = jax.lax.dynamic_slice_in_dim(v_blk, k2, half, axis=1)
+        sc2 = jnp.einsum("bhqd,bkhd->bhqk", qT[:, :, half:], k2_blk) * scale_
+        m, l, acc = flash_update(m, l, acc, sc2, v2_blk, half)
+        return m, l, acc, k_blk, v_blk
+
+    m, l, acc, _, _ = jax.lax.fori_loop(1, n, body, (m, l, acc, k, v))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.transpose(0, 2, 1, 3)
 
 
 def ulysses_attention(q, k, v, axis_name: str = SEQ_AXIS, *, causal: bool = False, scale=None):

@@ -146,6 +146,16 @@ class _NpzBackend:
         pass
 
 
+def _make_backend(backend: str, directory: str, keep: int):
+    """Shared auto/orbax/npz backend selection for both checkpointers."""
+    if backend == "auto":
+        backend = "orbax" if _HAVE_ORBAX else "npz"
+    if backend == "orbax" and not _HAVE_ORBAX:
+        raise RuntimeError("orbax backend requested but orbax is not importable")
+    cls = _OrbaxBackend if backend == "orbax" else _NpzBackend
+    return backend, cls(directory, keep)
+
+
 class TreeCheckpointer:
     """Save/restore an arbitrary pytree + metadata (same backends).
 
@@ -157,14 +167,7 @@ class TreeCheckpointer:
     """
 
     def __init__(self, directory: str, *, keep: int = 3, backend: str = "auto"):
-        if backend == "auto":
-            backend = "orbax" if _HAVE_ORBAX else "npz"
-        if backend == "orbax" and not _HAVE_ORBAX:
-            raise RuntimeError("orbax backend requested but orbax is not importable")
-        self.backend_name = backend
-        self._b = (_OrbaxBackend if backend == "orbax" else _NpzBackend)(
-            directory, keep
-        )
+        self.backend_name, self._b = _make_backend(backend, directory, keep)
 
     def save(self, step: int, state, meta: dict | None = None) -> None:
         self._b.save(step, _host_tree(state), meta or {})
@@ -206,15 +209,8 @@ class Checkpointer:
         keep: int = 3,
         backend: str = "auto",
     ):
-        if backend == "auto":
-            backend = "orbax" if _HAVE_ORBAX else "npz"
-        if backend == "orbax" and not _HAVE_ORBAX:
-            raise RuntimeError("orbax backend requested but orbax is not importable")
-        self.backend_name = backend
+        self.backend_name, self._b = _make_backend(backend, directory, keep)
         self.every = every
-        self._b = (_OrbaxBackend if backend == "orbax" else _NpzBackend)(
-            directory, keep
-        )
 
     # ------------------------------------------------------------------ save
 
