@@ -114,6 +114,8 @@ def pipeline_lm_loss(
             )
             return x, None
 
+        if cfg.remat:
+            block = jax.checkpoint(block)
         x, _ = jax.lax.scan(block, x, params["layers"])
         return x
 

@@ -194,7 +194,6 @@ def zigzag_ring_attention(q, k, v, axis_name: str = SEQ_AXIS, *, scale=None):
         raise ValueError(f"zigzag needs even local length, got {s_local}")
     half = s_local // 2
     scale_ = (1.0 / jnp.sqrt(d)) if scale is None else scale
-    pos = zigzag_positions(s_local, axis_name)
 
     # (B, S, H, D) -> bhqd once; halves sliced as needed
     qT = q.transpose(0, 2, 1, 3)  # (B, Hh, S, D)
@@ -220,14 +219,34 @@ def zigzag_ring_attention(q, k, v, axis_name: str = SEQ_AXIS, *, scale=None):
             jax.lax.dynamic_update_slice_in_dim(acc, a_h, row0, axis=2),
         )
 
-    # --- diagonal step (t=0): local causal under zigzag positions
-    sc = jnp.einsum("bhqd,bkhd->bhqk", qT, k) * scale_
-    mask = pos[:, None] >= pos[None, :]
-    sc = jnp.where(mask[None, None], sc, _NEG_BIG)
-    m = sc.max(axis=-1)
-    p = jnp.exp(sc - m[..., None])
-    l = p.sum(axis=-1)
-    acc = jnp.einsum("bhqk,bkhd->bhqd", p, v)
+    def vary(x):
+        # constant-initialized flash state must carry q's varying axes
+        # through the fori_loop (same alignment ring_attention needs)
+        try:
+            want = jax.typeof(q).vma
+            missing = tuple(a for a in want if a not in jax.typeof(x).vma)
+        except AttributeError:
+            return x
+        return jax.lax.pcast(x, missing, to="varying") if missing else x
+
+    m = vary(jnp.full((b, h_heads, s_local), _NEG_BIG, q.dtype))
+    l = vary(jnp.zeros((b, h_heads, s_local), q.dtype))
+    acc = vary(jnp.zeros((b, h_heads, s_local, d), q.dtype))
+
+    # --- diagonal step (t=0): local causal as THREE half-blocks, skipping
+    # the q_lo x k_hi quadrant the causal mask would discard entirely
+    # (chunk i never attends chunk 2n-1-i): lo x lo causal, hi x lo full,
+    # hi x hi causal. The within-chunk causal mask is the same lower
+    # triangle for both chunks (positions are contiguous inside a chunk).
+    tri = jnp.arange(half)[:, None] >= jnp.arange(half)[None, :]
+    sc_ll = jnp.einsum("bhqd,bkhd->bhqk", qT[:, :, :half], k[:, :half]) * scale_
+    sc_ll = jnp.where(tri[None, None], sc_ll, _NEG_BIG)
+    m, l, acc = flash_update(m, l, acc, sc_ll, v[:, :half], 0)
+    sc_hl = jnp.einsum("bhqd,bkhd->bhqk", qT[:, :, half:], k[:, :half]) * scale_
+    m, l, acc = flash_update(m, l, acc, sc_hl, v[:, :half], half)
+    sc_hh = jnp.einsum("bhqd,bkhd->bhqk", qT[:, :, half:], k[:, half:]) * scale_
+    sc_hh = jnp.where(tri[None, None], sc_hh, _NEG_BIG)
+    m, l, acc = flash_update(m, l, acc, sc_hh, v[:, half:], half)
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 

@@ -147,3 +147,31 @@ def test_lm_loss_zigzag_matches_ring(n_devices):
     perm = zigzag_order(32, 4)
     got = loss_fn("zigzag", tokens[:, perm], targets[:, perm])
     assert np.isclose(got, want, rtol=2e-5), (got, want)
+
+
+def test_remat_matches_no_remat(n_devices):
+    """jax.checkpoint remat changes memory, not math: identical loss+grads."""
+    import numpy as np
+
+    from distributed_neural_network_tpu.train import lm as lmtrain
+
+    base = dict(vocab_size=32, d_model=32, n_heads=4, n_layers=2, d_ff=64)
+    tokens, targets = lmtrain.make_copy_task(
+        jax.random.key(1), batch=4, seq_len=16, vocab=32
+    )
+
+    def loss_and_grad(remat):
+        cfg = tfm.TransformerConfig(**base, remat=remat)
+        params = tfm.init_params(jax.random.key(0), cfg)
+        fn = lambda p: lmtrain.lm_loss(
+            p, tokens, targets, cfg,
+            seq_axis=None, tp_axis=None, attn_impl="full", axes=(),
+        )
+        loss, grads = jax.value_and_grad(fn)(params)
+        return float(loss), grads
+
+    l0, g0 = loss_and_grad(False)
+    l1, g1 = loss_and_grad(True)
+    assert np.isclose(l0, l1, rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
