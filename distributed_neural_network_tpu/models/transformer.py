@@ -52,8 +52,9 @@ from ..parallel.ring import (
 
 # "zigzag" = load-balanced causal ring attention; tokens must be fed in
 # zigzag shard order (parallel/ring.py zigzag_order) - ~2x the causal
-# throughput of "ring" at scale
-ATTN_IMPLS = ("full", "ring", "ulysses", "zigzag")
+# throughput of "ring" at scale. "flash" = Pallas TPU flash kernel for the
+# LOCAL (seq_axis=None) case - long contexts on one chip (ops/flash.py).
+ATTN_IMPLS = ("full", "ring", "ulysses", "zigzag", "flash")
 
 
 @dataclass(frozen=True)
@@ -214,7 +215,16 @@ def _sinusoid_pe(pos, d_model, dtype):
 
 def _attend(q, k, v, *, impl, seq_axis, s_local):
     if seq_axis is None:
+        if impl == "flash":
+            from ..ops.flash import flash_local_attention
+
+            return flash_local_attention(q, k, v, causal=True)
         return attention(q, k, v, causal=True)
+    if impl == "flash":
+        raise ValueError(
+            "attn impl 'flash' is the local kernel (no sequence axis); use "
+            "'ring'/'ulysses'/'zigzag' for sequence parallelism"
+        )
     if impl == "ring":
         return ring_attention(q, k, v, seq_axis, causal=True)
     if impl == "ulysses":

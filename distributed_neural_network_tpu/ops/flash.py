@@ -1,0 +1,62 @@
+"""Flash attention for local (single-device) long-context attention.
+
+The plain local kernel (`parallel/ring.py attention`) materializes the
+(B, H, S, S) score matrix, so single-chip long-context is HBM-bound: at
+seq 8192 it dominates step time (REPORT.md LM section). This wraps the
+Pallas TPU flash-attention kernel that ships with JAX
+(`jax.experimental.pallas.ops.tpu.flash_attention`) - the blockwise-softmax
+formulation where scores never leave VMEM - behind the framework's
+(B, S, H, D) layout convention, falling back to the plain kernel off-TPU
+(the Pallas op is Mosaic-only).
+
+Sits alongside the mesh-level answers to long context (ring / Ulysses /
+zigzag sequence parallelism, `parallel/ring.py`): flash bounds the
+per-chip attention memory at O(S); the seq axis scales beyond it.
+
+Measured reality (v5e-1, 58M-param LM, bf16, this repo's lm_train): at
+seq 2048-8192 with head_dim 64 the stock kernel ran 2-5x SLOWER than
+XLA's fused attention (which also wins on memory once --remat is on:
+45.4k vs 20.8k tokens/s at seq 8192). Exposed as `--attn flash` for
+shapes/hardware where the balance differs; verify with your own shapes
+before preferring it. Loss trajectories match the plain path exactly.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+
+from ..parallel.ring import attention
+
+
+@functools.cache
+def _flash_available() -> bool:
+    if jax.default_backend() != "tpu":
+        return False
+    try:
+        from jax.experimental.pallas.ops.tpu import flash_attention  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def flash_local_attention(q, k, v, *, causal: bool = True):
+    """q/k/v (B, S, H, D) -> (B, S, H, D); Pallas flash on TPU, plain
+    attention elsewhere. Numerics match `attention` to blockwise-softmax
+    reassociation tolerance."""
+    if not _flash_available():
+        return attention(q, k, v, causal=causal)
+    from jax.experimental.pallas.ops.tpu.flash_attention import flash_attention
+
+    d = q.shape[-1]
+    out = flash_attention(
+        q.transpose(0, 2, 1, 3),
+        k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3),
+        causal=causal,
+        sm_scale=1.0 / math.sqrt(d),
+    )
+    return out.transpose(0, 2, 1, 3)

@@ -182,12 +182,28 @@ def make_lm_train_step(
             params, mom = sgd_step(params, mom, grads, lr, momentum)
         return params, mom, loss
 
+    # The library Pallas flash kernel's outputs carry no vma type, which the
+    # shard_map checker rejects - and disabling the check changes gradient
+    # semantics on non-trivial meshes (verified: wrong grads). So flash is
+    # single-device only; on an all-ones mesh check_vma=False is vacuous
+    # (no cross-device gradients exist).
+    check_vma = True
+    if attn_impl == "flash":
+        if any(mesh.shape[a] > 1 for a in mesh.axis_names):
+            raise ValueError(
+                "attn_impl 'flash' supports single-device execution only "
+                "(the Pallas kernel is not shard_map-typed); use "
+                "'ring'/'ulysses'/'zigzag' for multi-chip sequence "
+                "parallelism or 'full' for plain sharded attention"
+            )
+        check_vma = False
     return jax.jit(
         jax.shard_map(
             step,
             mesh=mesh,
             in_specs=(specs, mom_spec, data_spec, data_spec),
             out_specs=(specs, mom_spec, P()),
+            check_vma=check_vma,
         ),
         donate_argnums=(0, 1),
     )

@@ -37,10 +37,12 @@ def main() -> int:
                    "exclusive with --sp/--experts/--optimizer zero)")
     p.add_argument("--microbatches", type=int, default=2)
     p.add_argument(
-        "--attn", choices=("ring", "ulysses", "zigzag"), default="ring",
+        "--attn", choices=("ring", "ulysses", "zigzag", "flash"),
+        default="ring",
         help="sequence-parallel attention; zigzag = load-balanced causal "
         "ring (~2x ring's causal throughput; tokens are fed in zigzag "
-        "shard order automatically)",
+        "shard order automatically); flash = Pallas TPU kernel for the "
+        "local sp=1 case",
     )
     p.add_argument("--experts", type=int, default=0,
                    help="MoE expert count (0 = dense FFN)")
@@ -77,6 +79,11 @@ def main() -> int:
         p.error(
             f"--attn zigzag needs --seq-len divisible by 2*sp "
             f"({2 * args.sp}); got {args.seq_len}"
+        )
+    if args.attn == "flash" and (args.dp > 1 or args.sp > 1 or args.tp > 1):
+        p.error(
+            "--attn flash is single-device only (Pallas kernel is not "
+            "shard_map-typed); use ring/ulysses/zigzag for multi-chip"
         )
 
     from distributed_neural_network_tpu.train.cli import honor_platform_env

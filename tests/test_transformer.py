@@ -175,3 +175,46 @@ def test_remat_matches_no_remat(n_devices):
     assert np.isclose(l0, l1, rtol=1e-6)
     for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_flash_attn_option_runs_and_matches(n_devices):
+    """attn_impl='flash' (plain-kernel fallback off-TPU) matches 'full'."""
+    import numpy as np
+
+    from distributed_neural_network_tpu.train import lm as lmtrain
+
+    cfg = tfm.TransformerConfig(
+        vocab_size=32, d_model=32, n_heads=4, n_layers=2, d_ff=64
+    )
+    mesh = lmtrain.create_lm_mesh(1, 1, 1)  # flash is single-device only
+    params0 = tfm.init_params(jax.random.key(0), cfg)
+    tokens, targets = lmtrain.make_copy_task(
+        jax.random.key(1), batch=8, seq_len=16, vocab=32
+    )
+    losses = {}
+    for impl in ("full", "flash"):
+        params, _ = lmtrain.shard_params(
+            jax.tree.map(jnp.array, params0), cfg, mesh
+        )
+        mom = lmtrain.init_lm_momentum(params, mesh)
+        step = lmtrain.make_lm_train_step(cfg, mesh, lr=0.3, attn_impl=impl)
+        for _ in range(5):
+            params, mom, loss = step(params, mom, tokens, targets)
+        losses[impl] = float(loss)
+    assert np.isclose(losses["full"], losses["flash"], rtol=1e-5), losses
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="single-device"):
+        lmtrain.make_lm_train_step(
+            cfg, lmtrain.create_lm_mesh(4, 1, 1), attn_impl="flash"
+        )
+
+
+def test_flash_rejects_sequence_axis(n_devices):
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="local kernel"):
+        tfm._attend(
+            jnp.zeros((1, 4, 2, 8)), jnp.zeros((1, 4, 2, 8)),
+            jnp.zeros((1, 4, 2, 8)), impl="flash", seq_axis="seq", s_local=4,
+        )
