@@ -43,6 +43,7 @@ def _leaves(tree):
     return [np.asarray(x) for x in jax.tree.leaves(tree)]
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("backend", ["orbax", "npz"])
 def test_resume_matches_uninterrupted_run(tmp_path, backend, n_devices):
     straight = Engine(_cfg(4), TRAIN, TEST)
@@ -69,6 +70,7 @@ def test_resume_matches_uninterrupted_run(tmp_path, backend, n_devices):
     )
 
 
+@pytest.mark.slow
 def test_retention_keeps_last_k(tmp_path, n_devices):
     ck = Checkpointer(str(tmp_path / "r"), every=1, keep=2, backend="npz")
     eng = Engine(_cfg(5), TRAIN, None)

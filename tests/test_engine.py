@@ -31,6 +31,7 @@ def _cfg(**kw):
     return TrainConfig(**base)
 
 
+@pytest.mark.slow
 def test_single_regime_trains_and_converges(n_devices):
     eng = Engine(_cfg(regime="single", epochs=6), TRAIN, TEST)
     hist = eng.run(log=lambda *_: None)
@@ -39,6 +40,7 @@ def test_single_regime_trains_and_converges(n_devices):
     assert hist[-1].val_acc > 45.0  # way above 10% chance on class-structured data
 
 
+@pytest.mark.slow
 def test_data_parallel_regime_8dev(n_devices):
     eng = Engine(
         _cfg(regime="data_parallel", nb_proc=8, epochs=6, batch_size=8, lr=0.05),
@@ -52,6 +54,7 @@ def test_data_parallel_regime_8dev(n_devices):
     assert eng.local_train_rows == 64
 
 
+@pytest.mark.slow
 def test_replication_regime_8dev(n_devices):
     eng = Engine(
         _cfg(regime="replication", nb_proc=8, epochs=4, batch_size=16), TRAIN, TEST
@@ -69,6 +72,7 @@ def test_reference_compat_uses_n_minus_1_workers(n_devices):
     assert eng.local_train_rows == 512 // 7
 
 
+@pytest.mark.slow
 def test_nb_proc_1_data_parallel_equals_single_regime(n_devices):
     """With one device, sharded local SGD == the single-process baseline."""
     e1 = Engine(_cfg(regime="single", epochs=2), TRAIN, TEST)
@@ -119,6 +123,7 @@ def test_fault_mask_excludes_dead_device(n_devices):
     )
 
 
+@pytest.mark.slow
 def test_fault_run_survives_failures(n_devices):
     eng = Engine(
         _cfg(
@@ -137,6 +142,7 @@ def test_fault_run_survives_failures(n_devices):
     assert all(m.val_acc is not None for m in hist)
 
 
+@pytest.mark.slow
 def test_step_sync_mode(n_devices):
     eng = Engine(
         _cfg(
@@ -165,6 +171,7 @@ def test_eval_handles_uneven_test_split(n_devices):
     assert 0.0 <= hist[0].val_acc <= 100.0
 
 
+@pytest.mark.slow
 def test_determinism_same_seed_same_result(n_devices):
     h1 = Engine(_cfg(regime="data_parallel", nb_proc=8, epochs=2), TRAIN, TEST).run(
         log=lambda *_: None
@@ -176,6 +183,7 @@ def test_determinism_same_seed_same_result(n_devices):
     assert h1[-1].val_acc == h2[-1].val_acc
 
 
+@pytest.mark.slow
 def test_momentum_reset_vs_persistent(n_devices):
     """reset_momentum=True (reference dynamics) differs from persistent."""
     hr = Engine(_cfg(regime="single", epochs=3, reset_momentum=True), TRAIN, TEST).run(
@@ -187,6 +195,7 @@ def test_momentum_reset_vs_persistent(n_devices):
     assert hr[-1].train_loss != hp[-1].train_loss
 
 
+@pytest.mark.slow
 def test_fused_span_matches_per_epoch_path(n_devices):
     """run_span (one compiled multi-epoch dispatch) must reproduce the
     per-epoch path exactly: same losses, same eval, same fault masks, and
@@ -213,6 +222,7 @@ def test_fused_span_matches_per_epoch_path(n_devices):
     )
 
 
+@pytest.mark.slow
 def test_fused_run_chunks_at_eval_boundaries(n_devices):
     """run(fused=True) with eval_every=2: spans split so eval lands exactly
     on the reference's eval cadence; history covers every epoch."""
@@ -230,6 +240,7 @@ def test_fused_span_without_eval(n_devices):
     assert all(np.isfinite(m.train_loss) for m in metrics)
 
 
+@pytest.mark.slow
 def test_reset_state_reproduces_run(n_devices):
     """Warm-up + reset_state (bench.py pattern) must not change the measured
     training trajectory."""

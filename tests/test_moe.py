@@ -15,6 +15,7 @@ Correctness bars:
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_neural_network_tpu.models import transformer as tfm
@@ -106,6 +107,7 @@ def test_expert_parallel_matches_single_device(n_devices):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_moe_lm_step_learns_dp_ep_tp(n_devices):
     """MoE transformer on a dp=4 x tp=2 mesh (experts over dp): loss drops."""
     cfg = tfm.TransformerConfig(
@@ -135,8 +137,6 @@ def test_moe_lm_step_learns_dp_ep_tp(n_devices):
 
 
 def test_indivisible_experts_rejected_upfront(n_devices):
-    import pytest
-
     cfg = tfm.TransformerConfig(n_experts=4)
     mesh = lmtrain.create_lm_mesh(3, 1, 1)
     with pytest.raises(ValueError, match="divisible by the data-axis"):

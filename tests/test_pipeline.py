@@ -64,6 +64,7 @@ def test_pipeline_loss_matches_single_device(n_devices, n_microbatches):
     assert np.isclose(got, want, rtol=2e-5), (got, want)
 
 
+@pytest.mark.slow
 def test_pipeline_grads_match_single_device(n_devices):
     mesh = pp.create_pp_mesh(1, 4, 1)
     params = tfm.init_params(jax.random.key(1), CFG)
@@ -98,6 +99,7 @@ def test_pipeline_grads_match_single_device(n_devices):
         )
 
 
+@pytest.mark.slow
 def test_pp_train_step_learns_dp_pp_tp(n_devices):
     """dp2 x pp2 x tp2: all three parallelism axes at once; loss falls."""
     mesh = pp.create_pp_mesh(2, 2, 2)

@@ -100,6 +100,7 @@ def test_stream_engine_trains_uint8_split(n_devices):
     assert hist[-1].val_acc is not None and 0 <= hist[-1].val_acc <= 100
 
 
+@pytest.mark.slow
 def test_stream_engine_deterministic(n_devices):
     a = _engine("stream", seed=3).run(log=lambda *_: None)
     b = _engine("stream", seed=3).run(log=lambda *_: None)

@@ -65,6 +65,7 @@ def test_sharded_forward_matches_single_device(n_devices, dp, sp, tp, attn):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_lm_train_step_learns_copy_task(n_devices):
     mesh = lm.create_lm_mesh(2, 2, 2)
     params = tfm.init_params(jax.random.key(0), CFG)
@@ -149,6 +150,7 @@ def test_lm_loss_zigzag_matches_ring(n_devices):
     assert np.isclose(got, want, rtol=2e-5), (got, want)
 
 
+@pytest.mark.slow
 def test_remat_matches_no_remat(n_devices):
     """jax.checkpoint remat changes memory, not math: identical loss+grads."""
     import numpy as np
@@ -177,6 +179,7 @@ def test_remat_matches_no_remat(n_devices):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_flash_attn_option_runs_and_matches(n_devices):
     """attn_impl='flash' (plain-kernel fallback off-TPU) matches 'full'."""
     import numpy as np

@@ -82,6 +82,7 @@ def test_shard_size_is_one_nth(n_devices):
     assert init_zero_momentum(params, 8).shape == (sz * 8,)
 
 
+@pytest.mark.slow
 def test_lm_zero_optimizer_matches_sgd_and_learns(n_devices):
     cfg = tfm.TransformerConfig(
         vocab_size=32, d_model=32, n_heads=4, n_layers=2, d_ff=64
