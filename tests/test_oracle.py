@@ -1,0 +1,135 @@
+"""Semantic-fidelity oracle tests (VERDICT r1 item 1).
+
+The pure-numpy oracle (tests/oracle_numpy.py) implements the reference's
+exact algorithm — contiguous shards, per-epoch SGD with momentum reset,
+epoch-edge parameter averaging — independently of JAX. These tests assert:
+
+1. the oracle's hand-written backprop matches jax.grad on the Flax model
+   (so the oracle itself is trustworthy);
+2. the engine's faithful path (`sync_mode="epoch"`, `reset_momentum=True`)
+   reproduces the oracle's parameter-and-loss trajectory step-for-step, for
+   both the data_parallel and replication regimes.
+
+Together: the TPU engine computes *the reference algorithm*
+(`/root/reference/data_parallelism_train.py:49-53,187-203,238-244`), not
+merely an algorithm that also converges.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_neural_network_tpu.data.cifar10 import load_split
+from distributed_neural_network_tpu.models.cnn import Network
+from distributed_neural_network_tpu.ops.train import make_batch_loss
+from distributed_neural_network_tpu.train.engine import Engine, TrainConfig
+
+from oracle_numpy import batch_loss_and_grads, reference_trajectory, to_f64
+
+
+def _engine_orders(seed, epochs, n_workers, n_rows):
+    """The engine's per-(seed, epoch, device) shuffle stream (engine.py
+    train_shard): permutation(fold_in(fold_in(key(seed), epoch), device))."""
+    return [
+        [
+            np.asarray(
+                jax.random.permutation(
+                    jax.random.fold_in(
+                        jax.random.fold_in(
+                            jax.random.key(seed), jnp.uint32(e)
+                        ),
+                        jnp.int32(d),
+                    ),
+                    n_rows,
+                )
+            )
+            for d in range(n_workers)
+        ]
+        for e in range(epochs)
+    ]
+
+
+def _host_tree(tree):
+    return jax.tree.map(np.asarray, tree)
+
+
+def _max_rel_err(a, b):
+    errs = jax.tree.map(
+        lambda x, y: float(
+            np.max(np.abs(x - y) / (np.abs(y) + 1e-3))
+        ),
+        a,
+        b,
+    )
+    return max(jax.tree_util.tree_leaves(errs))
+
+
+def test_oracle_grads_match_jax():
+    """Oracle backprop == jax.grad on the same params/batch (f64 vs f32)."""
+    split = load_split(True, source="synthetic", synthetic_size=32, seed=7)
+    model = Network()
+    params = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))["params"]
+    x = split.images[:16]
+    y = split.labels[:16]
+    w = np.ones(16, np.float32)
+    w[-3:] = 0.0  # exercise the padded-row mask path
+
+    loss_j, grads_j = jax.value_and_grad(make_batch_loss(model.apply))(
+        params, jnp.asarray(x), jnp.asarray(y), jnp.asarray(w)
+    )
+    loss_o, grads_o = batch_loss_and_grads(
+        to_f64(_host_tree(params)), x.astype(np.float64), y, w.astype(np.float64)
+    )
+    assert abs(float(loss_j) - loss_o) < 1e-5
+    assert _max_rel_err(_host_tree(grads_j), grads_o) < 1e-4
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("regime", ["data_parallel", "replication"])
+def test_engine_trajectory_matches_reference_oracle(n_devices, regime):
+    """Engine (faithful epoch-sync path) == numpy reference algorithm,
+    epoch by epoch, on params AND global train loss."""
+    n_rows = 512 if regime == "data_parallel" else 128
+    epochs = 3
+    split = load_split(True, source="synthetic", synthetic_size=n_rows, seed=3)
+    cfg = TrainConfig(
+        lr=0.01,
+        momentum=0.9,
+        batch_size=16,
+        epochs=epochs,
+        regime=regime,
+        sync_mode="epoch",
+        reset_momentum=True,
+        seed=0,
+    )
+    eng = Engine(cfg, split, None)
+    params0 = _host_tree(eng.params)
+
+    shard_rows = eng.local_train_rows
+    orders = _engine_orders(cfg.seed, epochs, n_devices, shard_rows)
+    oracle = reference_trajectory(
+        params0,
+        split.images,
+        split.labels,
+        n_workers=n_devices,
+        batch_size=cfg.batch_size,
+        epochs=epochs,
+        lr=cfg.lr,
+        momentum=cfg.momentum,
+        orders=orders,
+        regime=regime,
+    )
+
+    for e in range(epochs):
+        m = eng.run_epoch(e, do_eval=False)
+        rec = oracle[e]
+        assert abs(m.train_loss - rec["train_loss"]) < 5e-4, (
+            e,
+            m.train_loss,
+            rec["train_loss"],
+        )
+        rel = _max_rel_err(_host_tree(eng.params), rec["params"])
+        assert rel < 2e-3, (e, rel)
