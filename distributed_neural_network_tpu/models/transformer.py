@@ -286,7 +286,7 @@ def transformer_block(x, lp, cfg: TransformerConfig, *, attend, tp_axis=None,
     return x, aux
 
 
-def apply_with_aux(
+def apply_hidden(
     params,
     tokens,
     cfg: TransformerConfig,
@@ -296,15 +296,13 @@ def apply_with_aux(
     ep_axis: str | None = None,
     attn_impl: str = "ring",
 ):
-    """tokens (B, S_local) int32 -> (logits (B, S_local, vocab) f32, aux).
+    """tokens (B, S_local) int32 -> (hidden (B, S_local, d_model), aux).
 
-    Call directly for single-device, or inside shard_map with tokens sharded
-    (data/seq axes) and params placed per `param_specs`. With tp_axis, each
-    device holds H/tp heads and d_ff/tp hidden columns; one psum per
-    attention-out and MLP-out projection restores the full residual. With
-    cfg.n_experts, the MLP is a mixture-of-experts (experts sharded over
-    `ep_axis` when given) and `aux` is the mean Switch load-balancing loss
-    over layers (0.0 for dense).
+    The pre-head forward: embedding + blocks + final layer norm, WITHOUT the
+    vocab projection. Loss paths that chunk the cross-entropy (train/lm.py)
+    consume this directly so the (B, S, vocab) logits tensor is never
+    materialized whole - at vocab 32k/seq 2048 that tensor is GBs of HBM
+    traffic and the single biggest single-chip LM cost.
     """
     dt = cfg.dtype
     b, s_local = tokens.shape
@@ -333,8 +331,40 @@ def apply_with_aux(
         block = jax.checkpoint(block)
     x, aux = jax.lax.scan(block, x, params["layers"])
     x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"]).astype(dt)
-    logits = (x @ params["head"].astype(dt)).astype(jnp.float32)
-    return logits, aux.mean()
+    return x, aux.mean()
+
+
+def apply_with_aux(
+    params,
+    tokens,
+    cfg: TransformerConfig,
+    *,
+    seq_axis: str | None = None,
+    tp_axis: str | None = None,
+    ep_axis: str | None = None,
+    attn_impl: str = "ring",
+):
+    """tokens (B, S_local) int32 -> (logits (B, S_local, vocab) f32, aux).
+
+    Call directly for single-device, or inside shard_map with tokens sharded
+    (data/seq axes) and params placed per `param_specs`. With tp_axis, each
+    device holds H/tp heads and d_ff/tp hidden columns; one psum per
+    attention-out and MLP-out projection restores the full residual. With
+    cfg.n_experts, the MLP is a mixture-of-experts (experts sharded over
+    `ep_axis` when given) and `aux` is the mean Switch load-balancing loss
+    over layers (0.0 for dense).
+    """
+    x, aux = apply_hidden(
+        params,
+        tokens,
+        cfg,
+        seq_axis=seq_axis,
+        tp_axis=tp_axis,
+        ep_axis=ep_axis,
+        attn_impl=attn_impl,
+    )
+    logits = (x @ params["head"].astype(cfg.dtype)).astype(jnp.float32)
+    return logits, aux
 
 
 def apply(params, tokens, cfg: TransformerConfig, **kw):

@@ -8,26 +8,36 @@ absent from the reference; this module adds the capability TPU-natively.
 
 Design (ZeRO stage 1, the optimizer-state partition):
 
-- The param/grad pytree is flattened to ONE 1-D vector (`ravel_pytree`),
-  zero-padded to a multiple of the data-axis size, and split into equal
-  contiguous shards - perfect load balance regardless of leaf shapes, no
-  per-leaf divisibility constraints.
-- Each device owns 1/N of the momentum buffer (the O(params) optimizer
-  state) and updates only its shard: update FLOPs and optimizer memory both
-  drop by N.
+- Each leaf is zero-padded to a multiple of the data-axis size and split
+  into equal contiguous shards; each device owns 1/N of the momentum
+  buffer (the O(params) optimizer state) and updates only its shard:
+  update FLOPs and optimizer memory both drop by N.
 - Gradient reduction: either `jax.lax.psum_scatter` of the raw per-device
   gradient (the canonical ZeRO reduce-scatter, same bytes as half an
   all-reduce) or - when gradients arrive already summed by shard_map's typed
   autodiff psum - a free local slice.
 - Parameter reassembly: one tiled `jax.lax.all_gather` of the updated
-  shards. reduce_scatter + all_gather together cost exactly one all-reduce,
-  so ZeRO-1 is communication-neutral versus replicated SGD while saving the
-  memory and update compute.
+  shards per leaf. reduce_scatter + all_gather together cost exactly one
+  all-reduce, so ZeRO-1 is communication-neutral versus replicated SGD
+  while saving the memory and update compute.
+
+Two implementations, same math (the SGD update is elementwise, so the
+partitioning cannot change any value - parity is bitwise):
+
+- `zero_sgd_step_sharded` (the production path, round 2): per-leaf slice
+  maps precomputed by structure, O(leaf) temporaries only, true
+  `all_gather` reassembly. Runs inside a `check_vma=False` shard_map (the
+  optimizer is outside autodiff, so vma typing buys nothing) - see
+  train/lm.py.
+- `zero_sgd_step` (retained as the oracle + for vma-checked contexts):
+  `ravel_pytree` of the full tree per step and a one-hot psum reassembly,
+  whose *invariant*-typed output satisfies shard_map's vma checker at the
+  cost of O(D) temporaries and ~2x the reassembly communication.
 
 Pure functions for use inside `jax.shard_map` over a 1-D data axis; the
 param tree must be replicated across that axis (dense models; tensor- or
-expert-sharded leaves vary across other axes and are out of scope for the
-flat vector - validated by the caller in train/lm.py).
+expert-sharded leaves vary across other axes and are out of scope -
+validated by the caller in train/lm.py).
 """
 
 from __future__ import annotations
@@ -51,6 +61,83 @@ def init_zero_momentum(params, n_shards: int):
     """Global flat momentum buffer (pad(D),) - shard it over the data axis
     (jit-level sharding P('data')); each device then holds (pad(D)/N,)."""
     return jnp.zeros((zero_shard_size(params, n_shards) * n_shards,), jnp.float32)
+
+
+def leaf_shard_size(d: int, n_shards: int) -> int:
+    """Per-device shard length for one leaf of d elements (ceil(d/n))."""
+    return _padded(d, n_shards) // n_shards
+
+
+def init_zero_momentum_tree(params, n_shards: int):
+    """Per-leaf flat momentum buffers, (pad(leaf)/N * N,) each - shard every
+    leaf over the data axis (P('data')); a device then holds (pad(leaf)/N,)
+    per leaf. Pair with `zero_sgd_step_sharded`."""
+    return jax.tree.map(
+        lambda p: jnp.zeros(
+            (leaf_shard_size(p.size, n_shards) * n_shards,), jnp.float32
+        ),
+        params,
+    )
+
+
+def zero_sgd_step_sharded(
+    params,
+    mom_tree,
+    grads,
+    lr,
+    momentum,
+    *,
+    axis_name: str = "data",
+    grads_presummed: bool = True,
+):
+    """One SGD(momentum) step, momentum sharded per leaf over `axis_name`.
+
+    The production ZeRO-1 path: no full-tree flatten, no full-size one-hot
+    temporaries - each leaf is padded to N*S, this device updates its own
+    (S,) slice, and one tiled `all_gather` per leaf reassembles the
+    replicated parameter. Because `all_gather` outputs are device-varying
+    in shard_map's vma typing (identical values, but the checker cannot
+    prove it), call this inside `shard_map(..., check_vma=False)`; the
+    optimizer runs outside autodiff, so the typing is not load-bearing
+    (train/lm.py splits the step accordingly).
+
+    params/grads: full (local) pytrees; mom_tree: per-leaf (S,) slices
+    (init with `init_zero_momentum_tree`, sharded P(axis)). Gradient
+    contract matches `zero_sgd_step`. Returns (new_params, new_mom_tree).
+    """
+    n = jax.lax.axis_size(axis_name)
+    me = jax.lax.axis_index(axis_name)
+
+    def leaf(p, m, g):
+        d = p.size
+        s = m.shape[0]
+        flat_g = g.reshape(-1)
+        pad = s * n - d
+        if grads_presummed:
+            if pad:
+                flat_g = jnp.concatenate([flat_g, jnp.zeros((pad,), g.dtype)])
+            g_sh = jax.lax.dynamic_slice(flat_g, (me * s,), (s,))
+        else:
+            if pad:
+                flat_g = jnp.concatenate([flat_g, jnp.zeros((pad,), g.dtype)])
+            g_sh = jax.lax.psum_scatter(
+                flat_g, axis_name, scatter_dimension=0, tiled=True
+            )
+        m_new = momentum * m + g_sh
+        flat_p = p.reshape(-1)
+        if pad:
+            flat_p = jnp.concatenate([flat_p, jnp.zeros((pad,), p.dtype)])
+        p_sh = jax.lax.dynamic_slice(flat_p, (me * s,), (s,)) - lr * m_new
+        full = jax.lax.all_gather(p_sh, axis_name, tiled=True)
+        return full[:d].reshape(p.shape), m_new
+
+    leaves_p, treedef = jax.tree_util.tree_flatten(params)
+    leaves_m = treedef.flatten_up_to(mom_tree)
+    leaves_g = treedef.flatten_up_to(grads)
+    out = [leaf(p, m, g) for p, m, g in zip(leaves_p, leaves_m, leaves_g)]
+    new_p = jax.tree_util.tree_unflatten(treedef, [o[0] for o in out])
+    new_m = jax.tree_util.tree_unflatten(treedef, [o[1] for o in out])
+    return new_p, new_m
 
 
 def zero_sgd_step(

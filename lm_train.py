@@ -24,6 +24,31 @@ import json
 import sys
 import time
 
+# bf16 peak TFLOP/s by device kind (MFU denominator); None = unknown kind
+PEAK_TFLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def model_flops_per_token(cfg, seq_len: int) -> float:
+    """Model FLOPs per trained token (fwd + 2x bwd), PaLM-appendix style.
+
+    Per layer, per token (forward): 8*d^2 (QKV+out projections) +
+    4*seq*d (attention scores+values, causal NOT halved - the standard
+    convention) + 4*d*ff (MLP; for MoE, the top-k activated experts).
+    Plus 2*d*vocab for the LM head. Backward = 2x forward; remat recompute
+    is excluded (MFU counts model FLOPs, not hardware FLOPs).
+    """
+    d, f, v, L = cfg.d_model, cfg.d_ff, cfg.vocab_size, cfg.n_layers
+    mlp = 4 * d * f * (cfg.moe_top_k if cfg.n_experts else 1)
+    per_layer = 8 * d * d + 4 * seq_len * d + mlp
+    return 3.0 * (L * per_layer + 2 * d * v)
+
 
 def main() -> int:
     p = argparse.ArgumentParser(
@@ -136,7 +161,9 @@ def main() -> int:
         params, specs = lmtrain.shard_params(params, cfg, mesh)
         mom = lmtrain.init_lm_momentum(params, mesh, args.optimizer)
         mom_shardings = (
-            NamedSharding(mesh, P(lmtrain.DATA_AXIS))
+            jax.tree.map(
+                lambda _: NamedSharding(mesh, P(lmtrain.DATA_AXIS)), mom
+            )
             if args.optimizer == "zero"
             else jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
         )
@@ -205,7 +232,7 @@ def main() -> int:
         tokens, targets = tokens[:, zperm], targets[:, zperm]
     print(
         f"(LM {tfm.param_count(params):,} params, mesh {mesh_desc}, "
-        f"attn={args.attn if args.sp > 1 else 'full'}, "
+        f"attn={args.attn if args.sp > 1 or args.attn == 'flash' else 'full'}, "
         f"experts={args.experts or 'dense'}, optimizer={args.optimizer})"
     )
 
@@ -235,10 +262,24 @@ def main() -> int:
         ck.close()
     dt = time.perf_counter() - t0 if args.steps > 1 else 0.0
     tok_s = args.batch_size * args.seq_len * (args.steps - 1) / dt if dt else 0.0
+    flops_tok = model_flops_per_token(cfg, args.seq_len)
+    model_flops_s = flops_tok * tok_s
+    n_dev = mesh.devices.size
+    peak = PEAK_TFLOPS.get(jax.devices()[0].device_kind)
+    mfu = model_flops_s / (peak * n_dev) * 100.0 if peak else None
+    if mfu is not None:
+        print(
+            f"MFU {mfu:.1f}% = {model_flops_s / 1e12:.1f} model TFLOP/s / "
+            f"({peak / 1e12:.0f} peak bf16 TFLOP/s x {n_dev} dev); "
+            f"FLOPs/token = 3*(L*(8d^2 + 4sd + 4d*ff) + 2d*V) "
+            f"= {flops_tok / 1e6:.1f}M"
+        )
     print("SUMMARY " + json.dumps({
         "mesh": mesh_desc, "steps": args.steps, "start_step": step0,
         "first_loss": first_loss, "final_loss": float(loss),
         "tokens_per_s": round(tok_s), "wall_s_post_compile": round(dt, 3),
+        "model_tflops_per_s": round(model_flops_s / 1e12, 2),
+        "mfu_pct": round(mfu, 2) if mfu is not None else None,
     }))
     return 0
 

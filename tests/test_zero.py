@@ -20,8 +20,11 @@ from distributed_neural_network_tpu.models import transformer as tfm
 from distributed_neural_network_tpu.ops.sgd import init_momentum, sgd_step
 from distributed_neural_network_tpu.parallel.zero import (
     init_zero_momentum,
+    init_zero_momentum_tree,
+    leaf_shard_size,
     zero_shard_size,
     zero_sgd_step,
+    zero_sgd_step_sharded,
 )
 from distributed_neural_network_tpu.train import lm as lmtrain
 
@@ -72,6 +75,73 @@ def test_zero_matches_replicated_sgd(n_devices, presummed):
         np.testing.assert_allclose(
             np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-7
         )
+
+
+@pytest.mark.parametrize("presummed", [True, False])
+def test_sharded_step_bitwise_matches_flat_oracle(n_devices, presummed):
+    """The production per-leaf path == the flat ravel_pytree oracle over
+    multiple steps. The SGD update is elementwise, so the partitioning
+    cannot change the math; the only observed difference is 1-ulp FMA
+    contraction variance between the two XLA lowerings (the compiler may
+    fuse `momentum*m + g` differently for differently-shaped vectors),
+    amplified slightly by cancellation in `p - lr*mom` over steps. The
+    tolerance (1e-6 ~ a few ulp) is orders of magnitude below any semantic
+    difference (a wrong lr/momentum/grad term shows up at >1e-3)."""
+    mesh = Mesh(np.asarray(jax.devices()), ("data",))
+    params = _tree(2)
+    mom_flat = init_zero_momentum(params, 8)
+    mom_tree = init_zero_momentum_tree(params, 8)
+
+    def pseudo_grads(i):
+        return jax.tree.map(lambda p: jnp.sin(p * (i + 1)), params)
+
+    def prep(g):
+        if not presummed:
+            return jax.tree.map(lambda x: x / jax.lax.axis_size("data"), g)
+        return g
+
+    def flat_step(p, m, g):
+        return zero_sgd_step(
+            p, m, prep(g), 0.1, 0.9, axis_name="data",
+            grads_presummed=presummed,
+        )
+
+    def sharded_step(p, m, g):
+        return zero_sgd_step_sharded(
+            p, m, prep(g), 0.1, 0.9, axis_name="data",
+            grads_presummed=presummed,
+        )
+
+    f_flat = jax.jit(
+        jax.shard_map(
+            flat_step, mesh=mesh,
+            in_specs=(P(), P("data"), P()), out_specs=(P(), P("data")),
+        )
+    )
+    f_sh = jax.jit(
+        jax.shard_map(
+            sharded_step, mesh=mesh,
+            in_specs=(P(), P("data"), P()), out_specs=(P(), P("data")),
+            check_vma=False,
+        )
+    )
+    p_f, p_s = params, params
+    for i in range(4):
+        g = pseudo_grads(i)
+        p_f, mom_flat = f_flat(p_f, mom_flat, g)
+        p_s, mom_tree = f_sh(p_s, mom_tree, g)
+    for got, want in zip(jax.tree.leaves(p_s), jax.tree.leaves(p_f)):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6
+        )
+
+
+def test_sharded_momentum_is_one_nth_per_leaf(n_devices):
+    params = _tree(3)
+    mom = init_zero_momentum_tree(params, 8)
+    for p, m in zip(jax.tree.leaves(params), jax.tree.leaves(mom)):
+        assert m.shape == (leaf_shard_size(p.size, 8) * 8,)
+        assert leaf_shard_size(p.size, 8) == -(-p.size // 8)
 
 
 def test_shard_size_is_one_nth(n_devices):
