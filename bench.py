@@ -1,80 +1,358 @@
 #!/usr/bin/env python
-"""Benchmark: 25-epoch data-parallel CIFAR-10 training wall-clock.
+"""Benchmark matrix: CIFAR data-parallel sweep + LM throughput/MFU rows.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line on stdout:
+  {"metric": ..., "value": N, "unit": "s", "vs_baseline": N}
+(the headline row - 25-epoch bs=16 data-parallel CIFAR training wall-clock
+vs the reference's 1642 s 8-process MPI run, BASELINE.md Table 1). All other
+output goes to stderr; the full row matrix is written incrementally to
+BENCH_MATRIX.json at the repo root (r2 VERDICT item 1: the bench artifact
+must carry the reference's whole sweep, not one number).
 
-Headline comparison (BASELINE.md): the reference's 8-process MPI data-parallel
-run takes 1642 s of training time for 25 epochs at bs=16 on an 8-core
-i7-9800X (report Table 1; measured child train time 1566.3 s in
-`log/log_epochs25_proc8_children.txt:2`). This bench runs the same workload -
-25 epochs, bs=16 per worker, epoch-edge parameter averaging, per-epoch eval -
-on the available TPU mesh (all visible devices; 1 chip under the single-chip
-harness, 8 on a v5e-8) and reports training+sync wall-clock.
-`vs_baseline` = reference_seconds / ours, so > 1 means faster than the
-reference.
+Robustness (r2 post-mortem: BENCH_r02.json is rc=1/parsed=null because the
+TPU backend was busy at the single moment the driver ran this script, and
+the old bench touched jax at top level with no second chance):
 
-Data: real CIFAR-10 if present under ./data (see data/cifar10.py), else the
-synthetic stand-in with identical shapes - wall-clock comparable either way;
-accuracy only meaningful on real data.
+- every row runs in its OWN subprocess (`--worker`), so a backend-init
+  failure never poisons the parent (JAX caches backend-init failure
+  process-wide);
+- rows whose subprocess fails with an unavailable/busy backend retry with
+  backoff (--retries, default 5 over ~4 min);
+- an unrecoverable run still prints structured JSON with an "error" field -
+  never a bare traceback on stdout;
+- a global --deadline (default 1500 s) skips remaining non-headline rows so
+  the headline always gets printed before any driver timeout.
+
+Reference comparison columns (BASELINE.md):
+  Table 1 proc sweep @ bs16: 8-proc train time 1642 s (headline ref).
+  Table 2 bs sweep @ 4 procs, measured child train seconds
+  (`/root/reference/log/bs{N}_log_epochs25_proc4_children.txt:2`).
+`vs_baseline` = reference_seconds / ours, > 1 means faster. LM rows have no
+reference analog (the reference has no transformer); vs_baseline is null.
 """
 
 import argparse
 import json
+import os
+import subprocess
 import sys
+import time
 
-REFERENCE_TRAIN_S = 1642.0  # report Table 1, 8 procs, 25 epochs, bs=16
+REPO = os.path.dirname(os.path.abspath(__file__))
+MATRIX_PATH = os.path.join(REPO, "BENCH_MATRIX.json")
+
+REFERENCE_TRAIN_S = 1642.0  # Table 1: 8 procs, 25 epochs, bs=16
+
+# Table 2 measured child train times (25 ep, 4 procs), by batch size
+REFERENCE_BS_SWEEP_S = {
+    1: 1167.3, 2: 637.6, 4: 490.3, 8: 520.8, 16: 701.8, 32: 980.4, 64: 990.9,
+}
+
+# markers of "the chip was busy / backend not up" - retryable
+_RETRYABLE = (
+    "UNAVAILABLE",
+    "Unable to initialize backend",
+    "DEADLINE_EXCEEDED",
+    "RESOURCE_EXHAUSTED",
+    "ABORTED",
+)
 
 
-def main() -> None:
-    p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--epochs", type=int, default=25)
-    p.add_argument("--batch-size", type=int, default=16)
-    p.add_argument("--nb-proc", type=int, default=None, help="default: all devices")
-    p.add_argument("--sync-mode", choices=("epoch", "step"), default="epoch")
-    p.add_argument("--compute-dtype", default="float32")
-    p.add_argument("--kernels", choices=("xla", "pallas"), default="xla")
-    p.add_argument("--data", default="auto")
-    p.add_argument("--synthetic-size", type=int, default=None)
-    p.add_argument(
-        "--no-fused",
-        dest="fused",
-        action="store_false",
-        help="per-epoch dispatch instead of one fused multi-epoch span",
-    )
-    args = p.parse_args()
+def _rows(epochs: int) -> list[dict]:
+    """Row specs, headline first. Each runs in its own worker subprocess.
 
+    ref_s columns are only attached at epochs=25 (the reference's sweep
+    length); shorter smoke runs get no vs_baseline rather than a wildly
+    mis-scaled one. All comparisons are cross-platform by design: the
+    reference's numbers are N CPU processes on an 8-core i7, ours are the
+    visible TPU mesh - each row records its own `devices`.
+    """
+    at_ref_epochs = epochs == 25
+
+    def ref(ref_s, note):
+        return {"ref_s": ref_s, "ref": note} if at_ref_epochs else {}
+
+    rows = [
+        {
+            "id": f"cnn_dp_ep{epochs}_bs16",
+            "kind": "cnn",
+            "headline": True,
+            **ref(REFERENCE_TRAIN_S,
+                  "Table 1, 8 procs (log_epochs25_proc8_children.txt:2)"),
+            "args": {"batch_size": 16, "epochs": epochs},
+        }
+    ]
+    for bs, ref_s in REFERENCE_BS_SWEEP_S.items():
+        if bs == 16:
+            continue  # the headline row already covers bs16
+        rows.append(
+            {
+                "id": f"cnn_dp_ep{epochs}_bs{bs}",
+                "kind": "cnn",
+                **ref(ref_s,
+                      f"Table 2, 4 procs (bs{bs}_log_epochs25_proc4_"
+                      "children.txt:2)"),
+                "args": {"batch_size": bs, "epochs": epochs},
+            }
+        )
+    rows += [
+        # compiled Pallas classifier head (r2 VERDICT weak #7: the Mosaic
+        # path must execute in at least one artifact; off-TPU the worker
+        # reports kernel_path so fallback drift is visible, on TPU a
+        # Mosaic compile failure fails this row loudly)
+        {
+            "id": f"cnn_dp_ep{epochs}_bs16_pallas",
+            "kind": "cnn",
+            **ref(REFERENCE_TRAIN_S,
+                  "Table 1, 8 procs; fused Pallas classifier head"),
+            "args": {"batch_size": 16, "epochs": epochs, "kernels": "pallas"},
+        },
+        # bf16 compute row (MXU-native)
+        {
+            "id": f"cnn_dp_ep{epochs}_bs16_bf16",
+            "kind": "cnn",
+            **ref(REFERENCE_TRAIN_S,
+                  "Table 1, 8 procs; bfloat16 compute"),
+            "args": {
+                "batch_size": 16, "epochs": epochs,
+                "compute_dtype": "bfloat16",
+            },
+        },
+        # LM throughput/MFU rows (no reference analog)
+        {
+            "id": "lm_flash_d512_L8_seq2048_bf16",
+            "kind": "lm",
+            "args": {"attn": "flash", "dtype": "bfloat16", "steps": 20},
+        },
+        {
+            "id": "lm_xla_d512_L8_seq2048_bf16",
+            "kind": "lm",
+            "args": {"attn": "full", "dtype": "bfloat16", "steps": 20},
+        },
+    ]
+    return rows
+
+
+# --------------------------------------------------------------- worker
+
+def _run_worker(spec: dict) -> dict:
+    """Execute one row in-process (called in the worker subprocess)."""
     from distributed_neural_network_tpu.train.cli import honor_platform_env
 
     honor_platform_env()
-
-    from distributed_neural_network_tpu.train.measure import measure_dp_training
-
-    r = measure_dp_training(
-        nb_proc=args.nb_proc,
-        batch_size=args.batch_size,
-        epochs=args.epochs,
-        data=args.data,
-        synthetic_size=args.synthetic_size,
-        sync_mode=args.sync_mode,
-        compute_dtype=args.compute_dtype,
-        kernels=args.kernels,
-        fused=args.fused,
-    )
-    train_s = r["train_s"]
-    print(
-        json.dumps(
-            {
-                "metric": (
-                    f"cifar10_dp_train_s_{r['epochs']}ep_bs{r['batch_size']}"
-                    f"_dev{r['devices']}_{r['source']}"
-                    f"_acc{r['val_acc']:.2f}"
-                ),
-                "value": round(train_s, 3),
-                "unit": "s",
-                "vs_baseline": round(REFERENCE_TRAIN_S / max(train_s, 1e-9), 2),
-            }
+    if spec["kind"] == "cnn":
+        from distributed_neural_network_tpu.train.measure import (
+            measure_dp_training,
         )
-    )
+
+        r = measure_dp_training(**spec["args"])
+        r["train_s"] = round(r["train_s"], 3)
+        return r
+    if spec["kind"] == "lm":
+        from distributed_neural_network_tpu.train.measure import (
+            measure_lm_training,
+        )
+
+        return measure_lm_training(**spec["args"])
+    raise ValueError(f"unknown row kind {spec['kind']!r}")
+
+
+# ----------------------------------------------------------- orchestrator
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _write_matrix(state: dict) -> None:
+    with open(MATRIX_PATH + ".tmp", "w") as f:
+        json.dump(state, f, indent=1)
+    os.replace(MATRIX_PATH + ".tmp", MATRIX_PATH)
+
+
+def _run_row_subprocess(spec: dict, timeout: float) -> tuple[dict | None, str]:
+    """Run one row in a fresh subprocess; (result, error) - one is set."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--worker",
+           json.dumps(spec)]
+    try:
+        p = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout, cwd=REPO
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"row timed out after {timeout:.0f}s"
+    if p.returncode == 0:
+        for line in reversed(p.stdout.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                return json.loads(line), ""
+        return None, f"worker printed no JSON (stdout: {p.stdout[-500:]!r})"
+    return None, (p.stderr or p.stdout)[-2000:]
+
+
+def _retryable(err: str) -> bool:
+    # a busy chip shows up either as an UNAVAILABLE-style init error or as
+    # a backend-init hang (observed r3: jax.devices() blocked >8 min), which
+    # surfaces here as the row timeout
+    return any(m in err for m in _RETRYABLE) or "row timed out" in err
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--worker", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--epochs", type=int, default=25)
+    p.add_argument("--data", default="auto",
+                   help="cnn rows: dataset source (auto/pickle/npz/synthetic)")
+    p.add_argument("--synthetic-size", type=int, default=None,
+                   help="cnn rows: synthetic train-split rows")
+    p.add_argument("--retries", type=int, default=5,
+                   help="attempts per row on busy/unavailable backend")
+    p.add_argument("--row-timeout", type=float, default=420.0)
+    p.add_argument("--deadline", type=float, default=1500.0,
+                   help="wall-clock budget; remaining non-headline rows are "
+                   "skipped (recorded as skipped) once exceeded")
+    p.add_argument("--only", default=None,
+                   help="comma-separated exact row ids to run")
+    args = p.parse_args()
+
+    if args.worker:
+        # worker mode: one row, one JSON line on stdout, exceptions -> rc 1
+        print(json.dumps(_run_worker(json.loads(args.worker))), flush=True)
+        return 0
+
+    t_start = time.time()
+    backoffs = [15.0 * (2 ** i) for i in range(max(args.retries - 1, 0))]
+    rows = _rows(args.epochs)
+    for spec in rows:
+        if spec["kind"] == "cnn":
+            spec["args"]["data"] = args.data
+            if args.synthetic_size is not None:
+                spec["args"]["synthetic_size"] = args.synthetic_size
+    if args.only:
+        keys = {k.strip() for k in args.only.split(",")}
+        rows = [r for r in rows if r["id"] in keys]
+        unknown = keys - {r["id"] for r in rows}
+        if not rows or unknown:
+            _log(f"[bench] --only matched no row for: {sorted(unknown)}; "
+                 f"known ids: {[r['id'] for r in _rows(args.epochs)]}")
+            print(json.dumps({
+                "metric": "bench_rows_ok", "value": 0, "unit": "rows",
+                "vs_baseline": None,
+                "error": f"--only matched no row for {sorted(unknown)}",
+            }))
+            return 1
+    state = {
+        "started_unix": round(t_start, 1),
+        "epochs": args.epochs,
+        "note": (
+            "vs_baseline = reference_seconds / ours (cross-platform: "
+            "reference rows are MPI processes on an 8-core i7-9800X, "
+            "BASELINE.md Tables 1-2; ours run on the devices listed per "
+            "row). ref columns attach only at --epochs 25."
+        ),
+        "rows": [],
+    }
+    headline = None
+    for spec in rows:
+        elapsed = time.time() - t_start
+        if elapsed > args.deadline and not spec.get("headline"):
+            _log(f"[bench] {spec['id']}: skipped (deadline "
+                 f"{args.deadline:.0f}s exceeded at {elapsed:.0f}s)")
+            state["rows"].append(
+                {"id": spec['id'], "skipped": "deadline exceeded"}
+            )
+            _write_matrix(state)
+            continue
+        result, err = None, ""
+        for attempt in range(max(args.retries, 1)):
+            # cap the attempt so the stdout JSON always lands before a
+            # driver whose kill timeout matches --deadline (+60s grace
+            # floor so a late first attempt still gets a real chance)
+            budget = max(args.deadline - (time.time() - t_start), 60.0)
+            _log(f"[bench] {spec['id']}: attempt {attempt + 1}")
+            result, err = _run_row_subprocess(
+                spec, min(args.row_timeout, budget)
+            )
+            if result is not None or not _retryable(err):
+                break
+            if time.time() - t_start > args.deadline:
+                _log(f"[bench] {spec['id']}: deadline exceeded, "
+                     "no further retries")
+                break
+            if attempt < len(backoffs):
+                _log(f"[bench] {spec['id']}: backend busy/unavailable, "
+                     f"retrying in {backoffs[attempt]:.0f}s "
+                     f"(error tail: {err[-200:]!r})")
+                time.sleep(backoffs[attempt])
+        row = {"id": spec["id"], **{k: v for k, v in spec.items()
+                                    if k in ("ref_s", "ref")}}
+        if result is not None:
+            row.update(result)
+            if "train_s" in result and spec.get("ref_s"):
+                row["vs_baseline"] = round(spec["ref_s"] / max(
+                    result["train_s"], 1e-9), 2)
+            _log(f"[bench] {spec['id']}: ok {json.dumps(result)}")
+        else:
+            row["error"] = err
+            _log(f"[bench] {spec['id']}: FAILED: {err[-500:]}")
+        state["rows"].append(row)
+        _write_matrix(state)
+        if spec.get("headline"):
+            headline = row
+
+    # the bs16 cell of the Table 2 sweep: same measurement as the headline
+    # row (identical config), re-referenced against the 4-proc Table 2 time
+    # so the sweep carries every reference datapoint without a second run
+    if (headline is not None and "train_s" in headline
+            and args.epochs == 25):
+        t2 = REFERENCE_BS_SWEEP_S[16]
+        state["rows"].append({
+            "id": f"cnn_dp_ep{args.epochs}_bs16_table2",
+            "derived_from": headline["id"],
+            "ref_s": t2,
+            "ref": "Table 2, 4 procs (bs16_log_epochs25_proc4_"
+                   "children.txt:2)",
+            "train_s": headline["train_s"],
+            "devices": headline["devices"],
+            "vs_baseline": round(t2 / max(headline["train_s"], 1e-9), 2),
+        })
+
+    state["finished_unix"] = round(time.time(), 1)
+    _write_matrix(state)
+
+    # the single stdout JSON line: headline row, or structured error
+    if headline is not None and "train_s" in headline:
+        print(json.dumps({
+            "metric": (
+                f"cifar10_dp_train_s_{headline['epochs']}ep"
+                f"_bs{headline['batch_size']}_dev{headline['devices']}"
+                f"_{headline['source']}"
+            ),
+            "value": headline["train_s"],
+            "unit": "s",
+            "vs_baseline": headline.get("vs_baseline"),
+        }))
+        return 0
+    if headline is None and not any(r.get("headline") for r in rows):
+        # --only subset without the headline: report subset status instead
+        # of misreading a successful smoke run as a failure
+        ok = sum(1 for r in state["rows"] if "error" not in r
+                 and "skipped" not in r)
+        print(json.dumps({
+            "metric": "bench_rows_ok",
+            "value": ok,
+            "unit": "rows",
+            "vs_baseline": None,
+        }))
+        return 0 if ok == len(state["rows"]) else 1
+    print(json.dumps({
+        "metric": f"cifar10_dp_train_s_{args.epochs}ep_bs16",
+        "value": None,
+        "unit": "s",
+        "vs_baseline": None,
+        "error": (headline or {}).get(
+            "error", "headline row did not run"
+        )[-800:],
+    }))
+    return 1
 
 
 if __name__ == "__main__":

@@ -48,13 +48,26 @@ def _flash_available() -> bool:
 
 
 @functools.cache
-def _block_sizes(s: int):
-    """Uniform tuned blocks (1024, clamped to S, floor 128). Measured best
-    fwd+bwd at head_dim 64 on v5e among {defaults, 256, 512, 1024, 2048}^2
-    combinations; 512 wins fwd-only but loses the round trip."""
+def _block_sizes(s: int, head_dim: int = 64):
+    """Uniform tuned blocks for the flash kernel, or None for library defaults.
+
+    The 1024-uniform tuning was measured at head_dim 64 on v5e among
+    {defaults, 256, 512, 1024, 2048}^2 combinations (512 wins fwd-only but
+    loses the round trip). The kernel's `_verify_block` requires every block
+    to divide the sequence length, so the tuned size is the largest
+    power-of-two divisor of S in [128, 1024]; when none exists (S < 128 or
+    S not 128-aligned, e.g. the CLI default seq 64) or head_dim != 64
+    (where the tuning was never measured), return None and let the kernel
+    pick its own verified defaults instead of raising."""
+    if head_dim != 64:
+        return None
+    for b in (1024, 512, 256, 128):
+        if s % b == 0:
+            break
+    else:
+        return None
     from jax.experimental.pallas.ops.tpu.flash_attention import BlockSizes
 
-    b = max(min(1024, s), 128)
     return BlockSizes(
         block_q=b, block_k_major=b, block_k=b, block_b=1,
         block_q_major_dkv=b, block_k_major_dkv=b,
@@ -78,6 +91,6 @@ def flash_local_attention(q, k, v, *, causal: bool = True):
         v.transpose(0, 2, 1, 3),
         causal=causal,
         sm_scale=1.0 / math.sqrt(d),
-        block_sizes=_block_sizes(q.shape[1]),
+        block_sizes=_block_sizes(q.shape[1], d),
     )
     return out.transpose(0, 2, 1, 3)

@@ -95,7 +95,9 @@ def add_common_flags(p: argparse.ArgumentParser, *, epochs: int, batch_size: int
         help="run multi-epoch compiled spans (one dispatch per span) instead "
         "of one dispatch per phase per epoch - the fast path; phase timing "
         "then reports train+sync(+eval at --eval-every 1) as one TRAINING "
-        "number",
+        "number. Silently downgraded to the per-epoch path when combined "
+        "with --failure-duration > 0 (straggler sleeps can only interleave "
+        "between epochs) or --input-mode stream",
     )
     p.add_argument(
         "--profile-dir",

@@ -106,6 +106,16 @@ def _ce_sum_chunked(x, head, targets, n_chunks: int, axes=()):
     return total
 
 
+def auto_loss_chunks(b: int, s: int, vocab: int) -> int:
+    """Smallest chunk count dividing S that bounds one chunk's f32 logits
+    ((b, s/c, vocab)) to ~64 MB; 1 when the single pass already fits."""
+    budget = 64 * 2**20 // 4
+    for c in range(1, s + 1):
+        if s % c == 0 and b * (s // c) * vocab <= budget:
+            return c
+    return s
+
+
 def lm_loss(
     params,
     tokens,
@@ -138,13 +148,7 @@ def lm_loss(
     )
     b, s_local = tokens.shape
     if loss_chunks == 0:
-        # bound per-chunk f32 logits to ~64 MB; chunk count must divide S
-        budget = 64 * 2**20 // 4
-        loss_chunks = 1
-        for c in range(1, s_local + 1):
-            if s_local % c == 0 and b * (s_local // c) * cfg.vocab_size <= budget:
-                loss_chunks = c
-                break
+        loss_chunks = auto_loss_chunks(b, s_local, cfg.vocab_size)
     if loss_chunks > 1:
         local_sum = _ce_sum_chunked(
             x, params["head"], targets, loss_chunks, axes=axes
@@ -191,13 +195,15 @@ def make_lm_train_step(
     momentum: float = 0.9,
     attn_impl: str = "ring",
     optimizer: str = "sgd",
+    loss_chunks: int = 0,
 ):
     """Compiled (params, mom, tokens, targets) -> (params, mom, loss).
 
     tokens/targets: (B, S) int32, B divisible by dp, S by sp. Loss returns
     replicated. The step is donate-safe on params/mom. optimizer='zero'
     shards the momentum buffer over the data axis (ZeRO-1,
-    parallel/zero.py); init mom with `init_lm_momentum`.
+    parallel/zero.py); init mom with `init_lm_momentum`. loss_chunks is
+    passed through to `lm_loss` (0 = auto-chunk by the 64 MB logits budget).
     """
     sp = SEQ_AXIS if mesh.shape.get(SEQ_AXIS, 1) > 1 else None
     tp = TP_AXIS if mesh.shape.get(TP_AXIS, 1) > 1 else None
@@ -227,6 +233,7 @@ def make_lm_train_step(
             ep_axis=ep,
             attn_impl=attn_impl,
             axes=sync_axes,
+            loss_chunks=loss_chunks,
         )
 
     def step(params, mom, tokens, targets):

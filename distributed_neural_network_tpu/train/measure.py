@@ -1,19 +1,61 @@
-"""Shared benchmark harness for bench.py and report.py.
+"""Shared benchmark harness for bench.py, report.py and lm_train.py.
 
 One implementation of "train the data-parallel CIFAR workload and time the
-train+sync phases" so the two entry points cannot drift: split loading,
+train+sync phases" so the entry points cannot drift: split loading,
 warm-up policy, the fused-span fast path with its outside-the-timer final
 eval (mirroring the reference's child train-time metric, which excludes the
-parent's eval - SURVEY.md section 6), and the phase accounting.
+parent's eval - SURVEY.md section 6), and the phase accounting. Also the LM
+throughput/MFU measurement (`measure_lm_training`) and the MFU accounting
+(`model_flops_per_token`, `peak_flops`) shared by lm_train.py and bench.py.
 """
 
 from __future__ import annotations
+
+import time
 
 import jax
 
 from ..data.cifar10 import load_split
 from ..utils import timers as T
 from .engine import Engine, TrainConfig
+
+# peak TFLOP/s by device kind for the MFU denominator; None = unknown kind.
+# bf16 is the MXU-native rate; f32 matmuls run at roughly half of it on
+# TPU (the MXU computes f32 via bf16x3-style passes), so MFU for f32 runs
+# is reported against the halved peak (ADVICE r2: quoting the bf16 peak
+# silently understated f32 utilization).
+PEAK_TFLOPS_BF16 = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+F32_PEAK_FACTOR = 0.5
+
+
+def peak_flops(device_kind: str, dtype: str = "bfloat16") -> float | None:
+    """Per-device peak FLOP/s for the MFU denominator, dtype-adjusted."""
+    peak = PEAK_TFLOPS_BF16.get(device_kind)
+    if peak is None:
+        return None
+    return peak * (F32_PEAK_FACTOR if dtype == "float32" else 1.0)
+
+
+def model_flops_per_token(cfg, seq_len: int) -> float:
+    """Model FLOPs per trained token (fwd + 2x bwd), PaLM-appendix style.
+
+    Per layer, per token (forward): 8*d^2 (QKV+out projections) +
+    4*seq*d (attention scores+values, causal NOT halved - the standard
+    convention) + 4*d*ff (MLP; for MoE, the top-k activated experts).
+    Plus 2*d*vocab for the LM head. Backward = 2x forward; remat recompute
+    is excluded (MFU counts model FLOPs, not hardware FLOPs).
+    """
+    d, f, v, L = cfg.d_model, cfg.d_ff, cfg.vocab_size, cfg.n_layers
+    mlp = 4 * d * f * (cfg.moe_top_k if cfg.n_experts else 1)
+    per_layer = 8 * d * d + 4 * seq_len * d + mlp
+    return 3.0 * (L * per_layer + 2 * d * v)
 
 
 def measure_dp_training(
@@ -75,4 +117,81 @@ def measure_dp_training(
         "val_loss": final.val_loss,
         "train_s": timers.get(T.TRAINING) + timers.get(T.COMMUNICATION),
         "source": train_split.source,
+    }
+
+
+def measure_lm_training(
+    *,
+    d_model: int = 512,
+    n_layers: int = 8,
+    n_heads: int = 8,
+    d_ff: int = 2048,
+    vocab: int = 32768,
+    seq_len: int = 2048,
+    batch: int = 16,
+    steps: int = 20,
+    warmup: int = 2,
+    attn: str = "flash",
+    dtype: str = "bfloat16",
+    remat: bool = False,
+    loss_chunks: int = 0,
+    lr: float = 0.01,
+) -> dict:
+    """Single-mesh LM throughput: tokens/s and MFU over `steps` timed steps.
+
+    attn='flash' uses the tuned Pallas kernel on TPU (falls back to plain
+    attention elsewhere - the returned dict records which path ran, so
+    callers can fail loudly when the compiled kernel was required:
+    VERDICT r2 weak #7). MFU follows `model_flops_per_token` with the
+    dtype-adjusted peak.
+    """
+    import jax.numpy as jnp
+
+    from ..models import transformer as tfm
+    from ..ops.flash import _flash_available
+    from . import lm as lmtrain
+
+    cfg = tfm.TransformerConfig(
+        vocab_size=vocab, d_model=d_model, n_heads=n_heads,
+        n_layers=n_layers, d_ff=d_ff,
+        dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32,
+        remat=remat,
+    )
+    mesh = lmtrain.create_lm_mesh(1, 1, 1)
+    params0 = tfm.init_params(jax.random.key(0), cfg)
+    params, _ = lmtrain.shard_params(params0, cfg, mesh)
+    mom = lmtrain.init_lm_momentum(params, mesh)
+    step = lmtrain.make_lm_train_step(
+        cfg, mesh, lr=lr, attn_impl=attn, loss_chunks=loss_chunks
+    )
+    tokens, targets = lmtrain.make_copy_task(
+        jax.random.key(1), batch=batch, seq_len=seq_len, vocab=vocab
+    )
+    for _ in range(max(warmup, 1)):
+        params, mom, loss = step(params, mom, tokens, targets)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, mom, loss = step(params, mom, tokens, targets)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    tok_s = batch * seq_len * steps / dt
+    flops_tok = model_flops_per_token(cfg, seq_len)
+    dev = jax.devices()[0]
+    peak = peak_flops(dev.device_kind, dtype)
+    mfu = flops_tok * tok_s / peak * 100.0 if peak else None
+    return {
+        "d_model": d_model, "n_layers": n_layers, "seq_len": seq_len,
+        "vocab": vocab, "batch": batch, "steps": steps, "dtype": dtype,
+        "attn": attn, "remat": remat,
+        "attn_kernel": (
+            "pallas-flash" if attn == "flash" and _flash_available()
+            else "xla"
+        ),
+        "device_kind": dev.device_kind,
+        "tokens_per_s": round(tok_s),
+        "wall_s": round(dt, 3),
+        "model_tflops_per_s": round(flops_tok * tok_s / 1e12, 2),
+        "mfu_pct": round(mfu, 2) if mfu is not None else None,
+        "final_loss": float(loss),
     }

@@ -24,31 +24,10 @@ import json
 import sys
 import time
 
-# bf16 peak TFLOP/s by device kind (MFU denominator); None = unknown kind
-PEAK_TFLOPS = {
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,
-    "TPU v6e": 918e12,
-}
-
-
-def model_flops_per_token(cfg, seq_len: int) -> float:
-    """Model FLOPs per trained token (fwd + 2x bwd), PaLM-appendix style.
-
-    Per layer, per token (forward): 8*d^2 (QKV+out projections) +
-    4*seq*d (attention scores+values, causal NOT halved - the standard
-    convention) + 4*d*ff (MLP; for MoE, the top-k activated experts).
-    Plus 2*d*vocab for the LM head. Backward = 2x forward; remat recompute
-    is excluded (MFU counts model FLOPs, not hardware FLOPs).
-    """
-    d, f, v, L = cfg.d_model, cfg.d_ff, cfg.vocab_size, cfg.n_layers
-    mlp = 4 * d * f * (cfg.moe_top_k if cfg.n_experts else 1)
-    per_layer = 8 * d * d + 4 * seq_len * d + mlp
-    return 3.0 * (L * per_layer + 2 * d * v)
-
+# checkpoint momentum-layout version: "tree" = per-leaf momentum trees for
+# both sgd and zero (the round-2 layout); bump on any layout change so
+# resume rejects old checkpoints with a clear message
+MOM_FORMAT = "tree"
 
 def main() -> int:
     p = argparse.ArgumentParser(
@@ -81,6 +60,10 @@ def main() -> int:
     p.add_argument("--n-layers", type=int, default=4)
     p.add_argument("--d-ff", type=int, default=512)
     p.add_argument("--dtype", choices=("float32", "bfloat16"), default="float32")
+    p.add_argument("--loss-chunks", type=int, default=0,
+                   help="compute the CE loss in this many sequence chunks "
+                   "so full (B, S, vocab) logits never materialize "
+                   "(0 = auto-pick by a 64 MB logits budget, 1 = single pass)")
     p.add_argument("--remat", action="store_true",
                    help="rematerialize blocks in backward (jax.checkpoint): "
                    "~1/3 more FLOPs for far less activation memory")
@@ -100,6 +83,15 @@ def main() -> int:
         p.error("--checkpoint-every must be >= 1")
     if args.resume and not args.checkpoint_dir:
         p.error("--resume requires --checkpoint-dir")
+    if args.loss_chunks > 1 and (
+        args.seq_len // max(args.sp, 1)
+    ) % args.loss_chunks:
+        p.error(
+            f"--loss-chunks {args.loss_chunks} must divide the per-shard "
+            f"sequence length {args.seq_len // max(args.sp, 1)} "
+            f"(--seq-len / --sp; the CE is chunked along the local "
+            "sequence axis)"
+        )
     if args.attn == "zigzag" and args.sp > 1 and args.seq_len % (2 * args.sp):
         p.error(
             f"--attn zigzag needs --seq-len divisible by 2*sp "
@@ -170,6 +162,7 @@ def main() -> int:
         step = lmtrain.make_lm_train_step(
             cfg, mesh, lr=args.lr, momentum=args.momentum,
             attn_impl=args.attn, optimizer=args.optimizer,
+            loss_chunks=args.loss_chunks,
         )
     param_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
 
@@ -204,14 +197,29 @@ def main() -> int:
                 )
             if restored is not None:
                 state, meta, last = restored
-                for key_, want in (("mesh", mesh_desc),
-                                   ("optimizer", args.optimizer)):
+                # mom_format guards against checkpoints from before the
+                # ZeRO momentum layout change (flat buffer -> per-leaf
+                # tree): the mesh/optimizer checks pass on those but
+                # restore then dies on an opaque tree-structure mismatch,
+                # so reject with a clear message instead. Only the 'zero'
+                # layout ever changed - sgd checkpoints without the key
+                # (written before the key existed) restore fine and are
+                # accepted.
+                checks = [("mesh", mesh_desc), ("optimizer", args.optimizer)]
+                if args.optimizer == "zero":
+                    checks.append(("mom_format", MOM_FORMAT))
+                for key_, want in checks:
                     if meta.get(key_) != want:
                         raise SystemExit(
                             f"checkpoint was written with {key_}="
                             f"{meta.get(key_)!r}, this run has {want!r} - "
                             "momentum/param shards don't map across layouts; "
                             "resume with the original flags"
+                            + (
+                                " (or restart training: this checkpoint "
+                                "predates the current momentum layout)"
+                                if key_ == "mom_format" else ""
+                            )
                         )
                 params, mom = state["params"], state["mom"]
                 step0 = last + 1
@@ -253,29 +261,38 @@ def main() -> int:
         if ck is not None and (i + 1) % args.checkpoint_every == 0:
             ck.save(i, {"params": params, "mom": mom},
                     {"mesh": mesh_desc, "optimizer": args.optimizer,
-                     "loss": float(loss)})
+                     "mom_format": MOM_FORMAT, "loss": float(loss)})
     jax.block_until_ready(loss)
     if ck is not None:
         ck.save(steps_run[-1], {"params": params, "mom": mom},
                 {"mesh": mesh_desc, "optimizer": args.optimizer,
-                 "loss": float(loss)})
+                 "mom_format": MOM_FORMAT, "loss": float(loss)})
         ck.close()
+    from distributed_neural_network_tpu.train.measure import (
+        model_flops_per_token,
+        peak_flops,
+    )
+
     dt = time.perf_counter() - t0 if args.steps > 1 else 0.0
     tok_s = args.batch_size * args.seq_len * (args.steps - 1) / dt if dt else 0.0
     flops_tok = model_flops_per_token(cfg, args.seq_len)
     model_flops_s = flops_tok * tok_s
     n_dev = mesh.devices.size
-    peak = PEAK_TFLOPS.get(jax.devices()[0].device_kind)
+    peak = peak_flops(jax.devices()[0].device_kind, args.dtype)
     mfu = model_flops_s / (peak * n_dev) * 100.0 if peak else None
     if mfu is not None:
+        peak_label = (
+            "bf16" if args.dtype == "bfloat16" else "f32 (0.5x bf16 MXU)"
+        )
         print(
             f"MFU {mfu:.1f}% = {model_flops_s / 1e12:.1f} model TFLOP/s / "
-            f"({peak / 1e12:.0f} peak bf16 TFLOP/s x {n_dev} dev); "
+            f"({peak / 1e12:.0f} peak {peak_label} TFLOP/s x {n_dev} dev); "
             f"FLOPs/token = 3*(L*(8d^2 + 4sd + 4d*ff) + 2d*V) "
             f"= {flops_tok / 1e6:.1f}M"
         )
     print("SUMMARY " + json.dumps({
         "mesh": mesh_desc, "steps": args.steps, "start_step": step0,
+        "dtype": args.dtype,
         "first_loss": first_loss, "final_loss": float(loss),
         "tokens_per_s": round(tok_s), "wall_s_post_compile": round(dt, 3),
         "model_tflops_per_s": round(model_flops_s / 1e12, 2),
