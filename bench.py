@@ -118,6 +118,18 @@ def _rows(epochs: int) -> list[dict]:
                 "compute_dtype": "bfloat16",
             },
         },
+        # host-streaming input vs the HBM default: the >HBM-dataset path,
+        # double-buffered (r2 VERDICT weak #5 asks the gap measured; the
+        # hbm comparison point is the headline row)
+        {
+            "id": f"cnn_dp_ep{epochs}_bs16_stream",
+            "kind": "cnn",
+            **ref(REFERENCE_TRAIN_S,
+                  "Table 1, 8 procs; host-streaming input, prefetch 2"),
+            "args": {
+                "batch_size": 16, "epochs": epochs, "input_mode": "stream",
+            },
+        },
         # LM throughput/MFU rows (no reference analog)
         {
             "id": "lm_flash_d512_L8_seq2048_bf16",
@@ -183,7 +195,10 @@ def _run_row_subprocess(spec: dict, timeout: float) -> tuple[dict | None, str]:
         for line in reversed(p.stdout.strip().splitlines()):
             line = line.strip()
             if line.startswith("{"):
-                return json.loads(line), ""
+                try:
+                    return json.loads(line), ""
+                except json.JSONDecodeError:
+                    continue  # stray brace line (dict repr etc.): keep scanning
         return None, f"worker printed no JSON (stdout: {p.stdout[-500:]!r})"
     return None, (p.stderr or p.stdout)[-2000:]
 

@@ -74,6 +74,15 @@ class TransformerConfig:
     n_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 2.0
+    # dispatch: "sort" (scatter/gather coordinates, O(T*k + E*C*d) memory,
+    # real-scale default) or "dense" ((T, E, C) one-hot einsums, the
+    # small-shape oracle) - identical numerics (parallel/moe.py)
+    moe_dispatch: str = "sort"
+    # router z-loss weight RELATIVE to the load-balance aux: the training
+    # loss adds aux_weight * (switch_aux + moe_z_weight * mean(lse^2)), so
+    # the default 0.1 with lm_loss's aux_weight=0.01 gives the standard
+    # 1e-3 z-loss coefficient (ST-MoE)
+    moe_z_weight: float = 0.1
 
     @property
     def head_dim(self) -> int:
@@ -274,6 +283,8 @@ def transformer_block(x, lp, cfg: TransformerConfig, *, attend, tp_axis=None,
             capacity=capacity,
             ep_axis=ep_axis,
             tp_axis=tp_axis,
+            dispatch_impl=cfg.moe_dispatch,
+            z_loss_weight=cfg.moe_z_weight,
         )
         x = x + y.reshape(b, s_local, cfg.d_model)
     else:

@@ -5,12 +5,23 @@ expert parallelism explicitly absent; its only model is the 62K-param CNN at
 `/root/reference/models/model.py:9-27`). This module is the framework's
 expert-parallel capability, built TPU-first in the GShard/Switch style:
 
-- **Static shapes everywhere.** Routing is expressed as dense one-hot
-  dispatch/combine tensors with a fixed per-expert *capacity*; tokens that
-  overflow an expert's capacity are dropped (their FFN contribution is zero,
-  the residual stream passes them through). No gather/scatter with
-  data-dependent shapes - everything is einsum, so XLA tiles it onto the MXU
-  and the program never retraces.
+- **Static shapes everywhere.** Routing uses a fixed per-expert *capacity*;
+  tokens that overflow an expert's capacity are dropped (their FFN
+  contribution is zero, the residual stream passes them through). No
+  data-dependent shapes, so the program never retraces.
+- **Two dispatch implementations, one contract.** `dispatch_impl="dense"`
+  materializes (T, E, C) one-hot dispatch/combine tensors and runs pure
+  einsums - trivially correct, O(T*E*C) memory, the small-shape oracle.
+  `dispatch_impl="sort"` (default; r2 VERDICT weak #4) computes each
+  routed token's (expert, capacity-slot) coordinate with a one-hot cumsum
+  in token order - the same priority order as the dense path, so numerics
+  match - then scatter-adds tokens into the (E, C, d) slot tensor and
+  gathers results back: O(T*k*E) routing work and O(T*k + E*C*d) memory,
+  usable at real token/expert counts (tested at 64k tokens) where the
+  dense tensors would be tens of GB.
+- **Router z-loss** (ST-MoE): mean squared logsumexp of the router logits,
+  weighted into the returned aux, keeps router logits from drifting to
+  magnitudes where softmax saturates and bf16 rounds badly.
 - **Expert parallelism = one all_to_all each way.** Experts are sharded over
   a mesh axis (conventionally the data axis, as in GShard); each device
   routes its local tokens, materializes per-expert capacity slots
@@ -84,6 +95,38 @@ def topk_dispatch(probs, top_k: int, capacity: int):
     return combine, dispatch, aux
 
 
+def sort_route(probs, top_k: int, capacity: int):
+    """Coordinate-form top-k routing with per-expert capacity.
+
+    probs: (T, E) router probabilities. Returns (expert_idx, slot_idx,
+    weight, aux): each (k*T,) flat arrays in round-major order (all first
+    choices in token order, then all second choices - the same priority
+    the dense oracle uses), where `slot_idx` is the token's position in
+    its expert's capacity buffer (== capacity when the token overflowed
+    and must be dropped) and `weight` is the kept-gate renormalized
+    combine weight (0 for dropped slots). O(T*k*E) work, no (T, E, C)
+    tensor. aux is the Switch load-balancing loss.
+    """
+    t, e = probs.shape
+    gates, experts = jax.lax.top_k(probs, top_k)  # (T, k), priority order
+    flat_e = experts.T.reshape(-1)  # (k*T,) round-major
+    flat_g = gates.T.reshape(-1)
+    onehot = jax.nn.one_hot(flat_e, e, dtype=jnp.int32)  # (kT, E)
+    # position among same-expert entries, in round-major (= dense) order
+    pos = ((jnp.cumsum(onehot, axis=0) - 1) * onehot).sum(-1)  # (kT,)
+    keep = pos < capacity
+    slot = jnp.where(keep, pos, capacity)
+    # renormalize each token's kept gates to sum to 1 (dense-path parity)
+    kept_g = jnp.where(keep, flat_g, 0.0).reshape(top_k, t)
+    denom = jnp.maximum(kept_g.sum(0), 1e-9)
+    weight = (kept_g / denom[None, :]).reshape(-1)
+
+    # Switch aux from first-choice assignment: E * sum_i f_i * P_i
+    frac = onehot[:t].mean(0).astype(probs.dtype)
+    aux = jnp.float32(e) * jnp.sum(frac * probs.mean(0))
+    return flat_e, slot, weight, aux
+
+
 def moe_ffn(
     x,
     wr,
@@ -96,18 +139,40 @@ def moe_ffn(
     capacity: int,
     ep_axis: str | None = None,
     tp_axis: str | None = None,
+    dispatch_impl: str = "sort",
+    z_loss_weight: float = 0.0,
 ):
     """Mixture-of-experts gelu FFN on a flat token batch.
 
     x: (T, d) local tokens. wr: (d, E) router (E = global expert count).
     w1 (E_local, d, F_local), b1 (E_local, F_local), w2 (E_local, F_local, d),
     b2 (E_local, d) - the local expert shard (E_local = E/|ep|, F_local =
-    F/|tp|). Returns (y, aux) with y (T, d) in x.dtype.
+    F/|tp|). Returns (y, aux) with y (T, d) in x.dtype; aux is the Switch
+    load-balancing loss plus z_loss_weight * mean(logsumexp(logits)^2)
+    (router z-loss; the caller's aux weight multiplies the whole thing).
+    dispatch_impl: "sort" (scatter/gather, scalable) or "dense" (one-hot
+    einsum oracle) - identical numerics, different memory scaling.
     """
     dt = x.dtype
-    probs = jax.nn.softmax(x.astype(jnp.float32) @ wr.astype(jnp.float32), axis=-1)
-    combine, dispatch, aux = topk_dispatch(probs, top_k, capacity)
-    xe = jnp.einsum("tec,td->ecd", dispatch.astype(dt), x)  # (E, C, d)
+    logits = x.astype(jnp.float32) @ wr.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if dispatch_impl == "dense":
+        combine, dispatch, aux = topk_dispatch(probs, top_k, capacity)
+        xe = jnp.einsum("tec,td->ecd", dispatch.astype(dt), x)  # (E, C, d)
+    elif dispatch_impl == "sort":
+        k = top_k
+        t, e = probs.shape
+        flat_e, slot, weight, aux = sort_route(probs, top_k, capacity)
+        x_rep = jnp.tile(x, (k, 1))  # (kT, d) round-major
+        xe = jnp.zeros((e, capacity, x.shape[1]), dt)
+        # slot == capacity for dropped tokens -> out of bounds -> 'drop';
+        # slots are unique per expert, so add == set (combine applies the
+        # gate weight, matching the 0/1 dense dispatch tensor)
+        xe = xe.at[flat_e, slot].add(x_rep, mode="drop")
+    else:
+        raise ValueError(
+            f"dispatch_impl must be 'sort' or 'dense', got {dispatch_impl!r}"
+        )
     if ep_axis is not None:
         # token-major -> expert-major: device p gets slots for its E_local
         # experts from every source; (E, C, d) -> (E_local, n*C, d)
@@ -120,5 +185,15 @@ def moe_ffn(
     y = y + b2.astype(dt)[:, None]
     if ep_axis is not None:
         y = jax.lax.all_to_all(y, ep_axis, split_axis=1, concat_axis=0, tiled=True)
-    out = jnp.einsum("tec,ecd->td", combine.astype(dt), y)
+    if dispatch_impl == "dense":
+        out = jnp.einsum("tec,ecd->td", combine.astype(dt), y)
+    else:
+        # dropped slots (slot == capacity) are out of bounds -> fill 0
+        gathered = y.at[flat_e, slot].get(mode="fill", fill_value=0)
+        out = (gathered * weight.astype(dt)[:, None]).reshape(
+            top_k, t, x.shape[1]
+        ).sum(0)
+    if z_loss_weight:
+        z = jax.scipy.special.logsumexp(logits, axis=-1)
+        aux = aux + jnp.float32(z_loss_weight) * jnp.mean(z * z)
     return out, aux

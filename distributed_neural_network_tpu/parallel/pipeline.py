@@ -25,13 +25,22 @@ point-to-point-send way:
   params (device-varying over 'pipe') get local gradients, while embed/head
   (replicated over 'pipe') get their cross-stage gradient psum from
   shard_map's typing - no hand-written send/recv of activation grads.
+- **The LM head runs once per microbatch, sharded over the stages.** Ticks
+  only run blocks + ppermute - no vocab-sized work (r2 VERDICT weak #3:
+  the old schedule computed the full head on every stage every tick and
+  `where`-discarded it, paying the ~28%-of-FLOPs head P*(M+P-1)/M times
+  over). The last stage's exit activations (one microbatch per tick once
+  the pipe is full) are collected from the scan, redistributed round-robin
+  over the 'pipe' axis with one all_to_all, and each stage runs final-norm
+  + head + chunked CE for M/P microbatches: total head work is M passes
+  (plus up to P-1 padding passes when P does not divide M), and it
+  parallelizes over the stage axis instead of being wasted on it.
 - Composes with a 'data' axis (batch sharded, grad pmean automatic) and the
   tensor-parallel 'model' axis (per-block psums inside each stage).
 
-Known simplicity trade: every stage computes the (cheap) embedding and LM
-head every tick, with `where`-selection keeping only the boundary stages'
-results - wasted VPU work proportional to vocab, in exchange for a fully
-uniform SPMD program with zero stage branching.
+Remaining uniform-SPMD trade: every stage still performs the per-tick
+embedding *gather* (vocab-independent indexing work) so stage 0 needs no
+special program; only the matmul-heavy head was worth de-duplicating.
 """
 
 from __future__ import annotations
@@ -85,12 +94,15 @@ def pipeline_lm_loss(
     n_microbatches: int,
     tp_axis: str | None = None,
     sync_axes=(),
+    loss_chunks: int = 0,
 ):
     """Mean next-token cross-entropy via the microbatch pipeline schedule.
 
     Call inside shard_map. tokens/targets: (B_local, S) int32; params: the
     local stage shard (layers leaves (L/P, ...), embed/head replicated).
     Returns the replicated global mean loss (psum over pipe + sync_axes).
+    loss_chunks: CE sequence-chunk count (0 = auto by the 64 MB logits
+    budget; must divide S).
     """
     n_pipe = jax.lax.axis_size(pipe_axis)
     stage = jax.lax.axis_index(pipe_axis)
@@ -120,29 +132,18 @@ def pipeline_lm_loss(
         return x
 
     perm = [(i, (i + 1) % n_pipe) for i in range(n_pipe)]
-    is_last = stage == n_pipe - 1
 
-    def tick(carry, t):
-        x_in, loss_sum = carry
+    def tick(x_in, t):
         t_feed = jnp.clip(t, 0, m - 1)
         fresh = params["embed"][jax.lax.dynamic_index_in_dim(
             tok_mb, t_feed, keepdims=False
         )].astype(dt) + pe
         x = jnp.where(stage == 0, fresh, x_in)
         out = local_blocks(x)
-
-        # last stage: head + loss for microbatch t - (P-1), when valid
-        h = tfm._layer_norm(out, params["lnf_scale"], params["lnf_bias"]).astype(dt)
-        logits = (h @ params["head"].astype(dt)).astype(jnp.float32)
-        t_out = jnp.clip(t - (n_pipe - 1), 0, m - 1)
-        tgt = jax.lax.dynamic_index_in_dim(tgt_mb, t_out, keepdims=False)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        ll = jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
-        valid = jnp.logical_and(is_last, t >= n_pipe - 1)
-        loss_sum = loss_sum + jnp.where(valid, -ll.sum(), 0.0)
-
         x_out = jax.lax.ppermute(out, pipe_axis, perm)
-        return (x_out, loss_sum), None
+        # emit the pre-rotation output: on the last stage at tick t >= P-1
+        # it is the finished hidden state of microbatch t-(P-1)
+        return x_out, out
 
     def vary(x):
         # activations vary over the pipe axis (stage-dependent) and whatever
@@ -156,10 +157,63 @@ def pipeline_lm_loss(
         return jax.lax.pcast(x, missing, to="varying") if missing else x
 
     x0 = vary(jnp.zeros((mb, s, cfg.d_model), dt))
-    loss0 = vary(jnp.float32(0.0))
-    (_, loss_sum), _ = jax.lax.scan(
-        tick, (x0, loss0), jnp.arange(m + n_pipe - 1)
+    _, outs = jax.lax.scan(tick, x0, jnp.arange(m + n_pipe - 1))
+
+    # exit blocks: ticks P-1 .. P-1+M-1 (garbage on non-last stages). Pad M
+    # up to a multiple of P so one tiled all_to_all can deal each stage an
+    # equal share; padded microbatches carry zero weight.
+    exits = outs[n_pipe - 1:]
+    mp = -(-m // n_pipe) * n_pipe
+    k = mp // n_pipe
+    if mp > m:
+        exits = jnp.concatenate(
+            [exits, jnp.zeros((mp - m, mb, s, cfg.d_model), exits.dtype)], 0
+        )
+        tgt_mb = jnp.concatenate(
+            [tgt_mb, jnp.zeros((mp - m, mb, s), tgt_mb.dtype)], 0
+        )
+    w_mb = (jnp.arange(mp) < m).astype(jnp.float32)
+
+    # deal microbatches round-robin over stages: after the all_to_all,
+    # rows [(P-1)*k, P*k) on stage q are the LAST stage's exits for global
+    # microbatches [q*k, (q+1)*k) - the only rows holding finished hiddens
+    dealt = jax.lax.all_to_all(
+        exits, pipe_axis, split_axis=0, concat_axis=0, tiled=True
     )
+    mine = jax.lax.slice_in_dim(dealt, (n_pipe - 1) * k, n_pipe * k, axis=0)
+    my_tgt = jax.lax.dynamic_slice_in_dim(tgt_mb, stage * k, k, axis=0)
+    my_w = jax.lax.dynamic_slice_in_dim(w_mb, stage * k, k, axis=0)
+
+    # final norm + head + CE for my share, seq-chunked so the (k*mb, S,
+    # vocab) logits never materialize whole (same trick as train/lm.py)
+    h = tfm._layer_norm(
+        mine, params["lnf_scale"], params["lnf_bias"]
+    ).astype(dt)
+    rows = k * mb
+    x_rows = h.reshape(rows, s, cfg.d_model)
+    t_rows = my_tgt.reshape(rows, s)
+    w_rows = jnp.repeat(my_w, mb)
+    from ..train.lm import auto_loss_chunks
+
+    n_chunks = loss_chunks or auto_loss_chunks(rows, s, cfg.vocab_size)
+    cs = s // n_chunks
+    head = params["head"].astype(dt)
+
+    @jax.checkpoint
+    def chunk_ce(xc, tc):
+        logits = (xc @ head).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, tc[..., None], axis=-1)[..., 0]
+        return -(ll.sum(-1) * w_rows).sum()
+
+    xs = x_rows.reshape(rows, n_chunks, cs, cfg.d_model).swapaxes(0, 1)
+    ts = t_rows.reshape(rows, n_chunks, cs).swapaxes(0, 1)
+
+    def body(acc, xt):
+        return acc + chunk_ce(*xt), None
+
+    loss_sum, _ = jax.lax.scan(body, vary(jnp.float32(0.0)), (xs, ts))
+
     axes = (pipe_axis,) + tuple(sync_axes)
     total = jax.lax.psum(loss_sum, axes)
     # global token count is static: every data-shard holds tokens.size tokens
@@ -176,6 +230,7 @@ def make_pp_train_step(
     n_microbatches: int = 2,
     lr: float = 0.1,
     momentum: float = 0.9,
+    loss_chunks: int = 0,
 ):
     """Compiled pipeline-parallel (params, mom, tokens, targets) ->
     (params, mom, loss) over a (data, pipe, model) mesh.
@@ -210,6 +265,7 @@ def make_pp_train_step(
             n_microbatches=n_microbatches,
             tp_axis=tp,
             sync_axes=sync,
+            loss_chunks=loss_chunks,
         )
         params, mom = sgd_step(params, mom, grads, lr, momentum)
         return params, mom, loss

@@ -51,6 +51,13 @@ def add_common_flags(p: argparse.ArgumentParser, *, epochs: int, batch_size: int
         "batches assembled per step by the native C++ kernel - for "
         "datasets larger than HBM",
     )
+    p.add_argument(
+        "--stream-prefetch",
+        type=int,
+        default=2,
+        help="stream mode: batches assembled this many steps ahead on a "
+        "background thread (2 = double buffering, 0 = synchronous)",
+    )
     p.add_argument("--data", choices=("auto", "pickle", "npz", "synthetic"), default="auto")
     p.add_argument("--data-root", default=None, help="dataset dir (default ./data)")
     p.add_argument(
@@ -157,6 +164,7 @@ def config_from_args(args, regime: str) -> TrainConfig:
         kernels=getattr(args, "kernels", "xla"),
         reference_compat=getattr(args, "reference_compat", False),
         input_mode=getattr(args, "input_mode", "hbm"),
+        stream_prefetch=getattr(args, "stream_prefetch", 2),
     )
 
 

