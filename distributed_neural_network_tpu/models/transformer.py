@@ -385,3 +385,104 @@ def apply(params, tokens, cfg: TransformerConfig, **kw):
 
 def param_count(params) -> int:
     return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+
+
+# ------------------------------------------------------------- inference
+
+
+def generate(
+    params,
+    prompt,
+    cfg: TransformerConfig,
+    *,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    key: jax.Array | None = None,
+):
+    """Autoregressive decoding with per-layer KV caches.
+
+    prompt: (B, S_p) int32. Returns (B, S_p + max_new_tokens) int32 - the
+    prompt followed by generated tokens. temperature 0 = greedy argmax;
+    > 0 samples from softmax(logits / temperature) (requires `key`).
+
+    TPU-shaped: one `lax.scan` over time steps (static total length
+    S_p + max_new_tokens), an inner scan over the stacked layers, KV
+    caches updated in place with `dynamic_update_slice` - no growing
+    shapes, one compile. The prompt is consumed through the same cached
+    step as generation (its logits are discarded), so there is a single
+    code path whose cache math is pinned against the teacher-forced
+    forward by tests/test_generate.py. Training-side parallelism
+    (`apply`'s seq/tp/ep axes) is out of scope here: decode is the
+    single-device inference path; shard the batch outside for fleet
+    serving. MoE decode (cfg.n_experts > 0) is not supported.
+    """
+    if cfg.n_experts:
+        raise ValueError(
+            "generate() supports dense models only (cfg.n_experts="
+            f"{cfg.n_experts}); MoE decode routing is not implemented"
+        )
+    if temperature > 0.0 and key is None:
+        raise ValueError("temperature > 0 sampling requires `key`")
+    dt = cfg.dtype
+    b, s_p = prompt.shape
+    total = s_p + max_new_tokens
+    L, H, Dh = cfg.n_layers, cfg.n_heads, cfg.head_dim
+    prompt_pad = jnp.pad(prompt, ((0, 0), (0, max_new_tokens)))
+    cache_k = jnp.zeros((L, b, total, H, Dh), dt)
+    cache_v = jnp.zeros((L, b, total, H, Dh), dt)
+    pe_all = _sinusoid_pe(jnp.arange(total), cfg.d_model, dt)
+    neg = jnp.asarray(-1e30, jnp.float32)
+
+    def layer_step(xp, lcaches):
+        (x, pos) = xp
+        lp, ck, cv = lcaches
+        h = _layer_norm(x, lp["ln1_scale"], lp["ln1_bias"]).astype(dt)
+        q = (h @ lp["wq"].astype(dt)).reshape(b, 1, H, Dh)
+        k = (h @ lp["wk"].astype(dt)).reshape(b, 1, H, Dh)
+        v = (h @ lp["wv"].astype(dt)).reshape(b, 1, H, Dh)
+        ck = jax.lax.dynamic_update_slice_in_dim(ck, k, pos, axis=1)
+        cv = jax.lax.dynamic_update_slice_in_dim(cv, v, pos, axis=1)
+        # scores over the full static cache, future slots masked out
+        scores = jnp.einsum("bqhd,bshd->bhqs", q, ck).astype(jnp.float32)
+        scores = scores / np.sqrt(Dh)
+        live = (jnp.arange(total) <= pos)[None, None, None, :]
+        probs = jax.nn.softmax(jnp.where(live, scores, neg), axis=-1)
+        o = jnp.einsum("bhqs,bshd->bqhd", probs.astype(dt), cv)
+        x = x + o.reshape(b, 1, H * Dh) @ lp["wo"].astype(dt)
+        h2 = _layer_norm(x, lp["ln2_scale"], lp["ln2_bias"]).astype(dt)
+        h2 = jax.nn.gelu(h2 @ lp["w1"].astype(dt) + lp["b1"].astype(dt))
+        x = x + h2 @ lp["w2"].astype(dt) + lp["b2"].astype(dt)
+        return (x, pos), (ck, cv)
+
+    def time_step(carry, pos):
+        ck, cv, prev, k_rng = carry
+        tok = jnp.where(
+            pos < s_p,
+            jax.lax.dynamic_index_in_dim(prompt_pad, pos, axis=1,
+                                         keepdims=False),
+            prev,
+        )
+        x = params["embed"][tok].astype(dt)[:, None, :] + pe_all[pos][None, None]
+        (x, _), (ck, cv) = jax.lax.scan(
+            layer_step, (x, pos), (params["layers"], ck, cv)
+        )
+        h = _layer_norm(x, params["lnf_scale"], params["lnf_bias"]).astype(dt)
+        logits = (h[:, 0] @ params["head"].astype(dt)).astype(jnp.float32)
+        if temperature > 0.0:
+            k_rng, k_tok = jax.random.split(k_rng)
+            nxt = jax.random.categorical(k_tok, logits / temperature, axis=-1)
+        else:
+            nxt = jnp.argmax(logits, axis=-1)
+        nxt = nxt.astype(jnp.int32)
+        return (ck, cv, nxt, k_rng), nxt
+
+    k0 = key if key is not None else jax.random.key(0)
+    (_, _, _, _), nexts = jax.lax.scan(
+        time_step,
+        (cache_k, cache_v, jnp.zeros((b,), jnp.int32), k0),
+        jnp.arange(total),
+    )
+    # nexts[t] = token predicted AFTER consuming position t; generation
+    # starts from the prediction at the last prompt position
+    gen = nexts.swapaxes(0, 1)[:, s_p - 1: total - 1]
+    return jnp.concatenate([prompt, gen], axis=1)

@@ -1,0 +1,55 @@
+"""Adam/AdamW as pure tree ops.
+
+Beyond-reference capability: the reference's only optimizer is
+`torch.optim.SGD(lr, momentum)` (`data_parallelism_train.py:187`); a
+framework at this scale needs the adaptive family too. Same shape contract
+as `ops/sgd.py` - pure functions over parameter pytrees, layout-oblivious
+(elementwise), so they run replicated, tensor-sharded, or ZeRO-sharded
+(`parallel/zero.py zero_adam_step_sharded`) unchanged. Numerics follow the
+standard bias-corrected Adam (Kingma & Ba) with optional decoupled weight
+decay (AdamW, Loshchilov & Hutter); parity with optax.adam is pinned by
+tests/test_adam.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def init_adam(params):
+    """Zero first/second-moment trees + step counter."""
+    return {
+        "m": jax.tree.map(jnp.zeros_like, params),
+        "v": jax.tree.map(jnp.zeros_like, params),
+        "t": jnp.zeros((), jnp.int32),
+    }
+
+
+def adam_step(
+    params,
+    state,
+    grads,
+    lr: float,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+):
+    """One (bias-corrected) Adam/AdamW update; returns (params, state)."""
+    t = state["t"] + 1
+    tf = t.astype(jnp.float32)
+    c1 = 1.0 - b1 ** tf
+    c2 = 1.0 - b2 ** tf
+    m = jax.tree.map(lambda m, g: b1 * m + (1.0 - b1) * g, state["m"], grads)
+    v = jax.tree.map(
+        lambda v, g: b2 * v + (1.0 - b2) * (g * g), state["v"], grads
+    )
+
+    def upd(p, m_, v_):
+        step = (m_ / c1) / (jnp.sqrt(v_ / c2) + eps)
+        if weight_decay:
+            step = step + weight_decay * p
+        return p - lr * step
+
+    return jax.tree.map(upd, params, m, v), {"m": m, "v": v, "t": t}

@@ -80,6 +80,57 @@ def init_zero_momentum_tree(params, n_shards: int):
     )
 
 
+def _sharded_leaf_step(
+    params, grads, state_trees, update_fn, *, axis_name, grads_presummed
+):
+    """Shared ZeRO-1 per-leaf scaffolding for any elementwise optimizer.
+
+    For each leaf: pad to N*S, reduce (slice or psum_scatter) the gradient
+    to this device's (S,) shard, call `update_fn(p_sh, g_sh, *state_shs)
+    -> (p_sh_new, *state_shs_new)` on the shards, then all_gather +
+    truncate to reassemble the replicated parameter. state_trees is a
+    tuple of per-leaf flat shard trees (one per optimizer buffer).
+    Returns (new_params, tuple(new_state_trees)).
+    """
+    n = jax.lax.axis_size(axis_name)
+    me = jax.lax.axis_index(axis_name)
+
+    def leaf(p, g, *states):
+        d = p.size
+        s = states[0].shape[0] if states else _padded(d, n) // n
+        flat_g = g.reshape(-1)
+        pad = s * n - d
+        if pad:
+            flat_g = jnp.concatenate([flat_g, jnp.zeros((pad,), g.dtype)])
+        if grads_presummed:
+            g_sh = jax.lax.dynamic_slice(flat_g, (me * s,), (s,))
+        else:
+            g_sh = jax.lax.psum_scatter(
+                flat_g, axis_name, scatter_dimension=0, tiled=True
+            )
+        flat_p = p.reshape(-1)
+        if pad:
+            flat_p = jnp.concatenate([flat_p, jnp.zeros((pad,), p.dtype)])
+        p_sh = jax.lax.dynamic_slice(flat_p, (me * s,), (s,))
+        p_new, *st_new = update_fn(p_sh, g_sh, *states)
+        full = jax.lax.all_gather(p_new, axis_name, tiled=True)
+        return (full[:d].reshape(p.shape), *st_new)
+
+    leaves_p, treedef = jax.tree_util.tree_flatten(params)
+    leaves_g = treedef.flatten_up_to(grads)
+    leaves_st = [treedef.flatten_up_to(t) for t in state_trees]
+    out = [
+        leaf(p, g, *sts)
+        for p, g, *sts in zip(leaves_p, leaves_g, *leaves_st)
+    ]
+    new_p = jax.tree_util.tree_unflatten(treedef, [o[0] for o in out])
+    new_states = tuple(
+        jax.tree_util.tree_unflatten(treedef, [o[1 + i] for o in out])
+        for i in range(len(state_trees))
+    )
+    return new_p, new_states
+
+
 def zero_sgd_step_sharded(
     params,
     mom_tree,
@@ -105,39 +156,71 @@ def zero_sgd_step_sharded(
     (init with `init_zero_momentum_tree`, sharded P(axis)). Gradient
     contract matches `zero_sgd_step`. Returns (new_params, new_mom_tree).
     """
-    n = jax.lax.axis_size(axis_name)
-    me = jax.lax.axis_index(axis_name)
 
-    def leaf(p, m, g):
-        d = p.size
-        s = m.shape[0]
-        flat_g = g.reshape(-1)
-        pad = s * n - d
-        if grads_presummed:
-            if pad:
-                flat_g = jnp.concatenate([flat_g, jnp.zeros((pad,), g.dtype)])
-            g_sh = jax.lax.dynamic_slice(flat_g, (me * s,), (s,))
-        else:
-            if pad:
-                flat_g = jnp.concatenate([flat_g, jnp.zeros((pad,), g.dtype)])
-            g_sh = jax.lax.psum_scatter(
-                flat_g, axis_name, scatter_dimension=0, tiled=True
-            )
+    def upd(p_sh, g_sh, m):
         m_new = momentum * m + g_sh
-        flat_p = p.reshape(-1)
-        if pad:
-            flat_p = jnp.concatenate([flat_p, jnp.zeros((pad,), p.dtype)])
-        p_sh = jax.lax.dynamic_slice(flat_p, (me * s,), (s,)) - lr * m_new
-        full = jax.lax.all_gather(p_sh, axis_name, tiled=True)
-        return full[:d].reshape(p.shape), m_new
+        return p_sh - lr * m_new, m_new
 
-    leaves_p, treedef = jax.tree_util.tree_flatten(params)
-    leaves_m = treedef.flatten_up_to(mom_tree)
-    leaves_g = treedef.flatten_up_to(grads)
-    out = [leaf(p, m, g) for p, m, g in zip(leaves_p, leaves_m, leaves_g)]
-    new_p = jax.tree_util.tree_unflatten(treedef, [o[0] for o in out])
-    new_m = jax.tree_util.tree_unflatten(treedef, [o[1] for o in out])
+    new_p, (new_m,) = _sharded_leaf_step(
+        params, grads, (mom_tree,), upd,
+        axis_name=axis_name, grads_presummed=grads_presummed,
+    )
     return new_p, new_m
+
+
+def init_zero_adam_tree(params, n_shards: int):
+    """ZeRO-1 Adam state: per-leaf flat first/second-moment buffers (shard
+    each P('data') like the SGD momentum tree) + replicated step counter.
+    Pair with `zero_adam_step_sharded`."""
+    return {
+        "m": init_zero_momentum_tree(params, n_shards),
+        "v": init_zero_momentum_tree(params, n_shards),
+        "t": jnp.zeros((), jnp.int32),
+    }
+
+
+def zero_adam_step_sharded(
+    params,
+    state,
+    grads,
+    lr,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    *,
+    axis_name: str = "data",
+    grads_presummed: bool = True,
+):
+    """One Adam/AdamW step with BOTH moment buffers sharded per leaf over
+    `axis_name` - ZeRO-1 for the adaptive family, where the win doubles:
+    Adam state is 2x params, so sharding saves 2*D*(N-1)/N memory.
+
+    Same slice/update/all_gather pattern and calling contract as
+    `zero_sgd_step_sharded` (call inside shard_map(check_vma=False); see
+    train/lm.py) - both share `_sharded_leaf_step`. state: {"m": tree of
+    (S,), "v": tree of (S,), "t": ()} from `init_zero_adam_tree`. Returns
+    (new_params, new_state). Numerics match `ops/adam.py adam_step`
+    exactly (elementwise update on a partition of the elements).
+    """
+    t = state["t"] + 1
+    tf = t.astype(jnp.float32)
+    c1 = 1.0 - b1 ** tf
+    c2 = 1.0 - b2 ** tf
+
+    def upd(p_sh, g_sh, m, v):
+        m_new = b1 * m + (1.0 - b1) * g_sh
+        v_new = b2 * v + (1.0 - b2) * (g_sh * g_sh)
+        step = (m_new / c1) / (jnp.sqrt(v_new / c2) + eps)
+        if weight_decay:
+            step = step + weight_decay * p_sh
+        return p_sh - lr * step, m_new, v_new
+
+    new_p, (new_m, new_v) = _sharded_leaf_step(
+        params, grads, (state["m"], state["v"]), upd,
+        axis_name=axis_name, grads_presummed=grads_presummed,
+    )
+    return new_p, {"m": new_m, "v": new_v, "t": t}
 
 
 def zero_sgd_step(

@@ -171,20 +171,55 @@ def lm_loss(
     return loss
 
 
+OPTIMIZERS = ("sgd", "adam", "zero", "zero-adam")
+
+
+def optimizer_state_specs(optimizer: str, specs):
+    """PartitionSpec tree for the optimizer state matching
+    `init_lm_momentum`'s structure: sgd mirrors the param specs; adam holds
+    {"m", "v"} param-spec trees + a replicated counter; the zero variants
+    shard every flat buffer over the data axis."""
+    if optimizer == "sgd":
+        return specs
+    if optimizer == "adam":
+        return {"m": specs, "v": specs, "t": P()}
+    if optimizer == "zero":
+        return jax.tree.map(lambda _: P(DATA_AXIS), specs)
+    if optimizer == "zero-adam":
+        shard = jax.tree.map(lambda _: P(DATA_AXIS), specs)
+        return {"m": shard, "v": shard, "t": P()}
+    raise ValueError(f"unknown optimizer {optimizer!r} (use one of {OPTIMIZERS})")
+
+
 def init_lm_momentum(params, mesh: Mesh, optimizer: str = "sgd"):
     """Optimizer-state init matching `make_lm_train_step(optimizer=...)`:
-    'sgd' -> a replicated zero tree; 'zero' -> per-leaf flat ZeRO-1
-    momentum buffers sharded over the data axis (each device holds 1/dp of
-    every leaf; parallel/zero.py `init_zero_momentum_tree`)."""
+    'sgd'/'adam' -> zero trees built with zeros_like, so each state leaf
+    inherits its param's placement (replicated or tensor-sharded); adam
+    adds the second moment and a step counter. 'zero'/'zero-adam' ->
+    per-leaf flat ZeRO-1 buffers sharded over the data axis (each device
+    holds 1/dp of every leaf; parallel/zero.py)."""
+    from ..ops.adam import init_adam
+
+    dp = mesh.shape.get(DATA_AXIS, 1)
     if optimizer == "sgd":
         return init_momentum(params)
+    if optimizer == "adam":
+        return init_adam(params)
     if optimizer == "zero":
-        dp = mesh.shape.get(DATA_AXIS, 1)
         return jax.device_put(
             zero.init_zero_momentum_tree(params, dp),
             NamedSharding(mesh, P(DATA_AXIS)),
         )
-    raise ValueError(f"unknown optimizer {optimizer!r} (use 'sgd' or 'zero')")
+    if optimizer == "zero-adam":
+        state = zero.init_zero_adam_tree(params, dp)
+        shard = jax.tree.map(
+            lambda _: NamedSharding(mesh, P(DATA_AXIS)), state["m"]
+        )
+        return jax.device_put(
+            state,
+            {"m": shard, "v": shard, "t": NamedSharding(mesh, P())},
+        )
+    raise ValueError(f"unknown optimizer {optimizer!r} (use one of {OPTIMIZERS})")
 
 
 def make_lm_train_step(
@@ -211,16 +246,18 @@ def make_lm_train_step(
     sync_axes = tuple(a for a in (DATA_AXIS, SEQ_AXIS) if a in mesh.axis_names)
     specs = tfm.param_specs(cfg, tp_axis=tp, ep_axis=ep)
     data_spec = P(DATA_AXIS, SEQ_AXIS)
-    if optimizer not in ("sgd", "zero"):
-        raise ValueError(f"unknown optimizer {optimizer!r} (use 'sgd' or 'zero')")
-    if optimizer == "zero" and (tp or ep):
+    if optimizer not in OPTIMIZERS:
         raise ValueError(
-            "optimizer='zero' shards the flat param vector over the data "
-            "axis, which requires params replicated across the mesh - not "
-            f"compatible with tp_axis={tp!r} / ep_axis={ep!r}; use "
-            "optimizer='sgd' for tensor/expert-sharded configs"
+            f"unknown optimizer {optimizer!r} (use one of {OPTIMIZERS})"
         )
-    mom_spec = specs if optimizer == "sgd" else P(DATA_AXIS)
+    if optimizer.startswith("zero") and (tp or ep):
+        raise ValueError(
+            f"optimizer={optimizer!r} shards the flat param vector over the "
+            "data axis, which requires params replicated across the mesh - "
+            f"not compatible with tp_axis={tp!r} / ep_axis={ep!r}; use "
+            "'sgd'/'adam' for tensor/expert-sharded configs"
+        )
+    mom_spec = optimizer_state_specs(optimizer, specs)
 
     def fwd_bwd(params, tokens, targets):
         return jax.value_and_grad(lm_loss)(
@@ -238,7 +275,14 @@ def make_lm_train_step(
 
     def step(params, mom, tokens, targets):
         loss, grads = fwd_bwd(params, tokens, targets)
-        params, mom = sgd_step(params, mom, grads, lr, momentum)
+        if optimizer == "adam":
+            from ..ops.adam import adam_step
+
+            # momentum doubles as Adam's b1 (its momentum analog), so the
+            # CLI --momentum flag takes effect for every optimizer
+            params, mom = adam_step(params, mom, grads, lr, b1=momentum)
+        else:
+            params, mom = sgd_step(params, mom, grads, lr, momentum)
         return params, mom, loss
 
     # The library Pallas flash kernel's outputs carry no vma type, which the
@@ -257,13 +301,13 @@ def make_lm_train_step(
             )
         check_vma = False
 
-    if optimizer == "zero":
+    if optimizer.startswith("zero"):
         # Two shard_maps inside one jit: the vma-checked fwd/bwd (typed
         # autodiff inserts the grad psums), then the ZeRO-1 update with
         # check_vma=False - its all_gather reassembly produces values that
         # are replicated in fact but "varying" to the checker, and no
         # autodiff flows through the optimizer, so the typing buys nothing
-        # there (parallel/zero.py zero_sgd_step_sharded).
+        # there (parallel/zero.py zero_*_step_sharded).
         grad_fn = jax.shard_map(
             fwd_bwd,
             mesh=mesh,
@@ -273,6 +317,11 @@ def make_lm_train_step(
         )
 
         def opt_body(params, mom, grads):
+            if optimizer == "zero-adam":
+                return zero.zero_adam_step_sharded(
+                    params, mom, grads, lr, b1=momentum,
+                    axis_name=DATA_AXIS, grads_presummed=True,
+                )
             return zero.zero_sgd_step_sharded(
                 params, mom, grads, lr, momentum,
                 axis_name=DATA_AXIS, grads_presummed=True,

@@ -50,7 +50,13 @@ def main() -> int:
     )
     p.add_argument("--experts", type=int, default=0,
                    help="MoE expert count (0 = dense FFN)")
-    p.add_argument("--optimizer", choices=("sgd", "zero"), default="sgd")
+    p.add_argument(
+        "--optimizer", choices=("sgd", "adam", "zero", "zero-adam"),
+        default="sgd",
+        help="sgd/adam = replicated state; zero/zero-adam = ZeRO-1 state "
+        "sharded over the data axis (adam state is 2x params, so sharding "
+        "it saves the most)",
+    )
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--batch-size", type=int, default=32, help="global batch")
     p.add_argument("--seq-len", type=int, default=64)
@@ -68,7 +74,9 @@ def main() -> int:
                    help="rematerialize blocks in backward (jax.checkpoint): "
                    "~1/3 more FLOPs for far less activation memory")
     p.add_argument("--lr", type=float, default=0.1)
-    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--momentum", type=float, default=0.9,
+                   help="SGD momentum; for adam/zero-adam this is b1 "
+                   "(the first-moment decay, Adam's momentum analog)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument("--checkpoint-dir", default=None,
@@ -76,6 +84,10 @@ def main() -> int:
     p.add_argument("--checkpoint-every", type=int, default=50)
     p.add_argument("--resume", action="store_true",
                    help="resume from the latest checkpoint in --checkpoint-dir")
+    p.add_argument("--generate", type=int, default=0, metavar="N",
+                   help="after training, greedy-decode N tokens from the "
+                   "first sequences' prompts through the KV-cache path and "
+                   "print prompt/completion pairs (single-device decode)")
     args = p.parse_args()
     if args.steps < 1:
         p.error("--steps must be >= 1")
@@ -133,10 +145,11 @@ def main() -> int:
     params = tfm.init_params(jax.random.key(args.seed), cfg)
     pipe = args.pp > 1
     if pipe:
-        if args.sp > 1 or args.experts or args.optimizer == "zero":
+        if args.sp > 1 or args.experts or args.optimizer != "sgd":
             raise SystemExit(
-                "--pp composes with --dp/--tp; --sp/--experts/--optimizer "
-                "zero run on the dp x sp x tp mesh (drop --pp)"
+                "--pp composes with --dp/--tp and --optimizer sgd; "
+                "--sp/--experts/adam/zero optimizers run on the "
+                "dp x sp x tp mesh (drop --pp)"
             )
         mesh = ppl.create_pp_mesh(args.dp, args.pp, args.tp)
         params, specs = ppl.shard_pp_params(params, cfg, mesh)
@@ -153,12 +166,9 @@ def main() -> int:
         mesh = lmtrain.create_lm_mesh(args.dp, args.sp, args.tp)
         params, specs = lmtrain.shard_params(params, cfg, mesh)
         mom = lmtrain.init_lm_momentum(params, mesh, args.optimizer)
-        mom_shardings = (
-            jax.tree.map(
-                lambda _: NamedSharding(mesh, P(lmtrain.DATA_AXIS)), mom
-            )
-            if args.optimizer == "zero"
-            else jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
+        mom_shardings = jax.tree.map(
+            lambda s: NamedSharding(mesh, s),
+            lmtrain.optimizer_state_specs(args.optimizer, specs),
         )
         step = lmtrain.make_lm_train_step(
             cfg, mesh, lr=args.lr, momentum=args.momentum,
@@ -207,7 +217,7 @@ def main() -> int:
                 # (written before the key existed) restore fine and are
                 # accepted.
                 checks = [("mesh", mesh_desc), ("optimizer", args.optimizer)]
-                if args.optimizer == "zero":
+                if args.optimizer.startswith("zero"):
                     checks.append(("mom_format", MOM_FORMAT))
                 for key_, want in checks:
                     if meta.get(key_) != want:
@@ -291,6 +301,35 @@ def main() -> int:
             f"FLOPs/token = 3*(L*(8d^2 + 4sd + 4d*ff) + 2d*V) "
             f"= {flops_tok / 1e6:.1f}M"
         )
+    if args.generate > 0:
+        if pipe:
+            print("(--generate skipped: decode needs the non-pipeline "
+                  "param layout; rerun without --pp)")
+        elif args.experts:
+            print("(--generate skipped: MoE decode is not implemented)")
+        else:
+            import numpy as np
+
+            # decode on replicated single-device params (gather the tree)
+            host_params = jax.tree.map(
+                lambda x: jax.device_put(np.asarray(x), jax.devices()[0]),
+                params,
+            )
+            # fresh unpermuted prompts (zigzag feeds permuted tokens)
+            ptoks, _ = lmtrain.make_copy_task(
+                jax.random.key(args.seed + 1),
+                batch=args.batch_size, seq_len=args.seq_len, vocab=args.vocab,
+            )
+            half = args.seq_len // 2
+            prompt = ptoks[:2, : half + 1]
+            out = tfm.generate(
+                host_params, prompt, cfg, max_new_tokens=args.generate
+            )
+            for i, row in enumerate(np.asarray(out)):
+                cut = half + 1
+                print(f"gen[{i}] prompt={row[:cut].tolist()} "
+                      f"completion={row[cut:].tolist()}")
+
     # GPipe bubble: (P-1)/(M+P-1) of ticks process garbage; raise
     # --microbatches to shrink it (the head is no longer paid per tick)
     bubble = (

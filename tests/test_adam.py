@@ -1,0 +1,133 @@
+"""Adam/AdamW (ops/adam.py) and ZeRO-1 Adam (parallel/zero.py).
+
+Correctness bars: the hand-rolled tree update matches optax.adam step for
+step; the ZeRO-sharded variant reproduces the replicated trajectory
+exactly while each device holds only 1/dp of both moment buffers; the LM
+train step learns with every optimizer choice.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_neural_network_tpu.models import transformer as tfm
+from distributed_neural_network_tpu.ops.adam import adam_step, init_adam
+from distributed_neural_network_tpu.train import lm as lmtrain
+
+CFG = tfm.TransformerConfig(
+    vocab_size=32, d_model=32, n_heads=4, n_layers=2, d_ff=64
+)
+
+
+def test_adam_matches_optax(n_devices):
+    import optax
+
+    rng = np.random.default_rng(0)
+    params = {
+        "a": jnp.asarray(rng.normal(size=(5, 3)), jnp.float32),
+        "b": jnp.asarray(rng.normal(size=(7,)), jnp.float32),
+    }
+    lr, b1, b2, eps = 0.01, 0.9, 0.999, 1e-8
+    opt = optax.adam(lr, b1=b1, b2=b2, eps=eps)
+    o_state = opt.init(params)
+    o_params = params
+    state = init_adam(params)
+    for i in range(5):
+        grads = jax.tree.map(
+            lambda p: jnp.asarray(
+                np.random.default_rng(i).normal(size=p.shape), jnp.float32
+            ),
+            params,
+        )
+        params, state = adam_step(params, state, grads, lr, b1, b2, eps)
+        upd, o_state = opt.update(grads, o_state, o_params)
+        o_params = optax.apply_updates(o_params, upd)
+    for got, want in zip(jax.tree.leaves(params), jax.tree.leaves(o_params)):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-7
+        )
+
+
+@pytest.mark.parametrize("optimizer", ["adam", "zero-adam"])
+def test_lm_step_learns_with_adam(n_devices, optimizer):
+    mesh = lmtrain.create_lm_mesh(8, 1, 1)
+    params = tfm.init_params(jax.random.key(0), CFG)
+    params, _ = lmtrain.shard_params(params, CFG, mesh)
+    mom = lmtrain.init_lm_momentum(params, mesh, optimizer)
+    step = lmtrain.make_lm_train_step(
+        CFG, mesh, lr=0.01, attn_impl="ring", optimizer=optimizer
+    )
+    tokens, targets = lmtrain.make_copy_task(
+        jax.random.key(1), batch=16, seq_len=16, vocab=32
+    )
+    losses = []
+    for _ in range(30):
+        params, mom, loss = step(params, mom, tokens, targets)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] - 0.5, losses[:: len(losses) - 1]
+
+
+def test_zero_adam_matches_replicated_adam(n_devices):
+    """Same data, same steps: ZeRO-sharded Adam == replicated Adam (the
+    elementwise update runs on a partition of the elements)."""
+    tokens, targets = lmtrain.make_copy_task(
+        jax.random.key(1), batch=16, seq_len=16, vocab=32
+    )
+    mesh = lmtrain.create_lm_mesh(8, 1, 1)
+    results = {}
+    for optimizer in ("adam", "zero-adam"):
+        params = tfm.init_params(jax.random.key(0), CFG)
+        params, _ = lmtrain.shard_params(params, CFG, mesh)
+        mom = lmtrain.init_lm_momentum(params, mesh, optimizer)
+        step = lmtrain.make_lm_train_step(
+            CFG, mesh, lr=0.01, attn_impl="ring", optimizer=optimizer
+        )
+        for _ in range(5):
+            params, mom, loss = step(params, mom, tokens, targets)
+        results[optimizer] = (params, float(loss))
+    assert np.isclose(
+        results["adam"][1], results["zero-adam"][1], rtol=1e-6
+    ), (results["adam"][1], results["zero-adam"][1])
+    for a, b in zip(
+        jax.tree.leaves(results["adam"][0]),
+        jax.tree.leaves(results["zero-adam"][0]),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-7
+        )
+
+
+def test_zero_adam_state_is_sharded(n_devices):
+    """Each device holds 1/dp of BOTH moment buffers (the 2x-params Adam
+    state is where ZeRO-1 saves the most)."""
+    mesh = lmtrain.create_lm_mesh(8, 1, 1)
+    params = tfm.init_params(jax.random.key(0), CFG)
+    params, _ = lmtrain.shard_params(params, CFG, mesh)
+    state = lmtrain.init_lm_momentum(params, mesh, "zero-adam")
+    for buf in ("m", "v"):
+        leaf = jax.tree.leaves(state[buf])[0]
+        shard_rows = leaf.addressable_shards[0].data.shape[0]
+        assert shard_rows * 8 == leaf.shape[0], (shard_rows, leaf.shape)
+
+
+def test_adam_with_tensor_parallel_state_follows_params(n_devices):
+    """State built by zeros_like inherits tensor shardings; the dp x tp
+    step runs and learns."""
+    mesh = lmtrain.create_lm_mesh(4, 1, 2)
+    params = tfm.init_params(jax.random.key(0), CFG)
+    params, specs = lmtrain.shard_params(params, CFG, mesh)
+    mom = lmtrain.init_lm_momentum(params, mesh, "adam")
+    assert (
+        mom["m"]["layers"]["wq"].sharding == params["layers"]["wq"].sharding
+    )
+    step = lmtrain.make_lm_train_step(
+        CFG, mesh, lr=0.01, attn_impl="ring", optimizer="adam"
+    )
+    tokens, targets = lmtrain.make_copy_task(
+        jax.random.key(1), batch=16, seq_len=16, vocab=32
+    )
+    for _ in range(10):
+        params, mom, loss = step(params, mom, tokens, targets)
+    assert np.isfinite(float(loss))
