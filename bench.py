@@ -137,9 +137,22 @@ def _rows(epochs: int) -> list[dict]:
             "args": {"attn": "flash", "dtype": "bfloat16", "steps": 20},
         },
         {
-            "id": "lm_xla_d512_L8_seq2048_bf16",
+            # remat: the XLA path materializes (B, H, S, S) scores, which
+            # OOMs a 16 GB v5e at these shapes without recompute (measured
+            # r3); flash needs no remat - that contrast is the point
+            "id": "lm_xla_d512_L8_seq2048_bf16_remat",
             "kind": "lm",
-            "args": {"attn": "full", "dtype": "bfloat16", "steps": 20},
+            "args": {"attn": "full", "dtype": "bfloat16", "steps": 20,
+                     "remat": True},
+        },
+        {
+            # larger-model row: d1024/16L amortizes fixed overheads; the
+            # MFU>=40% target config (VERDICT r2 item 2)
+            "id": "lm_flash_d1024_L16_seq2048_bf16",
+            "kind": "lm",
+            "args": {"attn": "flash", "dtype": "bfloat16", "steps": 20,
+                     "d_model": 1024, "n_layers": 16, "n_heads": 16,
+                     "d_ff": 4096},
         },
     ]
     return rows

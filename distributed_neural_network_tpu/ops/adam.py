@@ -26,6 +26,31 @@ def init_adam(params):
     }
 
 
+def bias_corrections(t, b1: float, b2: float):
+    """(c1, c2) bias-correction divisors at integer step t (1-based)."""
+    tf = t.astype(jnp.float32)
+    return 1.0 - b1 ** tf, 1.0 - b2 ** tf
+
+
+def adam_leaf_update(
+    p, g, m, v, c1, c2, lr, b1, b2, eps, weight_decay
+):
+    """Elementwise Adam/AdamW update for one leaf (or leaf shard).
+
+    The single source of truth for the update math - `adam_step` (full
+    trees) and `parallel/zero.py zero_adam_step_sharded` (per-leaf shards)
+    both apply exactly this function, which is what makes the ZeRO
+    variant's "numerics match ops/adam.py" contract structural rather
+    than copy-maintained. Returns (new_p, new_m, new_v).
+    """
+    m_new = b1 * m + (1.0 - b1) * g
+    v_new = b2 * v + (1.0 - b2) * (g * g)
+    step = (m_new / c1) / (jnp.sqrt(v_new / c2) + eps)
+    if weight_decay:
+        step = step + weight_decay * p
+    return p - lr * step, m_new, v_new
+
+
 def adam_step(
     params,
     state,
@@ -38,18 +63,14 @@ def adam_step(
 ):
     """One (bias-corrected) Adam/AdamW update; returns (params, state)."""
     t = state["t"] + 1
-    tf = t.astype(jnp.float32)
-    c1 = 1.0 - b1 ** tf
-    c2 = 1.0 - b2 ** tf
-    m = jax.tree.map(lambda m, g: b1 * m + (1.0 - b1) * g, state["m"], grads)
-    v = jax.tree.map(
-        lambda v, g: b2 * v + (1.0 - b2) * (g * g), state["v"], grads
+    c1, c2 = bias_corrections(t, b1, b2)
+    new = jax.tree.map(
+        lambda p, g, m, v: adam_leaf_update(
+            p, g, m, v, c1, c2, lr, b1, b2, eps, weight_decay
+        ),
+        params, grads, state["m"], state["v"],
     )
-
-    def upd(p, m_, v_):
-        step = (m_ / c1) / (jnp.sqrt(v_ / c2) + eps)
-        if weight_decay:
-            step = step + weight_decay * p
-        return p - lr * step
-
-    return jax.tree.map(upd, params, m, v), {"m": m, "v": v, "t": t}
+    outer = jax.tree.structure(params)
+    inner = jax.tree.structure((0, 0, 0))
+    p_new, m, v = jax.tree.transpose(outer, inner, new)
+    return p_new, {"m": m, "v": v, "t": t}

@@ -203,18 +203,15 @@ def zero_adam_step_sharded(
     (new_params, new_state). Numerics match `ops/adam.py adam_step`
     exactly (elementwise update on a partition of the elements).
     """
+    from ..ops.adam import adam_leaf_update, bias_corrections
+
     t = state["t"] + 1
-    tf = t.astype(jnp.float32)
-    c1 = 1.0 - b1 ** tf
-    c2 = 1.0 - b2 ** tf
+    c1, c2 = bias_corrections(t, b1, b2)
 
     def upd(p_sh, g_sh, m, v):
-        m_new = b1 * m + (1.0 - b1) * g_sh
-        v_new = b2 * v + (1.0 - b2) * (g_sh * g_sh)
-        step = (m_new / c1) / (jnp.sqrt(v_new / c2) + eps)
-        if weight_decay:
-            step = step + weight_decay * p_sh
-        return p_sh - lr * step, m_new, v_new
+        return adam_leaf_update(
+            p_sh, g_sh, m, v, c1, c2, lr, b1, b2, eps, weight_decay
+        )
 
     new_p, (new_m, new_v) = _sharded_leaf_step(
         params, grads, (state["m"], state["v"]), upd,

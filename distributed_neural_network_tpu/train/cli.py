@@ -287,7 +287,9 @@ def run_training(args, regime: str, *, log=print) -> Engine:
                 # a failed fused dispatch may have consumed (donated) params;
                 # never let the fence mask the original exception or skip
                 # stop_trace/close below
-                jax.block_until_ready(engine.params)
+                from ..utils.timers import hard_block
+
+                hard_block(engine.params)
             except Exception:
                 pass
             jax.profiler.stop_trace()

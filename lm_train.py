@@ -273,7 +273,9 @@ def main() -> int:
             ck.save(i, {"params": params, "mom": mom},
                     {"mesh": mesh_desc, "optimizer": args.optimizer,
                      "mom_format": MOM_FORMAT, "loss": float(loss)})
-    jax.block_until_ready(loss)
+    from distributed_neural_network_tpu.utils.timers import hard_block
+
+    hard_block(loss)  # value-fetch fence; block_until_ready no-ops on axon
     if ck is not None:
         ck.save(steps_run[-1], {"params": params, "mom": mom},
                 {"mesh": mesh_desc, "optimizer": args.optimizer,
