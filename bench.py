@@ -154,6 +154,19 @@ def _rows(epochs: int) -> list[dict]:
                      "d_model": 1024, "n_layers": 16, "n_heads": 16,
                      "d_ff": 4096},
         },
+        # measured pp=4 pipeline bubble (VERDICT r2 item 4): fixed
+        # microbatch size, varying (M, interleave) -> tokens/s tracks
+        # 1 - bubble. Runs on a 4-device virtual CPU mesh (the one real
+        # chip cannot host 4 stages); the measurement is relative.
+        {
+            "id": "pp4_bubble_cpu4",
+            "kind": "pp_bubble",
+            "env": {
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            },
+            "args": {},
+        },
     ]
     return rows
 
@@ -179,6 +192,12 @@ def _run_worker(spec: dict) -> dict:
         )
 
         return measure_lm_training(**spec["args"])
+    if spec["kind"] == "pp_bubble":
+        from distributed_neural_network_tpu.train.measure import (
+            measure_pp_bubble,
+        )
+
+        return measure_pp_bubble(**spec["args"])
     raise ValueError(f"unknown row kind {spec['kind']!r}")
 
 
@@ -198,9 +217,13 @@ def _run_row_subprocess(spec: dict, timeout: float) -> tuple[dict | None, str]:
     """Run one row in a fresh subprocess; (result, error) - one is set."""
     cmd = [sys.executable, os.path.abspath(__file__), "--worker",
            json.dumps(spec)]
+    env = None
+    if spec.get("env"):
+        env = {**os.environ, **spec["env"]}
     try:
         p = subprocess.run(
-            cmd, capture_output=True, text=True, timeout=timeout, cwd=REPO
+            cmd, capture_output=True, text=True, timeout=timeout, cwd=REPO,
+            env=env,
         )
     except subprocess.TimeoutExpired:
         return None, f"row timed out after {timeout:.0f}s"

@@ -95,6 +95,7 @@ def pipeline_lm_loss(
     tp_axis: str | None = None,
     sync_axes=(),
     loss_chunks: int = 0,
+    interleave: int = 1,
 ):
     """Mean next-token cross-entropy via the microbatch pipeline schedule.
 
@@ -103,19 +104,50 @@ def pipeline_lm_loss(
     Returns the replicated global mean loss (psum over pipe + sync_axes).
     loss_chunks: CE sequence-chunk count (0 = auto by the 64 MB logits
     budget; must divide S).
+
+    interleave = v > 1 runs the circular (virtual-stage / Megatron
+    "interleaved") schedule: each device holds v round-robin layer chunks
+    of L/(v*P) layers (global chunk l*P + q lives on device q - place
+    params with `shard_pp_params(..., interleave=v)`), and every
+    microbatch makes v laps around the ring. Microbatches run in groups
+    of P kept fully in flight: work (group g, microbatch m, lap l) runs
+    on device q at tick g*v*P + m + l*P + q, which tiles every device's
+    timeline exactly once - total ticks v*M + P - 1 at L/(v*P) layers
+    per tick, so the bubble fraction drops from (P-1)/(M+P-1) to
+    (P-1)/(v*M + P - 1): the interleaved win, expressed as a dense scan
+    instead of a hand-rolled 1F1B schedule (autodiff still derives the
+    backward pipeline). Requires P | M (whole groups) and v*P | L.
+    v=1 is exactly the GPipe schedule.
     """
     n_pipe = jax.lax.axis_size(pipe_axis)
     stage = jax.lax.axis_index(pipe_axis)
     m = n_microbatches
+    v = interleave
     b_local, s = tokens.shape
     assert b_local % m == 0, (b_local, m)
+    assert v == 1 or m % n_pipe == 0, (m, n_pipe, v)
     mb = b_local // m
     dt = cfg.dtype
     tok_mb = tokens.reshape(m, mb, s)
     tgt_mb = targets.reshape(m, mb, s)
     pe = tfm._sinusoid_pe(jnp.arange(s), cfg.d_model, dt)[None]
 
-    def local_blocks(x):
+    def chunk_blocks(x, lap):
+        """Apply this device's layer chunk for the given lap (0 when v=1)."""
+        layers = params["layers"]
+        if v > 1:
+            # local leaves are (v, L/(v*P), ...) stacked lap-major
+            layers = jax.tree.map(
+                lambda a: a.reshape(v, a.shape[0] // v, *a.shape[1:]),
+                layers,
+            )
+            layers = jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(
+                    a, lap, keepdims=False
+                ),
+                layers,
+            )
+
         def block(x, lp):
             x, _ = tfm.transformer_block(
                 x,
@@ -128,21 +160,30 @@ def pipeline_lm_loss(
 
         if cfg.remat:
             block = jax.checkpoint(block)
-        x, _ = jax.lax.scan(block, x, params["layers"])
+        x, _ = jax.lax.scan(block, x, layers)
         return x
 
     perm = [(i, (i + 1) % n_pipe) for i in range(n_pipe)]
 
     def tick(x_in, t):
-        t_feed = jnp.clip(t, 0, m - 1)
+        # invert the schedule at this device: work (g, m_in_group, lap)
+        # runs here at tick t = g*v*P + m + lap*P + stage
+        u = t - stage
+        vp = v * n_pipe
+        g = u // vp
+        r = u - g * vp
+        lap = jnp.clip(r // n_pipe, 0, v - 1)
+        mb_idx = jnp.clip(g * n_pipe + r, 0, m - 1)  # lap-0 feed index
         fresh = params["embed"][jax.lax.dynamic_index_in_dim(
-            tok_mb, t_feed, keepdims=False
+            tok_mb, mb_idx, keepdims=False
         )].astype(dt) + pe
-        x = jnp.where(stage == 0, fresh, x_in)
-        out = local_blocks(x)
+        # device 0 feeds fresh embeds at its lap-0 ticks (r < P); later
+        # laps arrive by rotation from the last device
+        x = jnp.where((stage == 0) & (r < n_pipe), fresh, x_in)
+        out = chunk_blocks(x, lap)
         x_out = jax.lax.ppermute(out, pipe_axis, perm)
-        # emit the pre-rotation output: on the last stage at tick t >= P-1
-        # it is the finished hidden state of microbatch t-(P-1)
+        # emit the pre-rotation output: on the last stage at its lap-(v-1)
+        # ticks it is the finished hidden state of a microbatch
         return x_out, out
 
     def vary(x):
@@ -157,12 +198,16 @@ def pipeline_lm_loss(
         return jax.lax.pcast(x, missing, to="varying") if missing else x
 
     x0 = vary(jnp.zeros((mb, s, cfg.d_model), dt))
-    _, outs = jax.lax.scan(tick, x0, jnp.arange(m + n_pipe - 1))
+    _, outs = jax.lax.scan(tick, x0, jnp.arange(v * m + n_pipe - 1))
 
-    # exit blocks: ticks P-1 .. P-1+M-1 (garbage on non-last stages). Pad M
-    # up to a multiple of P so one tiled all_to_all can deal each stage an
-    # equal share; padded microbatches carry zero weight.
-    exits = outs[n_pipe - 1:]
+    # exit blocks: microbatch j = g*P + mm finishes its last lap on the
+    # last stage at tick g*v*P + mm + v*P - 1 (garbage on other stages;
+    # contiguous outs[P-1:] when v == 1). Pad M up to a multiple of P so
+    # one tiled all_to_all can deal each stage an equal share; padded
+    # microbatches carry zero weight.
+    j = np.arange(m)
+    exit_ticks = (j // n_pipe) * (v * n_pipe) + j % n_pipe + v * n_pipe - 1
+    exits = jnp.take(outs, jnp.asarray(exit_ticks), axis=0)
     mp = -(-m // n_pipe) * n_pipe
     k = mp // n_pipe
     if mp > m:
@@ -231,18 +276,31 @@ def make_pp_train_step(
     lr: float = 0.1,
     momentum: float = 0.9,
     loss_chunks: int = 0,
+    interleave: int = 1,
 ):
     """Compiled pipeline-parallel (params, mom, tokens, targets) ->
     (params, mom, loss) over a (data, pipe, model) mesh.
 
     tokens/targets: (B, S) int32 with B divisible by dp * n_microbatches.
     Layer-stack params must be placed per `pp_param_specs` (use
-    `shard_pp_params`).
+    `shard_pp_params(..., interleave=interleave)` - the interleaved
+    schedule needs the round-robin chunk layout). interleave = v > 1
+    cuts the pipeline bubble to (P-1)/(v*M+P-1); see `pipeline_lm_loss`.
     """
     pp = mesh.shape.get(PIPE_AXIS, 1)
-    if cfg.n_layers % pp:
+    v = interleave
+    if v < 1:
+        raise ValueError(f"interleave must be >= 1, got {v}")
+    if cfg.n_layers % (pp * v):
         raise ValueError(
-            f"n_layers ({cfg.n_layers}) must be divisible by pipeline size ({pp})"
+            f"n_layers ({cfg.n_layers}) must be divisible by pipeline size "
+            f"x interleave ({pp}x{v})"
+        )
+    if v > 1 and n_microbatches % pp:
+        raise ValueError(
+            f"the interleaved schedule runs microbatches in groups of the "
+            f"pipeline size: n_microbatches ({n_microbatches}) must be a "
+            f"multiple of {pp}"
         )
     if cfg.n_experts:
         raise ValueError(
@@ -266,6 +324,7 @@ def make_pp_train_step(
             tp_axis=tp,
             sync_axes=sync,
             loss_chunks=loss_chunks,
+            interleave=v,
         )
         params, mom = sgd_step(params, mom, grads, lr, momentum)
         return params, mom, loss
@@ -281,10 +340,48 @@ def make_pp_train_step(
     )
 
 
-def shard_pp_params(params, cfg, mesh: Mesh):
-    """Place a replicated-layout param tree per pp_param_specs."""
+def interleave_layer_order(
+    n_layers: int, pp: int, v: int, *, inverse: bool = False
+) -> np.ndarray:
+    """Layer-axis permutation for the interleaved chunk layout.
+
+    Global chunk c (of v*P chunks, L/(v*P) layers each) must live on
+    device c % P at local lap c // P, so the pipe-sharded leading axis is
+    ordered device-major, lap-minor: position (q*v + l)*cl + j holds
+    original layer (l*P + q)*cl + j. `inverse=True` returns the
+    permutation that restores the canonical order (for checkpoint export
+    or switching schedules).
+    """
+    cl = n_layers // (pp * v)
+    order = np.empty(n_layers, np.int64)
+    pos = 0
+    for q in range(pp):
+        for lap in range(v):
+            c = lap * pp + q
+            order[pos:pos + cl] = np.arange(c * cl, (c + 1) * cl)
+            pos += cl
+    if inverse:
+        inv = np.empty_like(order)
+        inv[order] = np.arange(n_layers)
+        return inv
+    return order
+
+
+def shard_pp_params(params, cfg, mesh: Mesh, *, interleave: int = 1):
+    """Place a replicated-layout param tree per pp_param_specs.
+
+    interleave > 1 additionally permutes the layer axis into the
+    round-robin chunk layout the interleaved schedule indexes
+    (`interleave_layer_order`)."""
     tp = TP_AXIS if mesh.shape.get(TP_AXIS, 1) > 1 else None
     specs = pp_param_specs(cfg, tp_axis=tp)
+    if interleave > 1:
+        pp = mesh.shape.get(PIPE_AXIS, 1)
+        order = interleave_layer_order(cfg.n_layers, pp, interleave)
+        params = dict(params)
+        params["layers"] = jax.tree.map(
+            lambda a: a[order], params["layers"]
+        )
     return jax.tree.map(
         lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), params, specs
     ), specs

@@ -41,6 +41,13 @@ def main() -> int:
                    "exclusive with --sp/--experts/--optimizer zero)")
     p.add_argument("--microbatches", type=int, default=2)
     p.add_argument(
+        "--pp-interleave", type=int, default=1,
+        help="virtual pipeline stages per device (circular schedule): "
+        "cuts the bubble from (P-1)/(M+P-1) to (P-1)/(v*M+P-1) at the "
+        "cost of v-times-finer layer chunks; needs pp*v | layers and "
+        "pp | microbatches",
+    )
+    p.add_argument(
         "--attn", choices=("ring", "ulysses", "zigzag", "flash"),
         default="ring",
         help="sequence-parallel attention; zigzag = load-balanced causal "
@@ -152,7 +159,9 @@ def main() -> int:
                 "dp x sp x tp mesh (drop --pp)"
             )
         mesh = ppl.create_pp_mesh(args.dp, args.pp, args.tp)
-        params, specs = ppl.shard_pp_params(params, cfg, mesh)
+        params, specs = ppl.shard_pp_params(
+            params, cfg, mesh, interleave=args.pp_interleave
+        )
         from distributed_neural_network_tpu.ops.sgd import init_momentum
 
         mom = init_momentum(params)
@@ -160,7 +169,7 @@ def main() -> int:
         step = ppl.make_pp_train_step(
             cfg, mesh, n_microbatches=args.microbatches,
             lr=args.lr, momentum=args.momentum,
-            loss_chunks=args.loss_chunks,
+            loss_chunks=args.loss_chunks, interleave=args.pp_interleave,
         )
     else:
         mesh = lmtrain.create_lm_mesh(args.dp, args.sp, args.tp)
@@ -219,6 +228,13 @@ def main() -> int:
                 checks = [("mesh", mesh_desc), ("optimizer", args.optimizer)]
                 if args.optimizer.startswith("zero"):
                     checks.append(("mom_format", MOM_FORMAT))
+                if pipe:
+                    # interleave permutes the layer axis on device
+                    # (interleave_layer_order), so a checkpoint written at
+                    # a different v holds a different layer order. Old
+                    # checkpoints without the key were written at v=1.
+                    meta.setdefault("pp_interleave", 1)
+                    checks.append(("pp_interleave", args.pp_interleave))
                 for key_, want in checks:
                     if meta.get(key_) != want:
                         raise SystemExit(
@@ -272,14 +288,16 @@ def main() -> int:
         if ck is not None and (i + 1) % args.checkpoint_every == 0:
             ck.save(i, {"params": params, "mom": mom},
                     {"mesh": mesh_desc, "optimizer": args.optimizer,
-                     "mom_format": MOM_FORMAT, "loss": float(loss)})
+                     "mom_format": MOM_FORMAT, "loss": float(loss),
+                     "pp_interleave": args.pp_interleave})
     from distributed_neural_network_tpu.utils.timers import hard_block
 
     hard_block(loss)  # value-fetch fence; block_until_ready no-ops on axon
     if ck is not None:
         ck.save(steps_run[-1], {"params": params, "mom": mom},
                 {"mesh": mesh_desc, "optimizer": args.optimizer,
-                 "mom_format": MOM_FORMAT, "loss": float(loss)})
+                 "mom_format": MOM_FORMAT, "loss": float(loss),
+                 "pp_interleave": args.pp_interleave})
         ck.close()
     from distributed_neural_network_tpu.train.measure import (
         model_flops_per_token,
@@ -332,10 +350,15 @@ def main() -> int:
                 print(f"gen[{i}] prompt={row[:cut].tolist()} "
                       f"completion={row[cut:].tolist()}")
 
-    # GPipe bubble: (P-1)/(M+P-1) of ticks process garbage; raise
-    # --microbatches to shrink it (the head is no longer paid per tick)
+    # pipeline bubble: (P-1)/(v*M+P-1) of tick-time processes garbage;
+    # raise --microbatches or --pp-interleave to shrink it (the head is
+    # not paid per tick)
     bubble = (
-        round((args.pp - 1) / (args.microbatches + args.pp - 1), 4)
+        round(
+            (args.pp - 1)
+            / (args.pp_interleave * args.microbatches + args.pp - 1),
+            4,
+        )
         if pipe else None
     )
     print("SUMMARY " + json.dumps({
