@@ -1,0 +1,111 @@
+"""Learning-rate schedules and gradient transforms as pure ops.
+
+Beyond-reference capability: the reference trains at a fixed lr with no
+clipping (`data_parallelism_train.py:187` - bare torch SGD); a framework
+carrying the transformer family needs the standard loop trio - warmup +
+decay schedules, global-norm clipping, gradient accumulation. All are
+pure functions over scalars/pytrees so they compose with any optimizer
+(`ops/sgd.py`, `ops/adam.py`, the ZeRO variants) under jit/shard_map.
+
+TPU notes: schedules take the step as a traced scalar (no Python-side
+recompile per step); `global_norm` is sharding-aware - pass the leaf ->
+PartitionSpec tree and the mesh axes, and leaves sharded over a mesh axis
+get their squared-sum psummed over exactly the axes they are split on
+(replicated leaves hold identical full gradients after shard_map's typed
+autodiff, so they contribute locally). That makes clip-by-global-norm
+produce the same scale factor on every device of a dp x sp x tp mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def warmup_cosine(
+    step,
+    *,
+    base_lr: float,
+    total_steps: int,
+    warmup_steps: int = 0,
+    min_lr_frac: float = 0.0,
+):
+    """lr at `step` (traced or int): linear warmup then cosine decay.
+
+    Warmup ramps 0 -> base_lr over `warmup_steps` (lr at step 0 is
+    base_lr/warmup_steps, reaching base_lr at step == warmup_steps); the
+    remaining total_steps - warmup_steps decay by half-cosine to
+    base_lr * min_lr_frac and stay there.
+    """
+    if total_steps <= 0:
+        raise ValueError(f"total_steps must be > 0, got {total_steps}")
+    if not 0 <= warmup_steps <= total_steps:
+        raise ValueError(
+            f"warmup_steps ({warmup_steps}) must be in [0, total_steps "
+            f"({total_steps})]"
+        )
+    t = jnp.asarray(step, jnp.float32)
+    warm = jnp.float32(max(warmup_steps, 1))
+    ramp = jnp.minimum((t + 1.0) / warm, 1.0)
+    span = jnp.float32(max(total_steps - warmup_steps, 1))
+    frac = jnp.clip((t - warmup_steps) / span, 0.0, 1.0)
+    cos = 0.5 * (1.0 + jnp.cos(jnp.pi * frac))
+    decay = min_lr_frac + (1.0 - min_lr_frac) * cos
+    return base_lr * jnp.where(t < warmup_steps, ramp, decay)
+
+
+def constant_lr(step, *, base_lr: float, **_):
+    """Fixed lr (the reference's behavior); same signature as the others."""
+    return jnp.asarray(base_lr, jnp.float32) + 0.0 * jnp.asarray(
+        step, jnp.float32
+    )
+
+
+SCHEDULES = {"constant": constant_lr, "cosine": warmup_cosine}
+
+
+def global_norm(grads, *, specs=None, axes=()):
+    """Global L2 norm of a gradient pytree, sharding-aware.
+
+    Single-device (specs=None or axes=()): plain sqrt(sum of squares).
+    Under shard_map: `specs` is the leaf-aligned PartitionSpec tree and
+    `axes` the mesh axis names in scope; each leaf's squared sum is
+    psummed over the axes its spec shards it on (tensor-parallel leaves),
+    while replicated leaves - whose gradient shard_map's typed autodiff
+    already psummed - contribute their local (= full) value once.
+    """
+    leaves = jax.tree.leaves(grads)
+    if specs is None or not axes:
+        sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+        return jnp.sqrt(sq)
+    spec_leaves = jax.tree.leaves(
+        specs, is_leaf=lambda s: isinstance(s, jax.sharding.PartitionSpec)
+    )
+    assert len(spec_leaves) == len(leaves), (len(spec_leaves), len(leaves))
+    total = jnp.float32(0.0)
+    axes = set(axes)
+    for g, spec in zip(leaves, spec_leaves):
+        sq = jnp.sum(jnp.square(g.astype(jnp.float32)))
+        shard_axes = tuple(
+            a
+            for entry in spec
+            if entry is not None
+            for a in ((entry,) if isinstance(entry, str) else tuple(entry))
+            if a in axes
+        )
+        if shard_axes:
+            sq = jax.lax.psum(sq, shard_axes)
+        total = total + sq
+    return jnp.sqrt(total)
+
+
+def clip_by_global_norm(grads, max_norm: float, *, specs=None, axes=()):
+    """Scale `grads` so the global norm is at most `max_norm`.
+
+    Returns (clipped_grads, pre_clip_norm). The scale factor is computed
+    from the sharding-aware `global_norm`, so every device applies the
+    identical factor and tensor-sharded layouts stay consistent.
+    """
+    norm = global_norm(grads, specs=specs, axes=axes)
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+    return jax.tree.map(lambda g: (g * scale).astype(g.dtype), grads), norm

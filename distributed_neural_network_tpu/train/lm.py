@@ -231,6 +231,9 @@ def make_lm_train_step(
     attn_impl: str = "ring",
     optimizer: str = "sgd",
     loss_chunks: int = 0,
+    lr_schedule=None,
+    clip_norm: float = 0.0,
+    accum_steps: int = 1,
 ):
     """Compiled (params, mom, tokens, targets) -> (params, mom, loss).
 
@@ -239,6 +242,19 @@ def make_lm_train_step(
     shards the momentum buffer over the data axis (ZeRO-1,
     parallel/zero.py); init mom with `init_lm_momentum`. loss_chunks is
     passed through to `lm_loss` (0 = auto-chunk by the 64 MB logits budget).
+
+    Loop transforms (ops/schedule.py):
+    - lr_schedule: callable step -> lr (e.g. partial(warmup_cosine, ...)).
+      When set, the compiled fn takes a fifth argument
+      (params, mom, tokens, targets, step) with `step` a traced int32, so
+      the schedule costs no recompile per step.
+    - clip_norm > 0: clip gradients by sharding-aware global norm before
+      the optimizer (identical scale factor on every device, including
+      tensor-sharded leaves).
+    - accum_steps = k > 1: each call scans k sequential fwd/bwd passes
+      over B/k-row micro-batches and averages the gradients - k-times
+      the effective batch in the same activation memory. B must be
+      divisible by dp * k.
     """
     sp = SEQ_AXIS if mesh.shape.get(SEQ_AXIS, 1) > 1 else None
     tp = TP_AXIS if mesh.shape.get(TP_AXIS, 1) > 1 else None
@@ -259,7 +275,10 @@ def make_lm_train_step(
         )
     mom_spec = optimizer_state_specs(optimizer, specs)
 
-    def fwd_bwd(params, tokens, targets):
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+
+    def fwd_bwd_one(params, tokens, targets):
         return jax.value_and_grad(lm_loss)(
             params,
             tokens,
@@ -273,16 +292,59 @@ def make_lm_train_step(
             loss_chunks=loss_chunks,
         )
 
-    def step(params, mom, tokens, targets):
+    def fwd_bwd(params, tokens, targets):
+        if accum_steps == 1:
+            return fwd_bwd_one(params, tokens, targets)
+        b_local = tokens.shape[0]
+        if b_local % accum_steps:
+            raise ValueError(
+                f"per-device batch ({b_local}) must divide by accum_steps "
+                f"({accum_steps})"
+            )
+        mb = b_local // accum_steps
+        tok_k = tokens.reshape(accum_steps, mb, -1)
+        tgt_k = targets.reshape(accum_steps, mb, -1)
+        # seed the accumulator with micro-batch 0 (outside the scan): its
+        # (loss, grads) carry exactly the vma types the scan carry needs,
+        # with no per-leaf guessing about which axes autodiff varies over
+        first = fwd_bwd_one(params, tok_k[0], tgt_k[0])
+
+        def body(carry, tt):
+            loss_acc, grads_acc = carry
+            loss, grads = fwd_bwd_one(params, *tt)
+            return (
+                loss_acc + loss,
+                jax.tree.map(jnp.add, grads_acc, grads),
+            ), None
+
+        (loss_sum, grads_sum), _ = jax.lax.scan(
+            body, first, (tok_k[1:], tgt_k[1:])
+        )
+        k = jnp.float32(accum_steps)
+        return loss_sum / k, jax.tree.map(lambda g: g / k, grads_sum)
+
+    def transform_grads(grads):
+        if clip_norm > 0.0:
+            from ..ops.schedule import clip_by_global_norm
+
+            grads, _ = clip_by_global_norm(
+                grads, clip_norm, specs=specs,
+                axes=tuple(mesh.axis_names),
+            )
+        return grads
+
+    def step(params, mom, tokens, targets, step_i=None):
         loss, grads = fwd_bwd(params, tokens, targets)
+        grads = transform_grads(grads)
+        lr_t = lr if lr_schedule is None else lr_schedule(step_i)
         if optimizer == "adam":
             from ..ops.adam import adam_step
 
             # momentum doubles as Adam's b1 (its momentum analog), so the
             # CLI --momentum flag takes effect for every optimizer
-            params, mom = adam_step(params, mom, grads, lr, b1=momentum)
+            params, mom = adam_step(params, mom, grads, lr_t, b1=momentum)
         else:
-            params, mom = sgd_step(params, mom, grads, lr, momentum)
+            params, mom = sgd_step(params, mom, grads, lr_t, momentum)
         return params, mom, loss
 
     # The library Pallas flash kernel's outputs carry no vma type, which the
@@ -301,6 +363,7 @@ def make_lm_train_step(
             )
         check_vma = False
 
+    has_step = lr_schedule is not None
     if optimizer.startswith("zero"):
         # Two shard_maps inside one jit: the vma-checked fwd/bwd (typed
         # autodiff inserts the grad psums), then the ZeRO-1 update with
@@ -316,35 +379,62 @@ def make_lm_train_step(
             check_vma=check_vma,
         )
 
-        def opt_body(params, mom, grads):
+        def opt_body(params, mom, grads, lr_t):
+            if clip_norm > 0.0:
+                from ..ops.schedule import clip_by_global_norm
+
+                # zero forbids tp/ep, so every grad leaf here is the full
+                # replicated gradient: the plain (no-psum) norm is global
+                grads, _ = clip_by_global_norm(grads, clip_norm)
             if optimizer == "zero-adam":
                 return zero.zero_adam_step_sharded(
-                    params, mom, grads, lr, b1=momentum,
+                    params, mom, grads, lr_t, b1=momentum,
                     axis_name=DATA_AXIS, grads_presummed=True,
                 )
             return zero.zero_sgd_step_sharded(
-                params, mom, grads, lr, momentum,
+                params, mom, grads, lr_t, momentum,
                 axis_name=DATA_AXIS, grads_presummed=True,
             )
 
         opt_fn = jax.shard_map(
             opt_body,
             mesh=mesh,
-            in_specs=(specs, mom_spec, specs),
+            in_specs=(specs, mom_spec, specs, P()),
             out_specs=(specs, mom_spec),
             check_vma=False,
         )
 
-        def zero_step(params, mom, tokens, targets):
+        def zero_step(params, mom, tokens, targets, step_i=None):
             loss, grads = grad_fn(params, tokens, targets)
-            params, mom = opt_fn(params, mom, grads)
+            lr_t = jnp.float32(lr) if lr_schedule is None else jnp.float32(
+                lr_schedule(step_i)
+            )
+            params, mom = opt_fn(params, mom, grads, lr_t)
             return params, mom, loss
 
-        return jax.jit(zero_step, donate_argnums=(0, 1))
+        if has_step:
+            return jax.jit(
+                lambda p, m, a, b, s: zero_step(p, m, a, b, s),
+                donate_argnums=(0, 1),
+            )
+        return jax.jit(
+            lambda p, m, a, b: zero_step(p, m, a, b), donate_argnums=(0, 1)
+        )
 
+    if has_step:
+        return jax.jit(
+            jax.shard_map(
+                step,
+                mesh=mesh,
+                in_specs=(specs, mom_spec, data_spec, data_spec, P()),
+                out_specs=(specs, mom_spec, P()),
+                check_vma=check_vma,
+            ),
+            donate_argnums=(0, 1),
+        )
     return jax.jit(
         jax.shard_map(
-            step,
+            lambda p, m, a, b: step(p, m, a, b),
             mesh=mesh,
             in_specs=(specs, mom_spec, data_spec, data_spec),
             out_specs=(specs, mom_spec, P()),

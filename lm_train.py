@@ -81,6 +81,20 @@ def main() -> int:
                    help="rematerialize blocks in backward (jax.checkpoint): "
                    "~1/3 more FLOPs for far less activation memory")
     p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--lr-schedule", choices=("constant", "cosine"),
+                   default="constant",
+                   help="cosine = linear warmup (--warmup-steps) then "
+                   "half-cosine decay over --steps to --min-lr-frac * lr")
+    p.add_argument("--warmup-steps", type=int, default=0)
+    p.add_argument("--min-lr-frac", type=float, default=0.0,
+                   help="cosine floor as a fraction of --lr")
+    p.add_argument("--clip-norm", type=float, default=0.0,
+                   help="clip gradients to this global L2 norm before the "
+                   "optimizer (0 = off); sharding-aware across dp/sp/tp")
+    p.add_argument("--accum-steps", type=int, default=1,
+                   help="gradient accumulation: scan this many sequential "
+                   "fwd/bwd micro-batches per optimizer step (batch-size "
+                   "must divide by dp * accum-steps); not with --pp")
     p.add_argument("--momentum", type=float, default=0.9,
                    help="SGD momentum; for adam/zero-adam this is b1 "
                    "(the first-moment decay, Adam's momentum analog)")
@@ -158,6 +172,12 @@ def main() -> int:
                 "--sp/--experts/adam/zero optimizers run on the "
                 "dp x sp x tp mesh (drop --pp)"
             )
+        if (args.lr_schedule != "constant" or args.clip_norm
+                or args.accum_steps > 1):
+            raise SystemExit(
+                "--lr-schedule/--clip-norm/--accum-steps run on the "
+                "dp x sp x tp mesh path (drop --pp)"
+            )
         mesh = ppl.create_pp_mesh(args.dp, args.pp, args.tp)
         params, specs = ppl.shard_pp_params(
             params, cfg, mesh, interleave=args.pp_interleave
@@ -179,11 +199,24 @@ def main() -> int:
             lambda s: NamedSharding(mesh, s),
             lmtrain.optimizer_state_specs(args.optimizer, specs),
         )
+        import functools
+
+        from distributed_neural_network_tpu.ops import schedule as sched
+
+        lr_schedule = None
+        if args.lr_schedule == "cosine":
+            lr_schedule = functools.partial(
+                sched.warmup_cosine, base_lr=args.lr,
+                total_steps=args.steps, warmup_steps=args.warmup_steps,
+                min_lr_frac=args.min_lr_frac,
+            )
         step = lmtrain.make_lm_train_step(
             cfg, mesh, lr=args.lr, momentum=args.momentum,
             attn_impl=args.attn, optimizer=args.optimizer,
-            loss_chunks=args.loss_chunks,
+            loss_chunks=args.loss_chunks, lr_schedule=lr_schedule,
+            clip_norm=args.clip_norm, accum_steps=args.accum_steps,
         )
+
     param_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
 
     mesh_desc = "x".join(
@@ -275,8 +308,14 @@ def main() -> int:
     t_compile = time.perf_counter()
     t0 = None
     steps_run = range(step0, step0 + args.steps)
+    scheduled = args.lr_schedule != "constant" and not pipe
     for i in steps_run:
-        params, mom, loss = step(params, mom, tokens, targets)
+        if scheduled:
+            params, mom, loss = step(
+                params, mom, tokens, targets, jnp.int32(i)
+            )
+        else:
+            params, mom, loss = step(params, mom, tokens, targets)
         if i == step0:
             jax.block_until_ready(loss)
             first_loss = float(loss)

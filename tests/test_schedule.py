@@ -1,0 +1,220 @@
+"""LR schedules, gradient clipping, gradient accumulation (ops/schedule.py
++ train/lm.py wiring) on the 8-device CPU mesh.
+
+Correctness bars:
+- warmup_cosine hits its three anchors (ramp start, peak at warmup end,
+  floor at total) and is monotone through the decay;
+- clip_by_global_norm matches optax.clip_by_global_norm exactly on an
+  unsharded tree, and the sharding-aware norm under a dp x tp mesh equals
+  the single-device norm of the same gradients;
+- an accum_steps=k train step produces the same params as one k-times-
+  larger-batch step (same data) - exact algebraic identity for the mean
+  CE loss;
+- the schedule-wired step at constant lr reproduces the unscheduled step.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_neural_network_tpu.models import transformer as tfm
+from distributed_neural_network_tpu.ops import schedule as S
+from distributed_neural_network_tpu.train import lm as lmtrain
+
+CFG = tfm.TransformerConfig(
+    vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64
+)
+
+
+def test_warmup_cosine_anchors():
+    kw = dict(base_lr=1.0, total_steps=100, warmup_steps=10, min_lr_frac=0.1)
+    assert np.isclose(float(S.warmup_cosine(0, **kw)), 0.1)  # 1/warmup
+    assert np.isclose(float(S.warmup_cosine(9, **kw)), 1.0)  # ramp top
+    assert np.isclose(float(S.warmup_cosine(100, **kw)), 0.1)  # floor
+    vals = [float(S.warmup_cosine(t, **kw)) for t in range(10, 101)]
+    assert all(a >= b - 1e-7 for a, b in zip(vals, vals[1:]))  # monotone
+
+    with pytest.raises(ValueError, match="total_steps"):
+        S.warmup_cosine(0, base_lr=1.0, total_steps=0)
+    with pytest.raises(ValueError, match="warmup_steps"):
+        S.warmup_cosine(0, base_lr=1.0, total_steps=5, warmup_steps=9)
+
+
+def test_clip_matches_optax():
+    tree = {
+        "a": jnp.asarray([[3.0, 4.0]]),
+        "b": {"c": jnp.arange(6.0).reshape(2, 3)},
+    }
+    for max_norm in (0.5, 2.0, 100.0):
+        got, norm = S.clip_by_global_norm(tree, max_norm)
+        want, _ = optax.clip_by_global_norm(max_norm).update(tree, None)
+        assert np.isclose(float(norm), float(optax.global_norm(tree)))
+        jax.tree.map(
+            lambda g, w: np.testing.assert_allclose(g, w, rtol=1e-6),
+            got, want,
+        )
+
+
+def test_sharded_global_norm_matches_single_device(n_devices):
+    """dp2 x tp2: the psum-aware norm inside shard_map equals the plain
+    norm of the gathered gradients."""
+    from jax.sharding import PartitionSpec as P
+
+    mesh = lmtrain.create_lm_mesh(2, 1, 2)
+    params0 = tfm.init_params(jax.random.key(0), CFG)
+    tokens, targets = lmtrain.make_copy_task(
+        jax.random.key(1), batch=8, seq_len=16, vocab=CFG.vocab_size
+    )
+
+    # reference: single-device grads + plain norm
+    g_ref = jax.grad(
+        lambda p: lmtrain.lm_loss(
+            p, tokens, targets, CFG,
+            seq_axis=None, tp_axis=None, attn_impl="full", axes=(),
+        )
+    )(params0)
+    want = float(S.global_norm(g_ref))
+
+    params, specs = lmtrain.shard_params(params0, CFG, mesh)
+
+    def norm_fn(p, tok, tgt):
+        g = jax.grad(
+            lambda p_: lmtrain.lm_loss(
+                p_, tok, tgt, CFG,
+                seq_axis=None, tp_axis=lmtrain.TP_AXIS, attn_impl="full",
+                axes=(lmtrain.DATA_AXIS,),
+            )
+        )(p)
+        return S.global_norm(
+            g, specs=specs, axes=(lmtrain.DATA_AXIS, lmtrain.TP_AXIS)
+        )
+
+    got = float(
+        jax.jit(
+            jax.shard_map(
+                norm_fn,
+                mesh=mesh,
+                in_specs=(specs, P(lmtrain.DATA_AXIS), P(lmtrain.DATA_AXIS)),
+                out_specs=P(),
+            )
+        )(params, tokens, targets)
+    )
+    assert np.isclose(got, want, rtol=1e-4), (got, want)
+
+
+def _mesh1():
+    import numpy as _np
+    from jax.sharding import Mesh
+
+    return Mesh(
+        _np.asarray(jax.devices()[:1]).reshape(1, 1, 1),
+        (lmtrain.DATA_AXIS, lmtrain.SEQ_AXIS, lmtrain.TP_AXIS),
+    )
+
+
+def test_accumulation_matches_full_batch(n_devices):
+    mesh = _mesh1()
+    tokens, targets = lmtrain.make_copy_task(
+        jax.random.key(2), batch=8, seq_len=16, vocab=CFG.vocab_size
+    )
+
+    def run(accum):
+        params0 = tfm.init_params(jax.random.key(0), CFG)
+        params, _ = lmtrain.shard_params(params0, CFG, mesh)
+        mom = lmtrain.init_lm_momentum(params, mesh)
+        step = lmtrain.make_lm_train_step(
+            CFG, mesh, lr=0.1, attn_impl="full", accum_steps=accum
+        )
+        params, mom, loss = step(params, mom, tokens, targets)
+        return float(loss), params
+
+    loss1, p1 = run(1)
+    loss4, p4 = run(4)
+    assert np.isclose(loss1, loss4, rtol=1e-5), (loss1, loss4)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6
+        ),
+        p1, p4,
+    )
+
+
+def test_accumulation_on_dp_mesh_learns(n_devices):
+    mesh = lmtrain.create_lm_mesh(2, 1, 1)
+    params0 = tfm.init_params(jax.random.key(0), CFG)
+    params, _ = lmtrain.shard_params(params0, CFG, mesh)
+    mom = lmtrain.init_lm_momentum(params, mesh)
+    step = lmtrain.make_lm_train_step(
+        CFG, mesh, lr=0.3, attn_impl="full", accum_steps=2, clip_norm=1.0
+    )
+    tokens, targets = lmtrain.make_copy_task(
+        jax.random.key(3), batch=8, seq_len=16, vocab=CFG.vocab_size
+    )
+    losses = []
+    for _ in range(25):
+        params, mom, loss = step(params, mom, tokens, targets)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] - 0.5, losses[:: len(losses) - 1]
+
+
+def test_scheduled_step_matches_unscheduled_at_constant_lr(n_devices):
+    import functools
+
+    mesh = _mesh1()
+    tokens, targets = lmtrain.make_copy_task(
+        jax.random.key(4), batch=4, seq_len=16, vocab=CFG.vocab_size
+    )
+
+    def run(schedule):
+        params0 = tfm.init_params(jax.random.key(0), CFG)
+        params, _ = lmtrain.shard_params(params0, CFG, mesh)
+        mom = lmtrain.init_lm_momentum(params, mesh)
+        step = lmtrain.make_lm_train_step(
+            CFG, mesh, lr=0.1, attn_impl="full", lr_schedule=schedule
+        )
+        for i in range(3):
+            args = (params, mom, tokens, targets)
+            out = step(*args, jnp.int32(i)) if schedule else step(*args)
+            params, mom, loss = out
+        return float(loss), params
+
+    l_plain, p_plain = run(None)
+    l_sched, p_sched = run(
+        functools.partial(S.constant_lr, base_lr=0.1)
+    )
+    assert np.isclose(l_plain, l_sched, rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+        ),
+        p_plain, p_sched,
+    )
+
+
+def test_scheduled_zero_adam_learns(n_devices):
+    """cosine schedule + clip + ZeRO-Adam on dp4: the full trio composes."""
+    import functools
+
+    mesh = lmtrain.create_lm_mesh(4, 1, 1)
+    params0 = tfm.init_params(jax.random.key(0), CFG)
+    params, _ = lmtrain.shard_params(params0, CFG, mesh)
+    mom = lmtrain.init_lm_momentum(params, mesh, "zero-adam")
+    sched = functools.partial(
+        S.warmup_cosine, base_lr=0.01, total_steps=30, warmup_steps=5
+    )
+    step = lmtrain.make_lm_train_step(
+        CFG, mesh, lr=0.01, attn_impl="full", optimizer="zero-adam",
+        lr_schedule=sched, clip_norm=1.0,
+    )
+    tokens, targets = lmtrain.make_copy_task(
+        jax.random.key(5), batch=8, seq_len=16, vocab=CFG.vocab_size
+    )
+    losses = []
+    for i in range(30):
+        params, mom, loss = step(params, mom, tokens, targets, jnp.int32(i))
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] - 0.5, losses[:: len(losses) - 1]
