@@ -246,6 +246,44 @@ def _retryable(err: str) -> bool:
     return any(m in err for m in _RETRYABLE) or "row timed out" in err
 
 
+def _probe_backend(timeout: float = 75.0) -> bool:
+    """Cheap subprocess check that the default backend can actually claim a
+    device and run. On the axon tunnel a wedged chip makes jax.devices()
+    hang indefinitely (observed r3: a kill mid-claim wedges the claim
+    server-side for tens of minutes) - probing for ~1 min is far cheaper
+    than burning a full --row-timeout per attempt, and the probe's own
+    kill-on-timeout is harmless because the chip is already wedged."""
+    code = (
+        "from distributed_neural_network_tpu.train.cli import "
+        "honor_platform_env; honor_platform_env(); import jax; "
+        "import jax.numpy as jnp; jax.devices(); "
+        "print(float(jnp.ones(4).sum()))"
+    )
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=timeout, cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        return False
+    return p.returncode == 0
+
+
+def _wait_backend(deadline_ts: float, *, probe_timeout: float = 75.0,
+                  sleep_s: float = 60.0) -> bool:
+    """Probe until the backend answers or the deadline passes."""
+    attempt = 0
+    while True:
+        attempt += 1
+        _log(f"[bench] backend probe attempt {attempt}")
+        if _probe_backend(probe_timeout):
+            return True
+        if time.time() + sleep_s + probe_timeout > deadline_ts:
+            return False
+        _log(f"[bench] backend not ready; sleeping {sleep_s:.0f}s")
+        time.sleep(sleep_s)
+
+
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--worker", default=None, help=argparse.SUPPRESS)
@@ -301,8 +339,37 @@ def main() -> int:
         ),
         "rows": [],
     }
+    # gate accelerator rows on a cheap backend probe: a wedged axon claim
+    # hangs jax.devices() indefinitely, and burning --row-timeout per
+    # attempt on it would eat the whole deadline (r2 post-mortem, r3
+    # wedge). Rows that pin their own platform via spec["env"] (the CPU
+    # pp-bubble row) do not need the device backend and always run.
+    backend_ok = True
+    if any(not r.get("env") for r in rows):
+        probe_budget = t_start + min(args.deadline * 0.5, 600.0)
+        backend_ok = _wait_backend(probe_budget)
+        if not backend_ok:
+            _log("[bench] device backend unavailable after probing; "
+                 "accelerator rows will be marked failed (cpu-env rows "
+                 "still run)")
+
     headline = None
     for spec in rows:
+        if not spec.get("env") and not backend_ok:
+            # one last cheap probe in case the claim cleared late
+            backend_ok = _probe_backend(45)
+            if not backend_ok:
+                state["rows"].append({
+                    "id": spec["id"],
+                    **{k: v for k, v in spec.items()
+                       if k in ("ref_s", "ref")},
+                    "error": "backend unavailable: device claim wedged "
+                             "(probe timed out); see BENCH note",
+                })
+                _write_matrix(state)
+                if spec.get("headline"):
+                    headline = state["rows"][-1]
+                continue
         elapsed = time.time() - t_start
         if elapsed > args.deadline and not spec.get("headline"):
             _log(f"[bench] {spec['id']}: skipped (deadline "
