@@ -99,6 +99,15 @@ def main() -> int:
                    help="SGD momentum; for adam/zero-adam this is b1 "
                    "(the first-moment decay, Adam's momentum analog)")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--data-path", default=None,
+                   help="token corpus (.npy or raw .bin of uint16 tokens, "
+                   "one flat stream): each step samples fresh (B, S) "
+                   "windows; default = the fixed synthetic copy-task batch")
+    p.add_argument("--eval-every", type=int, default=0,
+                   help="every N steps report held-out loss/perplexity "
+                   "over --eval-batches windows (requires --data-path; "
+                   "the stream tail is the eval split)")
+    p.add_argument("--eval-batches", type=int, default=8)
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument("--checkpoint-dir", default=None,
                    help="save params+momentum every --checkpoint-every steps")
@@ -116,6 +125,9 @@ def main() -> int:
         p.error("--checkpoint-every must be >= 1")
     if args.resume and not args.checkpoint_dir:
         p.error("--resume requires --checkpoint-dir")
+    if args.eval_every and not args.data_path:
+        p.error("--eval-every requires --data-path (the held-out split "
+                "is the token stream's tail)")
     if args.loss_chunks > 1 and (
         args.seq_len // max(args.sp, 1)
     ) % args.loss_chunks:
@@ -285,10 +297,7 @@ def main() -> int:
                 step0 = last + 1
                 print(f"(Resumed from step {last}; continuing at {step0})")
 
-    tokens, targets = lmtrain.make_copy_task(
-        jax.random.key(args.seed + 1),
-        batch=args.batch_size, seq_len=args.seq_len, vocab=args.vocab,
-    )
+    zperm = None
     if not pipe and args.attn == "zigzag" and args.sp > 1:
         # zigzag layout: permute the sequence axis so each device's shard
         # holds one early + one late chunk; next-token loss is a mean over
@@ -297,7 +306,59 @@ def main() -> int:
         from distributed_neural_network_tpu.parallel.ring import zigzag_order
 
         zperm = zigzag_order(args.seq_len, args.sp)
-        tokens, targets = tokens[:, zperm], targets[:, zperm]
+
+    stream = None
+    if args.data_path:
+        from distributed_neural_network_tpu.data.tokens import (
+            load_token_stream,
+            sample_batch,
+        )
+
+        stream = load_token_stream(args.data_path, vocab_size=args.vocab)
+        print(f"(token stream: {len(stream.tokens):,} tokens "
+              f"[{stream.source}], {stream.n_eval:,} held out)")
+
+        def batch_at(i, split="train"):
+            tok, tgt = sample_batch(
+                stream, batch=args.batch_size, seq_len=args.seq_len,
+                step=i, seed=args.seed, split=split,
+            )
+            tok, tgt = jnp.asarray(tok), jnp.asarray(tgt)
+            if zperm is not None:
+                tok, tgt = tok[:, zperm], tgt[:, zperm]
+            return tok, tgt
+
+        tokens, targets = batch_at(0)
+    else:
+        tokens, targets = lmtrain.make_copy_task(
+            jax.random.key(args.seed + 1),
+            batch=args.batch_size, seq_len=args.seq_len, vocab=args.vocab,
+        )
+        if zperm is not None:
+            tokens, targets = tokens[:, zperm], targets[:, zperm]
+
+    eval_fn = None
+    if args.eval_every and not pipe:
+        from jax.sharding import PartitionSpec as _P
+
+        tp_ax = lmtrain.TP_AXIS if args.tp > 1 else None
+        sp_ax = lmtrain.SEQ_AXIS if args.sp > 1 else None
+        sync = tuple(a for a in (lmtrain.DATA_AXIS, lmtrain.SEQ_AXIS)
+                     if a in mesh.axis_names)
+        eval_fn = jax.jit(
+            jax.shard_map(
+                lambda p, tok, tgt: lmtrain.lm_loss(
+                    p, tok, tgt, cfg, seq_axis=sp_ax, tp_axis=tp_ax,
+                    ep_axis=lmtrain._ep_axis(cfg, mesh),
+                    attn_impl=args.attn, axes=sync,
+                ),
+                mesh=mesh,
+                in_specs=(specs, _P(lmtrain.DATA_AXIS, lmtrain.SEQ_AXIS),
+                          _P(lmtrain.DATA_AXIS, lmtrain.SEQ_AXIS)),
+                out_specs=_P(),
+                check_vma=args.attn != "flash",
+            )
+        )
     print(
         f"(LM {tfm.param_count(params):,} params, mesh {mesh_desc}, "
         f"attn={args.attn if args.sp > 1 or args.attn == 'flash' else 'full'}, "
@@ -309,13 +370,27 @@ def main() -> int:
     t0 = None
     steps_run = range(step0, step0 + args.steps)
     scheduled = args.lr_schedule != "constant" and not pipe
+    last_eval = None
     for i in steps_run:
+        if stream is not None and i != step0:
+            tokens, targets = batch_at(i)
         if scheduled:
             params, mom, loss = step(
                 params, mom, tokens, targets, jnp.int32(i)
             )
         else:
             params, mom, loss = step(params, mom, tokens, targets)
+        if eval_fn is not None and (i + 1) % args.eval_every == 0:
+            import numpy as _np
+
+            ev = float(_np.mean([
+                float(eval_fn(params, *batch_at(j, "eval")))
+                for j in range(args.eval_batches)
+            ]))
+            last_eval = {"step": i, "eval_loss": round(ev, 4),
+                         "ppl": round(float(_np.exp(min(ev, 30.0))), 2)}
+            print(f"step {i:>5}  eval_loss {ev:.4f}  "
+                  f"ppl {last_eval['ppl']:.2f}")
         if i == step0:
             jax.block_until_ready(loss)
             first_loss = float(loss)
@@ -403,6 +478,8 @@ def main() -> int:
     print("SUMMARY " + json.dumps({
         "mesh": mesh_desc, "steps": args.steps, "start_step": step0,
         "dtype": args.dtype, "pp_bubble_frac": bubble,
+        "data_source": stream.source if stream is not None else "copy-task",
+        "eval": last_eval,
         "first_loss": first_loss, "final_loss": float(loss),
         "tokens_per_s": round(tok_s), "wall_s_post_compile": round(dt, 3),
         "model_tflops_per_s": round(model_flops_s / 1e12, 2),
