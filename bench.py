@@ -208,8 +208,27 @@ def _log(msg: str) -> None:
 
 
 def _write_matrix(state: dict) -> None:
+    """Write the matrix, merging by row id with any existing file.
+
+    Partial runs (--only, smoke epochs) must not clobber rows measured by
+    earlier full runs: rows from this run win on id collision, rows only
+    present on disk are kept. Every written row carries measured_unix so
+    provenance stays visible across merged runs.
+    """
+    now = round(time.time(), 1)
+    for r in state["rows"]:
+        r.setdefault("measured_unix", now)
+    merged = dict(state)
+    try:
+        with open(MATRIX_PATH) as f:
+            old_rows = json.load(f).get("rows", [])
+    except (OSError, json.JSONDecodeError):
+        old_rows = []
+    new_ids = {r.get("id") for r in state["rows"]}
+    kept = [r for r in old_rows if r.get("id") not in new_ids]
+    merged["rows"] = state["rows"] + kept
     with open(MATRIX_PATH + ".tmp", "w") as f:
-        json.dump(state, f, indent=1)
+        json.dump(merged, f, indent=1)
     os.replace(MATRIX_PATH + ".tmp", MATRIX_PATH)
 
 

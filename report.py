@@ -188,10 +188,92 @@ def main() -> int:
         ),
         "",
     ]
+    lines += _bench_matrix_sections()
     with open(args.out, "w") as f:
         f.write("\n".join(lines))
     print(f"wrote {args.out}")
     return 0
+
+
+def _bench_matrix_sections() -> list[str]:
+    """LM-throughput/MFU + pipeline-bubble sections from BENCH_MATRIX.json.
+
+    bench.py writes the matrix incrementally on every run; rendering it
+    here (rather than hand-editing REPORT.md) keeps the report
+    regenerable in one command. Rows with errors are listed as such -
+    an honest artifact beats a silently dropped row.
+    """
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_MATRIX.json")
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        matrix = json.load(f)
+    rows = matrix.get("rows", [])
+    out = []
+
+    lm = [r for r in rows if r.get("id", "").startswith("lm_")]
+    if lm:
+        out += [
+            "## LM throughput - single chip (beyond-reference model family)",
+            "",
+            "Transformer LM (`lm_train.py`), synthetic copy task, "
+            "steady-state tokens/s over the timed steps. Timing uses the "
+            "hard value-fetch fence (`utils/timers.py hard_block`; "
+            "`block_until_ready` alone is a no-op on the tunneled axon "
+            "backend - numbers recorded before round 3's fence fix were "
+            "dispatch time and have been discarded). MFU = model "
+            "FLOPs/token x tokens/s / dtype-adjusted peak "
+            "(`train/measure.py`).",
+            "",
+            fmt_row(["config", "attn", "remat", "batch", "seq",
+                     "tokens/s", "MFU %"]),
+            fmt_row(["---"] * 7),
+        ]
+        for r in lm:
+            if "error" in r:
+                out.append(fmt_row([
+                    r["id"], "-", "-", "-", "-",
+                    f"FAILED: {r['error'][:60]}...", "-",
+                ]))
+                continue
+            cfgs = (f"d{r['d_model']}/L{r['n_layers']}/voc{r['vocab']//1000}k"
+                    f"/{r['dtype']}")
+            out.append(fmt_row([
+                cfgs, r.get("attn_kernel", r["attn"]), r["remat"],
+                r["batch"], r["seq_len"], f"{r['tokens_per_s']:,}",
+                r.get("mfu_pct", "-"),
+            ]))
+        out.append("")
+
+    pb = [r for r in rows if r.get("id", "").startswith("pp4_bubble")
+          and "configs" in r]
+    if pb:
+        r = pb[-1]
+        out += [
+            "## Pipeline bubble - measured at pp=4 "
+            f"({r['devices']}x {r['platform']} mesh)",
+            "",
+            "Fixed microbatch size, varying (M microbatches, v interleave):"
+            " tokens/s tracks 1 - bubble since per-token work is identical"
+            " across configs (`train/measure.py measure_pp_bubble`). The"
+            " interleaved (circular) schedule cuts the bubble to"
+            " (P-1)/(v*M+P-1) (`parallel/pipeline.py`).",
+            "",
+            fmt_row(["microbatches", "interleave", "tokens/s",
+                     "bubble (analytic)", "bubble (measured)"]),
+            fmt_row(["---"] * 5),
+        ]
+        for c in r["configs"]:
+            out.append(fmt_row([
+                c["microbatches"], c["interleave"],
+                f"{c['tokens_per_s']:,}", c["bubble_analytic"],
+                c["bubble_measured"],
+            ]))
+        out += ["", r.get("note", ""), ""]
+    return out
 
 
 if __name__ == "__main__":
