@@ -69,6 +69,7 @@ def test_lm_step_learns_with_adam(n_devices, optimizer):
     assert losses[-1] < losses[0] - 0.5, losses[:: len(losses) - 1]
 
 
+@pytest.mark.slow
 def test_zero_adam_matches_replicated_adam(n_devices):
     """Same data, same steps: ZeRO-sharded Adam == replicated Adam (the
     elementwise update runs on a partition of the elements)."""
@@ -112,6 +113,7 @@ def test_zero_adam_state_is_sharded(n_devices):
         assert shard_rows * 8 == leaf.shape[0], (shard_rows, leaf.shape)
 
 
+@pytest.mark.slow
 def test_adam_with_tensor_parallel_state_follows_params(n_devices):
     """State built by zeros_like inherits tensor shardings; the dp x tp
     step runs and learns."""

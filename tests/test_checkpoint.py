@@ -79,6 +79,7 @@ def test_retention_keeps_last_k(tmp_path, n_devices):
     assert ck._b.all_steps() == [3, 4]
 
 
+@pytest.mark.slow
 def test_worker_count_mismatch_raises(tmp_path, n_devices):
     ck = Checkpointer(str(tmp_path / "m"), every=1, backend="npz")
     eng = Engine(_cfg(1), TRAIN, None)

@@ -34,6 +34,7 @@ def _greedy_oracle(params, prompt, n_new):
     return seq
 
 
+@pytest.mark.slow
 def test_cached_decode_matches_full_forward_greedy(n_devices):
     params = tfm.init_params(jax.random.key(0), CFG)
     prompt = jax.random.randint(jax.random.key(1), (3, 5), 2, 32, jnp.int32)

@@ -174,6 +174,7 @@ class TestSortDispatch:
         )
         assert np.isclose(float(aux_s), float(aux_d), rtol=1e-6)
 
+    @pytest.mark.slow
     def test_grads_match_dense_oracle(self, n_devices):
         rng = np.random.default_rng(9)
         x = jnp.asarray(rng.normal(size=(T, D)), jnp.float32)

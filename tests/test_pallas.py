@@ -83,6 +83,7 @@ def test_network_pallas_head_matches_xla_head(n_devices):
     np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_x), rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_engine_trains_with_pallas_kernels(n_devices):
     """Full sharded training epoch with the fused head on the 8-device mesh."""
     from distributed_neural_network_tpu.data.cifar10 import (

@@ -199,6 +199,7 @@ def test_indivisible_layers_rejected(n_devices):
         pp.make_pp_train_step(CFG, mesh)
 
 
+@pytest.mark.slow
 def test_interior_ticks_do_no_vocab_work(n_devices):
     """The head must run once per microbatch (sharded over stages), not
     per tick per stage (r2 VERDICT weak #3). Measured on the compiled

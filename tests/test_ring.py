@@ -85,6 +85,7 @@ def test_zigzag_matches_full_causal(n_devices, n_ring):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_zigzag_gradients_flow(n_devices):
     from distributed_neural_network_tpu.parallel.ring import (
         zigzag_order,

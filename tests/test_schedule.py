@@ -57,6 +57,7 @@ def test_clip_matches_optax():
         )
 
 
+@pytest.mark.slow
 def test_sharded_global_norm_matches_single_device(n_devices):
     """dp2 x tp2: the psum-aware norm inside shard_map equals the plain
     norm of the gathered gradients."""
@@ -114,6 +115,7 @@ def _mesh1():
     )
 
 
+@pytest.mark.slow
 def test_accumulation_matches_full_batch(n_devices):
     mesh = _mesh1()
     tokens, targets = lmtrain.make_copy_task(
@@ -160,6 +162,7 @@ def test_accumulation_on_dp_mesh_learns(n_devices):
     assert losses[-1] < losses[0] - 0.5, losses[:: len(losses) - 1]
 
 
+@pytest.mark.slow
 def test_scheduled_step_matches_unscheduled_at_constant_lr(n_devices):
     import functools
 
@@ -194,6 +197,7 @@ def test_scheduled_step_matches_unscheduled_at_constant_lr(n_devices):
     )
 
 
+@pytest.mark.slow
 def test_scheduled_zero_adam_learns(n_devices):
     """cosine schedule + clip + ZeRO-Adam on dp4: the full trio composes."""
     import functools

@@ -176,6 +176,7 @@ def test_prefetch_handles_tuple_items():
     np.testing.assert_array_equal(out[3][0], np.ones(3))
 
 
+@pytest.mark.slow
 def test_stream_prefetch_matches_synchronous(n_devices):
     """Prefetching changes timing, never results: identical loss surface."""
     a = _engine("stream", seed=4, stream_prefetch=2).run(log=lambda *_: None)

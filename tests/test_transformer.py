@@ -259,6 +259,7 @@ class TestChunkedCE:
                 np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
             )
 
+    @pytest.mark.slow
     def test_matches_on_mesh_train_step(self, n_devices):
         import numpy as np
 
