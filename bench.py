@@ -154,6 +154,15 @@ def _rows(epochs: int) -> list[dict]:
                      "d_model": 1024, "n_layers": 16, "n_heads": 16,
                      "d_ff": 4096},
         },
+        {
+            # attention-only remat: no (B,H,S,S) storage, only the
+            # attention einsums recomputed - the cheap XLA-path memory
+            # fix (vs whole-block remat's ~1/3 FLOP overhead)
+            "id": "lm_xla_d512_L8_seq2048_bf16_rematattn",
+            "kind": "lm",
+            "args": {"attn": "full", "dtype": "bfloat16", "steps": 20,
+                     "remat_attn": True},
+        },
         # measured pp=4 pipeline bubble (VERDICT r2 item 4): fixed
         # microbatch size, varying (M, interleave) -> tokens/s tracks
         # 1 - bubble. Runs on a 4-device virtual CPU mesh (the one real

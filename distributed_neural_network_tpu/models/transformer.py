@@ -69,6 +69,14 @@ class TransformerConfig:
     # ~1/3 more FLOPs for O(layers * seq^2) less activation memory - the
     # standard long-context/deep-stack memory lever on TPU
     remat: bool = False
+    # rematerialize ONLY the attention inner call (scores/softmax/values):
+    # the (B, H, S, S) score tensor - the piece that actually OOMs at long
+    # seq - is recomputed in backward while every matmul residual
+    # ((B, S, d)-sized, cheap) stays stored. Costs ~4*S*d extra
+    # FLOPs/token/layer (the attention einsums only) instead of block
+    # remat's full ~1/3, and needs no Pallas kernel. Ignored when
+    # remat=True (block remat already covers the scores).
+    remat_attn: bool = False
     # Mixture-of-experts FFN (0 = dense). Experts replace the MLP in every
     # block; capacity_factor sizes the static per-expert slot count.
     n_experts: int = 0
@@ -325,14 +333,20 @@ def apply_hidden(
         b * s_local, cfg.n_experts, cfg.moe_top_k, cfg.moe_capacity_factor
     ) if cfg.n_experts else None
 
+    def attend(q, k, v):
+        return _attend(
+            q, k, v, impl=attn_impl, seq_axis=seq_axis, s_local=s_local
+        )
+
+    if cfg.remat_attn and not cfg.remat:
+        attend = jax.checkpoint(attend)
+
     def block(x, lp):
         return transformer_block(
             x,
             lp,
             cfg,
-            attend=lambda q, k, v: _attend(
-                q, k, v, impl=attn_impl, seq_axis=seq_axis, s_local=s_local
-            ),
+            attend=attend,
             tp_axis=tp_axis,
             ep_axis=ep_axis,
             capacity=cap,

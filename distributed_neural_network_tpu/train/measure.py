@@ -230,6 +230,7 @@ def measure_lm_training(
     attn: str = "flash",
     dtype: str = "bfloat16",
     remat: bool = False,
+    remat_attn: bool = False,
     loss_chunks: int = 0,
     lr: float = 0.01,
 ) -> dict:
@@ -252,6 +253,7 @@ def measure_lm_training(
         n_layers=n_layers, d_ff=d_ff,
         dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32,
         remat=remat,
+        remat_attn=remat_attn,
     )
     mesh = lmtrain.create_lm_mesh(1, 1, 1)
     params0 = tfm.init_params(jax.random.key(0), cfg)
@@ -288,7 +290,7 @@ def measure_lm_training(
     return {
         "d_model": d_model, "n_layers": n_layers, "seq_len": seq_len,
         "vocab": vocab, "batch": batch, "steps": steps, "dtype": dtype,
-        "attn": attn, "remat": remat,
+        "attn": attn, "remat": remat, "remat_attn": remat_attn,
         "attn_kernel": (
             "pallas-flash" if attn == "flash" and _flash_available()
             else "xla"

@@ -80,6 +80,11 @@ def main() -> int:
     p.add_argument("--remat", action="store_true",
                    help="rematerialize blocks in backward (jax.checkpoint): "
                    "~1/3 more FLOPs for far less activation memory")
+    p.add_argument("--remat-attn", action="store_true",
+                   help="rematerialize ONLY the attention scores/softmax in "
+                   "backward: avoids storing the (B,H,S,S) tensor for a few "
+                   "percent extra FLOPs - the cheap alternative to --remat "
+                   "for the XLA attention path (no-op with --remat)")
     p.add_argument("--lr", type=float, default=0.1)
     p.add_argument("--lr-schedule", choices=("constant", "cosine"),
                    default="constant",
@@ -168,6 +173,7 @@ def main() -> int:
         d_ff=args.d_ff,
         dtype=jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32,
         remat=args.remat,
+        remat_attn=args.remat_attn,
         n_experts=args.experts,
     )
     if args.n_heads % max(args.tp, 1):

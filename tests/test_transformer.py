@@ -165,7 +165,7 @@ def test_remat_matches_no_remat(n_devices):
     def loss_and_grad(remat):
         cfg = tfm.TransformerConfig(**base, remat=remat)
         params = tfm.init_params(jax.random.key(0), cfg)
-        fn = lambda p: lmtrain.lm_loss(
+        fn = lambda p: lm.lm_loss(
             p, tokens, targets, cfg,
             seq_axis=None, tp_axis=None, attn_impl="full", axes=(),
         )
@@ -244,7 +244,7 @@ class TestChunkedCE:
         )
 
         def loss_and_grad(chunks):
-            fn = lambda p: lmtrain.lm_loss(
+            fn = lambda p: lm.lm_loss(
                 p, tokens, targets, cfg, seq_axis=None, tp_axis=None,
                 attn_impl="full", axes=(), loss_chunks=chunks,
             )
@@ -297,3 +297,33 @@ class TestChunkedCE:
         for b, s, v in [(16, 2048, 32768), (8, 384, 50000), (3, 96, 10**6)]:
             c = auto_loss_chunks(b, s, v)
             assert s % c == 0 and b * (s // c) * v <= 64 * 2**20 // 4
+
+
+def test_remat_attn_matches_no_remat(n_devices):
+    """remat_attn recomputes the attention inner call in backward; loss and
+    gradients must be bit-comparable to the stored-scores path (same math,
+    different schedule)."""
+    base = dict(vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64)
+    tokens, targets = lm.make_copy_task(
+        jax.random.key(9), batch=4, seq_len=16, vocab=64
+    )
+
+    def loss_and_grads(**kw):
+        cfg = tfm.TransformerConfig(**base, **kw)
+        params = tfm.init_params(jax.random.key(0), cfg)
+        return jax.value_and_grad(
+            lambda p: lm.lm_loss(
+                p, tokens, targets, cfg,
+                seq_axis=None, tp_axis=None, attn_impl="full", axes=(),
+            )
+        )(params)
+
+    l0, g0 = loss_and_grads()
+    l1, g1 = loss_and_grads(remat_attn=True)
+    assert np.isclose(float(l0), float(l1), rtol=1e-6), (l0, l1)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+        ),
+        g0, g1,
+    )
