@@ -80,6 +80,32 @@ def main() -> int:
         mesh=mesh,
     )
     m = eng.run_epoch(0)
+
+    # --- LM ZeRO-Adam step on the same 2-host mesh: optimizer state
+    # sharded 1/8 across processes, grads typed-psummed over hosts, the
+    # all-gather reassembly crossing the process boundary - the layout
+    # most likely to break under real multi-host (non-addressable arrays)
+    from distributed_neural_network_tpu.models import transformer as tfm
+    from distributed_neural_network_tpu.train import lm as lmtrain
+
+    z_cfg = tfm.TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64
+    )
+    zmesh = lmtrain.create_lm_mesh(8, 1, 1)
+    zparams = tfm.init_params(jax.random.key(0), z_cfg)
+    zparams, _ = lmtrain.shard_params(zparams, z_cfg, zmesh)
+    zmom = lmtrain.init_lm_momentum(zparams, zmesh, "zero-adam")
+    zstep = lmtrain.make_lm_train_step(
+        z_cfg, zmesh, lr=0.05, optimizer="zero-adam", clip_norm=1.0
+    )
+    tok, tgt = lmtrain.make_copy_task(
+        jax.random.key(1), batch=16, seq_len=16, vocab=z_cfg.vocab_size
+    )
+    zloss = None
+    for _ in range(2):
+        zparams, zmom, zloss = zstep(zparams, zmom, tok, tgt)
+    zloss = float(zloss)
+
     print("MP_RESULT " + json.dumps({
         "process": pid,
         "processes": jax.process_count(),
@@ -87,6 +113,7 @@ def main() -> int:
         "train_loss": m.train_loss,
         "val_loss": m.val_loss,
         "val_acc": m.val_acc,
+        "zero_adam_loss": zloss,
     }), flush=True)
     return 0
 

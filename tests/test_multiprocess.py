@@ -77,3 +77,9 @@ def test_two_process_mesh_trains_one_epoch():
     assert r0["train_loss"] == pytest.approx(r1["train_loss"], rel=1e-6)
     assert r0["val_loss"] == pytest.approx(r1["val_loss"], rel=1e-6)
     assert r0["val_acc"] == pytest.approx(r1["val_acc"], rel=1e-6)
+    # ZeRO-Adam across the process boundary: state sharded 1/8 over two
+    # hosts, identical replicated loss on both controllers, and it fell
+    assert r0["zero_adam_loss"] == pytest.approx(
+        r1["zero_adam_loss"], rel=1e-6
+    )
+    assert 0.0 < r0["zero_adam_loss"] < 10.0, r0["zero_adam_loss"]
