@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""One-shot TPU measurement session: every round artifact in one command.
+
+Runs, strictly serially (the single axon chip wedges if two processes
+race for the claim, and a kill mid-claim wedges it for everyone):
+
+  1. tools/tune_flash.py          -> tools/flash_tune_<dev>.json
+  2. bench.py (full 25-ep matrix) -> BENCH_MATRIX.json (+ headline line)
+  3. report.py --from-matrix      -> REPORT.md (no re-measurement)
+
+Each stage gets a generous subprocess timeout but is NOT killed early on
+a busy backend - bench.py's own probe gate handles that. Stage failures
+are recorded and later stages still run (report renders whatever the
+matrix holds, including error rows).
+
+Usage: python tools/measure_all.py [--skip tune] [--bench-args "..."]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run(name: str, cmd: list[str], timeout: float) -> dict:
+    print(f"[measure_all] {name}: {' '.join(cmd)}", flush=True)
+    t0 = time.time()
+    try:
+        p = subprocess.run(
+            cmd, cwd=REPO, timeout=timeout, capture_output=True, text=True
+        )
+        ok = p.returncode == 0
+        tail = (p.stdout + "\n" + p.stderr)[-1500:]
+    except subprocess.TimeoutExpired:
+        ok, tail = False, f"timed out after {timeout:.0f}s"
+    rec = {"stage": name, "ok": ok, "wall_s": round(time.time() - t0, 1),
+           "tail": tail}
+    print(f"[measure_all] {name}: {'ok' if ok else 'FAILED'} "
+          f"({rec['wall_s']}s)\n{tail[-400:]}", flush=True)
+    return rec
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--skip", nargs="*", default=[],
+                    choices=["tune", "bench", "report"])
+    ap.add_argument("--bench-args", default="",
+                    help="extra args appended to the bench.py invocation")
+    args = ap.parse_args()
+    py = sys.executable
+    log = []
+    if "tune" not in args.skip:
+        log.append(run("tune_flash",
+                       [py, os.path.join(REPO, "tools", "tune_flash.py")],
+                       timeout=1800))
+    if "bench" not in args.skip:
+        log.append(run(
+            "bench",
+            [py, os.path.join(REPO, "bench.py"), "--deadline", "2400",
+             *([a for a in args.bench_args.split() if a])],
+            timeout=3000,
+        ))
+    if "report" not in args.skip:
+        log.append(run(
+            "report",
+            [py, os.path.join(REPO, "report.py"), "--from-matrix"],
+            timeout=600,
+        ))
+    out = os.path.join(REPO, "tools", "measure_all_log.json")
+    with open(out, "w") as f:
+        json.dump(log, f, indent=1)
+    print(f"[measure_all] wrote {out}")
+    return 0 if all(r["ok"] for r in log) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
