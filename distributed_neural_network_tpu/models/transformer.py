@@ -428,13 +428,10 @@ def generate(
     forward by tests/test_generate.py. Training-side parallelism
     (`apply`'s seq/tp/ep axes) is out of scope here: decode is the
     single-device inference path; shard the batch outside for fleet
-    serving. MoE decode (cfg.n_experts > 0) is not supported.
+    serving. MoE models route through the dense dispatch (B tokens per
+    step is far below any capacity concern; capacity is sized so no
+    token ever drops, keeping decode exactly the training FFN).
     """
-    if cfg.n_experts:
-        raise ValueError(
-            "generate() supports dense models only (cfg.n_experts="
-            f"{cfg.n_experts}); MoE decode routing is not implemented"
-        )
     if temperature > 0.0 and key is None:
         raise ValueError("temperature > 0 sampling requires `key`")
     dt = cfg.dtype
@@ -464,8 +461,19 @@ def generate(
         o = jnp.einsum("bhqs,bshd->bqhd", probs.astype(dt), cv)
         x = x + o.reshape(b, 1, H * Dh) @ lp["wo"].astype(dt)
         h2 = _layer_norm(x, lp["ln2_scale"], lp["ln2_bias"]).astype(dt)
-        h2 = jax.nn.gelu(h2 @ lp["w1"].astype(dt) + lp["b1"].astype(dt))
-        x = x + h2 @ lp["w2"].astype(dt) + lp["b2"].astype(dt)
+        if cfg.n_experts:
+            # dense dispatch at decode shapes (B tokens/step): capacity =
+            # B guarantees zero drops, so decode routing is exactly the
+            # training FFN evaluated on one position
+            y, _ = moe_ffn(
+                h2.reshape(b, cfg.d_model),
+                lp["wr"], lp["w1"], lp["b1"], lp["w2"], lp["b2"],
+                top_k=cfg.moe_top_k, capacity=b, dispatch_impl="dense",
+            )
+            x = x + y.reshape(b, 1, cfg.d_model)
+        else:
+            h2 = jax.nn.gelu(h2 @ lp["w1"].astype(dt) + lp["b1"].astype(dt))
+            x = x + h2 @ lp["w2"].astype(dt) + lp["b2"].astype(dt)
         return (x, pos), (ck, cv)
 
     def time_step(carry, pos):

@@ -66,14 +66,26 @@ def test_temperature_sampling(n_devices):
         tfm.generate(params, prompt, CFG, max_new_tokens=2, temperature=1.0)
 
 
-def test_moe_decode_rejected(n_devices):
+def test_moe_cached_decode_matches_full_forward_greedy(n_devices):
+    """MoE decode routes through the dense dispatch at capacity=B (no
+    drops), so the cached step must reproduce the teacher-forced
+    forward's greedy picks exactly - same bar as the dense model."""
     cfg = tfm.TransformerConfig(
-        vocab_size=32, d_model=32, n_heads=4, n_layers=2, d_ff=64, n_experts=4
+        vocab_size=32, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        n_experts=4, moe_dispatch="dense",
     )
     params = tfm.init_params(jax.random.key(0), cfg)
-    prompt = jnp.zeros((1, 4), jnp.int32)
-    with pytest.raises(ValueError, match="dense models only"):
-        tfm.generate(params, prompt, cfg, max_new_tokens=2)
+    prompt = jax.random.randint(jax.random.key(4), (3, 5), 2, 32, jnp.int32)
+    got = tfm.generate(params, prompt, cfg, max_new_tokens=6)
+
+    seq = prompt
+    for _ in range(6):
+        logits = tfm.apply(
+            params, seq, cfg, seq_axis=None, tp_axis=None, attn_impl="full"
+        )
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(seq))
 
 
 @pytest.mark.slow
