@@ -445,8 +445,6 @@ def main() -> int:
         if pipe:
             print("(--generate skipped: decode needs the non-pipeline "
                   "param layout; rerun without --pp)")
-        elif args.experts:
-            print("(--generate skipped: MoE decode is not implemented)")
         else:
             import numpy as np
 
