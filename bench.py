@@ -233,9 +233,23 @@ def _write_matrix(state: dict) -> None:
             old_rows = json.load(f).get("rows", [])
     except (OSError, json.JSONDecodeError):
         old_rows = []
+    def measured(r):
+        return "error" not in r and "skipped" not in r
+
+    by_id = {r.get("id"): r for r in old_rows}
+    out_rows = []
+    for r in state["rows"]:
+        prev = by_id.get(r.get("id"))
+        # an error/skipped stub never replaces a previously MEASURED row:
+        # a wedged-chip rerun must not erase real numbers (the stub is
+        # dropped; stderr already logged the failure)
+        if not measured(r) and prev is not None and measured(prev):
+            out_rows.append(prev)
+        else:
+            out_rows.append(r)
     new_ids = {r.get("id") for r in state["rows"]}
     kept = [r for r in old_rows if r.get("id") not in new_ids]
-    merged["rows"] = state["rows"] + kept
+    merged["rows"] = out_rows + kept
     with open(MATRIX_PATH + ".tmp", "w") as f:
         json.dump(merged, f, indent=1)
     os.replace(MATRIX_PATH + ".tmp", MATRIX_PATH)

@@ -150,8 +150,11 @@ def sample_batch(
         lo, hi = stream.n_train, len(stream.tokens) - seq_len - 1
     else:
         raise ValueError(f"split must be 'train' or 'eval', got {split!r}")
+    # fixed per-split constants: Python's hash() is salted per process
+    # (PYTHONHASHSEED), which would silently void the cross-process
+    # determinism this function guarantees
     rng = np.random.default_rng(
-        np.random.SeedSequence([seed, hash(split) & 0x7FFFFFFF, step])
+        np.random.SeedSequence([seed, {"train": 0, "eval": 1}[split], step])
     )
     starts = _window_starts(rng, lo, hi, batch)
     idx = starts[:, None] + np.arange(seq_len + 1)[None, :]

@@ -352,6 +352,11 @@ def interleave_layer_order(
     permutation that restores the canonical order (for checkpoint export
     or switching schedules).
     """
+    if v < 1 or n_layers % (pp * v):
+        raise ValueError(
+            f"n_layers ({n_layers}) must be divisible by pipeline size x "
+            f"interleave ({pp}x{v})"
+        )
     cl = n_layers // (pp * v)
     order = np.empty(n_layers, np.int64)
     pos = 0

@@ -184,7 +184,7 @@ def measure_pp_bubble(
         tokens, targets = lmtrain.make_copy_task(
             jax.random.key(1), batch=batch, seq_len=seq_len, vocab=vocab
         )
-        for _ in range(warmup):
+        for _ in range(max(warmup, 1)):  # >=1: the fence needs a loss
             params, mom, loss = step(params, mom, tokens, targets)
         hard_block(loss)
         t0 = time.perf_counter()

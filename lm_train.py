@@ -377,8 +377,12 @@ def main() -> int:
     steps_run = range(step0, step0 + args.steps)
     scheduled = args.lr_schedule != "constant" and not pipe
     last_eval = None
+    eval_s = 0.0
     for i in steps_run:
-        if stream is not None and i != step0:
+        if stream is not None:
+            # refresh at EVERY step (including step0): on resume the
+            # pre-loop batch is batch_at(0), not batch_at(step0), and a
+            # continuous run must see the same stream as a fresh one
             tokens, targets = batch_at(i)
         if scheduled:
             params, mom, loss = step(
@@ -389,10 +393,14 @@ def main() -> int:
         if eval_fn is not None and (i + 1) % args.eval_every == 0:
             import numpy as _np
 
+            t_ev = time.perf_counter()
             ev = float(_np.mean([
                 float(eval_fn(params, *batch_at(j, "eval")))
                 for j in range(args.eval_batches)
             ]))
+            # excluded from the throughput window: only training tokens
+            # are counted, so eval wall time must not deflate tokens/s
+            eval_s += time.perf_counter() - t_ev
             last_eval = {"step": i, "eval_loss": round(ev, 4),
                          "ppl": round(float(_np.exp(min(ev, 30.0))), 2)}
             print(f"step {i:>5}  eval_loss {ev:.4f}  "
@@ -424,7 +432,7 @@ def main() -> int:
         peak_flops,
     )
 
-    dt = time.perf_counter() - t0 if args.steps > 1 else 0.0
+    dt = time.perf_counter() - t0 - eval_s if args.steps > 1 else 0.0
     tok_s = args.batch_size * args.seq_len * (args.steps - 1) / dt if dt else 0.0
     flops_tok = model_flops_per_token(cfg, args.seq_len)
     model_flops_s = flops_tok * tok_s

@@ -281,10 +281,11 @@ def _bench_matrix_sections() -> list[str]:
             fmt_row(["---"] * 7),
         ]
         for r in lm:
-            if "error" in r:
+            if "tokens_per_s" not in r:
+                why = r.get("error", r.get("skipped", "no measurement"))
                 out.append(fmt_row([
                     r["id"], "-", "-", "-", "-",
-                    f"FAILED: {r['error'][:60]}...", "-",
+                    f"FAILED: {str(why)[:60]}", "-",
                 ]))
                 continue
             cfgs = (f"d{r['d_model']}/L{r['n_layers']}/voc{r['vocab']//1000}k"
