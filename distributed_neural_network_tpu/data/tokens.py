@@ -17,9 +17,9 @@ TPU-shaped pipeline:
   (seed, step), so any batch is reproducible in isolation (resume-safe),
 - an optional held-out split reserves the stream tail for eval windows.
 
-No torch, no HF: loading is pure numpy; synthetic fallback
-(`synthetic_stream`) generates the copy-task stream so every test and
-CLI path runs with zero files.
+No torch, no HF: loading is pure numpy; the synthetic fallback inside
+`load_token_stream` generates a copy-task stream so every test and CLI
+path runs with zero files.
 """
 
 from __future__ import annotations
