@@ -114,6 +114,10 @@ def main() -> int:
                    "the stream tail is the eval split)")
     p.add_argument("--eval-batches", type=int, default=8)
     p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--metrics-jsonl", default=None,
+                   help="append train/loss (+ val/loss on --eval-every) "
+                   "series to this JSONL file - the reference's metric "
+                   "channel (utils/metrics.py), shared with the CNN engine")
     p.add_argument("--checkpoint-dir", default=None,
                    help="save params+momentum every --checkpoint-every steps")
     p.add_argument("--checkpoint-every", type=int, default=50)
@@ -375,6 +379,16 @@ def main() -> int:
     t_compile = time.perf_counter()
     t0 = None
     steps_run = range(step0, step0 + args.steps)
+    from distributed_neural_network_tpu.utils import metrics as M
+
+    run = M.init_run(jsonl_path=args.metrics_jsonl) if args.metrics_jsonl \
+        else M.MetricsRun([])
+    run["parameters"] = {
+        "mesh": mesh_desc, "optimizer": args.optimizer, "lr": args.lr,
+        "lr_schedule": args.lr_schedule, "batch_size": args.batch_size,
+        "seq_len": args.seq_len, "d_model": args.d_model,
+        "n_layers": args.n_layers, "dtype": args.dtype,
+    }
     scheduled = args.lr_schedule != "constant" and not pipe
     last_eval = None
     eval_s = 0.0
@@ -405,6 +419,7 @@ def main() -> int:
                          "ppl": round(float(_np.exp(min(ev, 30.0))), 2)}
             print(f"step {i:>5}  eval_loss {ev:.4f}  "
                   f"ppl {last_eval['ppl']:.2f}")
+            run.append(M.VAL_LOSS, ev)
         if i == step0:
             jax.block_until_ready(loss)
             first_loss = float(loss)
@@ -413,6 +428,7 @@ def main() -> int:
             t0 = time.perf_counter()
         if (i - step0) % args.log_every == 0 or i == steps_run[-1]:
             print(f"step {i:>5}  loss {float(loss):.4f}")
+            run.append(M.TRAIN_LOSS, float(loss))
         if ck is not None and (i + 1) % args.checkpoint_every == 0:
             ck.save(i, {"params": params, "mom": mom},
                     {"mesh": mesh_desc, "optimizer": args.optimizer,
@@ -476,6 +492,7 @@ def main() -> int:
                 print(f"gen[{i}] prompt={row[:cut].tolist()} "
                       f"completion={row[cut:].tolist()}")
 
+    run.stop()
     # pipeline bubble: (P-1)/(v*M+P-1) of tick-time processes garbage;
     # raise --microbatches or --pp-interleave to shrink it (the head is
     # not paid per tick)
