@@ -163,6 +163,14 @@ def _rows(epochs: int) -> list[dict]:
             "args": {"attn": "full", "dtype": "bfloat16", "steps": 20,
                      "remat_attn": True},
         },
+        {
+            # long-context row: seq 8192 is where flash earns its keep
+            # (round-1 XLA+remat measured 45.4k tok/s here, pre-fence-fix)
+            "id": "lm_flash_d512_L8_seq8192_bf16",
+            "kind": "lm",
+            "args": {"attn": "flash", "dtype": "bfloat16", "steps": 10,
+                     "batch": 4, "seq_len": 8192},
+        },
         # measured pp=4 pipeline bubble (VERDICT r2 item 4): fixed
         # microbatch size, varying (M, interleave) -> tokens/s tracks
         # 1 - bubble. Runs on a 4-device virtual CPU mesh (the one real

@@ -290,8 +290,10 @@ def _bench_matrix_sections() -> list[str]:
                 continue
             cfgs = (f"d{r['d_model']}/L{r['n_layers']}/voc{r['vocab']//1000}k"
                     f"/{r['dtype']}")
+            remat = ("block" if r.get("remat")
+                     else "attn" if r.get("remat_attn") else "none")
             out.append(fmt_row([
-                cfgs, r.get("attn_kernel", r["attn"]), r["remat"],
+                cfgs, r.get("attn_kernel", r["attn"]), remat,
                 r["batch"], r["seq_len"], f"{r['tokens_per_s']:,}",
                 r.get("mfu_pct", "-"),
             ]))
