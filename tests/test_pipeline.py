@@ -297,3 +297,24 @@ def test_interleaved_grads_match_single_device(n_devices):
         np.testing.assert_allclose(
             got, np.asarray(want), rtol=5e-4, atol=1e-5
         )
+
+
+@pytest.mark.slow
+def test_interleaved_composes_with_dp_tp(n_devices):
+    """dp2 x pp2 x tp2 with v=2: the circular schedule must compose with
+    batch sharding (grad pmean over data) and tensor parallelism
+    (per-block psums) - all three axes plus lap indexing in one step."""
+    mesh = pp.create_pp_mesh(2, 2, 2)
+    params = tfm.init_params(jax.random.key(0), CFG8)
+    params, _ = pp.shard_pp_params(params, CFG8, mesh, interleave=2)
+    mom = jax.tree.map(jnp.zeros_like, params)
+    step = pp.make_pp_train_step(
+        CFG8, mesh, n_microbatches=2, lr=0.3, momentum=0.9, interleave=2
+    )
+    tokens, targets = _data(batch=16, seq=16, seed=7)
+    losses = []
+    for _ in range(30):
+        params, mom, loss = step(params, mom, tokens, targets)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] - 0.5, losses[:: len(losses) - 1]
