@@ -234,6 +234,7 @@ def make_lm_train_step(
     lr_schedule=None,
     clip_norm: float = 0.0,
     accum_steps: int = 1,
+    weight_decay: float = 0.0,
 ):
     """Compiled (params, mom, tokens, targets) -> (params, mom, loss).
 
@@ -255,6 +256,10 @@ def make_lm_train_step(
       over B/k-row micro-batches and averages the gradients - k-times
       the effective batch in the same activation memory. B must be
       divisible by dp * k.
+    - weight_decay > 0: decoupled (AdamW-style) decay for every
+      optimizer - Adam applies it inside adam_leaf_update; SGD applies
+      p -= lr_t * wd * p after the momentum update (never folded into
+      the gradient, so momentum stays decay-free).
     """
     sp = SEQ_AXIS if mesh.shape.get(SEQ_AXIS, 1) > 1 else None
     tp = TP_AXIS if mesh.shape.get(TP_AXIS, 1) > 1 else None
@@ -342,9 +347,16 @@ def make_lm_train_step(
 
             # momentum doubles as Adam's b1 (its momentum analog), so the
             # CLI --momentum flag takes effect for every optimizer
-            params, mom = adam_step(params, mom, grads, lr_t, b1=momentum)
+            params, mom = adam_step(
+                params, mom, grads, lr_t, b1=momentum,
+                weight_decay=weight_decay,
+            )
         else:
             params, mom = sgd_step(params, mom, grads, lr_t, momentum)
+            if weight_decay:
+                params = jax.tree.map(
+                    lambda p: p - lr_t * weight_decay * p, params
+                )
         return params, mom, loss
 
     # The library Pallas flash kernel's outputs carry no vma type, which the
@@ -389,12 +401,18 @@ def make_lm_train_step(
             if optimizer == "zero-adam":
                 return zero.zero_adam_step_sharded(
                     params, mom, grads, lr_t, b1=momentum,
+                    weight_decay=weight_decay,
                     axis_name=DATA_AXIS, grads_presummed=True,
                 )
-            return zero.zero_sgd_step_sharded(
+            new_p, new_m = zero.zero_sgd_step_sharded(
                 params, mom, grads, lr_t, momentum,
                 axis_name=DATA_AXIS, grads_presummed=True,
             )
+            if weight_decay:
+                new_p = jax.tree.map(
+                    lambda p: p - lr_t * weight_decay * p, new_p
+                )
+            return new_p, new_m
 
         opt_fn = jax.shard_map(
             opt_body,

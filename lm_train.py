@@ -100,6 +100,9 @@ def main() -> int:
                    help="gradient accumulation: scan this many sequential "
                    "fwd/bwd micro-batches per optimizer step (batch-size "
                    "must divide by dp * accum-steps); not with --pp")
+    p.add_argument("--weight-decay", type=float, default=0.0,
+                   help="decoupled (AdamW-style) weight decay for the mesh "
+                   "path; applied by every optimizer")
     p.add_argument("--momentum", type=float, default=0.9,
                    help="SGD momentum; for adam/zero-adam this is b1 "
                    "(the first-moment decay, Adam's momentum analog)")
@@ -237,6 +240,7 @@ def main() -> int:
             attn_impl=args.attn, optimizer=args.optimizer,
             loss_chunks=args.loss_chunks, lr_schedule=lr_schedule,
             clip_norm=args.clip_norm, accum_steps=args.accum_steps,
+            weight_decay=args.weight_decay,
         )
 
     param_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs)

@@ -222,3 +222,43 @@ def test_scheduled_zero_adam_learns(n_devices):
         losses.append(float(loss))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0] - 0.5, losses[:: len(losses) - 1]
+
+
+def test_weight_decay_decoupled(n_devices):
+    """wd shrinks params beyond the gradient step for both sgd and adam;
+    sgd's decay must match the closed form p*(1-lr*wd) applied after the
+    momentum update."""
+    mesh = _mesh1()
+    tokens, targets = lmtrain.make_copy_task(
+        jax.random.key(6), batch=4, seq_len=16, vocab=CFG.vocab_size
+    )
+
+    def one_step(optimizer, wd):
+        params0 = tfm.init_params(jax.random.key(0), CFG)
+        params, _ = lmtrain.shard_params(params0, CFG, mesh)
+        mom = lmtrain.init_lm_momentum(params, mesh, optimizer)
+        step = lmtrain.make_lm_train_step(
+            CFG, mesh, lr=0.1, attn_impl="full", optimizer=optimizer,
+            weight_decay=wd,
+        )
+        params, mom, _ = step(params, mom, tokens, targets)
+        return params
+
+    for opt in ("sgd", "adam"):
+        p_plain = one_step(opt, 0.0)
+        p_wd = one_step(opt, 0.1)
+        diffs = jax.tree.map(
+            lambda a, b: float(np.abs(np.asarray(a) - np.asarray(b)).max()),
+            p_plain, p_wd,
+        )
+        assert max(jax.tree.leaves(diffs)) > 0.0, opt
+    # sgd closed form: wd applied after the update to the updated params
+    p_plain = one_step("sgd", 0.0)
+    p_wd = one_step("sgd", 0.1)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a) * (1.0 - 0.1 * 0.1), np.asarray(b),
+            rtol=1e-6, atol=1e-7,
+        ),
+        p_plain, p_wd,
+    )
