@@ -164,6 +164,16 @@ def _rows(epochs: int) -> list[dict]:
                      "remat_attn": True},
         },
         {
+            # d1024 fallback: the axon remote-compile helper 500s on the
+            # big no-remat program (r3); block remat shrinks the live
+            # set/program enough to have a chance
+            "id": "lm_flash_d1024_L16_seq2048_bf16_remat_b8",
+            "kind": "lm",
+            "args": {"attn": "flash", "dtype": "bfloat16", "steps": 20,
+                     "d_model": 1024, "n_layers": 16, "n_heads": 16,
+                     "d_ff": 4096, "batch": 8, "remat": True},
+        },
+        {
             # long-context row: seq 8192 is where flash earns its keep
             # (round-1 XLA+remat measured 45.4k tok/s here, pre-fence-fix)
             "id": "lm_flash_d512_L8_seq8192_bf16",
