@@ -314,14 +314,16 @@ def _bench_matrix_sections() -> list[str]:
             " (P-1)/(v*M+P-1) (`parallel/pipeline.py`).",
             "",
             fmt_row(["microbatches", "interleave", "tokens/s",
-                     "bubble (analytic)", "bubble (measured)"]),
-            fmt_row(["---"] * 5),
+                     "bubble (analytic)", "bubble (measured)",
+                     "bubble (overhead-adjusted)"]),
+            fmt_row(["---"] * 6),
         ]
         for c in r["configs"]:
             out.append(fmt_row([
                 c["microbatches"], c["interleave"],
                 f"{c['tokens_per_s']:,}", c["bubble_analytic"],
                 c["bubble_measured"],
+                c.get("bubble_overhead_adjusted", "-"),
             ]))
         out += ["", r.get("note", ""), ""]
     return out
