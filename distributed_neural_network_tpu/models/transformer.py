@@ -401,6 +401,41 @@ def param_count(params) -> int:
     return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
 
 
+def generate_sharded(
+    params,
+    prompt,
+    cfg: TransformerConfig,
+    mesh,
+    *,
+    data_axis: str = "data",
+    **kw,
+):
+    """`generate` with the batch sharded over `data_axis` of `mesh`.
+
+    Fleet-style decode: params replicate, each device decodes its slice of
+    the prompt batch - the KV caches and every per-token intermediate
+    carry the batch dimension, so XLA's SPMD partitioner runs the whole
+    scan with zero cross-device traffic after the initial placement
+    (verified identical to single-device `generate` by
+    tests/test_generate.py). Batch must divide the axis size.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    b = prompt.shape[0]
+    n = mesh.shape[data_axis]
+    if b % n:
+        raise ValueError(
+            f"prompt batch ({b}) must divide by the {data_axis!r} axis "
+            f"size ({n})"
+        )
+    repl = NamedSharding(mesh, PartitionSpec())
+    params = jax.tree.map(lambda p: jax.device_put(p, repl), params)
+    prompt = jax.device_put(
+        prompt, NamedSharding(mesh, PartitionSpec(data_axis))
+    )
+    return generate(params, prompt, cfg, **kw)
+
+
 # ------------------------------------------------------------- inference
 
 
