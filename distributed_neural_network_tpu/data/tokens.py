@@ -44,7 +44,7 @@ class TokenStream:
     tokens: np.ndarray  # 1-D, integer dtype (often a memmap)
     n_train: int  # tokens [0, n_train) are the train split
     vocab_size: int
-    source: str  # "npy" | "bin" | "synthetic"
+    source: str  # "npy" | "bin" | "txt" | "synthetic"
 
     @property
     def n_eval(self) -> int:
@@ -73,6 +73,16 @@ def load_token_stream(
         if path.endswith(".npy"):
             arr = np.load(path, mmap_mode="r")
             source = "npy"
+        elif path.endswith(".txt"):
+            # byte-level tokenization IS a uint8 memmap of the text file:
+            # zero-copy, no tokenizer dependency; needs vocab_size >= 256
+            if vocab_size < 256:
+                raise ValueError(
+                    f".txt corpora are byte-tokenized (ids 0-255); "
+                    f"vocab_size must be >= 256, got {vocab_size}"
+                )
+            arr = np.memmap(path, dtype=np.uint8, mode="r")
+            source = "txt"
         else:
             arr = np.memmap(path, dtype=np.dtype(bin_dtype), mode="r")
             source = "bin"
@@ -80,7 +90,7 @@ def load_token_stream(
             raise ValueError(
                 f"token file must be 1-D, got shape {arr.shape} ({path})"
             )
-        if arr.dtype not in _SUPPORTED:
+        if source != "txt" and arr.dtype not in _SUPPORTED:
             raise ValueError(
                 f"unsupported token dtype {arr.dtype} ({path}); use one of "
                 f"{sorted(str(d) for d in _SUPPORTED)}"

@@ -446,13 +446,15 @@ def generate(
     *,
     max_new_tokens: int,
     temperature: float = 0.0,
+    top_k: int = 0,
     key: jax.Array | None = None,
 ):
     """Autoregressive decoding with per-layer KV caches.
 
     prompt: (B, S_p) int32. Returns (B, S_p + max_new_tokens) int32 - the
     prompt followed by generated tokens. temperature 0 = greedy argmax;
-    > 0 samples from softmax(logits / temperature) (requires `key`).
+    > 0 samples from softmax(logits / temperature) (requires `key`);
+    top_k > 0 restricts sampling to the k most likely tokens first.
 
     TPU-shaped: one `lax.scan` over time steps (static total length
     S_p + max_new_tokens), an inner scan over the stacked layers, KV
@@ -526,6 +528,9 @@ def generate(
         h = _layer_norm(x, params["lnf_scale"], params["lnf_bias"]).astype(dt)
         logits = (h[:, 0] @ params["head"].astype(dt)).astype(jnp.float32)
         if temperature > 0.0:
+            if top_k > 0:
+                kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+                logits = jnp.where(logits < kth, -jnp.inf, logits)
             k_rng, k_tok = jax.random.split(k_rng)
             nxt = jax.random.categorical(k_tok, logits / temperature, axis=-1)
         else:

@@ -135,3 +135,13 @@ def test_sharded_decode_matches_single_device(n_devices):
         tfm.generate_sharded(
             params, prompt[:3], CFG, mesh, max_new_tokens=2
         )
+
+
+def test_top_k_sampling_stays_in_top_k(n_devices):
+    params = tfm.init_params(jax.random.key(0), CFG)
+    prompt = jax.random.randint(jax.random.key(6), (2, 4), 0, 32, jnp.int32)
+    out = tfm.generate(params, prompt, CFG, max_new_tokens=8,
+                       temperature=5.0, top_k=1, key=jax.random.key(9))
+    # top_k=1 at any temperature is exactly greedy
+    want = tfm.generate(params, prompt, CFG, max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(want))

@@ -86,3 +86,18 @@ def test_too_short_split_raises(tmp_path):
     s = load_token_stream(str(path), vocab_size=64, eval_frac=0.1)
     with pytest.raises(ValueError, match="too few tokens"):
         sample_batch(s, batch=2, seq_len=64, step=0)
+
+
+def test_txt_byte_tokenization(tmp_path):
+    path = tmp_path / "corpus.txt"
+    text = "hello token stream " * 400
+    path.write_text(text)
+    s = load_token_stream(str(path), vocab_size=256)
+    assert s.source == "txt"
+    np.testing.assert_array_equal(
+        np.asarray(s.tokens[:5]), np.frombuffer(b"hello", np.uint8)
+    )
+    tok, tgt = sample_batch(s, batch=2, seq_len=32, step=0)
+    assert tok.shape == (2, 32) and int(tok.max()) < 256
+    with pytest.raises(ValueError, match="byte-tokenized"):
+        load_token_stream(str(path), vocab_size=128)
