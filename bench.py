@@ -414,10 +414,15 @@ def main() -> int:
                  "still run)")
 
     headline = None
+    reprobed_late = False
     for spec in rows:
         if not spec.get("env") and not backend_ok:
-            # one last cheap probe in case the claim cleared late
-            backend_ok = _probe_backend(45)
+            # one last cheap probe in case the claim cleared late - but
+            # only once; paying 45s per accelerator row would burn the
+            # whole deadline on a wedged chip
+            if not reprobed_late:
+                reprobed_late = True
+                backend_ok = _probe_backend(45)
             if not backend_ok:
                 state["rows"].append({
                     "id": spec["id"],
@@ -521,6 +526,21 @@ def main() -> int:
             "vs_baseline": None,
         }))
         return 0 if ok == len(state["rows"]) else 1
+    # headline failed: report the structured error, and - when an earlier
+    # run measured the same row - reference that prior number so the
+    # artifact still carries context (clearly labeled, never substituted)
+    prior = {}
+    try:
+        with open(MATRIX_PATH) as f:
+            for r in json.load(f).get("rows", []):
+                if (headline is not None and r.get("id") == headline.get("id")
+                        and "train_s" in r):
+                    prior = {
+                        "prior_value": r["train_s"],
+                        "prior_measured_unix": r.get("measured_unix"),
+                    }
+    except (OSError, json.JSONDecodeError):
+        pass
     print(json.dumps({
         "metric": f"cifar10_dp_train_s_{args.epochs}ep_bs16",
         "value": None,
@@ -529,6 +549,7 @@ def main() -> int:
         "error": (headline or {}).get(
             "error", "headline row did not run"
         )[-800:],
+        **prior,
     }))
     return 1
 
