@@ -318,3 +318,33 @@ def test_interleaved_composes_with_dp_tp(n_devices):
         losses.append(float(loss))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0] - 0.5, losses[:: len(losses) - 1]
+
+
+@pytest.mark.parametrize("v,m", [(4, 2), (4, 4)])
+def test_deep_interleave_pp2(n_devices, v, m):
+    """pp=2 with v=4 virtual stages: four laps around a 2-ring - the lap
+    indexing and group chaining at v > 2 match the single-device loss."""
+    cfg = CFG8  # 8 layers = pp2 * v4 chunks of 1
+    mesh = pp.create_pp_mesh(1, 2, 1)
+    params = tfm.init_params(jax.random.key(8), cfg)
+    tokens, targets = _data(batch=8, seed=9)
+    want = float(lmtrain.lm_loss(
+        params, tokens, targets, cfg,
+        seq_axis=None, tp_axis=None, attn_impl="full", axes=(),
+    ))
+    sharded, specs = pp.shard_pp_params(params, cfg, mesh, interleave=v)
+    got = float(
+        jax.jit(
+            jax.shard_map(
+                lambda p, tok, tgt: pp.pipeline_lm_loss(
+                    p, tok, tgt, cfg,
+                    n_microbatches=m, tp_axis=None,
+                    sync_axes=(pp.DATA_AXIS,), interleave=v,
+                ),
+                mesh=mesh,
+                in_specs=(specs, P(pp.DATA_AXIS), P(pp.DATA_AXIS)),
+                out_specs=P(),
+            )
+        )(sharded, tokens, targets)
+    )
+    assert np.isclose(got, want, rtol=2e-5), (got, want)
