@@ -277,6 +277,9 @@ def make_pp_train_step(
     momentum: float = 0.9,
     loss_chunks: int = 0,
     interleave: int = 1,
+    lr_schedule=None,
+    clip_norm: float = 0.0,
+    weight_decay: float = 0.0,
 ):
     """Compiled pipeline-parallel (params, mom, tokens, targets) ->
     (params, mom, loss) over a (data, pipe, model) mesh.
@@ -286,6 +289,12 @@ def make_pp_train_step(
     `shard_pp_params(..., interleave=interleave)` - the interleaved
     schedule needs the round-robin chunk layout). interleave = v > 1
     cuts the pipeline bubble to (P-1)/(v*M+P-1); see `pipeline_lm_loss`.
+
+    Loop transforms match train/lm.py's mesh path: lr_schedule makes the
+    compiled fn take (params, mom, tokens, targets, step); clip_norm
+    clips by the sharding-aware global norm (layer leaves psum over
+    'pipe' + any tp axis, embed/head replicated); weight_decay applies
+    decoupled decay after the momentum update.
     """
     pp = mesh.shape.get(PIPE_AXIS, 1)
     v = interleave
@@ -313,7 +322,7 @@ def make_pp_train_step(
     specs = pp_param_specs(cfg, tp_axis=tp)
     data_spec = P(DATA_AXIS)
 
-    def step(params, mom, tokens, targets):
+    def step(params, mom, tokens, targets, step_i=None):
         loss, grads = jax.value_and_grad(pipeline_lm_loss)(
             params,
             tokens,
@@ -326,12 +335,34 @@ def make_pp_train_step(
             loss_chunks=loss_chunks,
             interleave=v,
         )
-        params, mom = sgd_step(params, mom, grads, lr, momentum)
+        if clip_norm > 0.0:
+            from ..ops.schedule import clip_by_global_norm
+
+            grads, _ = clip_by_global_norm(
+                grads, clip_norm, specs=specs,
+                axes=tuple(mesh.axis_names),
+            )
+        lr_t = lr if lr_schedule is None else lr_schedule(step_i)
+        params, mom = sgd_step(params, mom, grads, lr_t, momentum)
+        if weight_decay:
+            params = jax.tree.map(
+                lambda p: p - lr_t * weight_decay * p, params
+            )
         return params, mom, loss
 
+    if lr_schedule is not None:
+        return jax.jit(
+            jax.shard_map(
+                step,
+                mesh=mesh,
+                in_specs=(specs, specs, data_spec, data_spec, P()),
+                out_specs=(specs, specs, P()),
+            ),
+            donate_argnums=(0, 1),
+        )
     return jax.jit(
         jax.shard_map(
-            step,
+            lambda p, m, a, b: step(p, m, a, b),
             mesh=mesh,
             in_specs=(specs, specs, data_spec, data_spec),
             out_specs=(specs, specs, P()),

@@ -95,14 +95,14 @@ def main() -> int:
                    help="cosine floor as a fraction of --lr")
     p.add_argument("--clip-norm", type=float, default=0.0,
                    help="clip gradients to this global L2 norm before the "
-                   "optimizer (0 = off); sharding-aware across dp/sp/tp")
+                   "optimizer (0 = off); sharding-aware across dp/sp/tp/pp")
     p.add_argument("--accum-steps", type=int, default=1,
                    help="gradient accumulation: scan this many sequential "
                    "fwd/bwd micro-batches per optimizer step (batch-size "
                    "must divide by dp * accum-steps); not with --pp")
     p.add_argument("--weight-decay", type=float, default=0.0,
-                   help="decoupled (AdamW-style) weight decay for the mesh "
-                   "path; applied by every optimizer")
+                   help="decoupled (AdamW-style) weight decay; applied by "
+                   "every optimizer on both the mesh and pipeline paths")
     p.add_argument("--momentum", type=float, default=0.9,
                    help="SGD momentum; for adam/zero-adam this is b1 "
                    "(the first-moment decay, Adam's momentum analog)")
@@ -197,11 +197,11 @@ def main() -> int:
                 "--sp/--experts/adam/zero optimizers run on the "
                 "dp x sp x tp mesh (drop --pp)"
             )
-        if (args.lr_schedule != "constant" or args.clip_norm
-                or args.accum_steps > 1):
+        if args.accum_steps > 1:
             raise SystemExit(
-                "--lr-schedule/--clip-norm/--accum-steps run on the "
-                "dp x sp x tp mesh path (drop --pp)"
+                "--accum-steps runs on the dp x sp x tp mesh path; under "
+                "--pp raise --microbatches instead (the schedule already "
+                "accumulates across microbatches)"
             )
         mesh = ppl.create_pp_mesh(args.dp, args.pp, args.tp)
         params, specs = ppl.shard_pp_params(
@@ -211,10 +211,23 @@ def main() -> int:
 
         mom = init_momentum(params)
         mom_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
+        import functools
+
+        from distributed_neural_network_tpu.ops import schedule as sched
+
+        pp_lr_schedule = None
+        if args.lr_schedule == "cosine":
+            pp_lr_schedule = functools.partial(
+                sched.warmup_cosine, base_lr=args.lr,
+                total_steps=args.steps, warmup_steps=args.warmup_steps,
+                min_lr_frac=args.min_lr_frac,
+            )
         step = ppl.make_pp_train_step(
             cfg, mesh, n_microbatches=args.microbatches,
             lr=args.lr, momentum=args.momentum,
             loss_chunks=args.loss_chunks, interleave=args.pp_interleave,
+            lr_schedule=pp_lr_schedule, clip_norm=args.clip_norm,
+            weight_decay=args.weight_decay,
         )
     else:
         mesh = lmtrain.create_lm_mesh(args.dp, args.sp, args.tp)
@@ -393,7 +406,7 @@ def main() -> int:
         "seq_len": args.seq_len, "d_model": args.d_model,
         "n_layers": args.n_layers, "dtype": args.dtype,
     }
-    scheduled = args.lr_schedule != "constant" and not pipe
+    scheduled = args.lr_schedule != "constant"
     last_eval = None
     eval_s = 0.0
     for i in steps_run:
