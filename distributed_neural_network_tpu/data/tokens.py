@@ -110,7 +110,8 @@ def load_token_stream(
         if path:
             raise FileNotFoundError(
                 f"token file {path!r} not found (pass --data-path to an "
-                "existing .npy/.bin or omit it for the synthetic stream)"
+                "existing .npy/.bin/.txt or omit it for the synthetic "
+                "stream)"
             )
         # synthetic: concatenated copy-task sequences so the LM objective
         # is learnable and convergence is observable without a corpus

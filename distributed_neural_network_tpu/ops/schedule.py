@@ -109,3 +109,15 @@ def clip_by_global_norm(grads, max_norm: float, *, specs=None, axes=()):
     norm = global_norm(grads, specs=specs, axes=axes)
     scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
     return jax.tree.map(lambda g: (g * scale).astype(g.dtype), grads), norm
+
+
+def apply_decoupled_weight_decay(params, lr_t, weight_decay: float):
+    """AdamW-style decay applied AFTER the optimizer update: p -= lr*wd*p.
+
+    Shared by the mesh sgd, ZeRO-sgd, and pipeline paths so a future
+    refinement (e.g. excluding norm/bias leaves) lands everywhere at
+    once; Adam variants apply decay inside `adam_leaf_update` instead.
+    """
+    if not weight_decay:
+        return params
+    return jax.tree.map(lambda p: p - lr_t * weight_decay * p, params)

@@ -344,27 +344,20 @@ def make_pp_train_step(
             )
         lr_t = lr if lr_schedule is None else lr_schedule(step_i)
         params, mom = sgd_step(params, mom, grads, lr_t, momentum)
-        if weight_decay:
-            params = jax.tree.map(
-                lambda p: p - lr_t * weight_decay * p, params
-            )
+        from ..ops.schedule import apply_decoupled_weight_decay
+
+        params = apply_decoupled_weight_decay(params, lr_t, weight_decay)
         return params, mom, loss
 
     if lr_schedule is not None:
-        return jax.jit(
-            jax.shard_map(
-                step,
-                mesh=mesh,
-                in_specs=(specs, specs, data_spec, data_spec, P()),
-                out_specs=(specs, specs, P()),
-            ),
-            donate_argnums=(0, 1),
-        )
+        fn, extra = step, (P(),)
+    else:
+        fn, extra = (lambda p, m, a, b: step(p, m, a, b)), ()
     return jax.jit(
         jax.shard_map(
-            lambda p, m, a, b: step(p, m, a, b),
+            fn,
             mesh=mesh,
-            in_specs=(specs, specs, data_spec, data_spec),
+            in_specs=(specs, specs, data_spec, data_spec) + extra,
             out_specs=(specs, specs, P()),
         ),
         donate_argnums=(0, 1),

@@ -353,10 +353,9 @@ def make_lm_train_step(
             )
         else:
             params, mom = sgd_step(params, mom, grads, lr_t, momentum)
-            if weight_decay:
-                params = jax.tree.map(
-                    lambda p: p - lr_t * weight_decay * p, params
-                )
+            from ..ops.schedule import apply_decoupled_weight_decay
+
+            params = apply_decoupled_weight_decay(params, lr_t, weight_decay)
         return params, mom, loss
 
     # The library Pallas flash kernel's outputs carry no vma type, which the
@@ -408,10 +407,9 @@ def make_lm_train_step(
                 params, mom, grads, lr_t, momentum,
                 axis_name=DATA_AXIS, grads_presummed=True,
             )
-            if weight_decay:
-                new_p = jax.tree.map(
-                    lambda p: p - lr_t * weight_decay * p, new_p
-                )
+            from ..ops.schedule import apply_decoupled_weight_decay
+
+            new_p = apply_decoupled_weight_decay(new_p, lr_t, weight_decay)
             return new_p, new_m
 
         opt_fn = jax.shard_map(

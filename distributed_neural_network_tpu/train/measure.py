@@ -231,10 +231,15 @@ def measure_pp_bubble(
         c_fit = float(tw @ t_meas / (tw @ tw))
     pred = A @ np.array([c_fit, o_fit])
     fit_err = float(np.abs(pred - t_meas).max() / t_meas.max())
-    for r, tick_n, w in zip(results, ticks, work):
+    for r, tick_n, w, t_i in zip(results, ticks, work, t_meas):
+        # model time for the vM useful ticks over the MEASURED total: if
+        # the schedule is right this tracks bubble_analytic; a schedule
+        # paying extra ticks (broken lap indexing, say) inflates t_i and
+        # shows up here. (Dividing model useful by model total would
+        # cancel the fit entirely and always reproduce the analytic
+        # number - review r3 caught exactly that tautology.)
         useful = r["interleave"] * r["microbatches"] * (w * c_fit + o_fit)
-        total = tick_n * (w * c_fit + o_fit)
-        r["bubble_overhead_adjusted"] = round(1.0 - useful / total, 4)
+        r["bubble_overhead_adjusted"] = round(1.0 - useful / t_i, 4)
     return {
         "pp": 4, "d_model": d_model, "n_layers": n_layers,
         "seq_len": seq_len, "mb_rows": mb_rows,
@@ -249,9 +254,11 @@ def measure_pp_bubble(
             "bubble_measured compares raw tokens/s against the best "
             "config extrapolated by its analytic bubble; CPU-mesh "
             "per-tick dispatch overhead inflates it for long schedules "
-            "(high M at v=1). bubble_overhead_adjusted removes that via "
-            "the fitted tick model T*(w*c+o) and should track "
-            "bubble_analytic when the schedule math is right."
+            "(high M at v=1). bubble_overhead_adjusted = 1 - (model "
+            "time of the v*M useful ticks, from the fitted T*(w*c+o) "
+            "tick model) / MEASURED time: it tracks bubble_analytic "
+            "only if the schedule really pays v*M+P-1 ticks "
+            "(rel_fit_err is the model's residual)."
         ),
     }
 

@@ -108,9 +108,10 @@ def main() -> int:
                    "(the first-moment decay, Adam's momentum analog)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--data-path", default=None,
-                   help="token corpus (.npy or raw .bin of uint16 tokens, "
-                   "one flat stream): each step samples fresh (B, S) "
-                   "windows; default = the fixed synthetic copy-task batch")
+                   help="token corpus (.npy, raw .bin of uint16 tokens, or "
+                   ".txt byte-tokenized as uint8 - one flat stream): each "
+                   "step samples fresh (B, S) windows; default = the fixed "
+                   "synthetic copy-task batch")
     p.add_argument("--eval-every", type=int, default=0,
                    help="every N steps report held-out loss/perplexity "
                    "over --eval-batches windows (requires --data-path; "
