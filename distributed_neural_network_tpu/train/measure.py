@@ -121,6 +121,8 @@ def measure_dp_training(
         final = engine.history[-1]
     return {
         "devices": n,
+        "platform": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
         "batch_size": batch_size,
         "epochs": epochs,
         "val_acc": final.val_acc,
