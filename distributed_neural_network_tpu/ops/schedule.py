@@ -121,3 +121,23 @@ def apply_decoupled_weight_decay(params, lr_t, weight_decay: float):
     if not weight_decay:
         return params
     return jax.tree.map(lambda p: p - lr_t * weight_decay * p, params)
+
+
+def make_ema_update(decay: float):
+    """Compiled EMA tracker: ema <- decay*ema + (1-decay)*params.
+
+    Kept OUTSIDE the train step on purpose: the EMA is eval-side state
+    (evaluating/serving with averaged weights), so tracking it separately
+    leaves the optimizer state, checkpoints, and the donated step
+    signature untouched - call it after each step (or every k steps,
+    adjusting decay to decay**k for the same horizon).
+    """
+    if not 0.0 < decay < 1.0:
+        raise ValueError(f"ema decay must be in (0, 1), got {decay}")
+
+    def update(ema, params):
+        return jax.tree.map(
+            lambda e, p: decay * e + (1.0 - decay) * p, ema, params
+        )
+
+    return jax.jit(update)

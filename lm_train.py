@@ -100,6 +100,10 @@ def main() -> int:
                    help="gradient accumulation: scan this many sequential "
                    "fwd/bwd micro-batches per optimizer step (batch-size "
                    "must divide by dp * accum-steps); not with --pp")
+    p.add_argument("--ema-decay", type=float, default=0.0,
+                   help="track an exponential moving average of params "
+                   "(e.g. 0.999) and use it for --eval-every/--generate; "
+                   "0 = off")
     p.add_argument("--weight-decay", type=float, default=0.0,
                    help="decoupled (AdamW-style) weight decay; applied by "
                    "every optimizer on both the mesh and pipeline paths")
@@ -407,6 +411,14 @@ def main() -> int:
         "seq_len": args.seq_len, "d_model": args.d_model,
         "n_layers": args.n_layers, "dtype": args.dtype,
     }
+    ema = ema_fn = None
+    if args.ema_decay:
+        from distributed_neural_network_tpu.ops.schedule import (
+            make_ema_update,
+        )
+
+        ema_fn = make_ema_update(args.ema_decay)
+        ema = jax.tree.map(jnp.array, params)
     scheduled = args.lr_schedule != "constant"
     last_eval = None
     eval_s = 0.0
@@ -422,12 +434,15 @@ def main() -> int:
             )
         else:
             params, mom, loss = step(params, mom, tokens, targets)
+        if ema_fn is not None:
+            ema = ema_fn(ema, params)
         if eval_fn is not None and (i + 1) % args.eval_every == 0:
             import numpy as _np
 
             t_ev = time.perf_counter()
+            eval_params = ema if ema is not None else params
             ev = float(_np.mean([
-                float(eval_fn(params, *batch_at(j, "eval")))
+                float(eval_fn(eval_params, *batch_at(j, "eval")))
                 for j in range(args.eval_batches)
             ]))
             # excluded from the throughput window: only training tokens
@@ -490,10 +505,11 @@ def main() -> int:
         else:
             import numpy as np
 
-            # decode on replicated single-device params (gather the tree)
+            # decode on replicated single-device params (gather the tree);
+            # EMA weights when tracked - the eval-side parameters
             host_params = jax.tree.map(
                 lambda x: jax.device_put(np.asarray(x), jax.devices()[0]),
-                params,
+                ema if ema is not None else params,
             )
             # fresh unpermuted prompts (zigzag feeds permuted tokens)
             ptoks, _ = lmtrain.make_copy_task(

@@ -262,3 +262,18 @@ def test_weight_decay_decoupled(n_devices):
         ),
         p_plain, p_wd,
     )
+
+
+def test_ema_update_closed_form(n_devices):
+    tree = {"a": jnp.ones((3,)), "b": jnp.zeros((2, 2))}
+    target = {"a": jnp.full((3,), 2.0), "b": jnp.full((2, 2), 4.0)}
+    fn = S.make_ema_update(0.9)
+    ema = tree
+    for _ in range(5):
+        ema = fn(ema, target)
+    # closed form after k steps toward a constant target
+    k, d = 5, 0.9
+    want_a = 2.0 + (1.0 - 2.0) * d**k
+    assert np.allclose(np.asarray(ema["a"]), want_a, rtol=1e-6)
+    with pytest.raises(ValueError, match="decay"):
+        S.make_ema_update(1.5)
