@@ -348,3 +348,33 @@ def test_deep_interleave_pp2(n_devices, v, m):
         )(sharded, tokens, targets)
     )
     assert np.isclose(got, want, rtol=2e-5), (got, want)
+
+
+def test_interleave_with_remat_matches(n_devices):
+    """Block remat inside the lap-indexed chunk scan: same loss."""
+    cfg = tfm.TransformerConfig(
+        vocab_size=32, d_model=32, n_heads=4, n_layers=8, d_ff=64, remat=True
+    )
+    mesh = pp.create_pp_mesh(1, 4, 1)
+    params = tfm.init_params(jax.random.key(3), cfg)
+    tokens, targets = _data(batch=8, seed=4)
+    want = float(lmtrain.lm_loss(
+        params, tokens, targets, cfg,
+        seq_axis=None, tp_axis=None, attn_impl="full", axes=(),
+    ))
+    sharded, specs = pp.shard_pp_params(params, cfg, mesh, interleave=2)
+    got = float(
+        jax.jit(
+            jax.shard_map(
+                lambda p, tok, tgt: pp.pipeline_lm_loss(
+                    p, tok, tgt, cfg,
+                    n_microbatches=4, tp_axis=None,
+                    sync_axes=(pp.DATA_AXIS,), interleave=2,
+                ),
+                mesh=mesh,
+                in_specs=(specs, P(pp.DATA_AXIS), P(pp.DATA_AXIS)),
+                out_specs=P(),
+            )
+        )(sharded, tokens, targets)
+    )
+    assert np.isclose(got, want, rtol=2e-5), (got, want)
