@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Plot training curves from a metrics JSONL file (utils/metrics.py sink).
+
+The reference tracked its curves on Neptune's SaaS dashboard
+(`single_proc_train.py:20-26`); this is the local, credential-free
+equivalent: one PNG with the train/loss, val/loss and val/acc series of
+any run written with --metrics-jsonl (LM) or the CNN engine's JSONL sink.
+
+Usage: python tools/plot_metrics.py runs/lm.jsonl [-o curves.png]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+
+
+def load_series(path: str):
+    series = defaultdict(lambda: ([], []))
+    params = None
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            ev = json.loads(line)
+            if ev.get("series") == "parameters":
+                params = ev.get("data")
+                continue
+            if "value" in ev:
+                xs, ys = series[ev["series"]]
+                xs.append(ev.get("step", len(xs)))
+                ys.append(ev["value"])
+    return dict(series), params
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("jsonl")
+    ap.add_argument("-o", "--out", default=None,
+                    help="output PNG (default: <jsonl>.png)")
+    args = ap.parse_args()
+
+    series, params = load_series(args.jsonl)
+    if not series:
+        print(f"no series events in {args.jsonl}", file=sys.stderr)
+        return 1
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    loss_keys = [k for k in series if k.endswith("loss")]
+    acc_keys = [k for k in series if k.endswith("acc")]
+    n_axes = 1 + bool(acc_keys)
+    fig, axes = plt.subplots(1, n_axes, figsize=(6 * n_axes, 4))
+    axes = [axes] if n_axes == 1 else list(axes)
+
+    for k in sorted(loss_keys):
+        xs, ys = series[k]
+        axes[0].plot(xs, ys, marker=".", label=k)
+    axes[0].set_xlabel("step")
+    axes[0].set_ylabel("loss")
+    axes[0].legend()
+    axes[0].grid(True, alpha=0.3)
+    if acc_keys:
+        for k in sorted(acc_keys):
+            xs, ys = series[k]
+            axes[1].plot(xs, ys, marker=".", label=k)
+        axes[1].set_xlabel("step")
+        axes[1].set_ylabel("accuracy (%)")
+        axes[1].legend()
+        axes[1].grid(True, alpha=0.3)
+    if params:
+        fig.suptitle(
+            ", ".join(f"{k}={v}" for k, v in list(params.items())[:6]),
+            fontsize=9,
+        )
+    out = args.out or args.jsonl + ".png"
+    fig.tight_layout()
+    fig.savefig(out, dpi=120)
+    print(f"wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
