@@ -131,6 +131,11 @@ def main() -> int:
     p.add_argument("--checkpoint-every", type=int, default=50)
     p.add_argument("--resume", action="store_true",
                    help="resume from the latest checkpoint in --checkpoint-dir")
+    p.add_argument("--gen-temperature", type=float, default=0.0,
+                   help="sampling temperature for --generate (0 = greedy)")
+    p.add_argument("--gen-top-k", type=int, default=0,
+                   help="restrict --generate sampling to the k most likely "
+                   "tokens (0 = no restriction)")
     p.add_argument("--generate", type=int, default=0, metavar="N",
                    help="after training, greedy-decode N tokens from the "
                    "first sequences' prompts through the KV-cache path and "
@@ -519,7 +524,10 @@ def main() -> int:
             half = args.seq_len // 2
             prompt = ptoks[:2, : half + 1]
             out = tfm.generate(
-                host_params, prompt, cfg, max_new_tokens=args.generate
+                host_params, prompt, cfg, max_new_tokens=args.generate,
+                temperature=args.gen_temperature, top_k=args.gen_top_k,
+                key=(jax.random.key(args.seed + 2)
+                     if args.gen_temperature > 0 else None),
             )
             for i, row in enumerate(np.asarray(out)):
                 cut = half + 1
