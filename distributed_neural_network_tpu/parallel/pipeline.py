@@ -280,6 +280,7 @@ def make_pp_train_step(
     lr_schedule=None,
     clip_norm: float = 0.0,
     weight_decay: float = 0.0,
+    optimizer: str = "sgd",
 ):
     """Compiled pipeline-parallel (params, mom, tokens, targets) ->
     (params, mom, loss) over a (data, pipe, model) mesh.
@@ -294,7 +295,11 @@ def make_pp_train_step(
     compiled fn take (params, mom, tokens, targets, step); clip_norm
     clips by the sharding-aware global norm (layer leaves psum over
     'pipe' + any tp axis, embed/head replicated); weight_decay applies
-    decoupled decay after the momentum update.
+    decoupled decay after the momentum update (Adam applies it inside
+    the update). optimizer: 'sgd' (state mirrors the param layout) or
+    'adam' ({"m","v","t"} from ops/adam.init_adam - elementwise, so
+    pipe-sharded layer leaves keep their layout; ZeRO variants need
+    replicated params and stay mesh-path-only).
     """
     pp = mesh.shape.get(PIPE_AXIS, 1)
     v = interleave
@@ -310,6 +315,13 @@ def make_pp_train_step(
             f"the interleaved schedule runs microbatches in groups of the "
             f"pipeline size: n_microbatches ({n_microbatches}) must be a "
             f"multiple of {pp}"
+        )
+    if optimizer not in ("sgd", "adam"):
+        raise ValueError(
+            f"pipeline optimizer must be 'sgd' or 'adam', got {optimizer!r} "
+            "(ZeRO variants shard the flat param vector over the data axis, "
+            "which requires replicated params - incompatible with the "
+            "pipe-sharded layer stack; use the dp x sp x tp path)"
         )
     if cfg.n_experts:
         raise ValueError(
@@ -343,12 +355,23 @@ def make_pp_train_step(
                 axes=tuple(mesh.axis_names),
             )
         lr_t = lr if lr_schedule is None else lr_schedule(step_i)
-        params, mom = sgd_step(params, mom, grads, lr_t, momentum)
-        from ..ops.schedule import apply_decoupled_weight_decay
+        if optimizer == "adam":
+            from ..ops.adam import adam_step
 
-        params = apply_decoupled_weight_decay(params, lr_t, weight_decay)
+            params, mom = adam_step(
+                params, mom, grads, lr_t, b1=momentum,
+                weight_decay=weight_decay,
+            )
+        else:
+            params, mom = sgd_step(params, mom, grads, lr_t, momentum)
+            from ..ops.schedule import apply_decoupled_weight_decay
+
+            params = apply_decoupled_weight_decay(params, lr_t, weight_decay)
         return params, mom, loss
 
+    mom_spec = (
+        {"m": specs, "v": specs, "t": P()} if optimizer == "adam" else specs
+    )
     if lr_schedule is not None:
         fn, extra = step, (P(),)
     else:
@@ -357,8 +380,8 @@ def make_pp_train_step(
         jax.shard_map(
             fn,
             mesh=mesh,
-            in_specs=(specs, specs, data_spec, data_spec) + extra,
-            out_specs=(specs, specs, P()),
+            in_specs=(specs, mom_spec, data_spec, data_spec) + extra,
+            out_specs=(specs, mom_spec, P()),
         ),
         donate_argnums=(0, 1),
     )

@@ -201,10 +201,10 @@ def main() -> int:
     params = tfm.init_params(jax.random.key(args.seed), cfg)
     pipe = args.pp > 1
     if pipe:
-        if args.sp > 1 or args.experts or args.optimizer != "sgd":
+        if args.sp > 1 or args.experts or args.optimizer.startswith("zero"):
             raise SystemExit(
-                "--pp composes with --dp/--tp and --optimizer sgd; "
-                "--sp/--experts/adam/zero optimizers run on the "
+                "--pp composes with --dp/--tp and --optimizer sgd/adam; "
+                "--sp/--experts/zero optimizers run on the "
                 "dp x sp x tp mesh (drop --pp)"
             )
         if args.accum_steps > 1:
@@ -217,10 +217,23 @@ def main() -> int:
         params, specs = ppl.shard_pp_params(
             params, cfg, mesh, interleave=args.pp_interleave
         )
-        from distributed_neural_network_tpu.ops.sgd import init_momentum
+        from jax.sharding import PartitionSpec as _PS
 
-        mom = init_momentum(params)
-        mom_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
+        if args.optimizer == "adam":
+            from distributed_neural_network_tpu.ops.adam import init_adam
+
+            mom = init_adam(params)
+            mom_shardings = jax.tree.map(
+                lambda s: NamedSharding(mesh, s),
+                {"m": specs, "v": specs, "t": _PS()},
+            )
+        else:
+            from distributed_neural_network_tpu.ops.sgd import init_momentum
+
+            mom = init_momentum(params)
+            mom_shardings = jax.tree.map(
+                lambda s: NamedSharding(mesh, s), specs
+            )
         import functools
 
         from distributed_neural_network_tpu.ops import schedule as sched
@@ -237,7 +250,7 @@ def main() -> int:
             lr=args.lr, momentum=args.momentum,
             loss_chunks=args.loss_chunks, interleave=args.pp_interleave,
             lr_schedule=pp_lr_schedule, clip_norm=args.clip_norm,
-            weight_decay=args.weight_decay,
+            weight_decay=args.weight_decay, optimizer=args.optimizer,
         )
     else:
         mesh = lmtrain.create_lm_mesh(args.dp, args.sp, args.tp)
