@@ -103,7 +103,8 @@ def main() -> int:
     p.add_argument("--ema-decay", type=float, default=0.0,
                    help="track an exponential moving average of params "
                    "(e.g. 0.999) and use it for --eval-every/--generate; "
-                   "0 = off")
+                   "0 = off. Not checkpointed: resume restarts the average "
+                   "from the restored params")
     p.add_argument("--weight-decay", type=float, default=0.0,
                    help="decoupled (AdamW-style) weight decay; applied by "
                    "every optimizer on both the mesh and pipeline paths")
