@@ -230,3 +230,40 @@ def test_lm_train_checkpoint_resume(tmp_path):
     # resumed loss continues from the trained state, not from scratch
     assert second["first_loss"] < first["first_loss"] / 2
     assert second["final_loss"] <= second["first_loss"] + 1e-3
+
+
+@pytest.mark.slow
+def test_lm_train_pp_interleave_resume_guard(tmp_path):
+    """A pipeline checkpoint written at one --pp-interleave holds a
+    permuted layer layout; resuming at a different v must be rejected
+    with the clear meta-guard message, not an opaque restore error."""
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    base = [
+        sys.executable, os.path.join(REPO, "lm_train.py"),
+        "--pp", "4", "--n-layers", "8", "--microbatches", "4",
+        "--batch-size", "8", "--seq-len", "16",
+        "--d-model", "32", "--n-heads", "4", "--d-ff", "64",
+        "--vocab", "32", "--lr", "0.3",
+        "--checkpoint-dir", str(tmp_path / "ck"),
+    ]
+    proc = subprocess.run(
+        [*base, "--steps", "4", "--pp-interleave", "2"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    proc = subprocess.run(
+        [*base, "--steps", "2", "--resume", "--pp-interleave", "1"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=600,
+    )
+    assert proc.returncode != 0
+    assert "pp_interleave" in (proc.stderr + proc.stdout)
+    # matching layout resumes fine
+    proc = subprocess.run(
+        [*base, "--steps", "2", "--resume", "--pp-interleave", "2"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
