@@ -369,9 +369,9 @@ def make_pp_train_step(
             params = apply_decoupled_weight_decay(params, lr_t, weight_decay)
         return params, mom, loss
 
-    mom_spec = (
-        {"m": specs, "v": specs, "t": P()} if optimizer == "adam" else specs
-    )
+    from ..train.lm import optimizer_state_specs
+
+    mom_spec = optimizer_state_specs(optimizer, specs)
     if lr_schedule is not None:
         fn, extra = step, (P(),)
     else:

@@ -151,6 +151,14 @@ def main() -> int:
     if args.eval_every and not args.data_path:
         p.error("--eval-every requires --data-path (the held-out split "
                 "is the token stream's tail)")
+    if args.gen_top_k and args.gen_temperature <= 0:
+        p.error("--gen-top-k only applies when sampling; set "
+                "--gen-temperature > 0 (temperature 0 is greedy and "
+                "ignores top-k)")
+    if args.ema_decay and args.pp > 1:
+        p.error("--ema-decay is unused under --pp (the pipeline path has "
+                "no --eval-every/--generate consumer for the averaged "
+                "weights); drop it or use the dp x sp x tp mesh")
     if args.loss_chunks > 1 and (
         args.seq_len // max(args.sp, 1)
     ) % args.loss_chunks:
@@ -218,23 +226,18 @@ def main() -> int:
         params, specs = ppl.shard_pp_params(
             params, cfg, mesh, interleave=args.pp_interleave
         )
-        from jax.sharding import PartitionSpec as _PS
-
         if args.optimizer == "adam":
             from distributed_neural_network_tpu.ops.adam import init_adam
 
             mom = init_adam(params)
-            mom_shardings = jax.tree.map(
-                lambda s: NamedSharding(mesh, s),
-                {"m": specs, "v": specs, "t": _PS()},
-            )
         else:
             from distributed_neural_network_tpu.ops.sgd import init_momentum
 
             mom = init_momentum(params)
-            mom_shardings = jax.tree.map(
-                lambda s: NamedSharding(mesh, s), specs
-            )
+        mom_shardings = jax.tree.map(
+            lambda s: NamedSharding(mesh, s),
+            lmtrain.optimizer_state_specs(args.optimizer, specs),
+        )
         import functools
 
         from distributed_neural_network_tpu.ops import schedule as sched
