@@ -13,16 +13,19 @@ Sits alongside the mesh-level answers to long context (ring / Ulysses /
 zigzag sequence parallelism, `parallel/ring.py`): flash bounds the
 per-chip attention memory at O(S); the seq axis scales beyond it.
 
-Block-size tuning (round 2, v5e-1, bs16 x seq2048 x 8h x d64, bf16,
-chained-dispatch timing so nothing is elided): the kernel's DEFAULT blocks
-(block_q 512 / block_k_major 128 / ...) are the reason round 1 measured
-flash 2-5x slower than XLA - defaults give fwd 18.3 ms / fwd+bwd 26.8 ms
-vs XLA's 13.3 / 22.2 ms. With uniform 1024 blocks the same kernel runs
-fwd 8.4 ms / fwd+bwd 9.5 ms - 2.3x FASTER than XLA fused attention - and,
-unlike the XLA path, never materializes the (B, H, S, S) score matrix, so
-the LM can drop --remat (the S^2 buffers were what forced it) and skip
-the whole forward recompute. `_block_sizes` applies that tuning, clamped
-to the sequence length. Loss trajectories match the plain path exactly.
+Block-size tuning status: the round-2 sweep that picked uniform 1024
+blocks (and its "2.3x faster than XLA" result) was fenced only with
+`block_until_ready`, which is a NO-OP on this backend - those were
+dispatch-time artifacts and are RETRACTED (ROADMAP.md measurement-status
+note). The honest hard-fenced end-to-end numbers (round 3,
+BENCH_MATRIX.json) show flash at 1.25x the XLA+remat path (164.5k vs
+132.0k tok/s at d512/L8/seq2048/bf16), with the gap concentrated in the
+backward pass. The uniform blocks in `_block_sizes` are therefore a
+PROVISIONAL choice pending a hard-fenced re-tune
+(`tools/tune_flash.py`); what is solid is that flash never materializes
+the (B, H, S, S) score matrix, so the LM can drop --remat (the S^2
+buffers were what forced it). Loss trajectories match the plain path
+exactly.
 """
 
 from __future__ import annotations
@@ -49,11 +52,14 @@ def _flash_available() -> bool:
 
 @functools.cache
 def _block_sizes(s: int, head_dim: int = 64):
-    """Uniform tuned blocks for the flash kernel, or None for library defaults.
+    """Uniform provisional blocks for the flash kernel, or None for defaults.
 
-    The 1024-uniform tuning was measured at head_dim 64 on v5e among
-    {defaults, 256, 512, 1024, 2048}^2 combinations (512 wins fwd-only but
-    loses the round trip). The kernel's `_verify_block` requires every block
+    The 1024-uniform choice came from the retracted round-2 dispatch-time
+    sweep (see module docstring) and awaits hard-fenced re-validation via
+    `tools/tune_flash.py` - it is kept because the honest round-3
+    end-to-end row still beat XLA+remat with these blocks, but the
+    per-block numbers behind it bound nothing.
+    The kernel's `_verify_block` requires every block
     to divide the sequence length, so the tuned size is the largest
     power-of-two divisor of S in [128, 1024]; when none exists (S < 128 or
     S not 128-aligned, e.g. the CLI default seq 64) or head_dim != 64
