@@ -1,17 +1,26 @@
-"""Flash attention for local (single-device) long-context attention.
+"""Flash attention dispatch for local (per-device) long-context attention.
 
 The plain local kernel (`parallel/ring.py attention`) materializes the
 (B, H, S, S) score matrix, so single-chip long-context is HBM-bound: at
-seq 8192 it dominates step time (REPORT.md LM section). This wraps the
-Pallas TPU flash-attention kernel that ships with JAX
-(`jax.experimental.pallas.ops.tpu.flash_attention`) - the blockwise-softmax
-formulation where scores never leave VMEM - behind the framework's
-(B, S, H, D) layout convention, falling back to the plain kernel off-TPU
-(the Pallas op is Mosaic-only).
+seq 8192 it dominates step time (REPORT.md LM section). This module picks
+the flash implementation:
 
-Sits alongside the mesh-level answers to long context (ring / Ulysses /
-zigzag sequence parallelism, `parallel/ring.py`): flash bounds the
-per-chip attention memory at O(S); the seq axis scales beyond it.
+- **"own"** (default): this framework's Pallas kernels
+  (`ops/flash_pallas.py`) - vma-typed outputs, so they compose with
+  dp x tp shard_map under check_vma=True (the library kernel cannot), and
+  the backward block sizes are first-class tunables (the r3-diagnosed MFU
+  bottleneck).
+- **"lib"**: the Pallas kernel that ships with JAX
+  (`jax.experimental.pallas.ops.tpu.flash_attention`) - kept as the A/B
+  baseline for `tools/tune_flash.py` and as a fallback; single-device
+  only (no vma typing).
+- Off-TPU both fall back to the plain kernel (Pallas TPU kernels are
+  Mosaic-only; the interpreter is not shard_map-compatible).
+
+Select with `DNN_TPU_FLASH_IMPL=own|lib` or the `impl=` argument. Block
+sizes: `tools/tune_flash.py` writes `tools/flash_tune_<device>_s<seq>.json`;
+`tuned_blocks()` loads the matching file's best own-kernel blocks at call
+time (cached), else `FlashBlocks()` defaults.
 
 Block-size tuning status: the round-2 sweep that picked uniform 1024
 blocks (and its "2.3x faster than XLA" result) was fenced only with
@@ -20,27 +29,37 @@ dispatch-time artifacts and are RETRACTED (ROADMAP.md measurement-status
 note). The honest hard-fenced end-to-end numbers (round 3,
 BENCH_MATRIX.json) show flash at 1.25x the XLA+remat path (164.5k vs
 132.0k tok/s at d512/L8/seq2048/bf16), with the gap concentrated in the
-backward pass. The uniform blocks in `_block_sizes` are therefore a
-PROVISIONAL choice pending a hard-fenced re-tune
-(`tools/tune_flash.py`); what is solid is that flash never materializes
-the (B, H, S, S) score matrix, so the LM can drop --remat (the S^2
-buffers were what forced it). Loss trajectories match the plain path
-exactly.
+backward pass. What is solid is that flash never materializes the
+(B, H, S, S) score matrix, so the LM can drop --remat (the S^2 buffers
+were what forced it).
+
+Sits alongside the mesh-level answers to long context (ring / Ulysses /
+zigzag sequence parallelism, `parallel/ring.py`): flash bounds the
+per-chip attention memory at O(S); the seq axis scales beyond it.
 """
 
 from __future__ import annotations
 
 import functools
+import glob
+import json
 import math
+import os
 
 import jax
 
 from ..parallel.ring import attention
+from .flash_pallas import FlashBlocks, flash_mha
 
 
 @functools.cache
-def _flash_available() -> bool:
-    if jax.default_backend() != "tpu":
+def _on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+@functools.cache
+def _lib_available() -> bool:
+    if not _on_tpu():
         return False
     try:
         from jax.experimental.pallas.ops.tpu import flash_attention  # noqa: F401
@@ -51,20 +70,52 @@ def _flash_available() -> bool:
 
 
 @functools.cache
-def _block_sizes(s: int, head_dim: int = 64):
-    """Uniform provisional blocks for the flash kernel, or None for defaults.
+def tuned_blocks(s: int, head_dim: int) -> FlashBlocks:
+    """Best own-kernel blocks for (seq s, head_dim) from the tuner's JSON,
+    else defaults. A tune file applies only when it was measured on THIS
+    device kind at THIS head_dim (mismatched tunings were never measured -
+    the guard the retracted r2 sweep lacked), and its seq must equal s or
+    divide it (divisor-tuned blocks still tile s; `FlashBlocks.resolve`
+    keeps them legal). Exact-seq files win; among divisor files the
+    largest seq wins."""
+    try:
+        dev = jax.devices()[0].device_kind.replace(" ", "_")
+    except Exception:
+        return FlashBlocks()
+    pat = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))),
+        "tools", "flash_tune_*.json")
+    best, best_seq = None, -1
+    for path in glob.glob(pat):
+        try:
+            with open(path) as f:
+                data = json.load(f)
+            own = data.get("best_own")
+            shape = data.get("shape", {})
+            seq = shape.get("seq", 0)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if (not own or data.get("device") != dev
+                or shape.get("head_dim") != head_dim):
+            continue
+        if seq == s or (seq and s % seq == 0):
+            if best_seq != s and (seq == s or seq > best_seq):
+                best, best_seq = own, seq
+    if not best:
+        return FlashBlocks()
+    return FlashBlocks(**{k: int(v) for k, v in best.items()
+                          if k in FlashBlocks.__dataclass_fields__})
 
-    The 1024-uniform choice came from the retracted round-2 dispatch-time
-    sweep (see module docstring) and awaits hard-fenced re-validation via
-    `tools/tune_flash.py` - it is kept because the honest round-3
-    end-to-end row still beat XLA+remat with these blocks, but the
-    per-block numbers behind it bound nothing.
-    The kernel's `_verify_block` requires every block
-    to divide the sequence length, so the tuned size is the largest
-    power-of-two divisor of S in [128, 1024]; when none exists (S < 128 or
-    S not 128-aligned, e.g. the CLI default seq 64) or head_dim != 64
-    (where the tuning was never measured), return None and let the kernel
-    pick its own verified defaults instead of raising."""
+
+@functools.cache
+def _lib_block_sizes(s: int, head_dim: int = 64):
+    """Uniform provisional blocks for the LIBRARY kernel, or None for its
+    defaults (see module docstring: the 1024-uniform choice came from the
+    retracted round-2 sweep; kept because the honest round-3 end-to-end row
+    still beat XLA+remat with it). The kernel's `_verify_block` requires
+    every block to divide the sequence length, so the size is the largest
+    power-of-two divisor of S in [128, 1024]; None when none exists or
+    head_dim != 64 (never measured)."""
     if head_dim != 64:
         return None
     for b in (1024, 512, 256, 128):
@@ -82,12 +133,7 @@ def _block_sizes(s: int, head_dim: int = 64):
     )
 
 
-def flash_local_attention(q, k, v, *, causal: bool = True):
-    """q/k/v (B, S, H, D) -> (B, S, H, D); Pallas flash on TPU, plain
-    attention elsewhere. Numerics match `attention` to blockwise-softmax
-    reassociation tolerance."""
-    if not _flash_available():
-        return attention(q, k, v, causal=causal)
+def _lib_flash(q, k, v, *, causal: bool):
     from jax.experimental.pallas.ops.tpu.flash_attention import flash_attention
 
     d = q.shape[-1]
@@ -97,6 +143,30 @@ def flash_local_attention(q, k, v, *, causal: bool = True):
         v.transpose(0, 2, 1, 3),
         causal=causal,
         sm_scale=1.0 / math.sqrt(d),
-        block_sizes=_block_sizes(q.shape[1], d),
+        block_sizes=_lib_block_sizes(q.shape[1], d),
     )
     return out.transpose(0, 2, 1, 3)
+
+
+def flash_local_attention(q, k, v, *, causal: bool = True,
+                          impl: str | None = None):
+    """q/k/v (B, S, H, D) -> (B, S, H, D); Pallas flash on TPU, plain
+    attention elsewhere. Numerics match `attention` to blockwise-softmax
+    reassociation tolerance. `impl`: "own" (default; shard_map-composable)
+    or "lib" (library kernel, A/B baseline), overridable via
+    DNN_TPU_FLASH_IMPL."""
+    if not _on_tpu():
+        return attention(q, k, v, causal=causal)
+    impl = impl or os.environ.get("DNN_TPU_FLASH_IMPL", "own")
+    if impl == "lib":
+        if not _lib_available():
+            raise RuntimeError(
+                "flash impl 'lib' requested (DNN_TPU_FLASH_IMPL?) but the "
+                "library kernel failed to import on this backend; unset "
+                "it to use the own kernel"
+            )
+        return _lib_flash(q, k, v, causal=causal)
+    if impl != "own":
+        raise ValueError(f"unknown flash impl {impl!r} (use 'own' or 'lib')")
+    return flash_mha(q, k, v, causal=causal,
+                     blocks=tuned_blocks(q.shape[1], q.shape[-1]))

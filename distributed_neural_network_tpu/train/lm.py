@@ -19,6 +19,8 @@ elementwise so it is layout-oblivious.
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -27,6 +29,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..models import transformer as tfm
 from ..ops.sgd import init_momentum, sgd_step
 from ..parallel import zero
+from ..parallel.collectives import vary_like
 
 DATA_AXIS = "data"
 SEQ_AXIS = "seq"
@@ -101,7 +104,7 @@ def _ce_sum_chunked(x, head, targets, n_chunks: int, axes=()):
 
     # under shard_map the per-chunk CE is device-varying; the scan carry's
     # initial value must carry the same vma type
-    init = jax.lax.pvary(jnp.float32(0.0), tuple(axes))
+    init = vary_like(jnp.float32(0.0), extra=tuple(axes))
     total, _ = jax.lax.scan(body, init, (xs, ts))
     return total
 
@@ -358,21 +361,33 @@ def make_lm_train_step(
             params = apply_decoupled_weight_decay(params, lr_t, weight_decay)
         return params, mom, loss
 
-    # The library Pallas flash kernel's outputs carry no vma type, which the
-    # shard_map checker rejects - and disabling the check changes gradient
-    # semantics on non-trivial meshes (verified: wrong grads). So flash is
-    # single-device only; on an all-ones mesh check_vma=False is vacuous
-    # (no cross-device gradients exist).
+    # attn='flash' composes with dp x tp meshes since round 4: the own
+    # Pallas kernels (ops/flash_pallas.py) stamp vma-typed outputs, so the
+    # shard_map checker accepts them and autodiff inserts the right psums
+    # (attention is purely local when only batch/head axes are sharded).
+    # A sequence axis still needs ring/ulysses/zigzag - flash is the
+    # per-device kernel. The LIBRARY kernel (DNN_TPU_FLASH_IMPL=lib) is
+    # not vma-typed and stays single-device-only.
     check_vma = True
     if attn_impl == "flash":
-        if any(mesh.shape[a] > 1 for a in mesh.axis_names):
+        if sp is not None:
             raise ValueError(
-                "attn_impl 'flash' supports single-device execution only "
-                "(the Pallas kernel is not shard_map-typed); use "
-                "'ring'/'ulysses'/'zigzag' for multi-chip sequence "
-                "parallelism or 'full' for plain sharded attention"
+                "attn_impl 'flash' is the local (per-device) kernel; with "
+                "a sequence axis use 'ring'/'ulysses'/'zigzag' (flash "
+                "composes with dp/tp meshes, not sp)"
             )
-        check_vma = False
+        if os.environ.get("DNN_TPU_FLASH_IMPL") == "lib":
+            if any(mesh.shape[a] > 1 for a in mesh.axis_names):
+                raise ValueError(
+                    "DNN_TPU_FLASH_IMPL=lib selects the library flash "
+                    "kernel, which carries no vma typing and cannot run "
+                    "on a non-trivial mesh; unset it (own kernel) or use "
+                    "a single-device mesh"
+                )
+            # jax 0.9 rejects ANY untyped pallas_call output under
+            # check_vma=True, even on an all-ones mesh - where disabling
+            # the check is vacuous (no cross-device gradients exist)
+            check_vma = False
 
     has_step = lr_schedule is not None
     if optimizer.startswith("zero"):

@@ -173,10 +173,11 @@ def main() -> int:
             f"--attn zigzag needs --seq-len divisible by 2*sp "
             f"({2 * args.sp}); got {args.seq_len}"
         )
-    if args.attn == "flash" and (args.dp > 1 or args.sp > 1 or args.tp > 1):
+    if args.attn == "flash" and args.sp > 1:
         p.error(
-            "--attn flash is single-device only (Pallas kernel is not "
-            "shard_map-typed); use ring/ulysses/zigzag for multi-chip"
+            "--attn flash is the local (per-device) kernel and composes "
+            "with --dp/--tp (own vma-typed Pallas kernels, round 4); a "
+            "sequence axis needs --attn ring/ulysses/zigzag"
         )
 
     from distributed_neural_network_tpu.train.cli import honor_platform_env

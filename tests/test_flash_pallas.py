@@ -1,0 +1,96 @@
+"""Own flash-attention kernels (ops/flash_pallas.py): fwd + grad parity.
+
+Interpret-mode execution on CPU (the Mosaic-compiled path is exercised on
+TPU via lm_train / the bench matrix). Correctness bar: forward matches the
+plain attention reference and every input gradient matches `jax.grad` of
+the reference through an arbitrary scalar loss, causal and non-causal,
+f32 and bf16, at block sizes that tile the sequence both evenly and with
+the diagonal crossing block boundaries (bq != bk).
+
+The reference model (`/root/reference/models/model.py`) has no attention;
+this pins the beyond-reference long-context family instead (SURVEY.md
+section 5.7).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_neural_network_tpu.ops.flash_pallas import (
+    FlashBlocks,
+    flash_mha,
+)
+from distributed_neural_network_tpu.parallel.ring import attention
+
+
+def _qkv(b=2, s=256, h=2, d=64, dtype=jnp.float32, seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(rng.normal(size=(b, s, h, d)) * 0.3, dtype)
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("blocks", [
+    FlashBlocks(128, 128, 128, 128, 128, 128),
+    FlashBlocks(128, 64, 64, 128, 128, 64),   # diagonal crosses blocks
+])
+def test_forward_matches_reference(n_devices, causal, blocks):
+    q, k, v = _qkv()
+    out = flash_mha(q, k, v, causal=causal, blocks=blocks, interpret=True)
+    ref = attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_grads_match_reference(n_devices, causal):
+    q, k, v = _qkv(s=128)
+    blocks = FlashBlocks(64, 64, 64, 64, 64, 64)
+    # arbitrary non-uniform scalar loss so every element's cotangent differs
+    w = jnp.asarray(
+        np.random.default_rng(1).normal(size=q.shape), jnp.float32
+    )
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_mha(q, k, v, causal=causal, blocks=blocks, interpret=True)
+            * w
+        )
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention(q, k, v, causal=causal) * w)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4,
+            err_msg=f"d{name} mismatch",
+        )
+
+
+def test_bf16_forward_close(n_devices):
+    q, k, v = _qkv(dtype=jnp.bfloat16)
+    out = flash_mha(q, k, v, causal=True,
+                    blocks=FlashBlocks(128, 128, 128, 128, 128, 128),
+                    interpret=True)
+    ref = attention(q.astype(jnp.float32), k.astype(jnp.float32),
+                    v.astype(jnp.float32), causal=True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, dtype=np.float32), np.asarray(ref),
+        rtol=2e-2, atol=2e-2,
+    )
+
+
+def test_block_resolution_clamps_to_divisors(n_devices):
+    # S=96: no 128-multiple divides it -> falls back to plain divisors
+    assert FlashBlocks(512, 512, 512, 512, 512, 512).resolve(96).bq == 96
+    assert FlashBlocks(64, 64, 64, 64, 64, 64).resolve(96).bq == 48
+    # S=2048 keeps the requested lane-friendly sizes
+    r = FlashBlocks().resolve(2048)
+    assert (r.bq, r.bk) == (512, 512)
+    r = FlashBlocks(384, 384, 384, 384, 384, 384).resolve(2048)
+    assert r.bq == 256  # largest 128-multiple divisor <= 384

@@ -110,13 +110,13 @@ def test_engine_trains_with_pallas_kernels(n_devices):
 
 
 class TestFlashBlockSizes:
-    """ops/flash.py _block_sizes: tuned blocks must satisfy the kernel's
+    """ops/flash.py _lib_block_sizes: library-kernel blocks must satisfy the kernel's
     divisibility constraints (ADVICE r2: S<128 gave block>S; S=1536 failed
     the backward divisibility check), falling back to library defaults
     (None) when no aligned divisor exists or the tuning doesn't apply."""
 
     def test_tuned_sizes_divide_sequence(self):
-        from distributed_neural_network_tpu.ops.flash import _block_sizes
+        from distributed_neural_network_tpu.ops.flash import _lib_block_sizes as _block_sizes
 
         for s, want in [(2048, 1024), (1024, 1024), (1536, 512),
                         (2560, 512), (512, 512), (384, 128), (128, 128),
@@ -131,13 +131,13 @@ class TestFlashBlockSizes:
                 assert s % b == 0 and b <= s, (s, b)
 
     def test_small_or_unaligned_seq_falls_back_to_defaults(self):
-        from distributed_neural_network_tpu.ops.flash import _block_sizes
+        from distributed_neural_network_tpu.ops.flash import _lib_block_sizes as _block_sizes
 
         for s in (64, 96, 100, 127, 192, 1000):
             assert _block_sizes(s, 64) is None, s
 
     def test_untuned_head_dim_falls_back_to_defaults(self):
-        from distributed_neural_network_tpu.ops.flash import _block_sizes
+        from distributed_neural_network_tpu.ops.flash import _lib_block_sizes as _block_sizes
 
         assert _block_sizes(2048, 128) is None
         assert _block_sizes(2048, 96) is None

@@ -1,16 +1,22 @@
 #!/usr/bin/env python
-"""Re-tune the Pallas flash-attention block sizes with honest fencing.
+"""Tune flash-attention block sizes with honest fencing (hard_block).
 
 Round-2 note: the original tuning (uniform 1024 blocks, "2.3x faster than
 XLA") was measured with `jax.block_until_ready` as the fence - which is a
-no-op on the axon tunnel backend, so those numbers were dispatch time.
-This tool measures with `hard_block` (value-fetch fence) and reports
-fwd-only and fwd+bwd times per block-size variant, plus the XLA fused
-attention as the baseline, then prints the winner in the `_block_sizes`
-format (ops/flash.py).
+no-op on the axon tunnel backend, so those numbers were dispatch time and
+are retracted. Everything here fences with `hard_block` (value fetch).
+
+Round 4: the framework's OWN kernels (ops/flash_pallas.py) are the
+default flash path, with independently tunable forward and backward
+blocks - the r3 MFU diagnosis put the gap in the backward pass (fwd ~45%
+MXU efficiency, bwd ~25%), so the sweep is staged: forward blocks first
+(fwd-only timing), then a (dq x dkv) grid at the best forward blocks
+(fwd+bwd timing). The library kernel and XLA fused attention run as
+baselines. Writes tools/flash_tune_<device>_s<seq>.json with `best_own`
+in exactly the FlashBlocks-field format `ops/flash.py tuned_blocks()`
+loads at run time.
 
 Usage (on real TPU):  python tools/tune_flash.py [--seq 2048] [--batch 16]
-Writes tools/flash_tune_<device>.json and prints one JSON line per variant.
 """
 
 from __future__ import annotations
@@ -33,42 +39,35 @@ def main() -> int:
     ap.add_argument("--seq", type=int, default=2048)
     ap.add_argument("--head-dim", type=int, default=64)
     ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--skip-lib", action="store_true",
+                    help="skip the library-kernel baseline rows")
     args = ap.parse_args()
 
     import jax
     import jax.numpy as jnp
 
+    from distributed_neural_network_tpu.ops.flash_pallas import (
+        FlashBlocks,
+        flash_mha,
+    )
     from distributed_neural_network_tpu.utils.timers import hard_block
 
     if jax.default_backend() != "tpu":
         print(json.dumps({"error": "flash tuning needs a TPU backend"}))
         return 1
 
-    from jax.experimental.pallas.ops.tpu.flash_attention import (
-        BlockSizes,
-        flash_attention,
-    )
-
     B, H, S, D = args.batch, args.heads, args.seq, args.head_dim
-    q = jax.random.normal(jax.random.key(0), (B, H, S, D), jnp.bfloat16)
-    k = jax.random.normal(jax.random.key(1), (B, H, S, D), jnp.bfloat16)
-    v = jax.random.normal(jax.random.key(2), (B, H, S, D), jnp.bfloat16)
-
-    def uniform(b):
-        b = min(b, S)
-        return BlockSizes(
-            block_q=b, block_k_major=b, block_k=b, block_b=1,
-            block_q_major_dkv=b, block_k_major_dkv=b,
-            block_q_dkv=b, block_k_dkv=b,
-            block_q_dq=b, block_k_dq=b, block_k_major_dq=b,
-        )
+    # (B, S, H, D) - the framework's layout (own kernel transposes inside)
+    q = jax.random.normal(jax.random.key(0), (B, S, H, D), jnp.bfloat16)
+    k = jax.random.normal(jax.random.key(1), (B, S, H, D), jnp.bfloat16)
+    v = jax.random.normal(jax.random.key(2), (B, S, H, D), jnp.bfloat16)
 
     def xla_attn(q, k, v):
-        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(D)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(D)
         mask = jnp.tril(jnp.ones((S, S), bool))
         s = jnp.where(mask, s.astype(jnp.float32), -jnp.inf)
         p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
-        return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
     def fwdbwd(attn):
         def f(q, k, v):
@@ -99,17 +98,67 @@ def main() -> int:
         results.append(row)
         return row
 
-    variants = {"lib-defaults": None}
-    for b in (256, 512, 1024):
-        if S % b == 0 or b >= S:
-            variants[f"uniform{b}"] = uniform(b)
+    def own(blocks):
+        return functools.partial(flash_mha, causal=True, blocks=blocks)
 
-    for name, bs in variants.items():
-        fa = functools.partial(
-            _flash, flash_attention, bs, 1.0 / math.sqrt(D)
+    cand = [b for b in (256, 512, 1024) if S % b == 0] or [S]
+
+    # stage 1: forward blocks (fwd-only timing)
+    fwd_rows = {}
+    for b in cand:
+        blocks = FlashBlocks(bq=b, bk=b)
+        fwd_rows[b] = timeit(f"own_fwd_q{b}k{b}", own(blocks))
+    ok_fwd = {b: r["ms"] for b, r in fwd_rows.items() if "ms" in r}
+    best_fwd = min(ok_fwd, key=ok_fwd.get) if ok_fwd else cand[0]
+
+    # stage 2: backward blocks at the best forward blocks (fwd+bwd timing)
+    best_own, best_own_ms = None, float("inf")
+    for bdq in cand:
+        for bdkv in cand:
+            blocks = FlashBlocks(
+                bq=best_fwd, bk=best_fwd,
+                bq_dq=bdq, bk_dq=bdq,
+                bq_dkv=bdkv, bk_dkv=bdkv,
+            )
+            r = timeit(f"own_fb_q{best_fwd}_dq{bdq}_dkv{bdkv}",
+                       fwdbwd(own(blocks)))
+            if "ms" in r and r["ms"] < best_own_ms:
+                best_own_ms = r["ms"]
+                best_own = blocks
+
+    # baselines: library kernel (its best uniform blocks) + XLA fused
+    if not args.skip_lib:
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            BlockSizes,
+            flash_attention,
         )
-        timeit(f"flash_fwd_{name}", fa)
-        timeit(f"flash_fb_{name}", fwdbwd(fa))
+
+        def uniform(b):
+            b = min(b, S)
+            return BlockSizes(
+                block_q=b, block_k_major=b, block_k=b, block_b=1,
+                block_q_major_dkv=b, block_k_major_dkv=b,
+                block_q_dkv=b, block_k_dkv=b,
+                block_q_dq=b, block_k_dq=b, block_k_major_dq=b,
+            )
+
+        def lib(bs):
+            def f(q, k, v):
+                out = flash_attention(
+                    q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                    v.transpose(0, 2, 1, 3), causal=True,
+                    sm_scale=1.0 / math.sqrt(D), block_sizes=bs,
+                )
+                return out.transpose(0, 2, 1, 3)
+
+            return f
+
+        variants = {"defaults": None}
+        for b in cand:
+            variants[f"uniform{b}"] = uniform(b)
+        for name, bs in variants.items():
+            timeit(f"lib_fwd_{name}", lib(bs))
+            timeit(f"lib_fb_{name}", fwdbwd(lib(bs)))
     timeit("xla_fwd", xla_attn)
     timeit("xla_fb", fwdbwd(xla_attn))
 
@@ -118,22 +167,29 @@ def main() -> int:
         os.path.dirname(os.path.abspath(__file__)),
         f"flash_tune_{dev}_s{S}.json",
     )
-    fb = [r for r in results if r["cfg"].startswith("flash_fb_") and "ms" in r]
-    best = min(fb, key=lambda r: r["ms"]) if fb else None
+    lib_fb = [r for r in results
+              if r["cfg"].startswith("lib_fb_") and "ms" in r]
+    payload = {
+        "shape": {"batch": B, "heads": H, "seq": S, "head_dim": D},
+        "device": dev,
+        "rows": results,
+        "best_own": (
+            {f: getattr(best_own, f) for f in
+             ("bq", "bk", "bq_dq", "bk_dq", "bq_dkv", "bk_dkv")}
+            if best_own else None
+        ),
+        "best_own_ms": None if best_own is None else best_own_ms,
+        "best_lib_fwdbwd": (
+            min(lib_fb, key=lambda r: r["ms"]) if lib_fb else None
+        ),
+    }
     with open(out_path, "w") as f:
-        json.dump(
-            {"shape": {"batch": B, "heads": H, "seq": S, "head_dim": D},
-             "device": dev, "rows": results, "best_fwdbwd": best},
-            f, indent=1,
-        )
-    print(json.dumps({"wrote": out_path, "best_fwdbwd": best}), flush=True)
+        json.dump(payload, f, indent=1)
+    print(json.dumps({"wrote": out_path, "best_own": payload["best_own"],
+                      "best_own_ms": payload["best_own_ms"],
+                      "best_lib_fwdbwd": payload["best_lib_fwdbwd"]}),
+          flush=True)
     return 0
-
-
-def _flash(flash_attention, bs, scale, q, k, v):
-    return flash_attention(
-        q, k, v, causal=True, sm_scale=scale, block_sizes=bs
-    )
 
 
 if __name__ == "__main__":
