@@ -47,6 +47,19 @@ Design (per the Pallas TPU guide):
   delta = rowsum(do * o) is precomputed in XLA (one fused elementwise
   pass) and streamed in. Each kernel re-forms p from q/k/lse, so the
   (S, S) score matrix never exists anywhere in fwd or bwd.
+- Per-row residuals (lse, delta) cross the pallas_call boundary
+  lane-replicated to (..., 128): Mosaic requires the last two dims of
+  every block to be (8, 128)-tileable or full, so a (bq,) row vector is
+  not a legal block - it lives as a (bq, 128) broadcast tile (the
+  library kernel's MIN_BLOCK_SIZE layout) and kernels read [:, :1].
+  Between fwd and bwd only the slim (bh, s) lse is saved; _bwd_call
+  re-broadcasts once in XLA. Known cost: the dkv kernel holds both
+  residuals full-length in VMEM (2 * S * 128 * 4 bytes - 2 MB at
+  S=2048, 8 MB at S=8192), which bounds the practical single-device
+  backward at S ~= 6k; past that use sequence parallelism
+  (parallel/ring.py), or see the planned 3-D-grid bwd restructure
+  (grid over q-blocks instead of an in-kernel fori_loop) that blocks
+  the residuals per grid step.
 
 Reference parity: behaves as `parallel/ring.py attention(q, k, v,
 causal=...)` up to blockwise-softmax reassociation; `tests/test_flash_pallas.py`
@@ -69,6 +82,7 @@ from jax.experimental.pallas import tpu as pltpu
 from ..parallel.collectives import vma_union
 
 _NEG_BIG = -1e30  # large-negative mask; avoids -inf NaN propagation
+_LANES = 128  # TPU lane width: per-row residuals are lane-replicated
 
 # (m,k)x(n,k)->(m,n), (m,k)x(k,n)->(m,n), (k,m)x(k,n)->(m,n)
 _NT = (((1,), (1,)), ((), ()))
@@ -157,7 +171,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, n_k,
     m, l, acc = jax.lax.fori_loop(0, n_iter, body, (m0, l0, acc0))
     l = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l))[:, 0]
+    # lane-replicated (bq, 128) write: Mosaic requires the last two block
+    # dims to be (8, 128)-tileable, so per-row residuals live broadcast
+    # across the lane axis (the library kernel's MIN_BLOCK_SIZE layout);
+    # the caller slices lane 0 back off
+    lse_ref[0] = jnp.broadcast_to(m + jnp.log(l), (bq, _LANES))
 
 
 def _fwd_call(q, k, v, *, blocks, scale, causal, interpret):
@@ -180,16 +198,18 @@ def _fwd_call(q, k, v, *, blocks, scale, causal, interpret):
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq), lambda b, i: (b, i),
+            pl.BlockSpec((1, bq, _LANES), lambda b, i: (b, i, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
             _struct((bh, s, d), q.dtype, q, k, v),
-            _struct((bh, s), jnp.float32, q, k, v),
+            _struct((bh, s, _LANES), jnp.float32, q, k, v),
         ],
         interpret=interpret,
     )(q, k, v)
-    return o, lse
+    # keep only lane 0 as the residual: between fwd and bwd the saved lse
+    # is (bh, s), not 128x that (the broadcast back happens in _bwd_call)
+    return o, lse[..., 0]
 
 
 # --------------------------------------------------------------- backward
@@ -200,8 +220,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dq_ref, *,
     qi = pl.program_id(1)
     q = q_ref[0]
     do = do_ref[0]
-    lse = lse_ref[0][:, None]  # (bq, 1) f32
-    dlt = dlt_ref[0][:, None]
+    lse = lse_ref[0][:, :1]  # (bq, 1) f32 from the lane-replicated block
+    dlt = dlt_ref[0][:, :1]
 
     def body(kj, dq_acc):
         k_blk = k_ref[0, pl.ds(kj * bk, bk), :]
@@ -230,8 +250,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref,
         dk_acc, dv_acc = carry
         q_blk = q_ref[0, pl.ds(qi * bq, bq), :]
         do_blk = do_ref[0, pl.ds(qi * bq, bq), :]
-        lse_q = lse_ref[0, pl.ds(qi * bq, bq)][:, None]
-        dlt_q = dlt_ref[0, pl.ds(qi * bq, bq)][:, None]
+        lse_q = lse_ref[0, pl.ds(qi * bq, bq), :][:, :1]
+        dlt_q = dlt_ref[0, pl.ds(qi * bq, bq), :][:, :1]
         s = _dot(q_blk, k, _NT) * scale  # (bq, bk)
         if causal:
             s = _causal_mask(s, qi, bq, kj, bk)
@@ -255,12 +275,15 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref,
 def _bwd_call(q, k, v, o, lse, do, *, blocks, scale, causal, interpret):
     bh, s, d = q.shape
     # delta = rowsum(do * o): one fused XLA elementwise+reduce, streamed
-    # into both kernels (recomputing it per block would re-read o)
+    # into both kernels (recomputing it per block would re-read o).
+    # Both per-row residuals enter the kernels lane-replicated to
+    # (bh, s, 128) - the Mosaic-tileable layout (see _fwd_kernel's note);
+    # XLA materializes each broadcast once and both kernels read it.
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta_l = jnp.broadcast_to(delta[..., None], (bh, s, _LANES))
+    lse_l = jnp.broadcast_to(lse[..., None], (bh, s, _LANES))
 
-    full = lambda last: pl.BlockSpec((1, s, last) if last else (1, s),
-                                     (lambda b, i: (b, 0, 0) if last
-                                      else (b, 0)),
+    full = lambda last: pl.BlockSpec((1, s, last), lambda b, i: (b, 0, 0),
                                      memory_space=pltpu.VMEM)
     bq, bk = blocks.bq_dq, blocks.bk_dq
     dq = pl.pallas_call(
@@ -273,16 +296,16 @@ def _bwd_call(q, k, v, o, lse, do, *, blocks, scale, causal, interpret):
             full(d), full(d),
             pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq), lambda b, i: (b, i),
+            pl.BlockSpec((1, bq, _LANES), lambda b, i: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq), lambda b, i: (b, i),
+            pl.BlockSpec((1, bq, _LANES), lambda b, i: (b, i, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0),
                                memory_space=pltpu.VMEM),
         out_shape=_struct((bh, s, d), q.dtype, q, k, v, o, do),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse_l, delta_l)
 
     bq, bk = blocks.bq_dkv, blocks.bk_dkv
     dk, dv = pl.pallas_call(
@@ -295,7 +318,7 @@ def _bwd_call(q, k, v, o, lse, do, *, blocks, scale, causal, interpret):
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0),
                          memory_space=pltpu.VMEM),
-            full(d), full(None), full(None),
+            full(d), full(_LANES), full(_LANES),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0),
@@ -308,7 +331,7 @@ def _bwd_call(q, k, v, o, lse, do, *, blocks, scale, causal, interpret):
             _struct((bh, s, d), v.dtype, q, k, v, o, do),
         ],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse_l, delta_l)
     return dq, dk, dv
 
 
