@@ -264,6 +264,78 @@ def pipeline_lm_loss(
     return total / jnp.float32(n_tokens)
 
 
+def pp_optimizer_state_specs(optimizer: str, specs):
+    """PartitionSpec tree for the optimizer state on the pipeline mesh.
+
+    sgd/adam mirror the param layout (elementwise state follows its leaf).
+    The ZeRO-1 variants hold per-leaf FLAT buffers of the *stage-local*
+    leaf, sharded over the data axis (the DeepSpeed ZeRO-1 + PP layout:
+    optimizer state partitions across data-parallel ranks only, never
+    across stages). A pipe-sharded layer leaf's buffer therefore carries
+    both splits - stage content over 'pipe', ZeRO shard over 'data' -
+    as one flat P(('pipe','data')) axis (stage-major); pipe-replicated
+    leaves (embed/head/final-norm) shard P('data') exactly like the
+    dp x sp x tp mesh path (train/lm.py optimizer_state_specs).
+    """
+    if optimizer == "sgd":
+        return specs
+    if optimizer == "adam":
+        return {"m": specs, "v": specs, "t": P()}
+
+    def leaf_spec(spec: P) -> P:
+        if PIPE_AXIS in spec:
+            return P((PIPE_AXIS, DATA_AXIS))
+        return P(DATA_AXIS)
+
+    if optimizer == "zero":
+        return jax.tree.map(leaf_spec, specs)
+    if optimizer == "zero-adam":
+        shard = jax.tree.map(leaf_spec, specs)
+        return {"m": shard, "v": shard, "t": P()}
+    raise ValueError(f"unknown pipeline optimizer {optimizer!r}")
+
+
+def init_pp_zero_state(params, specs, mesh: Mesh, optimizer: str):
+    """ZeRO-1 optimizer state for the pipeline mesh (see
+    `pp_optimizer_state_specs` for the layout).
+
+    params: the (already pipe-sharded) global param tree; specs: its
+    PartitionSpec tree from `shard_pp_params`. Each state leaf is a flat
+    zeros buffer sized so every (pipe, data) device holds the padded
+    1/dp shard of its *stage-local* leaf: pipe-sharded leaves get
+    (pp * dp * S,) with S = ceil((size/pp)/dp) padded; replicated leaves
+    (dp * S,). Zeros make content trivially layout-independent, so
+    `device_put` against the spec is the whole init.
+    """
+    from .zero import leaf_shard_size
+
+    dp = mesh.shape.get(DATA_AXIS, 1)
+    pp = mesh.shape.get(PIPE_AXIS, 1)
+    state_specs = pp_optimizer_state_specs(optimizer, specs)
+
+    def buf(p, spec: P):
+        if PIPE_AXIS in spec:
+            local = p.size // pp
+            return jnp.zeros((pp * dp * leaf_shard_size(local, dp),),
+                             jnp.float32)
+        return jnp.zeros((dp * leaf_shard_size(p.size, dp),), jnp.float32)
+
+    if optimizer == "zero":
+        state = jax.tree.map(buf, params, specs)
+    elif optimizer == "zero-adam":
+        state = {
+            "m": jax.tree.map(buf, params, specs),
+            "v": jax.tree.map(buf, params, specs),
+            "t": jnp.zeros((), jnp.int32),
+        }
+    else:
+        raise ValueError(f"not a ZeRO optimizer: {optimizer!r}")
+    return jax.tree.map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+        state, state_specs,
+    )
+
+
 def make_pp_train_step(
     cfg: tfm.TransformerConfig,
     mesh: Mesh,
@@ -292,10 +364,12 @@ def make_pp_train_step(
     clips by the sharding-aware global norm (layer leaves psum over
     'pipe' + any tp axis, embed/head replicated); weight_decay applies
     decoupled decay after the momentum update (Adam applies it inside
-    the update). optimizer: 'sgd' (state mirrors the param layout) or
+    the update). optimizer: 'sgd' (state mirrors the param layout),
     'adam' ({"m","v","t"} from ops/adam.init_adam - elementwise, so
-    pipe-sharded layer leaves keep their layout; ZeRO variants need
-    replicated params and stay mesh-path-only).
+    pipe-sharded layer leaves keep their layout), or 'zero'/'zero-adam'
+    (ZeRO-1: per-leaf flat state sharded over the data axis per
+    stage-local leaf - init with `init_pp_zero_state`, specs from
+    `pp_optimizer_state_specs`; not with tp).
     """
     pp = mesh.shape.get(PIPE_AXIS, 1)
     v = interleave
@@ -312,12 +386,18 @@ def make_pp_train_step(
             f"pipeline size: n_microbatches ({n_microbatches}) must be a "
             f"multiple of {pp}"
         )
-    if optimizer not in ("sgd", "adam"):
+    if optimizer not in ("sgd", "adam", "zero", "zero-adam"):
         raise ValueError(
-            f"pipeline optimizer must be 'sgd' or 'adam', got {optimizer!r} "
-            "(ZeRO variants shard the flat param vector over the data axis, "
-            "which requires replicated params - incompatible with the "
-            "pipe-sharded layer stack; use the dp x sp x tp path)"
+            f"pipeline optimizer must be one of sgd/adam/zero/zero-adam, "
+            f"got {optimizer!r}"
+        )
+    if optimizer.startswith("zero") and mesh.shape.get(TP_AXIS, 1) > 1:
+        raise ValueError(
+            f"optimizer={optimizer!r} under --pp shards optimizer state "
+            "over the data axis per stage-local leaf; tensor-sharded "
+            "leaves (tp > 1) additionally vary over 'model', which the "
+            "flat per-leaf layout does not track - use 'sgd'/'adam' with "
+            "tp (matches the dp x sp x tp mesh path's rule)"
         )
     if cfg.n_experts:
         raise ValueError(
@@ -365,10 +445,47 @@ def make_pp_train_step(
             params = apply_decoupled_weight_decay(params, lr_t, weight_decay)
         return params, mom, loss
 
-    from ..train.lm import optimizer_state_specs
+    mom_spec = pp_optimizer_state_specs(optimizer, specs)
+    has_step = lr_schedule is not None
 
-    mom_spec = optimizer_state_specs(optimizer, specs)
-    if lr_schedule is not None:
+    if optimizer.startswith("zero"):
+        # Shared two-shard_map ZeRO-1 orchestration (zero.py
+        # make_zero_split_step - same protocol as train/lm.py's zero
+        # path). parallel/zero.py's per-leaf machinery needs no pipe
+        # awareness: each device updates the 1/dp shard of whatever
+        # leaf it holds - the full embed/head, or its own stage's
+        # (L/P, ...) chunk (the DeepSpeed ZeRO-1 + PP layout). The
+        # clip closure is this path's specs-aware norm: layer-leaf
+        # sq-norms psum over 'pipe' (each stage holds its own chunk),
+        # embed/head are replicated.
+        from .zero import make_zero_split_step
+
+        def fwd_bwd(params, tokens, targets):
+            return jax.value_and_grad(pipeline_lm_loss)(
+                params, tokens, targets, cfg,
+                pipe_axis=PIPE_AXIS, n_microbatches=n_microbatches,
+                tp_axis=tp, sync_axes=sync, loss_chunks=loss_chunks,
+                interleave=v,
+            )
+
+        clip_fn = None
+        if clip_norm > 0.0:
+            from ..ops.schedule import clip_by_global_norm
+
+            def clip_fn(grads):
+                return clip_by_global_norm(
+                    grads, clip_norm, specs=specs,
+                    axes=tuple(mesh.axis_names),
+                )[0]
+
+        return make_zero_split_step(
+            mesh=mesh, fwd_bwd=fwd_bwd, specs=specs, mom_spec=mom_spec,
+            data_spec=data_spec, optimizer=optimizer, lr=lr,
+            momentum=momentum, weight_decay=weight_decay,
+            lr_schedule=lr_schedule, clip_fn=clip_fn, axis_name=DATA_AXIS,
+        )
+
+    if has_step:
         fn, extra = step, (P(),)
     else:
         fn, extra = (lambda p, m, a, b: step(p, m, a, b)), ()

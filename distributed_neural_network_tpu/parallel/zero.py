@@ -220,6 +220,95 @@ def zero_adam_step_sharded(
     return new_p, {"m": new_m, "v": new_v, "t": t}
 
 
+def make_zero_split_step(
+    *,
+    mesh,
+    fwd_bwd,
+    specs,
+    mom_spec,
+    data_spec,
+    optimizer: str,
+    lr: float,
+    momentum: float,
+    weight_decay: float = 0.0,
+    lr_schedule=None,
+    clip_fn=None,
+    axis_name: str = "data",
+    check_vma: bool = True,
+):
+    """Shared two-shard_map ZeRO-1 step orchestration.
+
+    Used by BOTH the dp x sp x tp mesh path (train/lm.py) and the
+    pipeline path (parallel/pipeline.py) so the protocol lives once:
+    a vma-checked fwd/bwd shard_map (typed autodiff inserts the grad
+    psums per `specs`), then the per-leaf ZeRO-1 update inside a
+    check_vma=False shard_map - its all_gather reassembly produces
+    values that are replicated in fact but "varying" to the checker,
+    and no autodiff flows through the optimizer, so the typing buys
+    nothing there.
+
+    fwd_bwd(params, tokens, targets) -> (loss, grads), called inside
+    shard_map. clip_fn(grads) -> grads, called inside the optimizer
+    shard_map (pass the caller's specs-aware or plain clip). momentum
+    doubles as Adam's b1 so a single --momentum flag drives every
+    optimizer. Returns the jitted (params, mom, tokens, targets[, step])
+    -> (params, mom, loss) with params/mom donated.
+    """
+    import jax.numpy as _jnp
+    from jax.sharding import PartitionSpec as _P
+
+    grad_fn = jax.shard_map(
+        fwd_bwd,
+        mesh=mesh,
+        in_specs=(specs, data_spec, data_spec),
+        out_specs=(_P(), specs),
+        check_vma=check_vma,
+    )
+
+    def opt_body(params, mom, grads, lr_t):
+        if clip_fn is not None:
+            grads = clip_fn(grads)
+        if optimizer == "zero-adam":
+            return zero_adam_step_sharded(
+                params, mom, grads, lr_t, b1=momentum,
+                weight_decay=weight_decay,
+                axis_name=axis_name, grads_presummed=True,
+            )
+        new_p, new_m = zero_sgd_step_sharded(
+            params, mom, grads, lr_t, momentum,
+            axis_name=axis_name, grads_presummed=True,
+        )
+        from ..ops.schedule import apply_decoupled_weight_decay
+
+        new_p = apply_decoupled_weight_decay(new_p, lr_t, weight_decay)
+        return new_p, new_m
+
+    opt_fn = jax.shard_map(
+        opt_body,
+        mesh=mesh,
+        in_specs=(specs, mom_spec, specs, _P()),
+        out_specs=(specs, mom_spec),
+        check_vma=False,
+    )
+
+    def zero_step(params, mom, tokens, targets, step_i=None):
+        loss, grads = grad_fn(params, tokens, targets)
+        lr_t = _jnp.float32(lr) if lr_schedule is None else _jnp.float32(
+            lr_schedule(step_i)
+        )
+        params, mom = opt_fn(params, mom, grads, lr_t)
+        return params, mom, loss
+
+    if lr_schedule is not None:
+        return jax.jit(
+            lambda p, m, a, b, s: zero_step(p, m, a, b, s),
+            donate_argnums=(0, 1),
+        )
+    return jax.jit(
+        lambda p, m, a, b: zero_step(p, m, a, b), donate_argnums=(0, 1)
+    )
+
+
 def zero_sgd_step(
     params,
     mom_shard,

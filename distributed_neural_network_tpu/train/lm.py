@@ -391,65 +391,23 @@ def make_lm_train_step(
 
     has_step = lr_schedule is not None
     if optimizer.startswith("zero"):
-        # Two shard_maps inside one jit: the vma-checked fwd/bwd (typed
-        # autodiff inserts the grad psums), then the ZeRO-1 update with
-        # check_vma=False - its all_gather reassembly produces values that
-        # are replicated in fact but "varying" to the checker, and no
-        # autodiff flows through the optimizer, so the typing buys nothing
-        # there (parallel/zero.py zero_*_step_sharded).
-        grad_fn = jax.shard_map(
-            fwd_bwd,
-            mesh=mesh,
-            in_specs=(specs, data_spec, data_spec),
-            out_specs=(P(), specs),
+        # Shared two-shard_map ZeRO-1 orchestration (parallel/zero.py
+        # make_zero_split_step; the pipeline path uses the same helper).
+        # zero forbids tp/ep, so every grad leaf here is the full
+        # replicated gradient: the plain (no-psum) norm is global.
+        clip_fn = None
+        if clip_norm > 0.0:
+            from ..ops.schedule import clip_by_global_norm
+
+            def clip_fn(grads):
+                return clip_by_global_norm(grads, clip_norm)[0]
+
+        return zero.make_zero_split_step(
+            mesh=mesh, fwd_bwd=fwd_bwd, specs=specs, mom_spec=mom_spec,
+            data_spec=data_spec, optimizer=optimizer, lr=lr,
+            momentum=momentum, weight_decay=weight_decay,
+            lr_schedule=lr_schedule, clip_fn=clip_fn, axis_name=DATA_AXIS,
             check_vma=check_vma,
-        )
-
-        def opt_body(params, mom, grads, lr_t):
-            if clip_norm > 0.0:
-                from ..ops.schedule import clip_by_global_norm
-
-                # zero forbids tp/ep, so every grad leaf here is the full
-                # replicated gradient: the plain (no-psum) norm is global
-                grads, _ = clip_by_global_norm(grads, clip_norm)
-            if optimizer == "zero-adam":
-                return zero.zero_adam_step_sharded(
-                    params, mom, grads, lr_t, b1=momentum,
-                    weight_decay=weight_decay,
-                    axis_name=DATA_AXIS, grads_presummed=True,
-                )
-            new_p, new_m = zero.zero_sgd_step_sharded(
-                params, mom, grads, lr_t, momentum,
-                axis_name=DATA_AXIS, grads_presummed=True,
-            )
-            from ..ops.schedule import apply_decoupled_weight_decay
-
-            new_p = apply_decoupled_weight_decay(new_p, lr_t, weight_decay)
-            return new_p, new_m
-
-        opt_fn = jax.shard_map(
-            opt_body,
-            mesh=mesh,
-            in_specs=(specs, mom_spec, specs, P()),
-            out_specs=(specs, mom_spec),
-            check_vma=False,
-        )
-
-        def zero_step(params, mom, tokens, targets, step_i=None):
-            loss, grads = grad_fn(params, tokens, targets)
-            lr_t = jnp.float32(lr) if lr_schedule is None else jnp.float32(
-                lr_schedule(step_i)
-            )
-            params, mom = opt_fn(params, mom, grads, lr_t)
-            return params, mom, loss
-
-        if has_step:
-            return jax.jit(
-                lambda p, m, a, b, s: zero_step(p, m, a, b, s),
-                donate_argnums=(0, 1),
-            )
-        return jax.jit(
-            lambda p, m, a, b: zero_step(p, m, a, b), donate_argnums=(0, 1)
         )
 
     if has_step:

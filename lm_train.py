@@ -38,7 +38,8 @@ def main() -> int:
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel axis size")
     p.add_argument("--pp", type=int, default=1,
                    help="pipeline stages (uses the dp x pp x tp mesh; "
-                   "exclusive with --sp/--experts/--optimizer zero)")
+                   "exclusive with --sp/--experts; zero optimizers "
+                   "compose with --dp, not --tp)")
     p.add_argument("--microbatches", type=int, default=2)
     p.add_argument(
         "--pp-interleave", type=int, default=1,
@@ -211,11 +212,17 @@ def main() -> int:
     params = tfm.init_params(jax.random.key(args.seed), cfg)
     pipe = args.pp > 1
     if pipe:
-        if args.sp > 1 or args.experts or args.optimizer.startswith("zero"):
+        if args.sp > 1 or args.experts:
             raise SystemExit(
-                "--pp composes with --dp/--tp and --optimizer sgd/adam; "
-                "--sp/--experts/zero optimizers run on the "
-                "dp x sp x tp mesh (drop --pp)"
+                "--pp composes with --dp/--tp and any --optimizer "
+                "(zero/zero-adam shard state over dp per stage); "
+                "--sp/--experts run on the dp x sp x tp mesh (drop --pp)"
+            )
+        if args.optimizer.startswith("zero") and args.tp > 1:
+            raise SystemExit(
+                "--pp with zero optimizers composes with --dp only "
+                "(tensor-sharded leaves are out of the per-leaf ZeRO "
+                "layout's scope, same rule as the mesh path)"
             )
         if args.accum_steps > 1:
             raise SystemExit(
@@ -231,13 +238,15 @@ def main() -> int:
             from distributed_neural_network_tpu.ops.adam import init_adam
 
             mom = init_adam(params)
+        elif args.optimizer.startswith("zero"):
+            mom = ppl.init_pp_zero_state(params, specs, mesh, args.optimizer)
         else:
             from distributed_neural_network_tpu.ops.sgd import init_momentum
 
             mom = init_momentum(params)
         mom_shardings = jax.tree.map(
             lambda s: NamedSharding(mesh, s),
-            lmtrain.optimizer_state_specs(args.optimizer, specs),
+            ppl.pp_optimizer_state_specs(args.optimizer, specs),
         )
         import functools
 

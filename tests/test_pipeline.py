@@ -400,5 +400,85 @@ def test_pp_adam_learns(n_devices):
         losses.append(float(loss))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0] - 1.0, losses[:: len(losses) - 1]
-    with pytest.raises(ValueError, match="must be 'sgd' or 'adam'"):
-        pp.make_pp_train_step(CFG8, mesh, optimizer="zero")
+    with pytest.raises(ValueError, match="one of sgd/adam/zero"):
+        pp.make_pp_train_step(CFG8, mesh, optimizer="rmsprop")
+
+
+@pytest.mark.parametrize(
+    "zero_opt,base_opt", [("zero-adam", "adam"), ("zero", "sgd")]
+)
+def test_pp_zero_parity_vs_unsharded(n_devices, zero_opt, base_opt):
+    """ZeRO-1 under dp2 x pp2 is numerically the unsharded optimizer.
+
+    The per-leaf ZeRO step (parallel/zero.py) updates a partition of each
+    stage-local leaf's elements with the same elementwise rule, so the
+    trajectory must match the replicated-state optimizer to float
+    round-off - including clipping and decoupled weight decay (VERDICT r3
+    item 6: the DeepSpeed ZeRO-1 + PP layout)."""
+    mesh = pp.create_pp_mesh(2, 2, 1)
+    tokens, targets = _data(batch=16, seq=16, seed=13)
+    kw = dict(n_microbatches=2, lr=0.02, momentum=0.9,
+              clip_norm=1.0, weight_decay=0.01)
+
+    def run(optimizer, steps=5):
+        params = tfm.init_params(jax.random.key(5), CFG)
+        params, specs = pp.shard_pp_params(params, CFG, mesh)
+        if optimizer == "adam":
+            from distributed_neural_network_tpu.ops.adam import init_adam
+
+            mom = init_adam(params)
+        elif optimizer == "sgd":
+            mom = jax.tree.map(jnp.zeros_like, params)
+        else:
+            mom = pp.init_pp_zero_state(params, specs, mesh, optimizer)
+        step = pp.make_pp_train_step(CFG, mesh, optimizer=optimizer, **kw)
+        losses = []
+        for _ in range(steps):
+            params, mom, loss = step(params, mom, tokens, targets)
+            losses.append(float(loss))
+        return params, losses
+
+    p_ref, l_ref = run(base_opt)
+    p_z, l_z = run(zero_opt)
+    np.testing.assert_allclose(l_z, l_ref, rtol=1e-5)
+    for (path, a), (_, b) in zip(
+        jax.tree_util.tree_flatten_with_path(p_z)[0],
+        jax.tree_util.tree_flatten_with_path(p_ref)[0],
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6,
+            err_msg=str(path),
+        )
+
+
+def test_pp_zero_rejects_tp(n_devices):
+    mesh = pp.create_pp_mesh(2, 2, 2)
+    with pytest.raises(ValueError, match="stage-local leaf"):
+        pp.make_pp_train_step(CFG, mesh, optimizer="zero-adam")
+
+
+def test_pp_zero_interleaved_learns(n_devices):
+    """zero-adam composes with the interleaved schedule + lr schedule."""
+    import functools
+
+    from distributed_neural_network_tpu.ops import schedule as sched
+
+    mesh = pp.create_pp_mesh(2, 2, 1)
+    params = tfm.init_params(jax.random.key(0), CFG8)
+    params, specs = pp.shard_pp_params(params, CFG8, mesh, interleave=2)
+    mom = pp.init_pp_zero_state(params, specs, mesh, "zero-adam")
+    step = pp.make_pp_train_step(
+        CFG8, mesh, n_microbatches=4, lr=0.01, interleave=2,
+        optimizer="zero-adam", clip_norm=1.0,
+        lr_schedule=functools.partial(
+            sched.warmup_cosine, base_lr=0.01, total_steps=25,
+            warmup_steps=2, min_lr_frac=0.1,
+        ),
+    )
+    tokens, targets = _data(batch=16, seq=16, seed=11)
+    losses = []
+    for i in range(25):
+        params, mom, loss = step(params, mom, tokens, targets, jnp.int32(i))
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] - 1.0, losses[:: len(losses) - 1]
