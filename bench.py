@@ -20,8 +20,15 @@ the old bench touched jax at top level with no second chance):
   backoff (--retries, default 5 over ~4 min);
 - an unrecoverable run still prints structured JSON with an "error" field -
   never a bare traceback on stdout;
-- a global --deadline (default 1500 s) skips remaining non-headline rows so
-  the headline always gets printed before any driver timeout.
+- a global --deadline (default 3600 s) skips STARTING remaining
+  non-headline rows so the headline always gets printed before any driver
+  timeout; an in-flight accelerator row is never killed for the deadline
+  (killing a process that holds the single axon chip claim wedges the
+  backend for every later process - r4 post-mortem: the first-pass 420 s
+  row kills are what "wedged the chip" in r3/r4). Each accelerator row
+  instead gets a generous honest-fencing budget (`est_s`, scaled by bs)
+  and a 2x+300 s last-resort cap; hitting that cap kills once and then
+  stops all further claims this session.
 
 Reference comparison columns (BASELINE.md):
   Table 1 proc sweep @ bs16: 8-proc train time 1642 s (headline ref).
@@ -72,11 +79,23 @@ def _rows(epochs: int) -> list[dict]:
     def ref(ref_s, note):
         return {"ref_s": ref_s, "ref": note} if at_ref_epochs else {}
 
+    # est_s: generous per-row wall-clock budget under HONEST fencing
+    # (dispatch-time numbers bound nothing - r3). Small batches mean more
+    # sequential steps per epoch, so the budget scales inversely with bs;
+    # these are caps, not predictions - a row finishing early costs
+    # nothing, a row killed early costs the whole session (wedged claim).
+    bs_est = {1: 3600, 2: 2400, 4: 1500, 8: 1200, 16: 900, 32: 700, 64: 600}
+    scale = max(epochs / 25.0, 0.2)  # smoke runs get proportional caps
+
+    def est(bs):
+        return round(bs_est[bs] * scale)
+
     rows = [
         {
             "id": f"cnn_dp_ep{epochs}_bs16",
             "kind": "cnn",
             "headline": True,
+            "est_s": est(16),
             **ref(REFERENCE_TRAIN_S,
                   "Table 1, 8 procs (log_epochs25_proc8_children.txt:2)"),
             "args": {"batch_size": 16, "epochs": epochs},
@@ -89,6 +108,7 @@ def _rows(epochs: int) -> list[dict]:
             {
                 "id": f"cnn_dp_ep{epochs}_bs{bs}",
                 "kind": "cnn",
+                "est_s": est(bs),
                 **ref(ref_s,
                       f"Table 2, 4 procs (bs{bs}_log_epochs25_proc4_"
                       "children.txt:2)"),
@@ -103,6 +123,7 @@ def _rows(epochs: int) -> list[dict]:
         {
             "id": f"cnn_dp_ep{epochs}_bs16_pallas",
             "kind": "cnn",
+            "est_s": est(16),
             **ref(REFERENCE_TRAIN_S,
                   "Table 1, 8 procs; fused Pallas classifier head"),
             "args": {"batch_size": 16, "epochs": epochs, "kernels": "pallas"},
@@ -111,6 +132,7 @@ def _rows(epochs: int) -> list[dict]:
         {
             "id": f"cnn_dp_ep{epochs}_bs16_bf16",
             "kind": "cnn",
+            "est_s": est(16),
             **ref(REFERENCE_TRAIN_S,
                   "Table 1, 8 procs; bfloat16 compute"),
             "args": {
@@ -124,6 +146,7 @@ def _rows(epochs: int) -> list[dict]:
         {
             "id": f"cnn_dp_ep{epochs}_bs16_stream",
             "kind": "cnn",
+            "est_s": est(16),
             **ref(REFERENCE_TRAIN_S,
                   "Table 1, 8 procs; host-streaming input, prefetch 2"),
             "args": {
@@ -134,6 +157,7 @@ def _rows(epochs: int) -> list[dict]:
         {
             "id": "lm_flash_d512_L8_seq2048_bf16",
             "kind": "lm",
+            "est_s": 600,
             "args": {"attn": "flash", "dtype": "bfloat16", "steps": 20},
         },
         {
@@ -142,6 +166,7 @@ def _rows(epochs: int) -> list[dict]:
             # r3); flash needs no remat - that contrast is the point
             "id": "lm_xla_d512_L8_seq2048_bf16_remat",
             "kind": "lm",
+            "est_s": 600,
             "args": {"attn": "full", "dtype": "bfloat16", "steps": 20,
                      "remat": True},
         },
@@ -150,6 +175,7 @@ def _rows(epochs: int) -> list[dict]:
             # MFU>=40% target config (VERDICT r2 item 2)
             "id": "lm_flash_d1024_L16_seq2048_bf16",
             "kind": "lm",
+            "est_s": 900,
             "args": {"attn": "flash", "dtype": "bfloat16", "steps": 20,
                      "d_model": 1024, "n_layers": 16, "n_heads": 16,
                      "d_ff": 4096},
@@ -160,6 +186,7 @@ def _rows(epochs: int) -> list[dict]:
             # fix (vs whole-block remat's ~1/3 FLOP overhead)
             "id": "lm_xla_d512_L8_seq2048_bf16_rematattn",
             "kind": "lm",
+            "est_s": 600,
             "args": {"attn": "full", "dtype": "bfloat16", "steps": 20,
                      "remat_attn": True},
         },
@@ -169,6 +196,7 @@ def _rows(epochs: int) -> list[dict]:
             # set/program enough to have a chance
             "id": "lm_flash_d1024_L16_seq2048_bf16_remat_b8",
             "kind": "lm",
+            "est_s": 900,
             "args": {"attn": "flash", "dtype": "bfloat16", "steps": 20,
                      "d_model": 1024, "n_layers": 16, "n_heads": 16,
                      "d_ff": 4096, "batch": 8, "remat": True},
@@ -178,6 +206,7 @@ def _rows(epochs: int) -> list[dict]:
             # (round-1 XLA+remat measured 45.4k tok/s here, pre-fence-fix)
             "id": "lm_flash_d512_L8_seq8192_bf16",
             "kind": "lm",
+            "est_s": 900,
             "args": {"attn": "flash", "dtype": "bfloat16", "steps": 10,
                      "batch": 4, "seq_len": 8192},
         },
@@ -274,7 +303,16 @@ def _write_matrix(state: dict) -> None:
 
 
 def _run_row_subprocess(spec: dict, timeout: float) -> tuple[dict | None, str]:
-    """Run one row in a fresh subprocess; (result, error) - one is set."""
+    """Run one row in a fresh subprocess; (result, error) - one is set.
+
+    `timeout` here is a HARD CAP, not a working budget - killing a process
+    that holds (or is acquiring) the single axon chip claim wedges the
+    backend for every later process (r3 wedge; r4 post-mortem confirmed:
+    the r4 first-pass kills at 420 s/61 s wedged the session). Callers
+    pass generous caps (see `est_s` row budgets) and treat a timeout as
+    terminal for the whole accelerator session, not as a retryable row
+    error.
+    """
     cmd = [sys.executable, os.path.abspath(__file__), "--worker",
            json.dumps(spec)]
     env = None
@@ -300,10 +338,13 @@ def _run_row_subprocess(spec: dict, timeout: float) -> tuple[dict | None, str]:
 
 
 def _retryable(err: str) -> bool:
-    # a busy chip shows up either as an UNAVAILABLE-style init error or as
-    # a backend-init hang (observed r3: jax.devices() blocked >8 min), which
-    # surfaces here as the row timeout
-    return any(m in err for m in _RETRYABLE) or "row timed out" in err
+    # a busy chip shows up as an UNAVAILABLE-style init error. A row
+    # TIMEOUT is deliberately NOT retryable: with the generous est_s caps
+    # a timeout means the subprocess was killed, and a kill mid-claim
+    # wedges the chip - retrying against a wedged claim only stacks more
+    # doomed claims (r4 post-mortem). The caller poisons the session
+    # instead.
+    return any(m in err for m in _RETRYABLE)
 
 
 def _probe_backend(timeout: float = 75.0) -> bool:
@@ -354,10 +395,16 @@ def main() -> int:
                    help="cnn rows: synthetic train-split rows")
     p.add_argument("--retries", type=int, default=5,
                    help="attempts per row on busy/unavailable backend")
-    p.add_argument("--row-timeout", type=float, default=420.0)
-    p.add_argument("--deadline", type=float, default=1500.0,
-                   help="wall-clock budget; remaining non-headline rows are "
-                   "skipped (recorded as skipped) once exceeded")
+    p.add_argument("--row-timeout", type=float, default=420.0,
+                   help="kill timeout for CPU-pinned rows, and the est_s "
+                   "fallback for accelerator rows without one (their hard "
+                   "cap is 2*est_s+300; accelerator rows are never killed "
+                   "for the --deadline)")
+    p.add_argument("--deadline", type=float, default=3600.0,
+                   help="wall-clock budget gating row STARTS; remaining "
+                   "non-headline rows are skipped (recorded as skipped) "
+                   "once exceeded - in-flight accelerator rows run to "
+                   "their own hard cap regardless")
     p.add_argument("--only", default=None,
                    help="comma-separated exact row ids to run")
     args = p.parse_args()
@@ -415,12 +462,14 @@ def main() -> int:
 
     headline = None
     reprobed_late = False
+    poisoned = False  # a row was killed at its hard cap this session
     for spec in rows:
         if not spec.get("env") and not backend_ok:
             # one last cheap probe in case the claim cleared late - but
             # only once; paying 45s per accelerator row would burn the
-            # whole deadline on a wedged chip
-            if not reprobed_late:
+            # whole deadline on a wedged chip. Never re-probe a claim
+            # this session itself wedged with a cap-kill.
+            if not reprobed_late and not poisoned:
                 reprobed_late = True
                 backend_ok = _probe_backend(45)
             if not backend_ok:
@@ -428,8 +477,13 @@ def main() -> int:
                     "id": spec["id"],
                     **{k: v for k, v in spec.items()
                        if k in ("ref_s", "ref")},
-                    "error": "backend unavailable: device claim wedged "
-                             "(probe timed out); see BENCH note",
+                    "error": (
+                        "skipped: a prior row was killed at its hard cap "
+                        "this session (claim presumed wedged by the kill)"
+                        if poisoned else
+                        "backend unavailable: device claim wedged "
+                        "(probe timed out); see BENCH note"
+                    ),
                 })
                 _write_matrix(state)
                 if spec.get("headline"):
@@ -445,15 +499,29 @@ def main() -> int:
             _write_matrix(state)
             continue
         result, err = None, ""
+        if spec.get("env"):
+            # CPU-pinned row: a kill cannot wedge anything, keep the old
+            # deadline-capped budget
+            row_cap = min(args.row_timeout,
+                          max(args.deadline - (time.time() - t_start), 60.0))
+        else:
+            # accelerator row: the cap is a last-resort bound, NOT a
+            # working budget - see _run_row_subprocess. est_s is already
+            # generous; 2x + 5 min means only a genuinely hung claim is
+            # ever killed, and that kill poisons the rest of the
+            # accelerator session (no further claims after a wedge).
+            row_cap = 2 * spec.get("est_s", args.row_timeout) + 300
         for attempt in range(max(args.retries, 1)):
-            # cap the attempt so the stdout JSON always lands before a
-            # driver whose kill timeout matches --deadline (+60s grace
-            # floor so a late first attempt still gets a real chance)
-            budget = max(args.deadline - (time.time() - t_start), 60.0)
-            _log(f"[bench] {spec['id']}: attempt {attempt + 1}")
-            result, err = _run_row_subprocess(
-                spec, min(args.row_timeout, budget)
-            )
+            _log(f"[bench] {spec['id']}: attempt {attempt + 1} "
+                 f"(cap {row_cap:.0f}s)")
+            result, err = _run_row_subprocess(spec, row_cap)
+            if err.startswith("row timed out") and not spec.get("env"):
+                _log(f"[bench] {spec['id']}: killed at the hard cap - "
+                     "treating the claim as wedged; no further "
+                     "accelerator rows this session")
+                backend_ok = False
+                poisoned = True
+                break
             if result is not None or not _retryable(err):
                 break
             if time.time() - t_start > args.deadline:

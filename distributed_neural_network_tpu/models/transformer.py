@@ -465,9 +465,12 @@ def generate(
     forward by tests/test_generate.py. Training-side parallelism
     (`apply`'s seq/tp/ep axes) is out of scope here: decode is the
     single-device inference path; shard the batch outside for fleet
-    serving. MoE models route through the dense dispatch (B tokens per
-    step is far below any capacity concern; capacity is sized so no
-    token ever drops, keeping decode exactly the training FFN).
+    serving. MoE models route through the dense dispatch with capacity
+    sized so decode never drops a token; the training forward, by
+    contrast, is capacity-limited (moe_capacity_factor) and can drop
+    under router imbalance - parity with the teacher-forced forward
+    therefore holds exactly in the no-drop regime and diverges on
+    whatever tokens training would have dropped.
     """
     if temperature > 0.0 and key is None:
         raise ValueError("temperature > 0 sampling requires `key`")
@@ -500,8 +503,12 @@ def generate(
         h2 = _layer_norm(x, lp["ln2_scale"], lp["ln2_bias"]).astype(dt)
         if cfg.n_experts:
             # dense dispatch at decode shapes (B tokens/step): capacity =
-            # B guarantees zero drops, so decode routing is exactly the
-            # training FFN evaluated on one position
+            # B guarantees zero drops. Parity caveat: the training
+            # forward uses moe_capacity_factor and CAN drop tokens under
+            # router imbalance, so cached decode matches the
+            # teacher-forced forward exactly only in the no-drop regime
+            # (dropped training tokens pass through the residual with no
+            # expert output; decode never drops)
             y, _ = moe_ffn(
                 h2.reshape(b, cfg.d_model),
                 lp["wr"], lp["w1"], lp["b1"], lp["w2"], lp["b2"],

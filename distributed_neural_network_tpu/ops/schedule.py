@@ -123,6 +123,51 @@ def apply_decoupled_weight_decay(params, lr_t, weight_decay: float):
     return jax.tree.map(lambda p: p - lr_t * weight_decay * p, params)
 
 
+def accumulate_fwd_bwd(fwd_bwd_one, accum_steps: int):
+    """Wrap a per-micro-batch (params, tokens, targets) -> (loss, grads)
+    into a k-step gradient-accumulation scan over B/k-row slices.
+
+    Shared by the mesh path (train/lm.py) and the pipeline path
+    (parallel/pipeline.py): k-times the effective batch in one
+    activation-memory footprint. The accumulator is seeded with
+    micro-batch 0 OUTSIDE the scan: its (loss, grads) carry exactly the
+    vma types the scan carry needs, with no per-leaf guessing about
+    which mesh axes autodiff varies over. Call inside shard_map; the
+    averaged (loss, grads) match one k-times-larger batch up to float
+    reassociation.
+    """
+    if accum_steps == 1:
+        return fwd_bwd_one
+
+    def fwd_bwd(params, tokens, targets):
+        b_local = tokens.shape[0]
+        if b_local % accum_steps:
+            raise ValueError(
+                f"per-device batch ({b_local}) must divide by accum_steps "
+                f"({accum_steps})"
+            )
+        mb = b_local // accum_steps
+        tok_k = tokens.reshape(accum_steps, mb, -1)
+        tgt_k = targets.reshape(accum_steps, mb, -1)
+        first = fwd_bwd_one(params, tok_k[0], tgt_k[0])
+
+        def body(carry, tt):
+            loss_acc, grads_acc = carry
+            loss, grads = fwd_bwd_one(params, *tt)
+            return (
+                loss_acc + loss,
+                jax.tree.map(jnp.add, grads_acc, grads),
+            ), None
+
+        (loss_sum, grads_sum), _ = jax.lax.scan(
+            body, first, (tok_k[1:], tgt_k[1:])
+        )
+        k = jnp.float32(accum_steps)
+        return loss_sum / k, jax.tree.map(lambda g: g / k, grads_sum)
+
+    return fwd_bwd
+
+
 def make_ema_update(decay: float):
     """Compiled EMA tracker: ema <- decay*ema + (1-decay)*params.
 

@@ -349,15 +349,27 @@ def make_pp_train_step(
     clip_norm: float = 0.0,
     weight_decay: float = 0.0,
     optimizer: str = "sgd",
+    accum_steps: int = 1,
 ):
     """Compiled pipeline-parallel (params, mom, tokens, targets) ->
     (params, mom, loss) over a (data, pipe, model) mesh.
 
-    tokens/targets: (B, S) int32 with B divisible by dp * n_microbatches.
-    Layer-stack params must be placed per `pp_param_specs` (use
-    `shard_pp_params(..., interleave=interleave)` - the interleaved
-    schedule needs the round-robin chunk layout). interleave = v > 1
-    cuts the pipeline bubble to (P-1)/(v*M+P-1); see `pipeline_lm_loss`.
+    tokens/targets: (B, S) int32 with B divisible by
+    dp * accum_steps * n_microbatches. Layer-stack params must be placed
+    per `pp_param_specs` (use `shard_pp_params(..., interleave=interleave)`
+    - the interleaved schedule needs the round-robin chunk layout).
+    interleave = v > 1 cuts the pipeline bubble to (P-1)/(v*M+P-1); see
+    `pipeline_lm_loss`.
+
+    accum_steps = k > 1 runs k sequential schedule passes over B/k-row
+    slices and averages the gradients (ops/schedule.accumulate_fwd_bwd).
+    Raising n_microbatches instead shrinks the bubble but NOT the
+    memory: the schedule is differentiated through, so its saved
+    activations (and the collected exit blocks) scale with the rows in
+    flight per pass - k passes cap that at B/k rows while reaching the
+    k*B effective batch. Trade-off: each extra pass pays its own bubble,
+    so prefer raising n_microbatches until activation memory binds, then
+    accumulate.
 
     Loop transforms match train/lm.py's mesh path: lr_schedule makes the
     compiled fn take (params, mom, tokens, targets, step); clip_norm
@@ -405,24 +417,27 @@ def make_pp_train_step(
             f"(cfg.n_experts={cfg.n_experts}); use the dp/ep path in train/lm.py "
             "for MoE models"
         )
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
     tp = TP_AXIS if mesh.shape.get(TP_AXIS, 1) > 1 else None
     sync = tuple(a for a in (DATA_AXIS,) if a in mesh.axis_names)
     specs = pp_param_specs(cfg, tp_axis=tp)
     data_spec = P(DATA_AXIS)
 
-    def step(params, mom, tokens, targets, step_i=None):
-        loss, grads = jax.value_and_grad(pipeline_lm_loss)(
-            params,
-            tokens,
-            targets,
-            cfg,
-            pipe_axis=PIPE_AXIS,
-            n_microbatches=n_microbatches,
-            tp_axis=tp,
-            sync_axes=sync,
-            loss_chunks=loss_chunks,
+    def fwd_bwd_one(params, tokens, targets):
+        return jax.value_and_grad(pipeline_lm_loss)(
+            params, tokens, targets, cfg,
+            pipe_axis=PIPE_AXIS, n_microbatches=n_microbatches,
+            tp_axis=tp, sync_axes=sync, loss_chunks=loss_chunks,
             interleave=v,
         )
+
+    from ..ops.schedule import accumulate_fwd_bwd
+
+    fwd_bwd = accumulate_fwd_bwd(fwd_bwd_one, accum_steps)
+
+    def step(params, mom, tokens, targets, step_i=None):
+        loss, grads = fwd_bwd(params, tokens, targets)
         if clip_norm > 0.0:
             from ..ops.schedule import clip_by_global_norm
 
@@ -460,14 +475,6 @@ def make_pp_train_step(
         # embed/head are replicated.
         from .zero import make_zero_split_step
 
-        def fwd_bwd(params, tokens, targets):
-            return jax.value_and_grad(pipeline_lm_loss)(
-                params, tokens, targets, cfg,
-                pipe_axis=PIPE_AXIS, n_microbatches=n_microbatches,
-                tp_axis=tp, sync_axes=sync, loss_chunks=loss_chunks,
-                interleave=v,
-            )
-
         clip_fn = None
         if clip_norm > 0.0:
             from ..ops.schedule import clip_by_global_norm
@@ -497,6 +504,37 @@ def make_pp_train_step(
             out_specs=(specs, mom_spec, P()),
         ),
         donate_argnums=(0, 1),
+    )
+
+
+def make_pp_eval_fn(
+    cfg: tfm.TransformerConfig,
+    mesh: Mesh,
+    *,
+    n_microbatches: int = 2,
+    loss_chunks: int = 0,
+    interleave: int = 1,
+):
+    """Compiled (params, tokens, targets) -> replicated mean loss through
+    the same microbatch schedule as training, no grad - the held-out
+    eval for pipeline runs. Lives here so the CLI never re-derives the
+    pipeline's spec/axis wiring (it must match `make_pp_train_step`)."""
+    tp = TP_AXIS if mesh.shape.get(TP_AXIS, 1) > 1 else None
+    sync = tuple(a for a in (DATA_AXIS,) if a in mesh.axis_names)
+    specs = pp_param_specs(cfg, tp_axis=tp)
+    data_spec = P(DATA_AXIS)
+    return jax.jit(
+        jax.shard_map(
+            lambda p, tok, tgt: pipeline_lm_loss(
+                p, tok, tgt, cfg,
+                n_microbatches=n_microbatches, tp_axis=tp,
+                sync_axes=sync, loss_chunks=loss_chunks,
+                interleave=interleave,
+            ),
+            mesh=mesh,
+            in_specs=(specs, data_spec, data_spec),
+            out_specs=P(),
+        )
     )
 
 

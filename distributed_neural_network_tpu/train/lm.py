@@ -300,36 +300,9 @@ def make_lm_train_step(
             loss_chunks=loss_chunks,
         )
 
-    def fwd_bwd(params, tokens, targets):
-        if accum_steps == 1:
-            return fwd_bwd_one(params, tokens, targets)
-        b_local = tokens.shape[0]
-        if b_local % accum_steps:
-            raise ValueError(
-                f"per-device batch ({b_local}) must divide by accum_steps "
-                f"({accum_steps})"
-            )
-        mb = b_local // accum_steps
-        tok_k = tokens.reshape(accum_steps, mb, -1)
-        tgt_k = targets.reshape(accum_steps, mb, -1)
-        # seed the accumulator with micro-batch 0 (outside the scan): its
-        # (loss, grads) carry exactly the vma types the scan carry needs,
-        # with no per-leaf guessing about which axes autodiff varies over
-        first = fwd_bwd_one(params, tok_k[0], tgt_k[0])
+    from ..ops.schedule import accumulate_fwd_bwd
 
-        def body(carry, tt):
-            loss_acc, grads_acc = carry
-            loss, grads = fwd_bwd_one(params, *tt)
-            return (
-                loss_acc + loss,
-                jax.tree.map(jnp.add, grads_acc, grads),
-            ), None
-
-        (loss_sum, grads_sum), _ = jax.lax.scan(
-            body, first, (tok_k[1:], tgt_k[1:])
-        )
-        k = jnp.float32(accum_steps)
-        return loss_sum / k, jax.tree.map(lambda g: g / k, grads_sum)
+    fwd_bwd = accumulate_fwd_bwd(fwd_bwd_one, accum_steps)
 
     def transform_grads(grads):
         if clip_norm > 0.0:

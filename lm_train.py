@@ -100,7 +100,9 @@ def main() -> int:
     p.add_argument("--accum-steps", type=int, default=1,
                    help="gradient accumulation: scan this many sequential "
                    "fwd/bwd micro-batches per optimizer step (batch-size "
-                   "must divide by dp * accum-steps); not with --pp")
+                   "must divide by dp * accum-steps; under --pp also by "
+                   "microbatches per pass - prefer raising --microbatches "
+                   "until activation memory binds, then accumulate)")
     p.add_argument("--ema-decay", type=float, default=0.0,
                    help="track an exponential moving average of params "
                    "(e.g. 0.999) and use it for --eval-every/--generate; "
@@ -224,12 +226,6 @@ def main() -> int:
                 "(tensor-sharded leaves are out of the per-leaf ZeRO "
                 "layout's scope, same rule as the mesh path)"
             )
-        if args.accum_steps > 1:
-            raise SystemExit(
-                "--accum-steps runs on the dp x sp x tp mesh path; under "
-                "--pp raise --microbatches instead (the schedule already "
-                "accumulates across microbatches)"
-            )
         mesh = ppl.create_pp_mesh(args.dp, args.pp, args.tp)
         params, specs = ppl.shard_pp_params(
             params, cfg, mesh, interleave=args.pp_interleave
@@ -265,6 +261,7 @@ def main() -> int:
             loss_chunks=args.loss_chunks, interleave=args.pp_interleave,
             lr_schedule=pp_lr_schedule, clip_norm=args.clip_norm,
             weight_decay=args.weight_decay, optimizer=args.optimizer,
+            accum_steps=args.accum_steps,
         )
     else:
         mesh = lmtrain.create_lm_mesh(args.dp, args.sp, args.tp)
@@ -402,7 +399,14 @@ def main() -> int:
             tokens, targets = tokens[:, zperm], targets[:, zperm]
 
     eval_fn = None
-    if args.eval_every and not pipe:
+    if args.eval_every and pipe:
+        # held-out eval through the same microbatch schedule, no grad
+        # (r3 ADVICE: --eval-every used to be silently ignored under --pp)
+        eval_fn = ppl.make_pp_eval_fn(
+            cfg, mesh, n_microbatches=args.microbatches,
+            loss_chunks=args.loss_chunks, interleave=args.pp_interleave,
+        )
+    elif args.eval_every:
         from jax.sharding import PartitionSpec as _P
 
         tp_ax = lmtrain.TP_AXIS if args.tp > 1 else None
@@ -478,8 +482,11 @@ def main() -> int:
                 for j in range(args.eval_batches)
             ]))
             # excluded from the throughput window: only training tokens
-            # are counted, so eval wall time must not deflate tokens/s
-            eval_s += time.perf_counter() - t_ev
+            # are counted, so eval wall time must not deflate tokens/s.
+            # Evals during the warmup/compile step (t0 unset) are outside
+            # the window entirely - counting them would inflate tokens/s
+            if t0 is not None:
+                eval_s += time.perf_counter() - t_ev
             last_eval = {"step": i, "eval_loss": round(ev, 4),
                          "ppl": round(float(_np.exp(min(ev, 30.0))), 2)}
             print(f"step {i:>5}  eval_loss {ev:.4f}  "

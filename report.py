@@ -69,21 +69,34 @@ def main() -> int:
     from distributed_neural_network_tpu.train.cli import honor_platform_env
 
     honor_platform_env()
-    import jax
 
     epochs = 2 if args.quick else args.epochs
     syn = 2000 if args.quick else args.synthetic_size
     data = "synthetic" if args.quick else args.data
-    ndev = jax.device_count()
 
     if args.from_matrix:
+        # NEVER touch the jax backend on this path: rendering a report
+        # must not claim the TPU (r4 post-mortem - a report.py blocked on
+        # a busy claim was killed at its stage timeout, wedging the chip
+        # for the rest of the session). Device identity comes from the
+        # measured rows themselves.
         proc_rows, bs_rows = _rows_from_matrix(epochs)
-        bs_devices = bs_rows[0]["devices"] if bs_rows else min(4, ndev)
         if not proc_rows:
             print("no 25-epoch cnn rows in BENCH_MATRIX.json; run "
                   "`python bench.py` first", file=sys.stderr)
             return 1
+        ndev = proc_rows[0].get("devices", 1)
+        bs_devices = bs_rows[0]["devices"] if bs_rows else min(4, ndev)
+        device_desc = (
+            f"{ndev}x {proc_rows[0].get('device_kind', 'unknown device')} "
+            f"({proc_rows[0].get('platform', '?')}, from matrix rows)"
+        )
     else:
+        import jax
+
+        ndev = jax.device_count()
+        dev0 = jax.devices()[0]
+        device_desc = f"{ndev}x {dev0.device_kind} ({dev0.platform})"
         procs = sorted({d for d in REF_PROC if d <= ndev} | {min(ndev, 8)})
         bss = [4, 16, 64] if args.quick else list(REF_BS)
 
@@ -101,12 +114,11 @@ def main() -> int:
             print(json.dumps(r), file=sys.stderr)
 
     src = proc_rows[0]["source"]
-    dev = jax.devices()[0]
     lines = [
         "# REPORT - measured results vs the reference",
         "",
         f"Generated {datetime.datetime.now():%Y-%m-%d %H:%M} by `report.py` "
-        f"on {ndev}x {dev.device_kind} ({dev.platform}); "
+        f"on {device_desc}; "
         f"data source: **{src}**; {epochs} epochs per run.",
         "",
         "Reference numbers: Project_Report.pdf Tables 1-2 (8-core i7-9800X,"

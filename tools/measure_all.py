@@ -55,22 +55,28 @@ def main() -> int:
     args = ap.parse_args()
     py = sys.executable
     log = []
+    # Stage timeouts are LAST-RESORT bounds, not budgets: killing a
+    # process that holds (or is acquiring) the chip claim wedges the
+    # backend for everyone after (r4 post-mortem - the killed report.py,
+    # which used to call jax.devices(), wedged the session). tune and
+    # bench hold claims, so their caps are far above any plausible run;
+    # report no longer touches the backend at all on --from-matrix.
     if "tune" not in args.skip:
         log.append(run("tune_flash",
                        [py, os.path.join(REPO, "tools", "tune_flash.py")],
-                       timeout=1800))
+                       timeout=5400))
     if "bench" not in args.skip:
         log.append(run(
             "bench",
-            [py, os.path.join(REPO, "bench.py"), "--deadline", "2400",
+            [py, os.path.join(REPO, "bench.py"), "--deadline", "7200",
              *([a for a in args.bench_args.split() if a])],
-            timeout=3000,
+            timeout=18000,
         ))
     if "report" not in args.skip:
         log.append(run(
             "report",
             [py, os.path.join(REPO, "report.py"), "--from-matrix"],
-            timeout=600,
+            timeout=900,
         ))
     out = os.path.join(REPO, "tools", "measure_all_log.json")
     with open(out, "w") as f:
