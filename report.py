@@ -196,20 +196,22 @@ def main() -> int:
             if src != "synthetic"
             else
             "Accuracy parity: no real CIFAR-10 exists in this "
-            "environment, so the accuracy axis is verified two ways. "
-            "(1) Semantic fidelity: `tests/test_oracle.py` proves the "
-            "engine's faithful path computes the reference's exact "
-            "algorithm (contiguous shards, per-epoch momentum-reset SGD, "
-            "epoch-edge parameter averaging) step-for-step against an "
-            "independent pure-numpy implementation "
+            "environment, so the accuracy claim is worded as "
+            "*algorithm-identical; band pending real data*, verified "
+            "three ways. (1) Semantic fidelity: `tests/test_oracle.py` "
+            "proves the engine's faithful path computes the reference's "
+            "exact algorithm (contiguous shards, per-epoch momentum-reset "
+            "SGD, epoch-edge parameter averaging) step-for-step against "
+            "an independent pure-numpy implementation "
             "(`tests/oracle_numpy.py`) - params and global train loss "
             "match epoch-by-epoch, and the test fails if any semantic "
-            "knob (e.g. momentum reset) is changed. (2) Ready-to-run "
-            "real-data path: drop `cifar-10-batches-py/` (or "
-            "`cifar10.npz`) under `./data` and run "
-            "`python report.py --data pickle --epochs 25` - the same "
-            "engine is then expected to land in the reference's 63-66% "
-            "accuracy band (Project_Report.pdf Tables 1-2)."
+            "knob (e.g. momentum reset) is changed. "
+            f"(2) Reference-scale trajectory: {_oracle_fullscale_line()} "
+            "(3) Ready-to-run real-data path: drop "
+            "`cifar-10-batches-py/` (or `cifar10.npz`) under `./data` "
+            "and run `python report.py --data pickle --epochs 25` - the "
+            "same engine is then expected to land in the reference's "
+            "63-66% accuracy band (Project_Report.pdf Tables 1-2)."
         ),
         "",
     ]
@@ -218,6 +220,40 @@ def main() -> int:
         f.write("\n".join(lines))
     print(f"wrote {args.out}")
     return 0
+
+
+def _oracle_fullscale_line() -> str:
+    """One sentence summarizing tools/oracle_fullscale_result.json."""
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "oracle_fullscale_result.json")
+    pending = ("`tools/oracle_fullscale.py` runs the same parity check at "
+               "the reference's full scale (25 epochs x 50k rows x 8 "
+               "workers); artifact pending.")
+    try:
+        with open(path) as f:
+            r = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return pending
+    s = r["scale"]
+    # never render a smoke-scale or failed artifact as the full-scale
+    # verification claim
+    full = (s["epochs"] >= 25 and s["rows"] >= 50000 and s["workers"] >= 8)
+    if not r.get("ok") or not full:
+        return (pending[:-1] +
+                f" (current artifact: ok={r.get('ok')}, {s['epochs']} "
+                f"epochs x {s['rows']} rows - not the full-scale claim).")
+    return (
+        f"`tools/oracle_fullscale_result.json` (ok={r['ok']}) matches the "
+        f"engine against the f64 numpy oracle at the reference's full "
+        f"scale - {s['epochs']} epochs x {s['rows']} rows x "
+        f"{s['workers']} workers, bs {s['batch_size']}: worst per-epoch "
+        f"loss diff {r['worst_loss_abs_diff']:.1e}, worst param rel err "
+        f"{r['worst_param_max_rel_err']:.1e} over the whole horizon "
+        f"(float-precision drift of the same algorithm, "
+        f"{r['wall_s'] / 60:.0f} min wall)."
+    )
 
 
 def _rows_from_matrix(epochs: int):
@@ -336,6 +372,36 @@ def _bench_matrix_sections() -> list[str]:
                 f"{c['tokens_per_s']:,}", c["bubble_analytic"],
                 c["bubble_measured"],
                 c.get("bubble_overhead_adjusted", "-"),
+            ]))
+        out += ["", r.get("note", ""), ""]
+
+    sc = [r for r in rows if r.get("id", "").startswith("cnn_dp_scaling")
+          and "points" in r]
+    if sc:
+        r = sc[-1]
+        out += [
+            "## Data-parallel scaling shape - "
+            f"{r['devices']}-device {r['platform']} mesh, "
+            f"{r['host_cores']} host core(s)",
+            "",
+            "The reference's Table 1 sweep (fixed 50k-row dataset, more "
+            "workers) re-run on the virtual mesh: fixed total work, mesh "
+            "size n swept, per-epoch (unfused) path so the sync phase is "
+            "attributable (`train/measure.py measure_dp_scaling`). On "
+            "shared host cores ideal wall-clock is FLAT in n, so "
+            "`overhead vs n=1` isolates the parallelization + sync cost "
+            "the reference pays 375 s -> 1642 s for (BASELINE.md "
+            "Table 1); real n-chip wall-clock divides by n modulo this "
+            "curve.",
+            "",
+            fmt_row(["mesh n", "train+sync s", "sync s", "sync %",
+                     "overhead vs n=1"]),
+            fmt_row(["---"] * 5),
+        ]
+        for c in r["points"]:
+            out.append(fmt_row([
+                c["n"], c["train_s"], c["sync_phase_s"],
+                f"{100 * c['sync_frac']:.2f}%", c["overhead_vs_n1"],
             ]))
         out += ["", r.get("note", ""), ""]
     return out
