@@ -23,43 +23,41 @@ which `ops/flash.py` wrapped through round 3? Two reasons, both structural:
 
 Design (per the Pallas TPU guide):
 - Layout: the public entry takes this framework's (B, S, H, D) convention,
-  collapses to (B*H, S, D), and grids over (B*H, blocks). Head dim D stays
-  the minor-most axis for MXU-friendly dots.
-- The full per-(b,h) K and V live VMEM-resident across a q-block's inner
-  loop (constant index_map over the sequence grid axis), so the inner loop
-  does no per-iteration HBM traffic. At bf16 that is 2*S*D*2 bytes per
-  (b,h) - 0.5 MB at S=2048, 2 MB at S=8192; beyond ~S=16k use sequence
-  parallelism (`parallel/ring.py`), which is the mesh-level answer anyway.
-- **Causal work skipping is exact, not masked-away**: the inner k-loop bound
-  is computed from the q-block's grid index (`lax.fori_loop` with a traced
-  bound, the same pattern the library kernel uses at
-  `flash_attention.py:363`), so a causal forward does S(S+bk)/2 work, not
-  S^2. The diagonal blocks mask with global row/col indices.
+  collapses to (B*H, S, D), and grids over (B*H, outer blocks, inner
+  blocks). Head dim D stays the minor-most axis for MXU-friendly dots.
+- **Every kernel is a 3-D grid with VMEM scratch accumulators** (the
+  r4 restructure; previously the inner dimension was an in-kernel
+  `fori_loop` over slices of full-length VMEM-resident operands, which
+  tied VMEM use to S and hid the inner DMAs from the compiler's
+  double-buffering). The inner grid axis is "arbitrary" (sequential);
+  the carried state (softmax recurrence m/l/acc in the forward, dq / dkv
+  partial sums in the backward) lives in VMEM scratch, initialized at
+  the first inner step and written to the output block at the last.
+  VMEM is now bounded by BLOCK sizes only - independent of S.
+- **Causal skipping**: an inner step whose block is entirely on the wrong
+  side of the diagonal skips its compute under `pl.when` and clamps its
+  index_map to a block that is already resident - the diagonal block in
+  fwd/dq (skips are the inner loop's suffix) and block 0 in dkv (skips
+  are the prefix) - so skipped steps issue no DMA. The diagonal blocks
+  mask with global row/col indices.
 - Numerics: dots accumulate in f32 (`preferred_element_type`); the softmax
   recurrence (running max m, denominator l, numerator acc) is carried in
-  f32; p / ds are cast back to the input dtype for the second MXU dot
-  (standard flash practice - keeps the MXU on the bf16 fast path). The
-  forward saves one f32 logsumexp per row (lse = m + log l) as the only
-  softmax residual.
-- Backward is the standard two-kernel flash recompute split:
-  dq-kernel grids over q blocks (inner loop over k), dkv-kernel grids over
-  k blocks (inner loop over q, starting at the diagonal under causality).
-  delta = rowsum(do * o) is precomputed in XLA (one fused elementwise
-  pass) and streamed in. Each kernel re-forms p from q/k/lse, so the
-  (S, S) score matrix never exists anywhere in fwd or bwd.
+  f32 scratch; p / ds are cast back to the input dtype for the second MXU
+  dot (standard flash practice - keeps the MXU on the bf16 fast path).
+  The forward saves one f32 logsumexp per row (lse = m + log l) as the
+  only softmax residual.
+- Backward is the standard two-kernel flash recompute split: the
+  dq-kernel's outer blocks are q (inner: k), the dkv-kernel's outer
+  blocks are k (inner: q). delta = rowsum(do * o) is precomputed in XLA
+  (one fused elementwise pass) and streamed in. Each kernel re-forms p
+  from q/k/lse, so the (S, S) score matrix never exists anywhere.
 - Per-row residuals (lse, delta) cross the pallas_call boundary
   lane-replicated to (..., 128): Mosaic requires the last two dims of
   every block to be (8, 128)-tileable or full, so a (bq,) row vector is
   not a legal block - it lives as a (bq, 128) broadcast tile (the
   library kernel's MIN_BLOCK_SIZE layout) and kernels read [:, :1].
   Between fwd and bwd only the slim (bh, s) lse is saved; _bwd_call
-  re-broadcasts once in XLA. Known cost: the dkv kernel holds both
-  residuals full-length in VMEM (2 * S * 128 * 4 bytes - 2 MB at
-  S=2048, 8 MB at S=8192), which bounds the practical single-device
-  backward at S ~= 6k; past that use sequence parallelism
-  (parallel/ring.py), or see the planned 3-D-grid bwd restructure
-  (grid over q-blocks instead of an in-kernel fori_loop) that blocks
-  the residuals per grid step.
+  re-broadcasts once in XLA.
 
 Reference parity: behaves as `parallel/ring.py attention(q, k, v,
 causal=...)` up to blockwise-softmax reassociation; `tests/test_flash_pallas.py`
@@ -142,69 +140,99 @@ def _causal_mask(s, qi, bq, kj, bk):
 # ---------------------------------------------------------------- forward
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, n_k,
-                scale, causal):
-    qi = pl.program_id(1)
-    q = q_ref[0]  # (bq, D) input dtype
+def _on_diag_or_below(i, bq, j, bk):
+    """True when q block i contains any row >= the first col of k block j
+    (the block pair carries causal work: max q row (i+1)*bq-1 >= j*bk)."""
+    return (i + 1) * bq > j * bk
 
-    def body(kj, carry):
-        m, l, acc = carry
-        k_blk = k_ref[0, pl.ds(kj * bk, bk), :]
-        v_blk = v_ref[0, pl.ds(kj * bk, bk), :]
-        s = _dot(q, k_blk, _NT) * scale  # (bq, bk) f32
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc,
+                *, bq, bk, scale, causal):
+    qi, kj = pl.program_id(1), pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_sc[...] = jnp.full(m_sc.shape, _NEG_BIG, m_sc.dtype)
+        l_sc[...] = jnp.zeros(l_sc.shape, l_sc.dtype)
+        acc_sc[...] = jnp.zeros(acc_sc.shape, acc_sc.dtype)
+
+    def _step():
+        q = q_ref[0]  # (bq, D) input dtype
+        s = _dot(q, k_ref[0], _NT) * scale  # (bq, bk) f32
         if causal:
             s = _causal_mask(s, qi, bq, kj, bk)
+        m = m_sc[...][:, :1]  # (bq, 1) from the lane-replicated scratch
         m_new = jnp.maximum(m, s.max(-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
-        l = l * alpha + p.sum(-1, keepdims=True)
-        acc = acc * alpha + _dot(p.astype(v_blk.dtype), v_blk, _NN)
-        return m_new, l, acc
+        l_new = l_sc[...][:, :1] * alpha + p.sum(-1, keepdims=True)
+        m_sc[...] = jnp.broadcast_to(m_new, m_sc.shape)
+        l_sc[...] = jnp.broadcast_to(l_new, l_sc.shape)
+        acc_sc[...] = acc_sc[...] * alpha + _dot(
+            p.astype(v_ref.dtype), v_ref[0], _NN
+        )
 
-    d = q_ref.shape[-1]
-    m0 = jnp.full((bq, 1), _NEG_BIG, jnp.float32)
-    l0 = jnp.zeros((bq, 1), jnp.float32)
-    acc0 = jnp.zeros((bq, d), jnp.float32)
-    # causal: q block qi only attends k rows < (qi+1)*bq - skip the rest
-    # entirely (traced loop bound), don't mask them away
-    n_iter = jnp.minimum((qi * bq + bq + bk - 1) // bk, n_k) if causal else n_k
-    m, l, acc = jax.lax.fori_loop(0, n_iter, body, (m0, l0, acc0))
-    l = jnp.maximum(l, 1e-30)
-    o_ref[0] = (acc / l).astype(o_ref.dtype)
-    # lane-replicated (bq, 128) write: Mosaic requires the last two block
-    # dims to be (8, 128)-tileable, so per-row residuals live broadcast
-    # across the lane axis (the library kernel's MIN_BLOCK_SIZE layout);
-    # the caller slices lane 0 back off
-    lse_ref[0] = jnp.broadcast_to(m + jnp.log(l), (bq, _LANES))
+    if causal:
+        pl.when(_on_diag_or_below(qi, bq, kj, bk))(_step)
+    else:
+        _step()
+
+    @pl.when(kj == n_k - 1)
+    def _finish():
+        l = jnp.maximum(l_sc[...][:, :1], 1e-30)
+        o_ref[0] = (acc_sc[...] / l).astype(o_ref.dtype)
+        # lane-replicated (bq, 128) write: Mosaic requires the last two
+        # block dims to be (8, 128)-tileable, so per-row residuals live
+        # broadcast across the lane axis (the library kernel's
+        # MIN_BLOCK_SIZE layout); the caller slices lane 0 back off
+        lse_ref[0] = jnp.broadcast_to(
+            m_sc[...][:, :1] + jnp.log(l), lse_ref.shape[1:]
+        )
 
 
 def _fwd_call(q, k, v, *, blocks, scale, causal, interpret):
     bh, s, d = q.shape
     bq, bk = blocks.bq, blocks.bk
     kernel = functools.partial(
-        _fwd_kernel, bq=bq, bk=bk, n_k=s // bk, scale=scale, causal=causal
+        _fwd_kernel, bq=bq, bk=bk, scale=scale, causal=causal
     )
+
+    def k_index(b, i, j):
+        if causal:
+            # skipped steps are the SUFFIX of the inner loop (k blocks
+            # strictly above the diagonal): re-point at the diagonal
+            # block, which the last valid step left resident - no new DMA
+            j = jnp.minimum(j, ((i + 1) * bq - 1) // bk)
+        return (b, j, 0)
+
     o, lse = pl.pallas_call(
         kernel,
-        grid=(bh, s // bq),
+        grid=(bh, s // bq, s // bk),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0),
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0),
-                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), k_index, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), k_index, memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0),
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq, _LANES), lambda b, i: (b, i, 0),
+            pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
             _struct((bh, s, d), q.dtype, q, k, v),
             _struct((bh, s, _LANES), jnp.float32, q, k, v),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, _LANES), jnp.float32),  # running max m
+            pltpu.VMEM((bq, _LANES), jnp.float32),  # running denom l
+            pltpu.VMEM((bq, d), jnp.float32),       # output accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         interpret=interpret,
     )(q, k, v)
     # keep only lane 0 as the residual: between fwd and bwd the saved lse
@@ -215,61 +243,73 @@ def _fwd_call(q, k, v, *, blocks, scale, causal, interpret):
 # --------------------------------------------------------------- backward
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dq_ref, *,
-               bq, bk, n_k, scale, causal):
-    qi = pl.program_id(1)
-    q = q_ref[0]
-    do = do_ref[0]
-    lse = lse_ref[0][:, :1]  # (bq, 1) f32 from the lane-replicated block
-    dlt = dlt_ref[0][:, :1]
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dq_ref,
+               dq_sc, *, bq, bk, scale, causal):
+    qi, kj = pl.program_id(1), pl.program_id(2)
+    n_k = pl.num_programs(2)
 
-    def body(kj, dq_acc):
-        k_blk = k_ref[0, pl.ds(kj * bk, bk), :]
-        v_blk = v_ref[0, pl.ds(kj * bk, bk), :]
+    @pl.when(kj == 0)
+    def _init():
+        dq_sc[...] = jnp.zeros(dq_sc.shape, dq_sc.dtype)
+
+    def _step():
+        q = q_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0][:, :1]  # (bq, 1) f32, lane-replicated block
+        dlt = dlt_ref[0][:, :1]
+        k_blk = k_ref[0]
         s = _dot(q, k_blk, _NT) * scale
         if causal:
             s = _causal_mask(s, qi, bq, kj, bk)
         p = jnp.exp(s - lse)  # (bq, bk) f32
-        dp = _dot(do, v_blk, _NT)
+        dp = _dot(do, v_ref[0], _NT)
         ds = p * (dp - dlt) * scale
-        return dq_acc + _dot(ds.astype(k_blk.dtype), k_blk, _NN)
+        dq_sc[...] = dq_sc[...] + _dot(ds.astype(k_blk.dtype), k_blk, _NN)
 
-    d = q_ref.shape[-1]
-    n_iter = jnp.minimum((qi * bq + bq + bk - 1) // bk, n_k) if causal else n_k
-    dq = jax.lax.fori_loop(0, n_iter, body, jnp.zeros((bq, d), jnp.float32))
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+    if causal:
+        pl.when(_on_diag_or_below(qi, bq, kj, bk))(_step)
+    else:
+        _step()
+
+    @pl.when(kj == n_k - 1)
+    def _finish():
+        dq_ref[0] = dq_sc[...].astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref,
-                dk_ref, dv_ref, *, bq, bk, n_q, scale, causal):
-    kj = pl.program_id(1)
-    k = k_ref[0]  # (bk, D)
-    v = v_ref[0]
+                dk_ref, dv_ref, dk_sc, dv_sc, *, bq, bk, scale, causal):
+    kj, qi = pl.program_id(1), pl.program_id(2)
+    n_q = pl.num_programs(2)
 
-    def body(qi, carry):
-        dk_acc, dv_acc = carry
-        q_blk = q_ref[0, pl.ds(qi * bq, bq), :]
-        do_blk = do_ref[0, pl.ds(qi * bq, bq), :]
-        lse_q = lse_ref[0, pl.ds(qi * bq, bq), :][:, :1]
-        dlt_q = dlt_ref[0, pl.ds(qi * bq, bq), :][:, :1]
+    @pl.when(qi == 0)
+    def _init():
+        dk_sc[...] = jnp.zeros(dk_sc.shape, dk_sc.dtype)
+        dv_sc[...] = jnp.zeros(dv_sc.shape, dv_sc.dtype)
+
+    def _step():
+        k = k_ref[0]  # (bk, D)
+        q_blk = q_ref[0]
+        do_blk = do_ref[0]
+        lse_q = lse_ref[0][:, :1]
+        dlt_q = dlt_ref[0][:, :1]
         s = _dot(q_blk, k, _NT) * scale  # (bq, bk)
         if causal:
             s = _causal_mask(s, qi, bq, kj, bk)
         p = jnp.exp(s - lse_q)
-        dv_acc = dv_acc + _dot(p.astype(do_blk.dtype), do_blk, _TN)
-        dp = _dot(do_blk, v, _NT)
+        dv_sc[...] = dv_sc[...] + _dot(p.astype(do_blk.dtype), do_blk, _TN)
+        dp = _dot(do_blk, v_ref[0], _NT)
         ds = p * (dp - dlt_q) * scale
-        dk_acc = dk_acc + _dot(ds.astype(q_blk.dtype), q_blk, _TN)
-        return dk_acc, dv_acc
+        dk_sc[...] = dk_sc[...] + _dot(ds.astype(q_blk.dtype), q_blk, _TN)
 
-    d = q_ref.shape[-1]
-    z = jnp.zeros((bk, d), jnp.float32)
-    # causal: k block kj only receives gradient from q rows >= kj*bk -
-    # start the loop at the diagonal
-    start = (kj * bk) // bq if causal else 0
-    dk, dv = jax.lax.fori_loop(start, n_q, body, (z, z))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    if causal:
+        pl.when(_on_diag_or_below(qi, bq, kj, bk))(_step)
+    else:
+        _step()
+
+    @pl.when(qi == n_q - 1)
+    def _finish():
+        dk_ref[0] = dk_sc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_sc[...].astype(dv_ref.dtype)
 
 
 def _bwd_call(q, k, v, o, lse, do, *, blocks, scale, causal, interpret):
@@ -282,54 +322,82 @@ def _bwd_call(q, k, v, o, lse, do, *, blocks, scale, causal, interpret):
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     delta_l = jnp.broadcast_to(delta[..., None], (bh, s, _LANES))
     lse_l = jnp.broadcast_to(lse[..., None], (bh, s, _LANES))
+    arb = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"),
+    )
 
-    full = lambda last: pl.BlockSpec((1, s, last), lambda b, i: (b, 0, 0),
-                                     memory_space=pltpu.VMEM)
+    # dq: grid (bh, q blocks, k inner); k/v/do follow their axes, the
+    # causally-skipped inner k blocks clamp to 0 (already resident)
     bq, bk = blocks.bq_dq, blocks.bk_dq
+
+    def k_index_dq(b, i, j):
+        if causal:
+            # suffix skips: clamp to the resident diagonal block (see
+            # _fwd_call's k_index)
+            j = jnp.minimum(j, ((i + 1) * bq - 1) // bk)
+        return (b, j, 0)
+
+    q_index_dq = lambda b, i, j: (b, i, 0)
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, bq=bq, bk=bk, n_k=s // bk,
-                          scale=scale, causal=causal),
-        grid=(bh, s // bq),
+        functools.partial(_dq_kernel, bq=bq, bk=bk, scale=scale,
+                          causal=causal),
+        grid=(bh, s // bq, s // bk),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0),
+            pl.BlockSpec((1, bq, d), q_index_dq, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), k_index_dq, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), k_index_dq, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, d), q_index_dq, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, _LANES), q_index_dq,
                          memory_space=pltpu.VMEM),
-            full(d), full(d),
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq, _LANES), lambda b, i: (b, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq, _LANES), lambda b, i: (b, i, 0),
+            pl.BlockSpec((1, bq, _LANES), q_index_dq,
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0),
+        out_specs=pl.BlockSpec((1, bq, d), q_index_dq,
                                memory_space=pltpu.VMEM),
         out_shape=_struct((bh, s, d), q.dtype, q, k, v, o, do),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=arb,
         interpret=interpret,
     )(q, k, v, do, lse_l, delta_l)
 
+    # dkv: grid (bh, k blocks, q inner); under causality q blocks strictly
+    # above the diagonal clamp to block 0 (the library's scheme: one
+    # redundant-but-resident DMA instead of a fresh one per skipped step)
     bq, bk = blocks.bq_dkv, blocks.bk_dkv
+
+    def q_index_dkv(b, j, i):
+        if causal:
+            i = jax.lax.select(_on_diag_or_below(i, bq, j, bk), i, 0)
+        return (b, i, 0)
+
+    k_index_dkv = lambda b, j, i: (b, j, 0)
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, bq=bq, bk=bk, n_q=s // bq,
-                          scale=scale, causal=causal),
-        grid=(bh, s // bk),
+        functools.partial(_dkv_kernel, bq=bq, bk=bk, scale=scale,
+                          causal=causal),
+        grid=(bh, s // bk, s // bq),
         in_specs=[
-            full(d),
-            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0),
+            pl.BlockSpec((1, bq, d), q_index_dkv, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), k_index_dkv, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), k_index_dkv, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, d), q_index_dkv, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, _LANES), q_index_dkv,
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0),
+            pl.BlockSpec((1, bq, _LANES), q_index_dkv,
                          memory_space=pltpu.VMEM),
-            full(d), full(_LANES), full(_LANES),
         ],
         out_specs=[
-            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0),
-                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), k_index_dkv, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), k_index_dkv, memory_space=pltpu.VMEM),
         ],
         out_shape=[
             _struct((bh, s, d), k.dtype, q, k, v, o, do),
             _struct((bh, s, d), v.dtype, q, k, v, o, do),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        compiler_params=arb,
         interpret=interpret,
     )(q, k, v, do, lse_l, delta_l)
     return dq, dk, dv

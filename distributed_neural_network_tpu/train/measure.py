@@ -172,7 +172,7 @@ def measure_dp_scaling(
     points = []
     for n in ns:
         if n > jax.device_count():
-            break
+            continue  # skip just this point; ns need not be sorted
         r = measure_dp_training(
             nb_proc=n, batch_size=batch_size, epochs=epochs,
             data="synthetic", synthetic_size=synthetic_size, fused=False,

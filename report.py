@@ -234,9 +234,11 @@ def _oracle_fullscale_line() -> str:
     try:
         with open(path) as f:
             r = json.load(f)
-    except (OSError, json.JSONDecodeError):
+        s = r["scale"]
+        s["epochs"], s["rows"], s["workers"]
+        r["worst_loss_abs_diff"], r["worst_param_max_rel_err"], r["wall_s"]
+    except (OSError, json.JSONDecodeError, KeyError, TypeError):
         return pending
-    s = r["scale"]
     # never render a smoke-scale or failed artifact as the full-scale
     # verification claim
     full = (s["epochs"] >= 25 and s["rows"] >= 50000 and s["workers"] >= 8)
