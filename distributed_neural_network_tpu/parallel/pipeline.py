@@ -69,13 +69,16 @@ def create_pp_mesh(dp: int, pp: int, tp: int = 1) -> Mesh:
     return Mesh(arr, (DATA_AXIS, PIPE_AXIS, TP_AXIS))
 
 
-def pp_param_specs(cfg: tfm.TransformerConfig, tp_axis: str | None = None):
+def pp_param_specs(cfg: tfm.TransformerConfig, tp_axis: str | None = None,
+                   ep_axis: str | None = None):
     """param_specs with every layer-stack leaf stage-sharded over 'pipe'.
 
     The layer dimension (leading axis of every `layers` leaf) is split
     across stages; embed/head/final-norm stay replicated over 'pipe'.
+    ep_axis additionally shards the expert dimension of MoE leaves (the
+    composition is orthogonal: 'pipe' splits dim 0, experts dim 1).
     """
-    specs = tfm.param_specs(cfg, tp_axis=tp_axis)
+    specs = tfm.param_specs(cfg, tp_axis=tp_axis, ep_axis=ep_axis)
 
     def stage_shard(spec: P) -> P:
         rest = tuple(spec)[1:]  # drop the layer-dim entry (None) if present
@@ -94,9 +97,11 @@ def pipeline_lm_loss(
     pipe_axis: str = PIPE_AXIS,
     n_microbatches: int,
     tp_axis: str | None = None,
+    ep_axis: str | None = None,
     sync_axes=(),
     loss_chunks: int = 0,
     interleave: int = 1,
+    aux_weight: float = 0.01,
 ):
     """Mean next-token cross-entropy via the microbatch pipeline schedule.
 
@@ -105,6 +110,18 @@ def pipeline_lm_loss(
     Returns the replicated global mean loss (psum over pipe + sync_axes).
     loss_chunks: CE sequence-chunk count (0 = auto by the 64 MB logits
     budget; must divide S).
+
+    MoE blocks (cfg.n_experts) route through the same schedule: experts
+    shard over `ep_axis` (the data axis, GShard convention - orthogonal
+    to the 'pipe' split of the layer dim), per-tick capacity is sized
+    from the MICROBATCH token count (mb * S; the mesh path sizes from the
+    whole local batch, so drop behavior differs at equal
+    capacity_factor), and the Switch load-balancing aux is accumulated
+    only over VALID ticks - pipeline-bubble ticks compute on garbage and
+    their aux is masked out exactly like their outputs are discarded.
+    The reported aux is the mean over (layers x microbatches), pmean'd
+    over sync_axes, weighted by aux_weight into the loss (lm_loss's
+    convention).
 
     interleave = v > 1 runs the circular (virtual-stage / Megatron
     "interleaved") schedule: each device holds v round-robin layer chunks
@@ -133,8 +150,19 @@ def pipeline_lm_loss(
     tgt_mb = targets.reshape(m, mb, s)
     pe = tfm._sinusoid_pe(jnp.arange(s), cfg.d_model, dt)[None]
 
+    if cfg.n_experts:
+        from .moe import expert_capacity
+
+        cap = expert_capacity(
+            mb * s, cfg.n_experts, cfg.moe_top_k, cfg.moe_capacity_factor
+        )
+    else:
+        cap = None
+
     def chunk_blocks(x, lap):
-        """Apply this device's layer chunk for the given lap (0 when v=1)."""
+        """Apply this device's layer chunk for the given lap (0 when v=1).
+        Returns (x, aux_sum) - the MoE aux summed over the chunk's layers
+        (0.0 dense)."""
         layers = params["layers"]
         if v > 1:
             # local leaves are (v, L/(v*P), ...) stacked lap-major
@@ -150,19 +178,21 @@ def pipeline_lm_loss(
             )
 
         def block(x, lp):
-            x, _ = tfm.transformer_block(
+            x, aux = tfm.transformer_block(
                 x,
                 lp,
                 cfg,
                 attend=lambda q, k, v: tfm.attention(q, k, v, causal=True),
                 tp_axis=tp_axis,
+                ep_axis=ep_axis,
+                capacity=cap,
             )
-            return x, None
+            return x, aux
 
         if cfg.remat:
             block = jax.checkpoint(block)
-        x, _ = jax.lax.scan(block, x, layers)
-        return x
+        x, auxes = jax.lax.scan(block, x, layers)
+        return x, jnp.sum(auxes)
 
     perm = [(i, (i + 1) % n_pipe) for i in range(n_pipe)]
 
@@ -181,11 +211,15 @@ def pipeline_lm_loss(
         # device 0 feeds fresh embeds at its lap-0 ticks (r < P); later
         # laps arrive by rotation from the last device
         x = jnp.where((stage == 0) & (r < n_pipe), fresh, x_in)
-        out = chunk_blocks(x, lap)
+        out, aux = chunk_blocks(x, lap)
         x_out = jax.lax.ppermute(out, pipe_axis, perm)
+        # bubble ticks compute on garbage: mask their aux exactly like
+        # their outputs are discarded (valid work units on this device
+        # are u in [0, v*m))
+        aux = jnp.where((u >= 0) & (u < v * m), aux, 0.0)
         # emit the pre-rotation output: on the last stage at its lap-(v-1)
         # ticks it is the finished hidden state of a microbatch
-        return x_out, out
+        return x_out, (out, aux)
 
     def vary(x):
         # activations vary over the pipe axis (stage-dependent) and whatever
@@ -194,7 +228,9 @@ def pipeline_lm_loss(
         return vary_like(x, tokens, extra=(pipe_axis,))
 
     x0 = vary(jnp.zeros((mb, s, cfg.d_model), dt))
-    _, outs = jax.lax.scan(tick, x0, jnp.arange(v * m + n_pipe - 1))
+    _, (outs, aux_ticks) = jax.lax.scan(
+        tick, x0, jnp.arange(v * m + n_pipe - 1)
+    )
 
     # exit blocks: microbatch j = g*P + mm finishes its last lap on the
     # last stage at tick g*v*P + mm + v*P - 1 (garbage on other stages;
@@ -261,7 +297,31 @@ def pipeline_lm_loss(
     n_tokens = tokens.size
     for a in sync_axes:
         n_tokens = n_tokens * jax.lax.axis_size(a)
-    return total / jnp.float32(n_tokens)
+    loss = total / jnp.float32(n_tokens)
+    if cfg.n_experts:
+        # masked per-tick aux sums -> mean over (layers x microbatches),
+        # pmean over the data shards: psum over pipe collects every
+        # stage/lap unit (m*v*P units of L/(v*P) layers = m*L layer
+        # instances per data shard)
+        aux_total = jax.lax.psum(jnp.sum(aux_ticks), axes)
+        n_aux = m * cfg.n_layers
+        for a in sync_axes:
+            n_aux = n_aux * jax.lax.axis_size(a)
+        loss = loss + aux_weight * aux_total / jnp.float32(n_aux)
+    return loss
+
+
+def pp_wiring(cfg: tfm.TransformerConfig, mesh: Mesh):
+    """(tp, ep, sync_axes, specs) for a pipeline mesh - the single source
+    of the axis/spec derivation shared by make_pp_train_step,
+    make_pp_eval_fn, and shard_pp_params (train/eval/placement must
+    agree or shardings silently desynchronize)."""
+    from ..train.lm import _ep_axis
+
+    tp = TP_AXIS if mesh.shape.get(TP_AXIS, 1) > 1 else None
+    ep = _ep_axis(cfg, mesh)
+    sync = tuple(a for a in (DATA_AXIS,) if a in mesh.axis_names)
+    return tp, ep, sync, pp_param_specs(cfg, tp_axis=tp, ep_axis=ep)
 
 
 def pp_optimizer_state_specs(optimizer: str, specs):
@@ -381,7 +441,9 @@ def make_pp_train_step(
     pipe-sharded layer leaves keep their layout), or 'zero'/'zero-adam'
     (ZeRO-1: per-leaf flat state sharded over the data axis per
     stage-local leaf - init with `init_pp_zero_state`, specs from
-    `pp_optimizer_state_specs`; not with tp).
+    `pp_optimizer_state_specs`; not with tp, and not with expert
+    parallelism - expert leaves vary over exactly the data axis the
+    per-leaf layout shards state over).
     """
     pp = mesh.shape.get(PIPE_AXIS, 1)
     v = interleave
@@ -411,25 +473,24 @@ def make_pp_train_step(
             "flat per-leaf layout does not track - use 'sgd'/'adam' with "
             "tp (matches the dp x sp x tp mesh path's rule)"
         )
-    if cfg.n_experts:
-        raise ValueError(
-            "pipeline parallelism currently supports dense blocks only "
-            f"(cfg.n_experts={cfg.n_experts}); use the dp/ep path in train/lm.py "
-            "for MoE models"
-        )
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
-    tp = TP_AXIS if mesh.shape.get(TP_AXIS, 1) > 1 else None
-    sync = tuple(a for a in (DATA_AXIS,) if a in mesh.axis_names)
-    specs = pp_param_specs(cfg, tp_axis=tp)
+    tp, ep, sync, specs = pp_wiring(cfg, mesh)
+    if optimizer.startswith("zero") and ep:
+        raise ValueError(
+            f"optimizer={optimizer!r} under --pp cannot combine with "
+            "expert parallelism: expert-sharded leaves vary over the data "
+            "axis, which is exactly the axis the per-leaf ZeRO layout "
+            "shards state over (same rule as the mesh path)"
+        )
     data_spec = P(DATA_AXIS)
 
     def fwd_bwd_one(params, tokens, targets):
         return jax.value_and_grad(pipeline_lm_loss)(
             params, tokens, targets, cfg,
             pipe_axis=PIPE_AXIS, n_microbatches=n_microbatches,
-            tp_axis=tp, sync_axes=sync, loss_chunks=loss_chunks,
-            interleave=v,
+            tp_axis=tp, ep_axis=ep, sync_axes=sync,
+            loss_chunks=loss_chunks, interleave=v,
         )
 
     from ..ops.schedule import accumulate_fwd_bwd
@@ -519,15 +580,13 @@ def make_pp_eval_fn(
     the same microbatch schedule as training, no grad - the held-out
     eval for pipeline runs. Lives here so the CLI never re-derives the
     pipeline's spec/axis wiring (it must match `make_pp_train_step`)."""
-    tp = TP_AXIS if mesh.shape.get(TP_AXIS, 1) > 1 else None
-    sync = tuple(a for a in (DATA_AXIS,) if a in mesh.axis_names)
-    specs = pp_param_specs(cfg, tp_axis=tp)
+    tp, ep, sync, specs = pp_wiring(cfg, mesh)
     data_spec = P(DATA_AXIS)
     return jax.jit(
         jax.shard_map(
             lambda p, tok, tgt: pipeline_lm_loss(
                 p, tok, tgt, cfg,
-                n_microbatches=n_microbatches, tp_axis=tp,
+                n_microbatches=n_microbatches, tp_axis=tp, ep_axis=ep,
                 sync_axes=sync, loss_chunks=loss_chunks,
                 interleave=interleave,
             ),
@@ -576,8 +635,7 @@ def shard_pp_params(params, cfg, mesh: Mesh, *, interleave: int = 1):
     interleave > 1 additionally permutes the layer axis into the
     round-robin chunk layout the interleaved schedule indexes
     (`interleave_layer_order`)."""
-    tp = TP_AXIS if mesh.shape.get(TP_AXIS, 1) > 1 else None
-    specs = pp_param_specs(cfg, tp_axis=tp)
+    specs = pp_wiring(cfg, mesh)[3]
     if interleave > 1:
         pp = mesh.shape.get(PIPE_AXIS, 1)
         order = interleave_layer_order(cfg.n_layers, pp, interleave)

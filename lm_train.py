@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -38,8 +39,9 @@ def main() -> int:
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel axis size")
     p.add_argument("--pp", type=int, default=1,
                    help="pipeline stages (uses the dp x pp x tp mesh; "
-                   "exclusive with --sp/--experts; zero optimizers "
-                   "compose with --dp, not --tp)")
+                   "exclusive with --sp; composes with --experts (experts "
+                   "shard over dp); zero optimizers compose with --dp, "
+                   "not --tp/--experts)")
     p.add_argument("--microbatches", type=int, default=2)
     p.add_argument(
         "--pp-interleave", type=int, default=1,
@@ -214,17 +216,19 @@ def main() -> int:
     params = tfm.init_params(jax.random.key(args.seed), cfg)
     pipe = args.pp > 1
     if pipe:
-        if args.sp > 1 or args.experts:
+        if args.sp > 1:
             raise SystemExit(
-                "--pp composes with --dp/--tp and any --optimizer "
-                "(zero/zero-adam shard state over dp per stage); "
-                "--sp/--experts run on the dp x sp x tp mesh (drop --pp)"
+                "--pp composes with --dp/--tp/--experts and any "
+                "--optimizer (zero/zero-adam shard state over dp per "
+                "stage; not with --experts or --tp); --sp runs on the "
+                "dp x sp x tp mesh (drop --pp)"
             )
-        if args.optimizer.startswith("zero") and args.tp > 1:
+        if args.optimizer.startswith("zero") and (
+                args.tp > 1 or args.experts):
             raise SystemExit(
                 "--pp with zero optimizers composes with --dp only "
-                "(tensor-sharded leaves are out of the per-leaf ZeRO "
-                "layout's scope, same rule as the mesh path)"
+                "(tensor- and expert-sharded leaves are out of the "
+                "per-leaf ZeRO layout's scope, same rule as the mesh path)"
             )
         mesh = ppl.create_pp_mesh(args.dp, args.pp, args.tp)
         params, specs = ppl.shard_pp_params(
@@ -424,7 +428,13 @@ def main() -> int:
                 in_specs=(specs, _P(lmtrain.DATA_AXIS, lmtrain.SEQ_AXIS),
                           _P(lmtrain.DATA_AXIS, lmtrain.SEQ_AXIS)),
                 out_specs=_P(),
-                check_vma=args.attn != "flash",
+                # the own flash kernels are vma-typed (r4); only the
+                # library kernel (lib impl, single-device-gated) needs
+                # the checker off
+                check_vma=not (
+                    args.attn == "flash"
+                    and os.environ.get("DNN_TPU_FLASH_IMPL") == "lib"
+                ),
             )
         )
     print(

@@ -1,0 +1,28 @@
+#!/bin/bash
+# Probe the axon chip in a loop; on the first healthy probe, run the full
+# measurement session. NEVER kills a probe - a wedged claim makes the
+# probe itself block 30-50 min before erroring, which IS the polling
+# interval (killing a claimer is what wedges the chip; r4 post-mortem).
+# Run detached:  setsid nohup bash tools/watch_and_measure.sh \
+#                    > watch_measure.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+attempt=0
+while true; do
+  attempt=$((attempt + 1))
+  echo "[watch] probe attempt ${attempt} at $(date -u +%H:%M:%S)"
+  if python -c "
+import time, jax, jax.numpy as jnp
+t0 = time.time()
+x = jnp.ones((512, 512), jnp.bfloat16)
+v = float((x @ x).sum())
+print('probe ok: value', v, 'in', round(time.time() - t0, 1), 's', flush=True)
+"; then
+    echo "[watch] chip healthy - starting measure_all at $(date -u +%H:%M:%S)"
+    python tools/measure_all.py
+    echo "[watch] measure_all done rc=$? at $(date -u +%H:%M:%S)"
+    break
+  fi
+  echo "[watch] probe failed; sleeping 180s before the next attempt"
+  sleep 180
+done
