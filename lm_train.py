@@ -224,11 +224,13 @@ def main() -> int:
                 "dp x sp x tp mesh (drop --pp)"
             )
         if args.optimizer.startswith("zero") and (
-                args.tp > 1 or args.experts):
+                args.tp > 1 or (args.experts and args.dp > 1)):
             raise SystemExit(
                 "--pp with zero optimizers composes with --dp only "
                 "(tensor- and expert-sharded leaves are out of the "
-                "per-leaf ZeRO layout's scope, same rule as the mesh path)"
+                "per-leaf ZeRO layout's scope, same rule as the mesh "
+                "path; --experts with --dp 1 keeps experts replicated "
+                "and is fine)"
             )
         mesh = ppl.create_pp_mesh(args.dp, args.pp, args.tp)
         params, specs = ppl.shard_pp_params(

@@ -81,16 +81,28 @@ def main() -> int:
         # for the rest of the session). Device identity comes from the
         # measured rows themselves.
         proc_rows, bs_rows = _rows_from_matrix(epochs)
-        if not proc_rows:
-            print("no 25-epoch cnn rows in BENCH_MATRIX.json; run "
-                  "`python bench.py` first", file=sys.stderr)
-            return 1
-        ndev = proc_rows[0].get("devices", 1)
-        bs_devices = bs_rows[0]["devices"] if bs_rows else min(4, ndev)
-        device_desc = (
-            f"{ndev}x {proc_rows[0].get('device_kind', 'unknown device')} "
-            f"({proc_rows[0].get('platform', '?')}, from matrix rows)"
-        )
+        any_row = (proc_rows or bs_rows or [None])[0]
+        if any_row is None:
+            # still render: the LM/bubble/scaling sections and the
+            # accuracy-parity wording carry their own evidence, and the
+            # CNN tables show honest pending cells rather than the whole
+            # report going missing when the chip was unavailable
+            print("note: no measured 25-epoch cnn rows in "
+                  "BENCH_MATRIX.json; CNN tables render as pending",
+                  file=sys.stderr)
+            ndev, bs_devices = 1, 1
+            device_desc = ("device pending (no measured cnn rows in "
+                           "BENCH_MATRIX.json)")
+        else:
+            # device identity / data source come from whichever sweep has
+            # measured rows (the headline bs16 row may be the missing one)
+            ndev = any_row.get("devices", 1)
+            bs_devices = bs_rows[0]["devices"] if bs_rows else min(4, ndev)
+            device_desc = (
+                f"{ndev}x "
+                f"{any_row.get('device_kind', 'unknown device')} "
+                f"({any_row.get('platform', '?')}, from matrix rows)"
+            )
     else:
         import jax
 
@@ -113,7 +125,8 @@ def main() -> int:
             bs_rows.append(r)
             print(json.dumps(r), file=sys.stderr)
 
-    src = proc_rows[0]["source"]
+    src_row = (proc_rows or bs_rows or [{}])[0]
+    src = src_row.get("source", "synthetic")
     lines = [
         "# REPORT - measured results vs the reference",
         "",
@@ -162,6 +175,10 @@ def main() -> int:
             r["devices"], f"{r['val_acc']:.2f}", f"{r['train_s']:.2f}",
             *ref_cells(r),
         ]))
+    if not proc_rows:
+        lines.append(fmt_row(
+            ["*pending measurement (chip unavailable)*"] + ["-"] * 5
+        ))
     lines += [
         "",
         f"## Table 2 - batch-size sweep ({bs_devices} device"
@@ -176,6 +193,10 @@ def main() -> int:
             r["batch_size"], f"{r['val_acc']:.2f}", f"{r['train_s']:.2f}",
             *ref_cells(r),
         ]))
+    if not bs_rows:
+        lines.append(fmt_row(
+            ["*pending measurement (chip unavailable)*"] + ["-"] * 5
+        ))
     lines += [
         "",
         "Notes: the reference's N procs = 1 idle parent + N-1 workers over "
