@@ -161,6 +161,16 @@ def _rows(epochs: int) -> list[dict]:
             "args": {"attn": "flash", "dtype": "bfloat16", "steps": 20},
         },
         {
+            # library-kernel A/B at the flagship shape: the default row
+            # above runs the OWN kernels (r4), this one pins the library
+            # baseline so the comparison is a matrix fact, not a memory
+            "id": "lm_flashlib_d512_L8_seq2048_bf16",
+            "kind": "lm",
+            "est_s": 600,
+            "env": {"DNN_TPU_FLASH_IMPL": "lib"},
+            "args": {"attn": "flash", "dtype": "bfloat16", "steps": 20},
+        },
+        {
             # remat: the XLA path materializes (B, H, S, S) scores, which
             # OOMs a 16 GB v5e at these shapes without recompute (measured
             # r3); flash needs no remat - that contrast is the point
@@ -321,6 +331,15 @@ def _write_matrix(state: dict) -> None:
     os.replace(MATRIX_PATH + ".tmp", MATRIX_PATH)
 
 
+def _cpu_pinned(spec: dict) -> bool:
+    """True when the row pins itself to the CPU platform via its env -
+    such rows never touch the chip claim, so killing them is safe and
+    they run even when the accelerator backend is wedged. An env that
+    only tweaks other knobs (e.g. DNN_TPU_FLASH_IMPL) does NOT make a
+    row CPU-pinned."""
+    return (spec.get("env") or {}).get("JAX_PLATFORMS") == "cpu"
+
+
 def _run_row_subprocess(spec: dict, timeout: float) -> tuple[dict | None, str]:
     """Run one row in a fresh subprocess; (result, error) - one is set.
 
@@ -468,10 +487,11 @@ def main() -> int:
     # gate accelerator rows on a cheap backend probe: a wedged axon claim
     # hangs jax.devices() indefinitely, and burning --row-timeout per
     # attempt on it would eat the whole deadline (r2 post-mortem, r3
-    # wedge). Rows that pin their own platform via spec["env"] (the CPU
-    # pp-bubble row) do not need the device backend and always run.
+    # wedge). CPU-pinned rows (_cpu_pinned: JAX_PLATFORMS=cpu in the row
+    # env - the pp-bubble and dp-scaling rows) do not need the device
+    # backend and always run.
     backend_ok = True
-    if any(not r.get("env") for r in rows):
+    if any(not _cpu_pinned(r) for r in rows):
         probe_budget = t_start + min(args.deadline * 0.5, 600.0)
         backend_ok = _wait_backend(probe_budget)
         if not backend_ok:
@@ -483,7 +503,7 @@ def main() -> int:
     reprobed_late = False
     poisoned = False  # a row was killed at its hard cap this session
     for spec in rows:
-        if not spec.get("env") and not backend_ok:
+        if not _cpu_pinned(spec) and not backend_ok:
             # one last cheap probe in case the claim cleared late - but
             # only once; paying 45s per accelerator row would burn the
             # whole deadline on a wedged chip. Never re-probe a claim
@@ -518,7 +538,7 @@ def main() -> int:
             _write_matrix(state)
             continue
         result, err = None, ""
-        if spec.get("env"):
+        if _cpu_pinned(spec):
             # CPU-pinned row: a kill cannot wedge anything, keep the old
             # deadline-capped budget
             row_cap = min(args.row_timeout,
@@ -534,7 +554,7 @@ def main() -> int:
             _log(f"[bench] {spec['id']}: attempt {attempt + 1} "
                  f"(cap {row_cap:.0f}s)")
             result, err = _run_row_subprocess(spec, row_cap)
-            if err.startswith("row timed out") and not spec.get("env"):
+            if err.startswith("row timed out") and not _cpu_pinned(spec):
                 _log(f"[bench] {spec['id']}: killed at the hard cap - "
                      "treating the claim as wedged; no further "
                      "accelerator rows this session")

@@ -370,7 +370,7 @@ def measure_lm_training(
     import jax.numpy as jnp
 
     from ..models import transformer as tfm
-    from ..ops.flash import _flash_available
+    from ..ops.flash import _on_tpu
     from . import lm as lmtrain
 
     cfg = tfm.TransformerConfig(
@@ -416,8 +416,12 @@ def measure_lm_training(
         "d_model": d_model, "n_layers": n_layers, "seq_len": seq_len,
         "vocab": vocab, "batch": batch, "steps": steps, "dtype": dtype,
         "attn": attn, "remat": remat, "remat_attn": remat_attn,
+        # provenance: WHICH flash kernel measured this row (r3's numbers
+        # were the library kernel; r4+ defaults to the own kernels)
         "attn_kernel": (
-            "pallas-flash" if attn == "flash" and _flash_available()
+            ("pallas-flash-"
+             + os.environ.get("DNN_TPU_FLASH_IMPL", "own"))
+            if attn == "flash" and _on_tpu()
             else "xla"
         ),
         "device_kind": dev.device_kind,
