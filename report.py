@@ -345,7 +345,11 @@ def _bench_matrix_sections() -> list[str]:
             "backend - numbers recorded before round 3's fence fix were "
             "dispatch time and have been discarded). MFU = model "
             "FLOPs/token x tokens/s / dtype-adjusted peak "
-            "(`train/measure.py`).",
+            "(`train/measure.py`). Kernel provenance: `pallas-flash` "
+            "(no suffix) = the LIBRARY kernel (rows measured in r3, "
+            "before the own kernels existed); `pallas-flash-own` / "
+            "`pallas-flash-lib` = this framework's vma-typed 3-D-grid "
+            "kernels vs the library A/B baseline (r4+).",
             "",
             fmt_row(["config", "attn", "remat", "batch", "seq",
                      "tokens/s", "MFU %"]),
