@@ -171,6 +171,19 @@ def _rows(epochs: int) -> list[dict]:
             "args": {"attn": "flash", "dtype": "bfloat16", "steps": 20},
         },
         {
+            # MXU-geometry row: same d_model split as H=4 x Dh=128 fills
+            # the MXU's 128-wide contraction in the attention dots (Dh=64
+            # half-fills it) - the Llama-2-7B head geometry. Model
+            # FLOPs/token are identical to the flagship row
+            # (model_flops_per_token has no H term), so any MFU delta is
+            # pure kernel geometry, not model size
+            "id": "lm_flash_d512_L8_seq2048_bf16_hd128",
+            "kind": "lm",
+            "est_s": 600,
+            "args": {"attn": "flash", "dtype": "bfloat16", "steps": 20,
+                     "n_heads": 4},
+        },
+        {
             # remat: the XLA path materializes (B, H, S, S) scores, which
             # OOMs a 16 GB v5e at these shapes without recompute (measured
             # r3); flash needs no remat - that contrast is the point

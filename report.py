@@ -363,8 +363,14 @@ def _bench_matrix_sections() -> list[str]:
                     f"FAILED: {str(why)[:60]}", "-",
                 ]))
                 continue
-            cfgs = (f"d{r['d_model']}/L{r['n_layers']}/voc{r['vocab']//1000}k"
-                    f"/{r['dtype']}")
+            # head geometry shown only for the non-default Dh (hd128 rows
+            # vs the hd64 flagship are otherwise identically labelled;
+            # suffixing every row would split the r3/r4 A/B pairs)
+            hd = ""
+            if r.get("n_heads") and r["d_model"] // r["n_heads"] != 64:
+                hd = f"/hd{r['d_model'] // r['n_heads']}"
+            cfgs = (f"d{r['d_model']}/L{r['n_layers']}{hd}"
+                    f"/voc{r['vocab']//1000}k/{r['dtype']}")
             remat = ("block" if r.get("remat")
                      else "attn" if r.get("remat_attn") else "none")
             out.append(fmt_row([

@@ -65,6 +65,13 @@ def main() -> int:
         log.append(run("tune_flash",
                        [py, os.path.join(REPO, "tools", "tune_flash.py")],
                        timeout=5400))
+        # second tune at the MXU-native head geometry (H=4 x Dh=128, the
+        # bench hd128 row): tuned_blocks() matches tune files by head_dim,
+        # so without this the hd128 row runs on default blocks
+        log.append(run("tune_flash_hd128",
+                       [py, os.path.join(REPO, "tools", "tune_flash.py"),
+                        "--heads", "4", "--head-dim", "128"],
+                       timeout=5400))
     if "bench" not in args.skip:
         log.append(run(
             "bench",

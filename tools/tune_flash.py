@@ -103,13 +103,22 @@ def main() -> int:
 
     cand = [b for b in (256, 512, 1024) if S % b == 0] or [S]
 
-    # stage 1: forward blocks (fwd-only timing)
+    # stage 1: forward blocks - the full ASYMMETRIC (bq, bk) grid, not
+    # just uniform pairs: the q block sets the scratch/accumulator
+    # footprint while the k block sets the inner-step granularity (and
+    # the causal-skip resolution), so the best pair need not be square
+    # (the r4 hardware sweep found the library kernel fastest at 512
+    # uniform while the own kernel preferred 1024 - sweep both axes)
     fwd_rows = {}
-    for b in cand:
-        blocks = FlashBlocks(bq=b, bk=b)
-        fwd_rows[b] = timeit(f"own_fwd_q{b}k{b}", own(blocks))
-    ok_fwd = {b: r["ms"] for b, r in fwd_rows.items() if "ms" in r}
-    best_fwd = min(ok_fwd, key=ok_fwd.get) if ok_fwd else cand[0]
+    for bq in cand:
+        for bk in cand:
+            blocks = FlashBlocks(bq=bq, bk=bk)
+            fwd_rows[(bq, bk)] = timeit(f"own_fwd_q{bq}k{bk}", own(blocks))
+    ok_fwd = {p: r["ms"] for p, r in fwd_rows.items() if "ms" in r}
+    best_fwd_pair = (min(ok_fwd, key=ok_fwd.get) if ok_fwd
+                     else (cand[0], cand[0]))
+    fwd_tag = (f"{best_fwd_pair[0]}" if best_fwd_pair[0] == best_fwd_pair[1]
+               else f"{best_fwd_pair[0]}x{best_fwd_pair[1]}")
 
     # stage 2: backward blocks at the best forward blocks (fwd+bwd
     # timing), staged to keep the grid small: symmetric dq sweep at a
@@ -121,7 +130,8 @@ def main() -> int:
 
     def try_fb(name, **fields):
         nonlocal best_own, best_own_ms
-        blocks = FlashBlocks(bq=best_fwd, bk=best_fwd, **fields)
+        blocks = FlashBlocks(bq=best_fwd_pair[0], bk=best_fwd_pair[1],
+                             **fields)
         if blocks in _seen:  # identical config under another stage's name
             return _seen[blocks]
         r = timeit(name, fwdbwd(own(blocks)))
@@ -133,7 +143,7 @@ def main() -> int:
     mid = cand[len(cand) // 2]
     sweep = {}
     for bdq in cand:
-        r = try_fb(f"own_fb_q{best_fwd}_dq{bdq}_dkv{mid}",
+        r = try_fb(f"own_fb_q{fwd_tag}_dq{bdq}_dkv{mid}",
                    bq_dq=bdq, bk_dq=bdq, bq_dkv=mid, bk_dkv=mid)
         if "ms" in r:
             sweep[(bdq, bdq)] = r["ms"]
@@ -142,7 +152,7 @@ def main() -> int:
     for bq_dkv in cand:
         for bk_dkv in cand:
             r = try_fb(
-                f"own_fb_q{best_fwd}_dq{best_dq[0]}_"
+                f"own_fb_q{fwd_tag}_dq{best_dq[0]}_"
                 f"dkv{bq_dkv}x{bk_dkv}",
                 bq_dq=best_dq[0], bk_dq=best_dq[1],
                 bq_dkv=bq_dkv, bk_dkv=bk_dkv,
@@ -155,7 +165,7 @@ def main() -> int:
             # symmetric pairs at THIS dkv were only pre-measured when
             # best_dkv happens to be (mid, mid) - _seen dedupes that case
             try_fb(
-                f"own_fb_q{best_fwd}_dq{bq_dq}x{bk_dq}_"
+                f"own_fb_q{fwd_tag}_dq{bq_dq}x{bk_dq}_"
                 f"dkv{best_dkv[0]}x{best_dkv[1]}",
                 bq_dq=bq_dq, bk_dq=bk_dq,
                 bq_dkv=best_dkv[0], bk_dkv=best_dkv[1],
@@ -198,9 +208,13 @@ def main() -> int:
     timeit("xla_fb", fwdbwd(xla_attn))
 
     dev = jax.devices()[0].device_kind.replace(" ", "_")
+    # head_dim is part of the filename (D != 64 tunes must not clobber
+    # the D=64 file; `tuned_blocks()` globs flash_tune_*.json and matches
+    # on the recorded shape, so both spellings load fine)
     out_path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)),
-        f"flash_tune_{dev}_s{S}.json",
+        f"flash_tune_{dev}_s{S}_d{D}.json" if D != 64
+        else f"flash_tune_{dev}_s{S}.json",
     )
     lib_fb = [r for r in results
               if r["cfg"].startswith("lib_fb_") and "ms" in r]
@@ -246,10 +260,10 @@ def main() -> int:
             "fwd_attn_tflops_per_s": tflops(fwd_flops, f),
             "bwd_attn_tflops_per_s": tflops(2.5 * fwd_flops, bwd),
         }
-    # own: every fb config used bq=bk=best_fwd for the forward, so the
-    # matching fwd row is exactly own_fwd_q{best_fwd}k{best_fwd}
+    # own: every fb config used best_fwd_pair for the forward, so the
+    # matching fwd row is exactly own_fwd_q{bq}k{bk} at that pair
     f_own = next((r["ms"] for r in results
-                  if r["cfg"] == f"own_fwd_q{best_fwd}k{best_fwd}"
+                  if r["cfg"] == f"own_fwd_q{best_fwd_pair[0]}k{best_fwd_pair[1]}"
                   and "ms" in r), None)
     fb_own = None if best_own is None else best_own_ms
     bwd_own = None if f_own is None or fb_own is None else round(
