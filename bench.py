@@ -493,7 +493,12 @@ def main() -> int:
             "vs_baseline = reference_seconds / ours (cross-platform: "
             "reference rows are MPI processes on an 8-core i7-9800X, "
             "BASELINE.md Tables 1-2; ours run on the devices listed per "
-            "row). ref columns attach only at --epochs 25."
+            "row). ref columns attach only at --epochs 25. Rows MERGE by "
+            "id across runs (see _write_matrix): header "
+            "started/finished/epochs describe the LATEST run only; each "
+            "row's provenance is its own measured_unix (rows measured by "
+            "earlier runs, including other --epochs, persist until "
+            "re-measured)."
         ),
         "rows": [],
     }

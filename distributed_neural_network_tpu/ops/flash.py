@@ -69,6 +69,12 @@ def _lib_available() -> bool:
         return False
 
 
+# where tune files live; module-level so tests can point it at a tmp dir
+# (tuned_blocks is cached - tests must also cache_clear())
+_TUNE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "tools")
+
+
 @functools.cache
 def tuned_blocks(s: int, head_dim: int) -> FlashBlocks:
     """Best own-kernel blocks for (seq s, head_dim) from the tuner's JSON,
@@ -82,9 +88,7 @@ def tuned_blocks(s: int, head_dim: int) -> FlashBlocks:
         dev = jax.devices()[0].device_kind.replace(" ", "_")
     except Exception:
         return FlashBlocks()
-    pat = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__)))),
-        "tools", "flash_tune_*.json")
+    pat = os.path.join(_TUNE_DIR, "flash_tune_*.json")
     best, best_seq = None, -1
     for path in glob.glob(pat):
         try:

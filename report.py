@@ -32,11 +32,19 @@ REF_PROC = {  # procs -> (acc %, train_s)
     3: (64.4, 375.0), 4: (63.05, 794.0), 5: (60.93, 1127.0),
     6: (59.41, 1386.0), 7: (57.95, 1528.0), 8: (55.28, 1642.0),
 }
-REF_BS = {  # bs -> (acc %, train_s)
-    1: (56.54, 1332.0), 2: (61.3, 734.0), 4: (63.48, 578.0),
-    8: (65.19, 591.0), 16: (63.59, 761.0), 32: (57.68, 1034.0),
-    64: (50.86, 1129.0),
-}
+# Train-time source of truth is bench.py's REFERENCE_BS_SWEEP_S (the
+# measured child logs, e.g. bs16_log_epochs25_proc4_children.txt:2 =
+# 701.8 s), NOT the reference report's published Table 2 (761 s at bs16)
+# - the two differ because the published table includes overhead outside
+# the child train metric; both artifacts must quote the SAME denominator
+# or REPORT.md and BENCH_MATRIX.json contradict each other for one
+# measurement. Accuracy has no child-log counterpart, so it stays from
+# the published table.
+from bench import REFERENCE_BS_SWEEP_S as _REF_BS_S
+
+_REF_BS_ACC = {1: 56.54, 2: 61.3, 4: 63.48, 8: 65.19, 16: 63.59,
+               32: 57.68, 64: 50.86}
+REF_BS = {bs: (_REF_BS_ACC[bs], _REF_BS_S[bs]) for bs in _REF_BS_ACC}
 
 
 def run_one(nb_proc, batch_size, epochs, data, synthetic_size):
@@ -237,6 +245,7 @@ def main() -> int:
         "",
     ]
     lines += _bench_matrix_sections()
+    lines += _flash_tune_sections()
     with open(args.out, "w") as f:
         f.write("\n".join(lines))
     print(f"wrote {args.out}")
@@ -437,6 +446,81 @@ def _bench_matrix_sections() -> list[str]:
                 f"{100 * c['sync_frac']:.2f}%", c["overhead_vs_n1"],
             ]))
         out += ["", r.get("note", ""), ""]
+    return out
+
+
+def _flash_tune_sections() -> list[str]:
+    """Per-pass flash-attention ablation from tools/flash_tune_*.json.
+
+    The r3 MFU diagnosis located the end-to-end gap in the attention
+    backward pass; this renders the hardware evidence (fwd-only and
+    fwd+bwd wall-clock per implementation, with attention-TFLOP/s) so the
+    ceiling argument is a table in the artifact, not a memory. Files are
+    written by tools/tune_flash.py under honest value-fetch fencing."""
+    import glob
+    import os
+
+    out = []
+    paths = sorted(glob.glob(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "tools", "flash_tune_*.json")))
+    for path in paths:
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        abl = data.get("ablation")
+        shape = data.get("shape", {})
+        if not abl:
+            continue
+        if not out:
+            out += [
+                "## Flash-attention kernel ablation - per-pass, measured",
+                "",
+                "Hard-fenced kernel microbenchmarks (`tools/tune_flash.py`,"
+                " 20-step mean after warm-up). `own` = this framework's"
+                " vma-typed Pallas kernels (`ops/flash_pallas.py`) at their"
+                " best swept blocks; `lib` = the kernel shipped with JAX at"
+                " its best uniform blocks; `xla` = fused plain attention."
+                " bwd is derived (fwd+bwd minus fwd at the same forward"
+                " config). TFLOP/s uses causal attention FLOPs"
+                " (2*B*H*S^2*D fwd; 2.5x that bwd).",
+                "",
+            ]
+        b, h = shape.get("batch"), shape.get("heads")
+        s, d = shape.get("seq"), shape.get("head_dim")
+        out += [
+            f"### B{b} x H{h} x S{s} x Dh{d} ({data.get('device')}, "
+            "bf16)",
+            "",
+            fmt_row(["impl", "fwd ms", "bwd ms", "fwd+bwd ms",
+                     "fwd TFLOP/s", "bwd TFLOP/s"]),
+            fmt_row(["---"] * 6),
+        ]
+        for name in ("own", "lib", "xla"):
+            a = abl.get(name)
+            if not a:
+                continue
+            out.append(fmt_row([
+                name,
+                a.get("fwd_ms", "-"), a.get("bwd_ms_derived", "-"),
+                a.get("fwdbwd_ms", "-"),
+                a.get("fwd_attn_tflops_per_s", "-"),
+                a.get("bwd_attn_tflops_per_s", "-"),
+            ]))
+        best = data.get("best_own")
+        if best:
+            out += [
+                "",
+                "best own blocks: "
+                f"fwd ({best['bq']}, {best['bk']}), "
+                f"dq ({best['bq_dq']}, {best['bk_dq']}), "
+                f"dkv ({best['bq_dkv']}, {best['bk_dkv']}) - loaded "
+                "automatically at matching shapes "
+                "(`ops/flash.py tuned_blocks`).",
+                "",
+            ]
     return out
 
 

@@ -81,6 +81,40 @@ def test_grads_match_reference(n_devices, causal, blocks):
         )
 
 
+def test_head_dim_128_fwd_and_grads(n_devices):
+    """Dh=128 (the MXU-native head geometry the hd128 bench row runs,
+    H=4 x Dh=128 at d_model 512): fwd + grad parity in interpret mode -
+    pinned before the config burns chip time (same rule as the
+    asymmetric-block combos above)."""
+    q, k, v = _qkv(s=128, h=1, d=128)
+    blocks = FlashBlocks(64, 64, 64, 64, 64, 64)
+    out = flash_mha(q, k, v, causal=True, blocks=blocks, interpret=True)
+    ref = attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+    w = jnp.asarray(
+        np.random.default_rng(2).normal(size=q.shape), jnp.float32
+    )
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_mha(q, k, v, causal=True, blocks=blocks, interpret=True)
+            * w
+        )
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention(q, k, v, causal=True) * w)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4,
+            err_msg=f"d{name} mismatch",
+        )
+
+
 def test_bf16_forward_close(n_devices):
     q, k, v = _qkv(dtype=jnp.bfloat16)
     out = flash_mha(q, k, v, causal=True,
@@ -104,3 +138,51 @@ def test_block_resolution_clamps_to_divisors(n_devices):
     assert (r.bq, r.bk) == (512, 512)
     r = FlashBlocks(384, 384, 384, 384, 384, 384).resolve(2048)
     assert r.bq == 256  # largest 128-multiple divisor <= 384
+
+
+def test_tuned_blocks_file_matching(tmp_path, monkeypatch):
+    """tuned_blocks picks tune files by device kind, head_dim, and seq
+    (exact wins over divisor; mismatched head_dim/device never load) -
+    the guard the retracted r2 sweep lacked (ops/flash.py docstring)."""
+    import json
+
+    from distributed_neural_network_tpu.ops import flash
+
+    def write(name, seq, head_dim, bq, device="cpu"):
+        payload = {
+            "shape": {"batch": 1, "heads": 1, "seq": seq,
+                      "head_dim": head_dim},
+            "device": device,
+            "best_own": {"bq": bq, "bk": bq, "bq_dq": bq, "bk_dq": bq,
+                         "bq_dkv": bq, "bk_dkv": bq},
+        }
+        (tmp_path / name).write_text(json.dumps(payload))
+
+    monkeypatch.setattr(flash, "_TUNE_DIR", str(tmp_path))
+    flash.tuned_blocks.cache_clear()
+    try:
+        # no files -> defaults
+        assert flash.tuned_blocks(2048, 64) == FlashBlocks()
+        flash.tuned_blocks.cache_clear()
+        # divisor-seq file applies; exact-seq file wins over it
+        write("flash_tune_cpu_s1024.json", 1024, 64, 256)
+        assert flash.tuned_blocks(2048, 64).bq == 256
+        flash.tuned_blocks.cache_clear()
+        write("flash_tune_cpu_s2048.json", 2048, 64, 1024)
+        assert flash.tuned_blocks(2048, 64).bq == 1024
+        flash.tuned_blocks.cache_clear()
+        # head_dim-qualified file loads only at ITS head_dim (the d128
+        # filename spelling tune_flash.py writes for D != 64)
+        write("flash_tune_cpu_s2048_d128.json", 2048, 128, 512)
+        assert flash.tuned_blocks(2048, 128).bq == 512
+        flash.tuned_blocks.cache_clear()
+        assert flash.tuned_blocks(2048, 64).bq == 1024  # d64 file intact
+        flash.tuned_blocks.cache_clear()
+        # divisor files still apply at larger seqs (2048 divides 4096)
+        assert flash.tuned_blocks(4096, 64).bq == 1024
+        flash.tuned_blocks.cache_clear()
+        # wrong device kind never loads (seq 3000: no cpu file matches)
+        write("flash_tune_other_s3000.json", 3000, 64, 128, device="TPU_x")
+        assert flash.tuned_blocks(3000, 64) == FlashBlocks()
+    finally:
+        flash.tuned_blocks.cache_clear()
