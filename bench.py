@@ -13,22 +13,30 @@ Robustness (r2 post-mortem: BENCH_r02.json is rc=1/parsed=null because the
 TPU backend was busy at the single moment the driver ran this script, and
 the old bench touched jax at top level with no second chance):
 
-- every row runs in its OWN subprocess (`--worker`), so a backend-init
-  failure never poisons the parent (JAX caches backend-init failure
-  process-wide);
-- rows whose subprocess fails with an unavailable/busy backend retry with
+- accelerator rows run in ONE worker subprocess holding ONE chip claim
+  (`--worker-multi`): the r4 wedge post-mortem points at claim churn - the
+  first measurement pass claimed/released the chip once per row and the
+  4th consecutive claim hung. The group worker streams one JSON record per
+  row to a file, so the parent can enforce per-row hard caps (the cap
+  clock resets as each record lands) without ever killing a healthy claim,
+  and a last-resort kill loses only the in-flight row;
+- CPU-pinned rows (JAX_PLATFORMS=cpu in the row env) never touch the chip
+  claim and keep the old per-row subprocess with kill-safe timeouts;
+- rows already measured in BENCH_MATRIX.json are KEPT, not re-measured
+  (the headline always re-measures - it is the driver's stdout metric);
+  pass --refresh for a full re-measure. This keeps the driver's round-end
+  run short and low-risk: one claim, a ~2-minute headline row, done;
+- the headline stdout line is printed the moment the headline row is
+  measured, so a driver-side kill during later rows cannot erase it;
+- rows whose worker fails with an unavailable/busy backend retry with
   backoff (--retries, default 5 over ~4 min);
 - an unrecoverable run still prints structured JSON with an "error" field -
   never a bare traceback on stdout;
-- a global --deadline (default 3600 s) skips STARTING remaining
-  non-headline rows so the headline always gets printed before any driver
-  timeout; an in-flight accelerator row is never killed for the deadline
-  (killing a process that holds the single axon chip claim wedges the
-  backend for every later process - r4 post-mortem: the first-pass 420 s
-  row kills are what "wedged the chip" in r3/r4). Each accelerator row
-  instead gets a generous honest-fencing budget (`est_s`, scaled by bs)
-  and a 2x+300 s last-resort cap; hitting that cap kills once and then
-  stops all further claims this session.
+- killing a process that holds the single axon chip claim wedges the
+  backend for every later process (r4 post-mortem: the first-pass 420 s
+  row kills are what "wedged the chip" in r3/r4), so caps are last-resort
+  bounds (2*est_s+300 per row), and a cap kill poisons the rest of the
+  accelerator session instead of retrying.
 
 Reference comparison columns (BASELINE.md):
   Table 1 proc sweep @ bs16: 8-proc train time 1642 s (headline ref).
@@ -66,7 +74,7 @@ _RETRYABLE = (
 
 
 def _rows(epochs: int) -> list[dict]:
-    """Row specs, headline first. Each runs in its own worker subprocess.
+    """Row specs, headline first. Accelerator rows share one group worker.
 
     ref_s columns are only attached at epochs=25 (the reference's sweep
     length); shorter smoke runs get no vs_baseline rather than a wildly
@@ -299,10 +307,51 @@ def _run_worker(spec: dict) -> dict:
     raise ValueError(f"unknown row kind {spec['kind']!r}")
 
 
+def _run_worker_multi(job_path: str) -> int:
+    """Run a LIST of accelerator rows in ONE process (one chip claim).
+
+    The job file holds {"specs": [...], "out": path}. One JSON record per
+    row - {"id", "result"} or {"id", "error"} - is appended to `out` as
+    each row finishes, so the parent tracks progress without killing the
+    claim and a last-resort kill loses only the in-flight row. Per-row env
+    overlays (e.g. DNN_TPU_FLASH_IMPL, read at trace time - ops/flash.py)
+    are applied around each row; JAX-init-sensitive vars (JAX_PLATFORMS /
+    XLA_FLAGS) make a row non-groupable instead (`_groupable`).
+    """
+    with open(job_path) as f:
+        job = json.load(f)
+    for spec in job["specs"]:
+        overlay = spec.get("env") or {}
+        saved = {k: os.environ.get(k) for k in overlay}
+        os.environ.update(overlay)
+        try:
+            rec = {"id": spec["id"], "result": _run_worker(spec)}
+        except Exception:  # noqa: BLE001 - per-row isolation
+            import traceback
+
+            rec = {"id": spec["id"], "error": traceback.format_exc()[-2000:]}
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        with open(job["out"], "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    return 0
+
+
 # ----------------------------------------------------------- orchestrator
 
 def _log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
+
+
+def _measured_row(r: dict | None) -> bool:
+    """One definition of 'this matrix row carries a real measurement' -
+    shared by the merge (stubs never replace measured rows) and the
+    keep-previously-measured filter, which must agree."""
+    return r is not None and "error" not in r and "skipped" not in r
 
 
 def _write_matrix(state: dict) -> None:
@@ -322,8 +371,6 @@ def _write_matrix(state: dict) -> None:
             old_rows = json.load(f).get("rows", [])
     except (OSError, json.JSONDecodeError):
         old_rows = []
-    def measured(r):
-        return "error" not in r and "skipped" not in r
 
     by_id = {r.get("id"): r for r in old_rows}
     out_rows = []
@@ -332,7 +379,7 @@ def _write_matrix(state: dict) -> None:
         # an error/skipped stub never replaces a previously MEASURED row:
         # a wedged-chip rerun must not erase real numbers (the stub is
         # dropped; stderr already logged the failure)
-        if not measured(r) and prev is not None and measured(prev):
+        if not _measured_row(r) and prev is not None and _measured_row(prev):
             out_rows.append(prev)
         else:
             out_rows.append(r)
@@ -353,17 +400,194 @@ def _cpu_pinned(spec: dict) -> bool:
     return (spec.get("env") or {}).get("JAX_PLATFORMS") == "cpu"
 
 
-def _run_row_subprocess(spec: dict, timeout: float) -> tuple[dict | None, str]:
-    """Run one row in a fresh subprocess; (result, error) - one is set.
+def _groupable(spec: dict) -> bool:
+    """Accelerator rows whose env (if any) can be applied in-process go
+    through the single-claim group worker. JAX-init-sensitive env keys
+    (platform/XLA flags) need a fresh process - in practice exactly the
+    CPU-pinned rows."""
+    env = spec.get("env") or {}
+    return not _cpu_pinned(spec) and not (
+        set(env) & {"JAX_PLATFORMS", "XLA_FLAGS"}
+    )
 
-    `timeout` here is a HARD CAP, not a working budget - killing a process
-    that holds (or is acquiring) the single axon chip claim wedges the
-    backend for every later process (r3 wedge; r4 post-mortem confirmed:
-    the r4 first-pass kills at 420 s/61 s wedged the session). Callers
-    pass generous caps (see `est_s` row budgets) and treat a timeout as
-    terminal for the whole accelerator session, not as a retryable row
-    error.
+
+def _row_cap(spec: dict, args) -> float:
+    """Last-resort per-row bound, NOT a working budget: est_s is already
+    generous, so 2x + 5 min means only a genuinely hung claim is ever
+    killed - and that kill poisons the rest of the accelerator session."""
+    return 2 * spec.get("est_s", args.row_timeout) + 300
+
+
+def _read_group_records(path: str) -> dict:
+    """id -> record from the group worker's JSONL stream (torn final
+    lines from an in-flight append are skipped)."""
+    recs = {}
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    r = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                recs[r["id"]] = r
+    except OSError:
+        pass
+    return recs
+
+
+def _run_accel_group(specs, args, backoffs, finalize) -> None:
+    """Run groupable accelerator rows through one `--worker-multi` claim.
+
+    `finalize(spec, result | None, err)` is called EXACTLY ONCE per spec,
+    as soon as that row's outcome is final: successes fire the moment
+    their record lands in the stream (so the headline prints and the
+    matrix persists before later rows run - a kill of this parent during
+    a later row cannot erase an already-measured headline); failures fire
+    when the retry logic gives up on them. The per-row hard cap is
+    enforced by watching the record stream: the cap clock resets as each
+    row's record lands, so the whole matrix shares one chip claim while a
+    genuinely hung row is still bounded by its own 2*est_s+300 budget. A
+    cap kill treats the claim as wedged and stubs everything after the
+    in-flight row. Natural worker exits with retryable backend errors
+    (busy chip at claim time) retry with backoff; the retry decision uses
+    only THIS attempt's records, never stale errors from prior attempts.
     """
+    final_ids: set = set()
+
+    def _final(spec, result, err):
+        if spec["id"] not in final_ids:
+            final_ids.add(spec["id"])
+            finalize(spec, result, err)
+
+    remaining = list(specs)
+    attempt = 0
+    while remaining:
+        out_path = os.path.join(
+            REPO, f".bench_group_{os.getpid()}_{attempt}.jsonl")
+        job_path = out_path + ".job"
+        err_path = out_path + ".err"
+        with open(job_path, "w") as f:
+            json.dump({"specs": remaining, "out": out_path}, f)
+        _log(f"[bench] group attempt {attempt + 1} "
+             f"({len(remaining)} rows, one claim): "
+             + ", ".join(s["id"] for s in remaining))
+        with open(err_path, "w") as ef:
+            proc = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__),
+                 "--worker-multi", job_path],
+                stdout=subprocess.DEVNULL, stderr=ef, cwd=REPO,
+            )
+        done = 0
+        row_t0 = time.time()
+        killed = False
+        teardown_killed = False
+        while True:
+            rc = proc.poll()
+            recs = _read_group_records(out_path)
+            if len(recs) > done:
+                for s in remaining[done:len(recs)]:
+                    r = recs.get(s["id"])
+                    _log(f"[bench] group: {s['id']} recorded "
+                         f"({time.time() - row_t0:.0f}s)")
+                    if r is not None and "result" in r:
+                        # success is final regardless of later attempts -
+                        # persist the matrix row / print the headline NOW
+                        _final(s, r["result"], "")
+                done, row_t0 = len(recs), time.time()
+            if rc is not None:
+                break
+            if done < len(remaining):
+                cur = remaining[done]
+                cap = _row_cap(cur, args)
+                if time.time() - row_t0 > cap:
+                    _log(f"[bench] {cur['id']}: hit its {cap:.0f}s "
+                         "in-group cap - killing the worker (treating the "
+                         "claim as wedged; no further accelerator rows "
+                         "this session)")
+                    proc.kill()
+                    killed = True
+                    proc.wait()
+                    break
+            elif time.time() - row_t0 > 900:
+                # every record landed but the worker never exited (claim
+                # release hang during teardown): all data is safe, bound
+                # the wait - the kill may wedge the claim for LATER
+                # processes, but an unbounded parent hang is worse
+                _log("[bench] group worker hung in teardown after its "
+                     "last record (900s) - killing it; all rows were "
+                     "already recorded. No further claims this session "
+                     "(a mid-claim kill presumably wedges the claim)")
+                proc.kill()
+                teardown_killed = True
+                proc.wait()
+                break
+            time.sleep(5)
+        try:
+            with open(err_path) as ef:
+                err_tail = ef.read()[-2000:]
+        except OSError:
+            err_tail = ""
+        recs = _read_group_records(out_path)
+        for p in (job_path, out_path, err_path):
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+        if killed:
+            # a record that landed in the kill window still counts; the
+            # first row WITHOUT a record is the killed in-flight one
+            stubbed_current = False
+            for s in remaining:
+                r = recs.get(s["id"])
+                if r is not None:
+                    _final(s, r.get("result"), r.get("error", ""))
+                elif not stubbed_current:
+                    stubbed_current = True
+                    _final(s, None,
+                           f"row killed at its {_row_cap(s, args):.0f}s "
+                           "in-group cap")
+                else:
+                    _final(s, None,
+                           "skipped: an earlier row was killed at its cap "
+                           "this session (claim presumed wedged by the "
+                           "kill)")
+            return
+        # natural/teardown-kill exit: decide per row from THIS attempt's
+        # records only. After a teardown kill no retry may claim again -
+        # the kill itself presumably wedged the claim (see above)
+        can_retry = attempt < len(backoffs) and not teardown_killed
+        retry = []
+        for s in remaining:
+            r = recs.get(s["id"])
+            if r is not None and "result" in r:
+                _final(s, r["result"], "")  # idempotent (already fired)
+            elif r is not None:
+                if _retryable(r.get("error", "")) and can_retry:
+                    retry.append(s)
+                else:
+                    _final(s, None, r.get("error", ""))
+            else:
+                if _retryable(err_tail) and can_retry:
+                    retry.append(s)
+                else:
+                    _final(s, None,
+                           err_tail or "group worker exited without "
+                           "recording this row")
+        if not retry:
+            return
+        _log(f"[bench] group: backend busy/unavailable for "
+             f"{len(retry)} rows, retrying in {backoffs[attempt]:.0f}s "
+             f"(error tail: {err_tail[-200:]!r})")
+        time.sleep(backoffs[attempt])
+        remaining = retry
+        attempt += 1
+
+
+def _run_row_subprocess(spec: dict, timeout: float) -> tuple[dict | None, str]:
+    """Run one CPU-pinned row in a fresh subprocess; (result, error)."""
     cmd = [sys.executable, os.path.abspath(__file__), "--worker",
            json.dumps(spec)]
     env = None
@@ -390,11 +614,10 @@ def _run_row_subprocess(spec: dict, timeout: float) -> tuple[dict | None, str]:
 
 def _retryable(err: str) -> bool:
     # a busy chip shows up as an UNAVAILABLE-style init error. A row
-    # TIMEOUT is deliberately NOT retryable: with the generous est_s caps
-    # a timeout means the subprocess was killed, and a kill mid-claim
-    # wedges the chip - retrying against a wedged claim only stacks more
-    # doomed claims (r4 post-mortem). The caller poisons the session
-    # instead.
+    # TIMEOUT is deliberately NOT retryable: with the generous caps a
+    # timeout means the worker was killed, and a kill mid-claim wedges
+    # the chip - retrying against a wedged claim only stacks more doomed
+    # claims (r4 post-mortem). The caller poisons the session instead.
     return any(m in err for m in _RETRYABLE)
 
 
@@ -403,7 +626,7 @@ def _probe_backend(timeout: float = 75.0) -> bool:
     device and run. On the axon tunnel a wedged chip makes jax.devices()
     hang indefinitely (observed r3: a kill mid-claim wedges the claim
     server-side for tens of minutes) - probing for ~1 min is far cheaper
-    than burning a full --row-timeout per attempt, and the probe's own
+    than burning a full row cap per attempt, and the probe's own
     kill-on-timeout is harmless because the chip is already wedged."""
     code = (
         "from distributed_neural_network_tpu.train.cli import "
@@ -436,26 +659,46 @@ def _wait_backend(deadline_ts: float, *, probe_timeout: float = 75.0,
         time.sleep(sleep_s)
 
 
+def _assemble_row(spec: dict, result: dict | None, err: str) -> dict:
+    row = {"id": spec["id"], **{k: v for k, v in spec.items()
+                                if k in ("ref_s", "ref")}}
+    if result is not None:
+        row.update(result)
+        if "train_s" in result and spec.get("ref_s"):
+            row["vs_baseline"] = round(
+                spec["ref_s"] / max(result["train_s"], 1e-9), 2)
+        _log(f"[bench] {spec['id']}: ok {json.dumps(result)}")
+    else:
+        row["error"] = err
+        _log(f"[bench] {spec['id']}: FAILED: {err[-500:]}")
+    return row
+
+
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--worker", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--worker-multi", default=None, help=argparse.SUPPRESS)
     p.add_argument("--epochs", type=int, default=25)
     p.add_argument("--data", default="auto",
                    help="cnn rows: dataset source (auto/pickle/npz/synthetic)")
     p.add_argument("--synthetic-size", type=int, default=None,
                    help="cnn rows: synthetic train-split rows")
     p.add_argument("--retries", type=int, default=5,
-                   help="attempts per row on busy/unavailable backend")
+                   help="attempts on busy/unavailable backend")
     p.add_argument("--row-timeout", type=float, default=420.0,
                    help="kill timeout for CPU-pinned rows, and the est_s "
                    "fallback for accelerator rows without one (their hard "
                    "cap is 2*est_s+300; accelerator rows are never killed "
                    "for the --deadline)")
     p.add_argument("--deadline", type=float, default=3600.0,
-                   help="wall-clock budget gating row STARTS; remaining "
-                   "non-headline rows are skipped (recorded as skipped) "
-                   "once exceeded - in-flight accelerator rows run to "
-                   "their own hard cap regardless")
+                   help="wall-clock budget gating CPU-pinned row starts; "
+                   "the accelerator group is bounded by its own per-row "
+                   "caps instead (in-flight accelerator work is never "
+                   "killed for the deadline)")
+    p.add_argument("--refresh", action="store_true",
+                   help="re-measure rows already measured in "
+                   "BENCH_MATRIX.json (default: keep them and run only "
+                   "the headline + missing/error rows)")
     p.add_argument("--only", default=None,
                    help="comma-separated exact row ids to run")
     args = p.parse_args()
@@ -464,6 +707,8 @@ def main() -> int:
         # worker mode: one row, one JSON line on stdout, exceptions -> rc 1
         print(json.dumps(_run_worker(json.loads(args.worker))), flush=True)
         return 0
+    if args.worker_multi:
+        return _run_worker_multi(args.worker_multi)
 
     t_start = time.time()
     backoffs = [15.0 * (2 ** i) for i in range(max(args.retries - 1, 0))]
@@ -486,6 +731,33 @@ def main() -> int:
                 "error": f"--only matched no row for {sorted(unknown)}",
             }))
             return 1
+    subset_without_headline = not any(r.get("headline") for r in rows)
+
+    # keep previously measured rows unless --refresh: the merge-by-id
+    # matrix makes skipping honest (each kept row's measured_unix shows
+    # when it was measured), and the driver's round-end run stays short -
+    # one claim, the headline row, any still-missing rows. The headline
+    # always re-measures: it is the stdout metric of THIS run.
+    prior_rows: dict = {}
+    try:
+        with open(MATRIX_PATH) as f:
+            prior_rows = {r.get("id"): r for r in json.load(f).get("rows", [])}
+    except (OSError, json.JSONDecodeError):
+        pass
+    if not args.refresh and not args.only:
+        # an explicit --only request always re-measures its rows; the
+        # keep filter applies only to full-matrix runs
+        kept = [r for r in rows if not r.get("headline")
+                and _measured_row(prior_rows.get(r["id"]))]
+        if kept:
+            _log("[bench] keeping previously measured rows (use --refresh "
+                 "to re-measure): " + ", ".join(
+                     f"{r['id']} (unix "
+                     f"{prior_rows[r['id']].get('measured_unix')})"
+                     for r in kept))
+            kept_ids = {r["id"] for r in kept}
+            rows = [r for r in rows if r["id"] not in kept_ids]
+
     state = {
         "started_unix": round(t_start, 1),
         "epochs": args.epochs,
@@ -502,14 +774,17 @@ def main() -> int:
         ),
         "rows": [],
     }
+
+    group_specs = [r for r in rows if _groupable(r)]
+    solo_specs = [r for r in rows if not _groupable(r)]
+
     # gate accelerator rows on a cheap backend probe: a wedged axon claim
-    # hangs jax.devices() indefinitely, and burning --row-timeout per
+    # hangs jax.devices() indefinitely, and burning a full row cap per
     # attempt on it would eat the whole deadline (r2 post-mortem, r3
-    # wedge). CPU-pinned rows (_cpu_pinned: JAX_PLATFORMS=cpu in the row
-    # env - the pp-bubble and dp-scaling rows) do not need the device
-    # backend and always run.
+    # wedge). CPU-pinned rows do not need the device backend and always
+    # run.
     backend_ok = True
-    if any(not _cpu_pinned(r) for r in rows):
+    if group_specs:
         probe_budget = t_start + min(args.deadline * 0.5, 600.0)
         backend_ok = _wait_backend(probe_budget)
         if not backend_ok:
@@ -518,35 +793,55 @@ def main() -> int:
                  "still run)")
 
     headline = None
-    reprobed_late = False
-    poisoned = False  # a row was killed at its hard cap this session
-    for spec in rows:
-        if not _cpu_pinned(spec) and not backend_ok:
-            # one last cheap probe in case the claim cleared late - but
-            # only once; paying 45s per accelerator row would burn the
-            # whole deadline on a wedged chip. Never re-probe a claim
-            # this session itself wedged with a cap-kill.
-            if not reprobed_late and not poisoned:
-                reprobed_late = True
-                backend_ok = _probe_backend(45)
-            if not backend_ok:
-                state["rows"].append({
-                    "id": spec["id"],
-                    **{k: v for k, v in spec.items()
-                       if k in ("ref_s", "ref")},
-                    "error": (
-                        "skipped: a prior row was killed at its hard cap "
-                        "this session (claim presumed wedged by the kill)"
-                        if poisoned else
-                        "backend unavailable: device claim wedged "
-                        "(probe timed out); see BENCH note"
-                    ),
-                })
-                _write_matrix(state)
-                if spec.get("headline"):
-                    headline = state["rows"][-1]
-                continue
-        elapsed = time.time() - t_start
+    printed_headline = False
+
+    def _emit_headline(row) -> None:
+        nonlocal printed_headline
+        print(json.dumps({
+            "metric": (
+                f"cifar10_dp_train_s_{row['epochs']}ep"
+                f"_bs{row['batch_size']}_dev{row['devices']}"
+                f"_{row['source']}"
+            ),
+            "value": row["train_s"],
+            "unit": "s",
+            "vs_baseline": row.get("vs_baseline"),
+        }), flush=True)
+        printed_headline = True
+
+    def _finalize_accel(spec, result, err) -> None:
+        """Persist one group row the moment its outcome is final: the
+        matrix write and the headline stdout line happen per row, not
+        after the whole group, so a kill of this process during a later
+        row cannot erase an already-measured headline."""
+        nonlocal headline
+        row = _assemble_row(spec, result, err)
+        state["rows"].append(row)
+        _write_matrix(state)
+        if spec.get("headline"):
+            headline = row
+            if "train_s" in row:
+                _emit_headline(row)
+
+    if group_specs:
+        if backend_ok:
+            _run_accel_group(group_specs, args, backoffs, _finalize_accel)
+        else:
+            for spec in group_specs:
+                _finalize_accel(
+                    spec, None,
+                    "backend unavailable: device claim wedged (probe "
+                    "timed out); see BENCH note",
+                )
+
+    # CPU-pinned rows: fresh per-row subprocess (their env is
+    # JAX-init-sensitive), kill-safe timeouts, deadline-gated starts.
+    # The deadline clock for this phase starts AFTER the accelerator
+    # group (which ignores --deadline by design): the cheap kill-safe
+    # CPU rows must not be starved by a long group session.
+    solo_t0 = time.time()
+    for spec in solo_specs:
+        elapsed = time.time() - solo_t0
         if elapsed > args.deadline and not spec.get("headline"):
             _log(f"[bench] {spec['id']}: skipped (deadline "
                  f"{args.deadline:.0f}s exceeded at {elapsed:.0f}s)")
@@ -555,33 +850,22 @@ def main() -> int:
             )
             _write_matrix(state)
             continue
-        result, err = None, ""
         if _cpu_pinned(spec):
-            # CPU-pinned row: a kill cannot wedge anything, keep the old
-            # deadline-capped budget
             row_cap = min(args.row_timeout,
-                          max(args.deadline - (time.time() - t_start), 60.0))
+                          max(args.deadline - (time.time() - solo_t0), 60.0))
         else:
-            # accelerator row: the cap is a last-resort bound, NOT a
-            # working budget - see _run_row_subprocess. est_s is already
-            # generous; 2x + 5 min means only a genuinely hung claim is
-            # ever killed, and that kill poisons the rest of the
-            # accelerator session (no further claims after a wedge).
-            row_cap = 2 * spec.get("est_s", args.row_timeout) + 300
+            # defensive: a future accelerator row with JAX-init-sensitive
+            # env lands here - it holds a chip claim, so it gets the
+            # generous last-resort cap, never the kill-happy CPU one
+            row_cap = _row_cap(spec, args)
+        result, err = None, ""
         for attempt in range(max(args.retries, 1)):
             _log(f"[bench] {spec['id']}: attempt {attempt + 1} "
                  f"(cap {row_cap:.0f}s)")
             result, err = _run_row_subprocess(spec, row_cap)
-            if err.startswith("row timed out") and not _cpu_pinned(spec):
-                _log(f"[bench] {spec['id']}: killed at the hard cap - "
-                     "treating the claim as wedged; no further "
-                     "accelerator rows this session")
-                backend_ok = False
-                poisoned = True
-                break
             if result is not None or not _retryable(err):
                 break
-            if time.time() - t_start > args.deadline:
+            if time.time() - solo_t0 > args.deadline:
                 _log(f"[bench] {spec['id']}: deadline exceeded, "
                      "no further retries")
                 break
@@ -590,17 +874,7 @@ def main() -> int:
                      f"retrying in {backoffs[attempt]:.0f}s "
                      f"(error tail: {err[-200:]!r})")
                 time.sleep(backoffs[attempt])
-        row = {"id": spec["id"], **{k: v for k, v in spec.items()
-                                    if k in ("ref_s", "ref")}}
-        if result is not None:
-            row.update(result)
-            if "train_s" in result and spec.get("ref_s"):
-                row["vs_baseline"] = round(spec["ref_s"] / max(
-                    result["train_s"], 1e-9), 2)
-            _log(f"[bench] {spec['id']}: ok {json.dumps(result)}")
-        else:
-            row["error"] = err
-            _log(f"[bench] {spec['id']}: FAILED: {err[-500:]}")
+        row = _assemble_row(spec, result, err)
         state["rows"].append(row)
         _write_matrix(state)
         if spec.get("headline"):
@@ -628,18 +902,10 @@ def main() -> int:
 
     # the single stdout JSON line: headline row, or structured error
     if headline is not None and "train_s" in headline:
-        print(json.dumps({
-            "metric": (
-                f"cifar10_dp_train_s_{headline['epochs']}ep"
-                f"_bs{headline['batch_size']}_dev{headline['devices']}"
-                f"_{headline['source']}"
-            ),
-            "value": headline["train_s"],
-            "unit": "s",
-            "vs_baseline": headline.get("vs_baseline"),
-        }))
+        if not printed_headline:
+            _emit_headline(headline)
         return 0
-    if headline is None and not any(r.get("headline") for r in rows):
+    if headline is None and subset_without_headline:
         # --only subset without the headline: report subset status instead
         # of misreading a successful smoke run as a failure
         ok = sum(1 for r in state["rows"] if "error" not in r

@@ -29,16 +29,38 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def run(name: str, cmd: list[str], timeout: float) -> dict:
+    """Run one stage in its own PROCESS GROUP.
+
+    A timeout kills the whole group (os.killpg), not just the direct
+    child: bench.py runs its accelerator rows in a `--worker-multi`
+    grandchild holding the single chip claim, and killing only bench.py
+    would orphan that grandchild - an invisible claim holder blocking
+    every later process (the r4 wedge failure mode). The kill still
+    wedges the claim (any mid-claim kill does), but the state is visible
+    and bounded instead of a silent orphan.
+    """
+    import signal
+
     print(f"[measure_all] {name}: {' '.join(cmd)}", flush=True)
     t0 = time.time()
+    proc = subprocess.Popen(
+        cmd, cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, start_new_session=True,
+    )
     try:
-        p = subprocess.run(
-            cmd, cwd=REPO, timeout=timeout, capture_output=True, text=True
-        )
-        ok = p.returncode == 0
-        tail = (p.stdout + "\n" + p.stderr)[-1500:]
+        out, _ = proc.communicate(timeout=timeout)
+        ok = proc.returncode == 0
+        tail = (out or "")[-1500:]
     except subprocess.TimeoutExpired:
-        ok, tail = False, f"timed out after {timeout:.0f}s"
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        out, _ = proc.communicate()
+        ok, tail = False, (
+            f"timed out after {timeout:.0f}s (process group killed)\n"
+            + (out or "")[-1200:]
+        )
     rec = {"stage": name, "ok": ok, "wall_s": round(time.time() - t0, 1),
            "tail": tail}
     print(f"[measure_all] {name}: {'ok' if ok else 'FAILED'} "
@@ -73,11 +95,21 @@ def main() -> int:
                         "--heads", "4", "--head-dim", "128"],
                        timeout=5400))
     if "bench" not in args.skip:
+        # --refresh: the measurement session re-measures EVERYTHING (old
+        # rows may predate the tuned/own kernels); without it bench.py
+        # keeps measured rows and runs only headline + missing rows (the
+        # driver's short round-end mode)
         log.append(run(
             "bench",
             [py, os.path.join(REPO, "bench.py"), "--deadline", "7200",
-             *([a for a in args.bench_args.split() if a])],
-            timeout=18000,
+             "--refresh", *([a for a in args.bench_args.split() if a])],
+            # last-resort only: bench's genuine worst case (every row
+            # running to near its 2*est_s+300 cap un-killed) sums past
+            # 40000 s, so anything lower risks killpg-ing a HEALTHY
+            # claim-holding grandchild (the r4 wedge failure mode).
+            # bench's own per-row caps are the real bounds; this fires
+            # only on a pathological parent hang.
+            timeout=43200,
         ))
     if "report" not in args.skip:
         log.append(run(
