@@ -559,31 +559,58 @@ def _run_accel_group(specs, args, backoffs, finalize) -> None:
         # records only. After a teardown kill no retry may claim again -
         # the kill itself presumably wedged the claim (see above)
         can_retry = attempt < len(backoffs) and not teardown_killed
-        retry = []
+        rc = proc.returncode
+        unrecorded = [s for s in remaining if s["id"] not in recs]
+        crash_ids: set = set()
+        if (rc != 0 and unrecorded and not teardown_killed
+                and not _retryable(err_tail)):
+            # hard worker death mid-list (segfault in native kernel code,
+            # host OOM kill): the first unrecorded row is the presumed
+            # crasher - it gets the error; rows AFTER it were never even
+            # attempted and restart in a fresh group without the crasher
+            # (a crash exit releases the claim normally, and progress is
+            # guaranteed: every restart finalizes at least the crasher).
+            # This keeps the old per-subprocess design's row isolation
+            crasher = unrecorded[0]
+            _final(crasher, None,
+                   f"group worker died (rc {rc}) during this row: "
+                   + (err_tail[-1200:] or "no stderr"))
+            crash_ids = {s["id"] for s in unrecorded[1:]}
+            if crash_ids:
+                _log(f"[bench] group: worker died during "
+                     f"{crasher['id']}; restarting a fresh group for the "
+                     f"{len(crash_ids)} never-attempted rows")
+        busy_retry = []
         for s in remaining:
+            if s["id"] in crash_ids or s["id"] in final_ids:
+                continue
             r = recs.get(s["id"])
             if r is not None and "result" in r:
                 _final(s, r["result"], "")  # idempotent (already fired)
             elif r is not None:
                 if _retryable(r.get("error", "")) and can_retry:
-                    retry.append(s)
+                    busy_retry.append(s)
                 else:
                     _final(s, None, r.get("error", ""))
             else:
                 if _retryable(err_tail) and can_retry:
-                    retry.append(s)
+                    busy_retry.append(s)
                 else:
                     _final(s, None,
                            err_tail or "group worker exited without "
                            "recording this row")
-        if not retry:
+        retry_ids = {s["id"] for s in busy_retry} | crash_ids
+        if not retry_ids:
             return
-        _log(f"[bench] group: backend busy/unavailable for "
-             f"{len(retry)} rows, retrying in {backoffs[attempt]:.0f}s "
-             f"(error tail: {err_tail[-200:]!r})")
-        time.sleep(backoffs[attempt])
-        remaining = retry
-        attempt += 1
+        if busy_retry:
+            _log(f"[bench] group: backend busy/unavailable for "
+                 f"{len(busy_retry)} rows, retrying in "
+                 f"{backoffs[attempt]:.0f}s "
+                 f"(error tail: {err_tail[-200:]!r})")
+            time.sleep(backoffs[attempt])
+            attempt += 1  # busy retries consume the backoff budget;
+            # crash restarts do not (they make guaranteed progress)
+        remaining = [s for s in remaining if s["id"] in retry_ids]
 
 
 def _run_row_subprocess(spec: dict, timeout: float) -> tuple[dict | None, str]:

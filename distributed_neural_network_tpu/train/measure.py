@@ -390,23 +390,20 @@ def measure_lm_training(
     tokens, targets = lmtrain.make_copy_task(
         jax.random.key(1), batch=batch, seq_len=seq_len, vocab=vocab
     )
-    from ..utils.timers import hard_block
+    from ..utils.timers import fence_rtt, hard_block
 
     for _ in range(max(warmup, 1)):
         params, mom, loss = step(params, mom, tokens, targets)
     hard_block(loss)
     # the fence is a value fetch (block_until_ready alone is a no-op on the
-    # axon tunnel); fencing an already-ready array measures its pure
-    # round-trip cost, which is then subtracted so the ~60-70 ms tunnel RTT
-    # is not charged to the steps
-    t_rt = time.perf_counter()
-    hard_block(loss)
-    fence_rtt = time.perf_counter() - t_rt
+    # axon tunnel); subtract its pure round-trip cost so the ~60-70 ms
+    # tunnel RTT is not charged to the steps (utils/timers.py fence_rtt)
+    rtt = fence_rtt(loss)
     t0 = time.perf_counter()
     for _ in range(steps):
         params, mom, loss = step(params, mom, tokens, targets)
     hard_block(loss)
-    dt = max(time.perf_counter() - t0 - fence_rtt, 1e-9)
+    dt = max(time.perf_counter() - t0 - rtt, 1e-9)
     tok_s = batch * seq_len * steps / dt
     flops_tok = model_flops_per_token(cfg, seq_len)
     dev = jax.devices()[0]

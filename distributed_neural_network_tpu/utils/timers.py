@@ -60,6 +60,18 @@ def hard_block(tree) -> None:
     s[0].item()  # the actual fence: value fetch forces remote completion
 
 
+def fence_rtt(tree) -> float:
+    """Measured cost of fencing an ALREADY-READY pytree - the pure
+    device->host round trip of `hard_block`'s value fetch (~60-70 ms
+    through the axon tunnel, sub-ms locally). Callers that fence a timed
+    loop once subtract this so the tunnel RTT is not charged to the
+    steps; shared by measure_lm_training and tools/tune_flash.py so the
+    two subtraction idioms cannot drift."""
+    t0 = time.perf_counter()
+    hard_block(tree)
+    return time.perf_counter() - t0
+
+
 class PhaseTimers:
     """Accumulating wall-clock timers keyed by phase name."""
 

@@ -498,6 +498,7 @@ def _flash_tune_sections() -> list[str]:
                      "fwd TFLOP/s", "bwd TFLOP/s"]),
             fmt_row(["---"] * 6),
         ]
+        suspect = []
         for name in ("own", "lib", "xla"):
             a = abl.get(name)
             if not a:
@@ -509,6 +510,35 @@ def _flash_tune_sections() -> list[str]:
                 a.get("fwd_attn_tflops_per_s", "-"),
                 a.get("bwd_attn_tflops_per_s", "-"),
             ]))
+            # a derived-bwd rate at/above the chip's peak is arithmetic
+            # proof that the paired fwd-only timing overstates the fwd
+            # cost inside the fwd+bwd program (different fusion/layout,
+            # or unsubtracted fence RTT in older tune files) - flag it
+            # rather than publish an impossible number. Peak is looked
+            # up for the file's recorded device (tune files write the
+            # kind with underscores)
+            from distributed_neural_network_tpu.train.measure import (
+                peak_flops,
+            )
+
+            kind = str(data.get("device", "")).replace("_", " ")
+            peak = peak_flops(kind, "bfloat16")
+            peak_tf = peak / 1e12 if peak else None
+            bwd_tf = a.get("bwd_attn_tflops_per_s")
+            if (peak_tf is not None
+                    and isinstance(bwd_tf, (int, float))
+                    and bwd_tf >= peak_tf):
+                suspect.append(name)
+        if suspect:
+            out += [
+                "",
+                f"NOTE: derived bwd TFLOP/s for {', '.join(suspect)} "
+                f"meets/exceeds this device's bf16 peak ({peak_tf:.0f}) "
+                "- the fwd/bwd SPLIT for that impl is unreliable (the "
+                "standalone fwd timing does not match the fwd embedded "
+                "in the fwd+bwd program); the fwd+bwd column remains a "
+                "direct measurement.",
+            ]
         best = data.get("best_own")
         if best:
             out += [

@@ -50,7 +50,10 @@ def main() -> int:
         FlashBlocks,
         flash_mha,
     )
-    from distributed_neural_network_tpu.utils.timers import hard_block
+    from distributed_neural_network_tpu.utils.timers import (
+        fence_rtt,
+        hard_block,
+    )
 
     if jax.default_backend() != "tpu":
         print(json.dumps({"error": "flash tuning needs a TPU backend"}))
@@ -86,11 +89,16 @@ def main() -> int:
         try:
             out = g(q, k, v)
             hard_block(out)
+            # subtract the pure fence round-trip (~60-70 ms through the
+            # tunnel), which would otherwise inflate every row by
+            # rtt/steps (~3 ms at 20 steps) and bias the fwd-vs-bwd
+            # ablation splits (utils/timers.py fence_rtt)
+            rtt = fence_rtt(out)
             t0 = time.perf_counter()
             for _ in range(args.steps):
                 out = g(q, k, v)
             hard_block(out)
-            ms = (time.perf_counter() - t0) / args.steps * 1000
+            ms = max(time.perf_counter() - t0 - rtt, 1e-9) / args.steps * 1e3
             row = {"cfg": name, "ms": round(ms, 2)}
         except Exception as e:  # noqa: BLE001 - report and continue tuning
             row = {"cfg": name, "error": str(e)[:200]}
