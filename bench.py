@@ -241,6 +241,16 @@ def _rows(epochs: int) -> list[dict]:
             "args": {"attn": "flash", "dtype": "bfloat16", "steps": 10,
                      "batch": 4, "seq_len": 8192},
         },
+        {
+            # KV-cache decode throughput (steady-state two-length diff;
+            # measure_lm_decode) - the inference surface's measured row.
+            # Utilization is reported against HBM bandwidth, the binding
+            # resource for decode, not the MXU peak
+            "id": "lm_decode_d512_L8_b16_bf16",
+            "kind": "lm_decode",
+            "est_s": 900,
+            "args": {"batch": 16, "dtype": "bfloat16"},
+        },
         # measured pp=4 pipeline bubble (VERDICT r2 item 4): fixed
         # microbatch size, varying (M, interleave) -> tokens/s tracks
         # 1 - bubble. Runs on a 4-device virtual CPU mesh (the one real
@@ -292,6 +302,12 @@ def _run_worker(spec: dict) -> dict:
         )
 
         return measure_lm_training(**spec["args"])
+    if spec["kind"] == "lm_decode":
+        from distributed_neural_network_tpu.train.measure import (
+            measure_lm_decode,
+        )
+
+        return measure_lm_decode(**spec["args"])
     if spec["kind"] == "pp_bubble":
         from distributed_neural_network_tpu.train.measure import (
             measure_pp_bubble,

@@ -342,7 +342,8 @@ def _bench_matrix_sections() -> list[str]:
     rows = matrix.get("rows", [])
     out = []
 
-    lm = [r for r in rows if r.get("id", "").startswith("lm_")]
+    lm = [r for r in rows if r.get("id", "").startswith("lm_")
+          and not r.get("id", "").startswith("lm_decode")]
     if lm:
         out += [
             "## LM throughput - single chip (beyond-reference model family)",
@@ -386,6 +387,38 @@ def _bench_matrix_sections() -> list[str]:
                 cfgs, r.get("attn_kernel", r["attn"]), remat,
                 r["batch"], r["seq_len"], f"{r['tokens_per_s']:,}",
                 r.get("mfu_pct", "-"),
+            ]))
+        out.append("")
+
+    dec = [r for r in rows if r.get("id", "").startswith("lm_decode")]
+    if dec:
+        out += [
+            "## KV-cache decode throughput - single chip (inference path)",
+            "",
+            "Autoregressive generation (`models/transformer.py generate`): "
+            "steady-state generated tokens/s from a two-length diff "
+            "(`train/measure.py measure_lm_decode` - the diff cancels "
+            "prompt consumption, dispatch, and the fence round-trip). "
+            "Decode streams every parameter once per step, so utilization "
+            "is reported against peak HBM BANDWIDTH (the binding resource), "
+            "not the MXU peak.",
+            "",
+            fmt_row(["config", "batch", "tok/s (steady)", "ms/step",
+                     "HBM util %"]),
+            fmt_row(["---"] * 5),
+        ]
+        for r in dec:
+            if "decode_tokens_per_s" not in r:
+                why = r.get("error", r.get("skipped", "no measurement"))
+                out.append(fmt_row([
+                    r["id"], "-", f"FAILED: {str(why)[:60]}", "-", "-",
+                ]))
+                continue
+            cfgs = (f"d{r['d_model']}/L{r['n_layers']}"
+                    f"/voc{r['vocab'] // 1000}k/{r['dtype']}")
+            out.append(fmt_row([
+                cfgs, r["batch"], f"{r['decode_tokens_per_s']:,}",
+                r.get("ms_per_step", "-"), r.get("hbm_util_pct", "-"),
             ]))
         out.append("")
 
@@ -525,19 +558,26 @@ def _flash_tune_sections() -> list[str]:
             peak = peak_flops(kind, "bfloat16")
             peak_tf = peak / 1e12 if peak else None
             bwd_tf = a.get("bwd_attn_tflops_per_s")
+            # the tune's TFLOP/s convention counts NON-halved causal
+            # FLOPs (2*B*H*S^2*D), so a causal-skipping kernel running
+            # at >50% MXU utilization can legitimately report up to ~2x
+            # the hardware peak - only beyond that ceiling is the split
+            # arithmetically impossible
             if (peak_tf is not None
                     and isinstance(bwd_tf, (int, float))
-                    and bwd_tf >= peak_tf):
+                    and bwd_tf >= 2 * peak_tf):
                 suspect.append(name)
         if suspect:
             out += [
                 "",
                 f"NOTE: derived bwd TFLOP/s for {', '.join(suspect)} "
-                f"meets/exceeds this device's bf16 peak ({peak_tf:.0f}) "
-                "- the fwd/bwd SPLIT for that impl is unreliable (the "
-                "standalone fwd timing does not match the fwd embedded "
-                "in the fwd+bwd program); the fwd+bwd column remains a "
-                "direct measurement.",
+                "meets/exceeds 2x this device's bf16 peak "
+                f"(2x{peak_tf:.0f}) - impossible even with causal "
+                "skipping (the convention counts non-halved causal "
+                "FLOPs), so the fwd/bwd SPLIT for that impl is "
+                "unreliable (the standalone fwd timing does not match "
+                "the fwd embedded in the fwd+bwd program); the fwd+bwd "
+                "column remains a direct measurement.",
             ]
         best = data.get("best_own")
         if best:

@@ -145,3 +145,27 @@ def test_top_k_sampling_stays_in_top_k(n_devices):
     # top_k=1 at any temperature is exactly greedy
     want = tfm.generate(params, prompt, CFG, max_new_tokens=8)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(want))
+
+
+def test_measure_lm_decode_tiny(n_devices):
+    """The decode bench row's measurement function runs end to end on a
+    tiny model and reports a physically coherent steady-state rate (the
+    two-length diff must be positive and the utilization fields line up
+    with n_params)."""
+    from distributed_neural_network_tpu.train.measure import (
+        measure_lm_decode,
+    )
+
+    r = measure_lm_decode(
+        d_model=32, n_layers=2, n_heads=4, d_ff=64, vocab=32,
+        batch=2, prompt_len=4, gen_short=4, gen_long=12,
+        dtype="float32", repeats=1,
+    )
+    assert r["decode_tokens_per_s"] > 0
+    assert r["decode_steps_per_s"] == pytest.approx(
+        r["decode_tokens_per_s"] / 2, rel=0.01
+    )
+    assert r["ms_per_step"] > 0
+    assert r["n_params"] > 0
+    # cpu has no HBM peak entry -> util is None there, a number on TPU
+    assert r["hbm_util_pct"] is None or r["hbm_util_pct"] > 0
