@@ -1,0 +1,261 @@
+"""Unit tests for bench.py's single-claim group runner (_run_accel_group).
+
+The group runner is the round's wedge-avoidance core: all accelerator
+rows share ONE worker subprocess, the parent watches a JSONL record
+stream, finalizes each row the moment its outcome is final, enforces
+per-row caps whose clock resets per record, stubs everything after a
+cap kill, restarts crashed groups without the crasher, and retries
+busy-backend rows with backoff. These tests drive that state machine
+hermetically with a scripted fake worker process (no jax, no chip).
+"""
+
+import json
+import os
+import sys
+import types
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench  # noqa: E402
+
+
+def _spec(i, est=1):
+    return {"id": f"row{i}", "kind": "cnn", "est_s": est, "args": {}}
+
+
+class _FakeProc:
+    """Stands in for the --worker-multi Popen: runs a scenario function
+    that appends records to the job's out file, then 'exits'."""
+
+    def __init__(self, scenario, job_path, err_path):
+        self._scenario = scenario
+        with open(job_path) as f:
+            self._job = json.load(f)
+        self._err_path = err_path
+        self._done = False
+        self.returncode = None
+        self.pid = 0
+
+    def _run_once(self):
+        if self._done:
+            return
+        self._done = True
+        self.returncode = self._scenario(self._job, self._err_path)
+
+    def poll(self):
+        self._run_once()
+        return self.returncode
+
+    def kill(self):
+        self.returncode = -9
+
+    def wait(self):
+        return self.returncode
+
+
+def _patch(monkeypatch, scenarios):
+    """Each Popen call consumes the next scenario callable; sleeps are
+    no-ops so backoff retries run instantly."""
+    calls = {"n": 0}
+
+    def fake_popen(cmd, **kw):
+        assert "--worker-multi" in cmd
+        job_path = cmd[cmd.index("--worker-multi") + 1]
+        err_path = job_path.replace(".job", "") + ".err"
+        sc = scenarios[min(calls["n"], len(scenarios) - 1)]
+        calls["n"] += 1
+        return _FakeProc(sc, job_path, err_path)
+
+    monkeypatch.setattr(bench.subprocess, "Popen", fake_popen)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    return calls
+
+
+def _args(retries=3):
+    return types.SimpleNamespace(retries=retries, row_timeout=420.0)
+
+
+def _record(job, i, payload):
+    with open(job["out"], "a") as f:
+        f.write(json.dumps({"id": job["specs"][i]["id"], **payload}) + "\n")
+
+
+def _run(specs, monkeypatch, scenarios, retries=3):
+    calls = _patch(monkeypatch, scenarios)
+    finals = []
+    bench._run_accel_group(
+        specs, _args(retries), [0.0] * (retries - 1),
+        lambda s, res, err: finals.append((s["id"], res, err)),
+    )
+    return finals, calls
+
+
+def test_all_rows_succeed_in_order(monkeypatch):
+    specs = [_spec(i) for i in range(3)]
+
+    def ok(job, err_path):
+        for i in range(len(job["specs"])):
+            _record(job, i, {"result": {"train_s": float(i)}})
+        return 0
+
+    finals, calls = _run(specs, monkeypatch, [ok])
+    assert calls["n"] == 1  # one claim for the whole group
+    assert [f[0] for f in finals] == ["row0", "row1", "row2"]
+    assert all(res is not None and err == "" for _, res, err in finals)
+
+
+def test_crash_restarts_without_crasher(monkeypatch):
+    """Worker dies during row1: row0's record survives, row1 carries the
+    death, row2 restarts in a FRESH group and succeeds."""
+    specs = [_spec(i) for i in range(3)]
+
+    def crash(job, err_path):
+        _record(job, 0, {"result": {"train_s": 1.0}})
+        with open(err_path, "w") as f:
+            f.write("Segmentation fault (core dumped)")
+        return 139
+
+    def ok(job, err_path):
+        for i in range(len(job["specs"])):
+            _record(job, i, {"result": {"train_s": 2.0}})
+        return 0
+
+    finals, calls = _run(specs, monkeypatch, [crash, ok])
+    assert calls["n"] == 2
+    by_id = {f[0]: f for f in finals}
+    assert by_id["row0"][1] == {"train_s": 1.0}
+    assert by_id["row1"][1] is None and "died" in by_id["row1"][2]
+    assert by_id["row2"][1] == {"train_s": 2.0}  # never-attempted row retried
+
+
+def test_busy_backend_retries_only_unfinished(monkeypatch):
+    """Attempt 1: row0 ok, row1 UNAVAILABLE; attempt 2 reruns ONLY row1."""
+    specs = [_spec(0), _spec(1)]
+    seen = []
+
+    def busy(job, err_path):
+        seen.append([s["id"] for s in job["specs"]])
+        _record(job, 0, {"result": {"train_s": 1.0}}
+                if job["specs"][0]["id"] == "row0"
+                else {"result": {"train_s": 9.0}})
+        for i in range(1, len(job["specs"])):
+            _record(job, i, {"error": "backend UNAVAILABLE: chip busy"})
+        return 0
+
+    def ok(job, err_path):
+        seen.append([s["id"] for s in job["specs"]])
+        for i in range(len(job["specs"])):
+            _record(job, i, {"result": {"train_s": 2.0}})
+        return 0
+
+    finals, calls = _run(specs, monkeypatch, [busy, ok])
+    assert seen[0] == ["row0", "row1"]
+    assert seen[1] == ["row1"]
+    by_id = {f[0]: f for f in finals}
+    assert by_id["row0"][1] == {"train_s": 1.0}  # finalized on attempt 1
+    assert by_id["row1"][1] == {"train_s": 2.0}
+
+
+def test_retry_budget_exhausts_to_recorded_error(monkeypatch):
+    specs = [_spec(0)]
+
+    def busy(job, err_path):
+        _record(job, 0, {"error": "backend UNAVAILABLE"})
+        return 0
+
+    finals, calls = _run(specs, monkeypatch, [busy], retries=2)
+    assert calls["n"] == 2  # initial + 1 backoff retry
+    assert finals[0][1] is None and "UNAVAILABLE" in finals[0][2]
+
+
+def test_cap_kill_stubs_current_and_rest(monkeypatch):
+    """Row0 records, then the worker hangs: the parent kills at row1's
+    cap; row1 gets the kill error, row2 a skip stub, and NO new group is
+    started (a mid-claim kill presumes a wedged claim)."""
+    specs = [_spec(i, est=1) for i in range(3)]
+
+    class _HangProc(_FakeProc):
+        def poll(self):
+            if self._done:
+                return self.returncode
+            self._done = True
+            _record(self._job, 0, {"result": {"train_s": 1.0}})
+            return None  # never exits on its own
+
+    calls = {"n": 0}
+
+    def fake_popen(cmd, **kw):
+        calls["n"] += 1
+        job_path = cmd[cmd.index("--worker-multi") + 1]
+        return _HangProc(lambda j, e: 0, job_path, job_path + ".err")
+
+    monkeypatch.setattr(bench.subprocess, "Popen", fake_popen)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    # advance a fake clock far past row1's 2*1+300 s cap on every read
+    t = {"now": 0.0}
+
+    def fake_time():
+        t["now"] += 200.0
+        return t["now"]
+
+    monkeypatch.setattr(bench.time, "time", fake_time)
+    finals = []
+    bench._run_accel_group(
+        specs, _args(), [0.0, 0.0],
+        lambda s, res, err: finals.append((s["id"], res, err)),
+    )
+    assert calls["n"] == 1  # no further claims after the kill
+    by_id = {f[0]: f for f in finals}
+    assert by_id["row0"][1] == {"train_s": 1.0}  # pre-kill record kept
+    assert by_id["row1"][1] is None and "killed" in by_id["row1"][2]
+    assert by_id["row2"][1] is None and "skipped" in by_id["row2"][2]
+
+
+def test_every_spec_finalized_exactly_once(monkeypatch):
+    specs = [_spec(i) for i in range(4)]
+
+    def half(job, err_path):
+        # records for half the rows, then silent non-retryable death
+        for i in range(len(job["specs"]) // 2):
+            _record(job, i, {"result": {"train_s": 1.0}})
+        with open(err_path, "w") as f:
+            f.write("ValueError: bad row")
+        return 1
+
+    def ok(job, err_path):
+        for i in range(len(job["specs"])):
+            _record(job, i, {"result": {"train_s": 2.0}})
+        return 0
+
+    finals, _ = _run(specs, monkeypatch, [half, ok])
+    ids = [f[0] for f in finals]
+    assert sorted(ids) == [f"row{i}" for i in range(4)]
+    assert len(set(ids)) == 4
+
+
+def test_worker_multi_env_overlay_restored(tmp_path, monkeypatch):
+    """--worker-multi applies per-row env overlays and restores them,
+    even when the row errors."""
+    recs = []
+
+    def fake_run_worker(spec):
+        recs.append((spec["id"], os.environ.get("DNN_TPU_FLASH_IMPL")))
+        if spec["id"] == "bad":
+            raise RuntimeError("boom")
+        return {"ok": 1}
+
+    monkeypatch.setattr(bench, "_run_worker", fake_run_worker)
+    monkeypatch.delenv("DNN_TPU_FLASH_IMPL", raising=False)
+    out = tmp_path / "out.jsonl"
+    job = tmp_path / "job.json"
+    job.write_text(json.dumps({"specs": [
+        {"id": "bad", "env": {"DNN_TPU_FLASH_IMPL": "lib"}},
+        {"id": "good"},
+    ], "out": str(out)}))
+    assert bench._run_worker_multi(str(job)) == 0
+    assert recs == [("bad", "lib"), ("good", None)]
+    assert "DNN_TPU_FLASH_IMPL" not in os.environ
+    lines = [json.loads(x) for x in out.read_text().splitlines()]
+    assert "error" in lines[0] and "boom" in lines[0]["error"]
+    assert lines[1] == {"id": "good", "result": {"ok": 1}}
