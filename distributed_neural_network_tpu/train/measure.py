@@ -539,7 +539,7 @@ def measure_lm_training(
     mfu = flops_tok * tok_s / peak * 100.0 if peak else None
     return {
         "d_model": d_model, "n_layers": n_layers, "n_heads": n_heads,
-        "seq_len": seq_len,
+        "d_ff": d_ff, "seq_len": seq_len,
         "vocab": vocab, "batch": batch, "steps": steps, "dtype": dtype,
         "attn": attn, "remat": remat, "remat_attn": remat_attn,
         # provenance: WHICH flash kernel measured this row (r3's numbers

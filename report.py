@@ -246,10 +246,122 @@ def main() -> int:
     ]
     lines += _bench_matrix_sections()
     lines += _flash_tune_sections()
+    lines += _mfu_ceiling_section()
     with open(args.out, "w") as f:
         f.write("\n".join(lines))
     print(f"wrote {args.out}")
     return 0
+
+
+def _mfu_ceiling_section() -> list[str]:
+    """Arithmetic MFU ceiling for the flagship LM row, from measured data.
+
+    VERDICT r3 item 2 asks for >=40% MFU or a written ablation proving
+    the ceiling. This derives the ceiling directly: the tune file's best
+    own-kernel fwd+bwd wall-clock is EXACTLY one layer's attention at
+    the flagship step shape (B16 x H8 x S2048 x Dh64), so
+
+        step_time >= L * attn_wall + (non-attention FLOPs) / peak
+
+    even if every matmul ran at 100% MXU. Ceiling MFU = step FLOPs /
+    (peak * that bound). Rendered only when both the tune file and the
+    flagship matrix row exist; all inputs are cited measured artifacts.
+    """
+    import glob
+    import os
+
+    from distributed_neural_network_tpu.models.transformer import (
+        TransformerConfig,
+    )
+    from distributed_neural_network_tpu.train.measure import (
+        model_flops_per_token,
+        peak_flops,
+    )
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    # the ceiling is only published for a flagship row that actually
+    # exists in the matrix, with the model read FROM that row (a
+    # hardcoded config could silently diverge from the bench spec)
+    try:
+        with open(os.path.join(here, "BENCH_MATRIX.json")) as f:
+            rows = json.load(f).get("rows", [])
+    except (OSError, json.JSONDecodeError):
+        return []
+    flag = next((r for r in rows
+                 if r.get("id") == "lm_flash_d512_L8_seq2048_bf16"
+                 and "tokens_per_s" in r), None)
+    if flag is None:
+        return []
+    # older-format rows (r3) may lack some fields; fall back to the bench
+    # spec's defaults for exactly this row id
+    flag.setdefault("n_heads", 8)
+    flag.setdefault("d_ff", 2048)
+    flag.setdefault("vocab", 32768)
+    seq, batch = flag["seq_len"], flag["batch"]
+    head_dim = flag["d_model"] // flag["n_heads"]
+    # matching tune file: same seq; shape must match the row's geometry
+    paths = sorted(glob.glob(
+        os.path.join(here, "tools", f"flash_tune_*_s{seq}*.json")))
+    tune = None
+    for p in paths:
+        try:
+            with open(p) as f:
+                cand = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        s = cand.get("shape", {})
+        if (s.get("seq") == seq and s.get("batch") == batch
+                and s.get("heads") == flag["n_heads"]
+                and s.get("head_dim") == head_dim
+                and cand.get("best_own_ms")):
+            tune, tune_path = cand, p
+            break
+    if tune is None:
+        return []
+    attn_ms = tune["best_own_ms"]
+    kind = str(tune.get("device", "")).replace("_", " ")
+    peak = peak_flops(kind, "bfloat16")
+    if not peak:
+        return []
+    cfg = TransformerConfig(
+        vocab_size=flag["vocab"], d_model=flag["d_model"],
+        n_heads=flag["n_heads"], n_layers=flag["n_layers"],
+        d_ff=flag.get("d_ff", 2048),
+    )
+    L = cfg.n_layers
+    flops_tok = model_flops_per_token(cfg, seq)
+    step_flops = flops_tok * batch * seq
+    # attention share of the model-FLOP count (the 4*S*d term, x3 fwd+bwd)
+    attn_flops = 3.0 * L * 4 * seq * cfg.d_model * batch * seq
+    non_attn = step_flops - attn_flops
+    attn_wall = L * attn_ms / 1e3
+    bound = attn_wall + non_attn / peak
+    ceiling = step_flops / (peak * bound) * 100.0
+    ideal = step_flops / peak
+    target_attn_ms = (step_flops / (0.40 * peak) - non_attn / peak) / L * 1e3
+    achieved = flag.get("mfu_pct")
+    ach = (f"measured {achieved}% on that row, " if achieved else "")
+    return [
+        "## MFU ceiling - flagship LM row, derived from measured kernels",
+        "",
+        f"At d{cfg.d_model}/L{L}/seq{seq}/bs{batch} the step computes "
+        f"{step_flops / 1e12:.2f} model TFLOP "
+        f"(ideal {ideal * 1e3:.0f} ms at the {peak / 1e12:.0f} TF/s bf16 "
+        f"peak). The tuned own flash kernel measures {attn_ms:.1f} ms "
+        "fwd+bwd for ONE layer's attention at exactly this shape "
+        f"(`{os.path.basename(tune_path)}`, best_own_ms), so attention "
+        f"alone costs {attn_wall * 1e3:.0f} ms/step across {L} layers. "
+        "Even with every non-attention matmul at 100% MXU utilization, "
+        f"step time >= {bound * 1e3:.0f} ms -> **MFU <= {ceiling:.0f}%** "
+        f"with the current kernel ({ach}the gap to the ceiling is the "
+        "matmul side). Reaching the 40% target at this shape requires "
+        f"attention at <= {target_attn_ms:.1f} ms/layer "
+        f"({attn_ms / max(target_attn_ms, 1e-9):.1f}x faster than "
+        "measured) - the kernel, not the surrounding program, is the "
+        "binding constraint; larger-d_model rows (attention is a "
+        "smaller FLOP fraction) are the config-level route past it.",
+        "",
+    ]
 
 
 def _oracle_fullscale_line() -> str:
@@ -418,15 +530,22 @@ def _bench_matrix_sections() -> list[str]:
                 continue
             cfgs = (f"d{r['d_model']}/L{r['n_layers']}"
                     f"/voc{r['vocab'] // 1000}k/{r['dtype']}")
-            for cache in ("at_cache_short", "at_cache_long"):
-                c = r.get(cache)
-                if not c:
-                    continue
-                is_long = cache == "at_cache_long"
+            caches = [c for c in (r.get("at_cache_short"),
+                                  r.get("at_cache_long")) if c]
+            if not caches:
+                # row measured under an older measure_lm_decode format
+                # (top-level fields only) - render it rather than drop it
+                caches = [{
+                    "cache_len": "-",
+                    "tokens_per_s": r["decode_tokens_per_s"],
+                    "ms_per_step": r.get("ms_per_step", "-"),
+                }]
+            for i, c in enumerate(caches):
+                is_last = i == len(caches) - 1
                 out.append(fmt_row([
                     cfgs, r["batch"], c["cache_len"],
                     f"{c['tokens_per_s']:,}", c["ms_per_step"],
-                    r.get("hbm_util_pct", "-") if is_long else "-",
+                    r.get("hbm_util_pct", "-") if is_last else "-",
                 ]))
         out.append("")
 
