@@ -277,6 +277,20 @@ def _rows(epochs: int) -> list[dict]:
             },
             "args": {},
         },
+        # ring-attention sequence-parallel scaling shape (the SP analog
+        # of the dp row): fixed global sequence (measure_sp_scaling's
+        # default, 2048 - a single host core must finish the sweep
+        # inside the CPU row cap), sp = 1..8 on the CPU mesh -
+        # long-context overhead evidence within one chip
+        {
+            "id": "lm_ring_sp_scaling_cpu8",
+            "kind": "sp_scaling",
+            "env": {
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+            },
+            "args": {},
+        },
     ]
     return rows
 
@@ -320,6 +334,12 @@ def _run_worker(spec: dict) -> dict:
         )
 
         return measure_dp_scaling(**spec["args"])
+    if spec["kind"] == "sp_scaling":
+        from distributed_neural_network_tpu.train.measure import (
+            measure_sp_scaling,
+        )
+
+        return measure_sp_scaling(**spec["args"])
     raise ValueError(f"unknown row kind {spec['kind']!r}")
 
 

@@ -226,6 +226,96 @@ def measure_dp_scaling(
     }
 
 
+def measure_sp_scaling(
+    *,
+    sps=(1, 2, 4, 8),
+    d_model: int = 128,
+    n_layers: int = 4,
+    n_heads: int = 8,
+    d_ff: int = 512,
+    vocab: int = 2048,
+    seq_len: int = 2048,
+    batch: int = 2,
+    steps: int = 3,
+    attn_impl: str = "ring",
+) -> dict:
+    """Ring-attention sequence-parallel scaling shape on the virtual CPU
+    mesh - the SP analog of `measure_dp_scaling` (long-context evidence
+    beyond the single-chip hardware this environment provides).
+
+    Fixed GLOBAL sequence, sp swept: each device holds seq_len/sp tokens
+    and the ring rotates K/V blocks sp-1 times per attention
+    (parallel/ring.py). On n virtual devices sharing ONE host core,
+    total model FLOPs are identical at every sp, so ideal wall-clock is
+    flat; growth of t_sp / t_1 is the sequence-parallel overhead
+    (per-device dispatch, ring permutes, per-hop softmax-merge). On real
+    chips wall divides by sp modulo exactly this curve plus ICI latency
+    (which a CPU mesh cannot see - stated in the row note).
+    """
+    from ..models import transformer as tfm
+    from ..utils.timers import hard_block
+    from . import lm as lmtrain
+
+    if not sps or sps[0] != 1:
+        raise ValueError(
+            f"sps must start at 1 (the overhead_vs_sp1 baseline), got {sps}"
+        )
+    cfg = tfm.TransformerConfig(
+        vocab_size=vocab, d_model=d_model, n_heads=n_heads,
+        n_layers=n_layers, d_ff=d_ff,
+    )
+    points = []
+    for sp in sps:
+        if sp > jax.device_count():
+            continue
+        mesh = lmtrain.create_lm_mesh(1, sp, 1)
+        params, _ = lmtrain.shard_params(
+            tfm.init_params(jax.random.key(0), cfg), cfg, mesh
+        )
+        mom = lmtrain.init_lm_momentum(params, mesh)
+        # at sp=1 the step builder drops the sequence axis (lm.py: seq
+        # axis None) and the same attn_impl runs as plain local
+        # attention - the baseline is the identical program minus the
+        # ring, exactly the overhead being measured
+        step = lmtrain.make_lm_train_step(cfg, mesh, lr=0.01,
+                                          attn_impl=attn_impl)
+        tokens, targets = lmtrain.make_copy_task(
+            jax.random.key(1), batch=batch, seq_len=seq_len, vocab=vocab
+        )
+        params, mom, loss = step(params, mom, tokens, targets)  # compile
+        hard_block(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, mom, loss = step(params, mom, tokens, targets)
+        hard_block(loss)
+        dt = time.perf_counter() - t0
+        points.append({
+            "sp": sp,
+            "wall_s": round(dt, 3),
+            "tokens_per_s": round(batch * seq_len * steps / dt),
+            "final_loss": round(float(loss), 4),
+        })
+    t1 = points[0]["wall_s"]
+    for p in points:
+        p["overhead_vs_sp1"] = round(p["wall_s"] / max(t1, 1e-9), 3)
+    return {
+        "devices": jax.device_count(),
+        "platform": jax.default_backend(),
+        "attn_impl": attn_impl,
+        "d_model": d_model, "n_layers": n_layers, "seq_len": seq_len,
+        "batch": batch, "steps": steps,
+        "host_cores": os.cpu_count(),
+        "points": points,
+        "overhead_vs_sp1_max": max(p["overhead_vs_sp1"] for p in points),
+        "note": (
+            "fixed global sequence on one shared host core: ideal wall "
+            "is flat in sp; overhead_vs_sp1 is the measured ring/"
+            "sequence-parallel cost. Real sp-chip wall divides by sp "
+            "modulo this curve (ICI latency not visible on a CPU mesh)."
+        ),
+    }
+
+
 def measure_pp_bubble(
     *,
     d_model: int = 256,

@@ -455,7 +455,7 @@ def _bench_matrix_sections() -> list[str]:
     out = []
 
     lm = [r for r in rows if r.get("id", "").startswith("lm_")
-          and not r.get("id", "").startswith("lm_decode")]
+          and not r.get("id", "").startswith(("lm_decode", "lm_ring_sp"))]
     if lm:
         out += [
             "## LM throughput - single chip (beyond-reference model family)",
@@ -606,6 +606,42 @@ def _bench_matrix_sections() -> list[str]:
                 f"{100 * c['sync_frac']:.2f}%", c["overhead_vs_n1"],
             ]))
         out += ["", r.get("note", ""), ""]
+
+    sp = [r for r in rows if r.get("id", "").startswith("lm_ring_sp")
+          and "points" in r]
+    if sp:
+        r = sp[-1]
+        out += [
+            "## Sequence-parallel scaling shape - ring attention, "
+            f"{r['devices']}-device {r['platform']} mesh, "
+            f"{r['host_cores']} host core(s)",
+            "",
+            "Long-context evidence within a one-chip environment: fixed "
+            f"global sequence ({r['seq_len']} tokens, "
+            f"d{r['d_model']}/L{r['n_layers']} LM), sp swept - each "
+            "device holds seq/sp tokens and ring attention rotates K/V "
+            "blocks sp-1 times per layer (`parallel/ring.py`; "
+            "`train/measure.py measure_sp_scaling`). Total FLOPs are "
+            "identical at every sp on the shared host core, so ideal "
+            "wall is flat and `overhead vs sp=1` is the measured "
+            "sequence-parallel cost; real sp-chip wall divides by sp "
+            "modulo this curve.",
+            "",
+            fmt_row(["sp", "wall s", "tokens/s", "loss",
+                     "overhead vs sp=1"]),
+            fmt_row(["---"] * 5),
+        ]
+        for c in r["points"]:
+            out.append(fmt_row([
+                c["sp"], c["wall_s"], f"{c['tokens_per_s']:,}",
+                c["final_loss"], c["overhead_vs_sp1"],
+            ]))
+        out += [
+            "",
+            "The identical loss column is the semantics check: every sp "
+            "computes the same model step.",
+            "",
+        ]
     return out
 
 
