@@ -575,7 +575,15 @@ def _bench_matrix_sections() -> list[str]:
                 c["bubble_measured"],
                 c.get("bubble_overhead_adjusted", "-"),
             ]))
-        out += ["", r.get("note", ""), ""]
+        tm = r.get("tick_model") or {}
+        fit = (f" Tick-model fit: per-layer {tm.get('per_layer_s')}s, "
+               f"per-tick overhead {tm.get('per_tick_overhead_s')}s, "
+               f"relative residual {tm.get('rel_fit_err')}. A NEGATIVE "
+               "overhead-adjusted cell means that config ran faster than "
+               "the fitted tick model predicts (fit residual, not a "
+               "physical negative bubble) - read those cells as ~0."
+               if tm else "")
+        out += ["", (r.get("note", "") + fit).strip(), ""]
 
     sc = [r for r in rows if r.get("id", "").startswith("cnn_dp_scaling")
           and "points" in r]
