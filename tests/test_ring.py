@@ -147,3 +147,25 @@ def test_ring_attention_single_device_degenerates(n_devices):
     got = _sharded(mesh, ring_attention, True)(q, k, v)
     want = attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+def test_measure_sp_scaling_tiny(n_devices):
+    """The sp-scaling bench row's measurement function: loss must be
+    IDENTICAL at every sp (the semantics pin - same global batch, same
+    model, only the mesh factorization changes) and the overhead column
+    must be relative to sp=1."""
+    from distributed_neural_network_tpu.train.measure import (
+        measure_sp_scaling,
+    )
+
+    r = measure_sp_scaling(
+        sps=(1, 2), d_model=32, n_layers=2, n_heads=4, d_ff=64,
+        vocab=64, seq_len=128, batch=2, steps=1,
+    )
+    pts = r["points"]
+    assert [p["sp"] for p in pts] == [1, 2]
+    assert pts[0]["final_loss"] == pts[1]["final_loss"]
+    assert pts[0]["overhead_vs_sp1"] == 1.0
+    assert all(p["tokens_per_s"] > 0 for p in pts)
+    with pytest.raises(ValueError, match="must start at 1"):
+        measure_sp_scaling(sps=(2, 4), seq_len=128, batch=2, steps=1)
