@@ -7,6 +7,14 @@
 #                    > watch_measure.log 2>&1 &
 set -u
 cd "$(dirname "$0")/.."
+# refuse to start while another measurement session is live (two claimers
+# wedge the chip). Anchored to a python first token: an unanchored name
+# match also hits unrelated processes embedding these filenames in argv
+while pgrep -f "^[^ ]*python[0-9.]* [^ ]*(bench|tune_flash|measure_all)\.py" \
+    > /dev/null; do
+  echo "[watch] a measurement session is still running; sleeping 120s"
+  sleep 120
+done
 attempt=0
 while true; do
   attempt=$((attempt + 1))
