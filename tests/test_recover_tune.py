@@ -1,0 +1,113 @@
+"""tools/recover_tune.py: rebuild a tune file from a session log.
+
+The tuner streams rows but writes its JSON only at sweep end; the r4
+tunnel death left the best measured backward blocks log-only. These
+tests pin the reconstruction: segment splitting, block parsing from cfg
+names (incl. asymmetric tags), the tuner's paired-ablation rule, and
+that `ops/flash.py tuned_blocks()` loads the recovered file.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+TOOLS = Path(__file__).resolve().parent.parent / "tools"
+sys.path.insert(0, str(TOOLS))
+
+from recover_tune import parse_segments, rebuild  # noqa: E402
+
+from distributed_neural_network_tpu.ops.flash_pallas import FlashBlocks  # noqa: E402
+
+
+LOG = """\
+[fill] probe attempt 1 at 07:16:19
+probe ok: value 1.0 in 2.6 s
+[fill] chip healthy at 07:16:24 - re-tuning (RTT-corrected)
+{"cfg": "own_fwd_q512k512", "ms": 5.0}
+{"cfg": "own_fwd_q1024k1024", "ms": 4.4}
+{"cfg": "own_fb_q1024_dq512_dkv512", "ms": 13.0}
+{"cfg": "own_fb_q1024_dq1024_dkv512", "ms": 12.4}
+{"cfg": "own_fb_q1024_dq1024_dkv512x1024", "ms": 11.81}
+{"cfg": "own_fb_q1024_dq1024_dkv1024x1024", "error": "UNAVAILABLE: boom"}
+"""
+
+
+def test_rebuild_best_own_and_ablation():
+    rows = parse_segments(LOG.splitlines())[0]
+    p = rebuild(rows, batch=16, heads=8, seq=2048, head_dim=64,
+                device="TPU_v5_lite")
+    assert p["best_own_ms"] == 11.81
+    assert p["best_own"] == {"bq": 1024, "bk": 1024, "bq_dq": 1024,
+                             "bk_dq": 1024, "bq_dkv": 512, "bk_dkv": 1024}
+    # fwd ms pairs with the fb rows' forward blocks (q1024 -> 4.4)
+    own = p["ablation"]["own"]
+    assert own["fwd_ms"] == 4.4
+    assert own["bwd_ms_derived"] == pytest.approx(11.81 - 4.4, abs=0.01)
+    # lib/xla rows never ran -> None, same shape as an errored sweep
+    assert p["ablation"]["lib"]["fwdbwd_ms"] is None
+    assert p["recovered_from_log"] is True
+    # error rows ride along for provenance
+    assert any("error" in r for r in p["rows"])
+
+
+def test_unpaired_baseline_rows_survive():
+    """A lone lib_fwd row from a sweep the tunnel cut short keeps its
+    measurement (the tuner's paired_ms fallback), but bwd is never
+    derived across unmatched fwd/fb configs."""
+    log = LOG + '{"cfg": "lib_fwd_uniform512", "ms": 12.4}\n'
+    rows = parse_segments(log.splitlines())[0]
+    p = rebuild(rows, batch=16, heads=8, seq=2048, head_dim=64,
+                device="TPU_v5_lite")
+    lib = p["ablation"]["lib"]
+    assert lib["fwd_ms"] == 12.4
+    assert lib["fwdbwd_ms"] is None and lib["bwd_ms_derived"] is None
+    assert lib["fwd_attn_tflops_per_s"] is not None
+
+
+def test_segment_split_on_wrote_and_restart():
+    two_runs = LOG + '{"wrote": "x.json", "best_own": {}}\n' + LOG
+    segs = parse_segments(two_runs.splitlines())
+    assert len(segs) == 2 and segs[0] == segs[1]
+    # restart WITHOUT a "wrote" line (tuner died): repeated cfg splits
+    no_wrote = LOG + LOG
+    assert len(parse_segments(no_wrote.splitlines())) == 2
+
+
+def test_cli_writes_loadable_tune_file(tmp_path, monkeypatch):
+    log = tmp_path / "fill.log"
+    log.write_text(LOG)
+    out = tmp_path / "flash_tune_cpu_s2048.json"
+    r = subprocess.run(
+        [sys.executable, str(TOOLS / "recover_tune.py"), "--log", str(log),
+         "--device", "cpu", "--out", str(out)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert json.loads(out.read_text())["best_own"]["bq_dkv"] == 512
+
+    # tuned_blocks() consumes it exactly like a tuner-written file
+    from distributed_neural_network_tpu.ops import flash
+
+    monkeypatch.setattr(flash, "_TUNE_DIR", str(tmp_path))
+    flash.tuned_blocks.cache_clear()
+    try:
+        blk = flash.tuned_blocks(2048, 64)
+        assert blk == FlashBlocks(bq=1024, bk=1024, bq_dq=1024, bk_dq=1024,
+                                  bq_dkv=512, bk_dkv=1024)
+    finally:
+        flash.tuned_blocks.cache_clear()
+
+    # refuses to clobber a real tuner file without --force
+    real = {"shape": {"seq": 2048, "head_dim": 64}, "device": "cpu",
+            "best_own": {"bq": 256}}
+    out.write_text(json.dumps(real))
+    r2 = subprocess.run(
+        [sys.executable, str(TOOLS / "recover_tune.py"), "--log", str(log),
+         "--device", "cpu", "--out", str(out)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r2.returncode == 1 and "real" in r2.stdout
+    assert json.loads(out.read_text()) == real

@@ -1,0 +1,234 @@
+#!/usr/bin/env python
+"""Reconstruct a `flash_tune_*.json` file from a measurement-session log.
+
+Why this exists: `tools/tune_flash.py` streams one JSON row per timed
+config but writes its output file only at the END of the sweep. When the
+axon tunnel dies mid-sweep (r4 second pass: the relay process carrying
+the tunnel died and every later RPC burns a ~50-minute retry window
+before erroring), the measured rows - including the best backward-block
+combination the whole sweep exists to find - survive only in the log.
+This tool re-derives the tuner's payload from those rows so
+`ops/flash.py tuned_blocks()` and REPORT.md's MFU-ceiling section can
+consume the measurements without re-running the sweep on a dead chip.
+
+Scope: the reconstruction covers exactly what the log rows contain. The
+per-pass ablation is derived with the tuner's own pairing rule (fwd and
+fwd+bwd of the SAME variant); sections whose rows never ran (e.g. the
+library baselines when the tunnel died first) are emitted as None, the
+same shape a completed-but-errored sweep produces. The payload carries
+`"recovered_from_log"` so provenance stays visible, and the tool refuses
+to overwrite a file the real tuner wrote (no marker) unless --force.
+
+Shape/device are NOT in the log rows; they come from flags whose
+defaults match `tune_flash.py`'s defaults (the hd64 flagship geometry).
+
+COUPLING: `rebuild()` mirrors the payload logic at the end of
+`tune_flash.py main()` (pairing rule, FLOP conventions, payload keys) -
+if that changes, change this in lockstep. They stay two copies rather
+than one shared module because tune_flash.py is executed by live
+measurement sessions that must never be edited mid-run; the mirror is
+pinned by tests/test_recover_tune.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+_FB = re.compile(r"^own_fb_q(\d+(?:x\d+)?)_dq(\d+(?:x\d+)?)_dkv(\d+(?:x\d+)?)$")
+
+
+def _pair(tag: str) -> tuple[int, int]:
+    """"512" -> (512, 512); "512x1024" -> (512, 1024)."""
+    if "x" in tag:
+        a, b = tag.split("x", 1)
+        return int(a), int(b)
+    return int(tag), int(tag)
+
+
+def parse_segments(lines: list[str]) -> list[list[dict]]:
+    """Split a session log into tuner-run segments of {"cfg": ...} rows.
+
+    A segment ends at the tuner's final `{"wrote": ...}` line, or when a
+    cfg name repeats (a fresh tuner run restarting its sweep without a
+    "wrote" line - the tunnel-death case this tool exists for)."""
+    segments: list[list[dict]] = []
+    cur: list[dict] = []
+    seen: set[str] = set()
+    for ln in lines:
+        ln = ln.strip()
+        if not ln.startswith("{"):
+            continue
+        try:
+            row = json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+        if not isinstance(row, dict):
+            continue
+        if "wrote" in row:
+            if cur:
+                segments.append(cur)
+            cur, seen = [], set()
+            continue
+        cfg = row.get("cfg")
+        if not isinstance(cfg, str):
+            continue
+        if cfg in seen:
+            segments.append(cur)
+            cur, seen = [], set()
+        cur.append(row)
+        seen.add(cfg)
+    if cur:
+        segments.append(cur)
+    return segments
+
+
+def rebuild(rows: list[dict], *, batch: int, heads: int, seq: int,
+            head_dim: int, device: str) -> dict:
+    """The tuner's payload (tune_flash.py's `payload` dict) from its
+    streamed rows, with `recovered_from_log` provenance."""
+    fb_ok = [(r, _FB.match(r["cfg"])) for r in rows
+             if "ms" in r and _FB.match(r.get("cfg", ""))]
+    best_own, best_own_ms = None, None
+    best_tag = None
+    for r, m in fb_ok:
+        if best_own_ms is None or r["ms"] < best_own_ms:
+            (bq, bk) = _pair(m.group(1))
+            (bq_dq, bk_dq) = _pair(m.group(2))
+            (bq_dkv, bk_dkv) = _pair(m.group(3))
+            best_own = {"bq": bq, "bk": bk, "bq_dq": bq_dq, "bk_dq": bk_dq,
+                        "bq_dkv": bq_dkv, "bk_dkv": bk_dkv}
+            best_own_ms, best_tag = r["ms"], m.group(1)
+
+    # fwd ms of the SAME forward blocks every fb config used (the tuner's
+    # pairing rule: bwd is only derivable when fwd configs match)
+    f_own = None
+    if best_tag is not None:
+        bq, bk = _pair(best_tag)
+        f_own = next((r["ms"] for r in rows
+                      if r.get("cfg") == f"own_fwd_q{bq}k{bk}"
+                      and "ms" in r), None)
+
+    fwd_flops = 2.0 * batch * heads * seq * seq * head_dim
+
+    def tflops(flops, ms):
+        return None if not ms else round(flops / (ms / 1e3) / 1e12, 2)
+
+    def paired(fwd_p: str, fb_p: str):
+        """(fwd_ms, fb_ms, matched) - the tuner's paired_ms rule,
+        including its fallback: when no variant has BOTH timings, keep
+        the best of whatever was measured (a lone lib_fwd row from a
+        sweep the tunnel cut short must not vanish), but flag the pair
+        unmatched so bwd is never derived across mismatched configs."""
+        fwd_by = {r["cfg"][len(fwd_p):]: r["ms"] for r in rows
+                  if r.get("cfg", "").startswith(fwd_p) and "ms" in r}
+        fb_by = {r["cfg"][len(fb_p):]: r["ms"] for r in rows
+                 if r.get("cfg", "").startswith(fb_p) and "ms" in r}
+        both = [v for v in fb_by if v in fwd_by]
+        if not both:
+            return (min(fwd_by.values()) if fwd_by else None,
+                    min(fb_by.values()) if fb_by else None, False)
+        v = min(both, key=fb_by.get)
+        return fwd_by[v], fb_by[v], True
+
+    ablation = {}
+    for name, fwd_p, fb_p in (("lib", "lib_fwd_", "lib_fb_"),
+                              ("xla", "xla_fwd", "xla_fb")):
+        f, fb, matched = paired(fwd_p, fb_p)
+        bwd = (None if f is None or fb is None or not matched
+               else round(fb - f, 2))
+        ablation[name] = {
+            "fwd_ms": f, "fwdbwd_ms": fb, "bwd_ms_derived": bwd,
+            "fwd_attn_tflops_per_s": tflops(fwd_flops, f),
+            "bwd_attn_tflops_per_s": tflops(2.5 * fwd_flops, bwd),
+        }
+    bwd_own = (None if f_own is None or best_own_ms is None
+               else round(best_own_ms - f_own, 2))
+    ablation["own"] = {
+        "fwd_ms": f_own, "fwdbwd_ms": best_own_ms,
+        "bwd_ms_derived": bwd_own,
+        "fwd_attn_tflops_per_s": tflops(fwd_flops, f_own),
+        "bwd_attn_tflops_per_s": tflops(2.5 * fwd_flops, bwd_own),
+    }
+
+    lib_fb = [r for r in rows
+              if r.get("cfg", "").startswith("lib_fb_") and "ms" in r]
+    return {
+        "shape": {"batch": batch, "heads": heads, "seq": seq,
+                  "head_dim": head_dim},
+        "device": device,
+        "rows": rows,
+        "best_own": best_own,
+        "best_own_ms": best_own_ms,
+        "best_lib_fwdbwd": (min(lib_fb, key=lambda r: r["ms"])
+                            if lib_fb else None),
+        "ablation": ablation,
+        "recovered_from_log": True,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--log", required=True, help="session log to parse")
+    ap.add_argument("--segment", type=int, default=0,
+                    help="which tuner run in the log (0 = first)")
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--head-dim", type=int, default=64)
+    ap.add_argument("--device", default="TPU_v5_lite",
+                    help="device kind as jax reports it, spaces as _")
+    ap.add_argument("--out", default=None,
+                    help="output path (default: the tuner's own filename "
+                         "convention next to this script)")
+    ap.add_argument("--force", action="store_true",
+                    help="overwrite even a file the real tuner wrote")
+    args = ap.parse_args()
+
+    with open(args.log) as f:
+        segments = parse_segments(f.readlines())
+    if not segments or args.segment >= len(segments):
+        print(json.dumps({"error": f"no tuner segment {args.segment} in "
+                                   f"{args.log} ({len(segments)} found)"}))
+        return 1
+    payload = rebuild(segments[args.segment], batch=args.batch,
+                      heads=args.heads, seq=args.seq,
+                      head_dim=args.head_dim, device=args.device)
+    if payload["best_own"] is None:
+        print(json.dumps({"error": "segment has no measured own_fb row"}))
+        return 1
+
+    out = args.out or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        f"flash_tune_{args.device}_s{args.seq}_d{args.head_dim}.json"
+        if args.head_dim != 64
+        else f"flash_tune_{args.device}_s{args.seq}.json",
+    )
+    if os.path.exists(out) and not args.force:
+        try:
+            with open(out) as f:
+                existing = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            existing = None  # unreadable/corrupt - NOT real tuner data
+        if existing is None:
+            print(json.dumps({"error": f"{out} exists but is unreadable/"
+                                       "corrupt; use --force to replace"}))
+            return 1
+        if not existing.get("recovered_from_log"):
+            print(json.dumps({"error": f"{out} was written by the real "
+                                       "tuner; use --force to replace"}))
+            return 1
+    with open(out, "w") as f:
+        json.dump(payload, f, indent=1)
+    print(json.dumps({"wrote": out, "best_own": payload["best_own"],
+                      "best_own_ms": payload["best_own_ms"],
+                      "n_rows": len(payload["rows"]),
+                      "recovered_from_log": True}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
