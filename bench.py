@@ -291,6 +291,21 @@ def _rows(epochs: int) -> list[dict]:
             },
             "args": {},
         },
+        # ZeRO-1 optimizer-state footprint: committed per-device buffer
+        # bytes, replicated Adam vs ZeRO-Adam over dp=8, measured at
+        # init AND after one compiled step (the sharding must survive
+        # the jitted update). The memory artifact behind the ZeRO
+        # capability row - the reference's per-worker private optimizers
+        # have the opposite slope (measure_zero_memory docstring)
+        {
+            "id": "zero1_adam_memory_cpu8",
+            "kind": "zero_memory",
+            "env": {
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+            },
+            "args": {},
+        },
     ]
     return rows
 
@@ -340,6 +355,12 @@ def _run_worker(spec: dict) -> dict:
         )
 
         return measure_sp_scaling(**spec["args"])
+    if spec["kind"] == "zero_memory":
+        from distributed_neural_network_tpu.train.measure import (
+            measure_zero_memory,
+        )
+
+        return measure_zero_memory(**spec["args"])
     raise ValueError(f"unknown row kind {spec['kind']!r}")
 
 

@@ -671,6 +671,54 @@ def _bench_matrix_sections() -> list[str]:
             "computes the same model step.",
             "",
         ]
+
+    zm = [r for r in rows if r.get("id", "").startswith("zero1_")
+          and "optimizers" in r]
+    if zm:
+        r = zm[-1]
+        opts = r["optimizers"]
+
+        def mb(b):
+            return f"{b / 1e6:.2f} MB"
+
+        out += [
+            "## ZeRO-1 optimizer-state footprint - measured device "
+            "buffers",
+            "",
+            f"Committed per-device buffer bytes (`addressable_shards`) "
+            f"for a d{r['d_model']}/L{r['n_layers']} LM "
+            f"({r['n_params']:,} params, {mb(r['param_bytes_per_device'])}"
+            f" of parameters per device) on a {r['devices']}-device "
+            f"{r['platform']} mesh - counted at init and again after one "
+            "compiled train step, so the artifact proves the state stays "
+            "sharded through the jitted update "
+            "(`train/measure.py measure_zero_memory`). The reference's "
+            "per-worker private optimizers multiply this memory with "
+            "worker count (`data_parallelism_train.py:187`); ZeRO-1 "
+            "divides it.",
+            "",
+            fmt_row(["optimizer", "state MB/device (init)",
+                     "after 1 step", "loss after 1 step"]),
+            fmt_row(["---"] * 4),
+        ]
+        for name, o in opts.items():
+            out.append(fmt_row([
+                name, mb(o["state_bytes_per_device"]),
+                mb(o["state_bytes_per_device_post_step"]),
+                o["final_loss"],
+            ]))
+        red = r.get("reduction_x")
+        exp = r.get("expected_zero_bytes_per_device")
+        zb = opts.get("zero-adam", {}).get("state_bytes_per_device")
+        exact = (" - byte-exact vs the derived per-leaf shard layout"
+                 if zb == exp else "")
+        out += [
+            "",
+            f"Measured reduction: **{red}x** per device{exact}; the "
+            "identical loss is the semantics check (ZeRO-1 partitions "
+            "state, not math - `tests/test_zero.py`).",
+            "",
+        ]
     return out
 
 
