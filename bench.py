@@ -306,6 +306,20 @@ def _rows(epochs: int) -> list[dict]:
             },
             "args": {},
         },
+        # the fault experiment the reference implemented but never ran
+        # (its report section 6.2): failure-probability sweep at fixed
+        # seed - wall-clock flat (drop-and-continue; the reference's
+        # straggler design stalls the epoch instead) and convergence
+        # surviving a 0.6 drop rate (measure_fault_tolerance docstring)
+        {
+            "id": "cnn_fault_sweep_cpu8",
+            "kind": "fault_sweep",
+            "env": {
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+            },
+            "args": {},
+        },
     ]
     return rows
 
@@ -361,6 +375,12 @@ def _run_worker(spec: dict) -> dict:
         )
 
         return measure_zero_memory(**spec["args"])
+    if spec["kind"] == "fault_sweep":
+        from distributed_neural_network_tpu.train.measure import (
+            measure_fault_tolerance,
+        )
+
+        return measure_fault_tolerance(**spec["args"])
     raise ValueError(f"unknown row kind {spec['kind']!r}")
 
 

@@ -757,3 +757,97 @@ def measure_zero_memory(
             "multiplies with workers; this divides."
         ),
     }
+
+
+def measure_fault_tolerance(
+    *,
+    probs=(0.0, 0.3, 0.6),
+    epochs: int = 8,
+    batch_size: int = 16,
+    synthetic_size: int = 2000,
+    lr: float = 0.05,
+    seed: int = 0,
+) -> dict:
+    """The fault experiment the reference implemented but never ran
+    (its report section 6.2: `simulate_failure` exists at
+    `data_parallelism_train.py:41-46` yet no fault numbers were ever
+    published). Sweeps `--failure-probability` at a fixed seed on the
+    full mesh and measures what drop-and-continue actually costs.
+
+    Two claims, both measured rather than asserted:
+
+    - **Wall-clock is flat in p.** A dropped device is excluded from the
+      epoch-edge parameter average by the live-mask (`parallel/fault.py`;
+      weighted pmean over survivors) - nobody waits for it. In the
+      reference the same event is a straggler sleep that stalls the
+      WHOLE epoch behind the blocking recv
+      (`data_parallelism_train.py:227`): its cost is p * duration *
+      epochs of pure wall-clock, unmeasured in its report.
+    - **Convergence survives.** Dropped devices discard their epoch's
+      contribution (mean_live_frac is the surviving fraction), yet the
+      run reaches the control's accuracy at the default settings even at
+      p=0.6, and never diverges or deadlocks - including all-dead epochs
+      (the mask degrades to keeping current params).
+
+    Same seed everywhere: p=0 is the exact control (identical shuffles,
+    identical init), so deltas are attributable to the masking alone.
+    """
+    n = jax.device_count()
+    train_split = load_split(True, source="synthetic",
+                             synthetic_size=synthetic_size)
+    test_split = load_split(False, source="synthetic",
+                            synthetic_size=max(1, synthetic_size // 5))
+    # ONE engine, ONE compile for the whole sweep: failure_probability
+    # only feeds the host-built live-masks run_span passes as runtime
+    # arguments (engine.py run_span), so the compiled span is identical
+    # at every p - the sweep mutates the config and resets state
+    # (same seed -> same init/shuffles: p=0 stays the exact control).
+    # This is also why the sweep cannot just call measure_dp_training
+    # per point (each call would rebuild + re-AOT-compile its engine).
+    cfg = TrainConfig(
+        lr=lr, batch_size=batch_size, epochs=epochs, nb_proc=n,
+        regime="data_parallel", seed=seed,
+    )
+    engine = Engine(cfg, train_split, test_split)
+    engine.compile_span(epochs, eval_inside=False)
+    points = []
+    for p in probs:
+        cfg.failure_probability = float(p)
+        engine.reset_state()
+        timers = T.PhaseTimers()
+        engine.run_span(0, epochs, eval_inside=False, timers=timers)
+        vl, va = engine._eval_fn(
+            engine.params, engine.test_images, engine.test_labels,
+            engine.test_weights,
+        )
+        lives = [h.n_live for h in engine.history]
+        points.append({
+            "failure_probability": float(p),
+            "val_acc": round(float(va), 2),
+            "val_loss": round(float(vl), 4),
+            "train_s": round(
+                timers.get(T.TRAINING) + timers.get(T.COMMUNICATION), 3),
+            "epochs_degraded": sum(1 for v in lives if v < n),
+            "min_live_devices": min(lives),
+            "mean_live_frac": round(sum(lives) / (len(lives) * n), 3),
+        })
+    # baseline = the actual p=0 point (first point only as a fallback for
+    # custom sweeps without a control - the field name promises p=0)
+    t0 = next((c["train_s"] for c in points
+               if c["failure_probability"] == 0.0), points[0]["train_s"])
+    for c in points:
+        c["wall_vs_p0"] = round(c["train_s"] / max(t0, 1e-9), 3)
+    return {
+        "devices": n,
+        "platform": jax.default_backend(),
+        "epochs": epochs, "batch_size": batch_size,
+        "synthetic_size": synthetic_size, "seed": seed,
+        "points": points,
+        "note": (
+            "fixed seed: p=0 is the exact control. wall_vs_p0 ~ 1.0 is "
+            "the drop-and-continue claim (no one waits for dead "
+            "devices); the reference's straggler-sleep design stalls "
+            "every epoch behind its blocking recv instead, and its "
+            "report ran no fault experiment at all (section 6.2)."
+        ),
+    }
