@@ -767,6 +767,7 @@ def measure_fault_tolerance(
     synthetic_size: int = 2000,
     lr: float = 0.05,
     seed: int = 0,
+    straggler_duration: float = 0.25,
 ) -> dict:
     """The fault experiment the reference implemented but never ran
     (its report section 6.2: `simulate_failure` exists at
@@ -837,12 +838,55 @@ def measure_fault_tolerance(
                if c["failure_probability"] == 0.0), points[0]["train_s"])
     for c in points:
         c["wall_vs_p0"] = round(c["train_s"] / max(t0, 1e-9), 3)
+
+    # the reference's ACTUAL failure semantics, priced: --failure-duration
+    # sleeps the epoch (straggler_sleep; one sleep per degraded epoch,
+    # like the reference's overlapping worker sleeps behind the blocking
+    # recv). Same seed and p, per-epoch path, duration 0 vs d: identical
+    # masks and compute, so the wall delta IS the stall - compared to the
+    # predicted epochs_degraded * duration.
+    straggler = None
+    if straggler_duration > 0 and max(probs) > 0:
+        import contextlib
+        import io
+
+        cfg.failure_probability = float(max(probs))
+        walls = {}
+        first = True
+        for dur in (0.0, float(straggler_duration)):
+            cfg.failure_duration = dur
+            engine.reset_state()
+            if first:  # compile the per-epoch path outside the timing
+                engine.run_epoch(0, timers=T.PhaseTimers(), do_eval=False)
+                engine.reset_state()
+                first = False
+            # stdout redirected SYMMETRICALLY on both sides: the dur>0
+            # run prints two fail/wake lines per failed device per epoch
+            # (parallel/fault.py straggler_sleep) and that I/O must not
+            # bias the delta; eval is skipped - the stall is the quantity
+            t_w = time.perf_counter()
+            with contextlib.redirect_stdout(io.StringIO()):
+                for e in range(epochs):
+                    engine.run_epoch(e, timers=T.PhaseTimers(),
+                                     do_eval=False)
+            walls[dur] = time.perf_counter() - t_w
+        degraded = sum(1 for h in engine.history if h.n_live < n)
+        cfg.failure_duration = 0.0
+        straggler = {
+            "failure_probability": float(max(probs)),
+            "duration_s": float(straggler_duration),
+            "epochs_degraded": degraded,
+            "predicted_stall_s": round(degraded * straggler_duration, 3),
+            "measured_stall_s": round(
+                walls[float(straggler_duration)] - walls[0.0], 3),
+        }
     return {
         "devices": n,
         "platform": jax.default_backend(),
         "epochs": epochs, "batch_size": batch_size,
         "synthetic_size": synthetic_size, "seed": seed,
         "points": points,
+        "straggler": straggler,
         "note": (
             "fixed seed: p=0 is the exact control. wall_vs_p0 ~ 1.0 is "
             "the drop-and-continue claim (no one waits for dead "

@@ -759,6 +759,19 @@ def _bench_matrix_sections() -> list[str]:
             "claim (same seed: p=0 is the exact control).",
             "",
         ]
+        st = r.get("straggler")
+        if st:
+            out += [
+                "The reference's straggler semantics, priced: with "
+                f"`--failure-duration {st['duration_s']}` at "
+                f"p={st['failure_probability']} (same seed, identical "
+                "masks and compute, per-epoch path, duration 0 vs "
+                f"{st['duration_s']}), {st['epochs_degraded']} degraded "
+                f"epochs predict a {st['predicted_stall_s']} s stall and "
+                f"measure {st['measured_stall_s']} s - wall-clock the "
+                "fused drop-and-continue path never pays.",
+                "",
+            ]
     return out
 
 
