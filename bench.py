@@ -291,6 +291,18 @@ def _rows(epochs: int) -> list[dict]:
             },
             "args": {},
         },
+        # same sweep through the Ulysses all-to-all path (heads
+        # re-sharded per attention instead of K/V ring rotation) - the
+        # two SP modes' overhead shapes side by side
+        {
+            "id": "lm_ulysses_sp_scaling_cpu8",
+            "kind": "sp_scaling",
+            "env": {
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+            },
+            "args": {"attn_impl": "ulysses"},
+        },
         # ZeRO-1 optimizer-state footprint: committed per-device buffer
         # bytes, replicated Adam vs ZeRO-Adam over dp=8, measured at
         # init AND after one compiled step (the sharding must survive

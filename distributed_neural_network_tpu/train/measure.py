@@ -309,7 +309,7 @@ def measure_sp_scaling(
         "overhead_vs_sp1_max": max(p["overhead_vs_sp1"] for p in points),
         "note": (
             "fixed global sequence on one shared host core: ideal wall "
-            "is flat in sp; overhead_vs_sp1 is the measured ring/"
+            f"is flat in sp; overhead_vs_sp1 is the measured {attn_impl} "
             "sequence-parallel cost. Real sp-chip wall divides by sp "
             "modulo this curve (ICI latency not visible on a CPU mesh)."
         ),

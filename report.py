@@ -476,7 +476,8 @@ def _bench_matrix_sections() -> list[str]:
     out = []
 
     lm = [r for r in rows if r.get("id", "").startswith("lm_")
-          and not r.get("id", "").startswith(("lm_decode", "lm_ring_sp"))]
+          and not r.get("id", "").startswith("lm_decode")
+          and "_sp_scaling_" not in r.get("id", "")]
     if lm:
         out += [
             "## LM throughput - single chip (beyond-reference model family)",
@@ -636,20 +637,24 @@ def _bench_matrix_sections() -> list[str]:
             ]))
         out += ["", r.get("note", ""), ""]
 
-    sp = [r for r in rows if r.get("id", "").startswith("lm_ring_sp")
-          and "points" in r]
-    if sp:
-        r = sp[-1]
+    sp_rows = [r for r in rows if "_sp_scaling_" in r.get("id", "")
+               and "points" in r]
+    for r in sp_rows:
+        impl = r.get("attn_impl", "ring")
         out += [
-            "## Sequence-parallel scaling shape - ring attention, "
+            f"## Sequence-parallel scaling shape - {impl} attention, "
             f"{r['devices']}-device {r['platform']} mesh, "
             f"{r['host_cores']} host core(s)",
             "",
             "Long-context evidence within a one-chip environment: fixed "
             f"global sequence ({r['seq_len']} tokens, "
             f"d{r['d_model']}/L{r['n_layers']} LM), sp swept - each "
-            "device holds seq/sp tokens and ring attention rotates K/V "
-            "blocks sp-1 times per layer (`parallel/ring.py`; "
+            "device holds seq/sp tokens and "
+            + ("ring attention rotates K/V blocks sp-1 times per layer"
+               if impl in ("ring", "zigzag") else
+               "Ulysses re-shards heads<->sequence with one all_to_all "
+               "each way per attention")
+            + " (`parallel/ring.py`; "
             "`train/measure.py measure_sp_scaling`). Total FLOPs are "
             "identical at every sp on the shared host core, so ideal "
             "wall is flat and `overhead vs sp=1` is the measured "
