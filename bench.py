@@ -303,6 +303,19 @@ def _rows(epochs: int) -> list[dict]:
             },
             "args": {"attn_impl": "ulysses"},
         },
+        # expert-parallel scaling shape (the EP analog): fixed global
+        # batch, experts sharded over 1..8 devices, no-drop capacity so
+        # every ep computes the same step - the all_to_all dispatch
+        # cost is the measured overhead (measure_ep_scaling docstring)
+        {
+            "id": "lm_moe_ep_scaling_cpu8",
+            "kind": "ep_scaling",
+            "env": {
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+            },
+            "args": {},
+        },
         # ZeRO-1 optimizer-state footprint: committed per-device buffer
         # bytes, replicated Adam vs ZeRO-Adam over dp=8, measured at
         # init AND after one compiled step (the sharding must survive
@@ -393,6 +406,12 @@ def _run_worker(spec: dict) -> dict:
         )
 
         return measure_fault_tolerance(**spec["args"])
+    if spec["kind"] == "ep_scaling":
+        from distributed_neural_network_tpu.train.measure import (
+            measure_ep_scaling,
+        )
+
+        return measure_ep_scaling(**spec["args"])
     raise ValueError(f"unknown row kind {spec['kind']!r}")
 
 

@@ -226,6 +226,64 @@ def measure_dp_scaling(
     }
 
 
+def _lm_axis_sweep(
+    sizes, *, cfg, make_mesh, axis_key, batch, seq_len, vocab, steps,
+    attn_impl="ring", point_extras=None,
+):
+    """Shared body of the sp/ep scaling sweeps: per mesh size, build the
+    mesh and a fresh sharded model, compile one LM train step, hard-fence
+    a warm-up, time `steps` steps, and normalize wall against the size-1
+    baseline (the first sweep entry, enforced). Returns the points list;
+    each point carries `{axis_key: n, wall_s, tokens_per_s, final_loss,
+    overhead_vs_{axis_key}1}` plus `point_extras(n)` if given.
+    (`measure_dp_scaling` stays engine-based: the CNN regime times the
+    train/sync phase split, which this LM-step loop has no notion of.)"""
+    from ..models import transformer as tfm
+    from ..utils.timers import hard_block
+    from . import lm as lmtrain
+
+    if not sizes or sizes[0] != 1:
+        raise ValueError(
+            f"{axis_key} sweep must start at 1 (the "
+            f"overhead_vs_{axis_key}1 baseline), got {sizes}"
+        )
+    points = []
+    for n in sizes:
+        if n > jax.device_count():
+            continue
+        mesh = make_mesh(n)
+        params, _ = lmtrain.shard_params(
+            tfm.init_params(jax.random.key(0), cfg), cfg, mesh
+        )
+        mom = lmtrain.init_lm_momentum(params, mesh)
+        step = lmtrain.make_lm_train_step(cfg, mesh, lr=0.01,
+                                          attn_impl=attn_impl)
+        tokens, targets = lmtrain.make_copy_task(
+            jax.random.key(1), batch=batch, seq_len=seq_len, vocab=vocab
+        )
+        params, mom, loss = step(params, mom, tokens, targets)  # compile
+        hard_block(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, mom, loss = step(params, mom, tokens, targets)
+        hard_block(loss)
+        dt = time.perf_counter() - t0
+        point = {
+            axis_key: n,
+            "wall_s": round(dt, 3),
+            "tokens_per_s": round(batch * seq_len * steps / dt),
+            "final_loss": round(float(loss), 4),
+        }
+        if point_extras:
+            point.update(point_extras(n))
+        points.append(point)
+    t1 = points[0]["wall_s"]
+    for p in points:
+        p[f"overhead_vs_{axis_key}1"] = round(
+            p["wall_s"] / max(t1, 1e-9), 3)
+    return points
+
+
 def measure_sp_scaling(
     *,
     sps=(1, 2, 4, 8),
@@ -253,51 +311,21 @@ def measure_sp_scaling(
     (which a CPU mesh cannot see - stated in the row note).
     """
     from ..models import transformer as tfm
-    from ..utils.timers import hard_block
     from . import lm as lmtrain
 
-    if not sps or sps[0] != 1:
-        raise ValueError(
-            f"sps must start at 1 (the overhead_vs_sp1 baseline), got {sps}"
-        )
     cfg = tfm.TransformerConfig(
         vocab_size=vocab, d_model=d_model, n_heads=n_heads,
         n_layers=n_layers, d_ff=d_ff,
     )
-    points = []
-    for sp in sps:
-        if sp > jax.device_count():
-            continue
-        mesh = lmtrain.create_lm_mesh(1, sp, 1)
-        params, _ = lmtrain.shard_params(
-            tfm.init_params(jax.random.key(0), cfg), cfg, mesh
-        )
-        mom = lmtrain.init_lm_momentum(params, mesh)
-        # at sp=1 the step builder drops the sequence axis (lm.py: seq
-        # axis None) and the same attn_impl runs as plain local
-        # attention - the baseline is the identical program minus the
-        # ring, exactly the overhead being measured
-        step = lmtrain.make_lm_train_step(cfg, mesh, lr=0.01,
-                                          attn_impl=attn_impl)
-        tokens, targets = lmtrain.make_copy_task(
-            jax.random.key(1), batch=batch, seq_len=seq_len, vocab=vocab
-        )
-        params, mom, loss = step(params, mom, tokens, targets)  # compile
-        hard_block(loss)
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            params, mom, loss = step(params, mom, tokens, targets)
-        hard_block(loss)
-        dt = time.perf_counter() - t0
-        points.append({
-            "sp": sp,
-            "wall_s": round(dt, 3),
-            "tokens_per_s": round(batch * seq_len * steps / dt),
-            "final_loss": round(float(loss), 4),
-        })
-    t1 = points[0]["wall_s"]
-    for p in points:
-        p["overhead_vs_sp1"] = round(p["wall_s"] / max(t1, 1e-9), 3)
+    # at sp=1 the step builder drops the sequence axis (lm.py: seq axis
+    # None) and the same attn_impl runs as plain local attention - the
+    # baseline is the identical program minus the ring, exactly the
+    # overhead being measured
+    points = _lm_axis_sweep(
+        sps, cfg=cfg, make_mesh=lambda sp: lmtrain.create_lm_mesh(1, sp, 1),
+        axis_key="sp", batch=batch, seq_len=seq_len, vocab=vocab,
+        steps=steps, attn_impl=attn_impl,
+    )
     return {
         "devices": jax.device_count(),
         "platform": jax.default_backend(),
@@ -893,5 +921,74 @@ def measure_fault_tolerance(
             "devices); the reference's straggler-sleep design stalls "
             "every epoch behind its blocking recv instead, and its "
             "report ran no fault experiment at all (section 6.2)."
+        ),
+    }
+
+
+def measure_ep_scaling(
+    *,
+    eps=(1, 2, 4, 8),
+    d_model: int = 128,
+    n_layers: int = 2,
+    n_heads: int = 8,
+    d_ff: int = 256,
+    vocab: int = 2048,
+    seq_len: int = 256,
+    batch: int = 8,
+    steps: int = 3,
+    n_experts: int = 8,
+    top_k: int = 2,
+) -> dict:
+    """Expert-parallel scaling shape on the virtual CPU mesh - the EP
+    analog of `measure_sp_scaling`, completing the measured-artifact set
+    for every parallelism axis the framework carries (dp / sp / pp / ep).
+
+    Fixed GLOBAL batch and data, expert axis swept: experts shard over
+    the data axis (`train/lm.py`: ep rides dp), so at ep=1 one device
+    holds all experts and no dispatch collective runs; at ep>1 each
+    device holds n_experts/ep experts and every MoE layer pays one
+    all_to_all each way (`parallel/moe.py`). Total model FLOPs are
+    identical at every ep on the shared host core, so ideal wall is flat
+    and overhead_vs_ep1 is the measured expert-parallel dispatch cost.
+
+    `moe_capacity_factor` is pinned to n_experts/top_k, which makes
+    per-expert capacity equal the device's token count - the no-drop
+    regime, where routing is load-independent and every ep computes the
+    same model step (the loss column is the semantics check; it agrees
+    to blockwise-reduction tolerance - the psum association varies with
+    ep. With a smaller factor, capacity is per-device and drop patterns
+    would legitimately vary with ep).
+    """
+    from ..models import transformer as tfm
+    from . import lm as lmtrain
+
+    cfg = tfm.TransformerConfig(
+        vocab_size=vocab, d_model=d_model, n_heads=n_heads,
+        n_layers=n_layers, d_ff=d_ff, n_experts=n_experts,
+        moe_top_k=top_k, moe_capacity_factor=n_experts / top_k,
+    )
+    points = _lm_axis_sweep(
+        eps, cfg=cfg, make_mesh=lambda ep: lmtrain.create_lm_mesh(ep, 1, 1),
+        axis_key="ep", batch=batch, seq_len=seq_len, vocab=vocab,
+        steps=steps,
+        point_extras=lambda ep: {"experts_per_device": n_experts // ep},
+    )
+    return {
+        "devices": jax.device_count(),
+        "platform": jax.default_backend(),
+        "d_model": d_model, "n_layers": n_layers, "seq_len": seq_len,
+        "batch": batch, "steps": steps,
+        "n_experts": n_experts, "top_k": top_k,
+        "host_cores": os.cpu_count(),
+        "points": points,
+        "overhead_vs_ep1_max": max(p["overhead_vs_ep1"] for p in points),
+        "note": (
+            "fixed global batch and data on one shared host core: ideal "
+            "wall is flat in ep; overhead_vs_ep1 is the measured "
+            "expert-parallel dispatch cost (one all_to_all each way per "
+            "MoE layer at ep>1, none at ep=1). capacity_factor = "
+            "E/top_k pins the no-drop regime, so the loss column agrees "
+            "at every ep to blockwise-reduction tolerance - the "
+            "semantics check."
         ),
     }

@@ -477,7 +477,7 @@ def _bench_matrix_sections() -> list[str]:
 
     lm = [r for r in rows if r.get("id", "").startswith("lm_")
           and not r.get("id", "").startswith("lm_decode")
-          and "_sp_scaling_" not in r.get("id", "")]
+          and "_scaling_" not in r.get("id", "")]
     if lm:
         out += [
             "## LM throughput - single chip (beyond-reference model family)",
@@ -676,6 +676,37 @@ def _bench_matrix_sections() -> list[str]:
             "computes the same model step.",
             "",
         ]
+
+    epr = [r for r in rows if "_ep_scaling_" in r.get("id", "")
+           and "points" in r]
+    if epr:
+        r = epr[-1]
+        out += [
+            f"## Expert-parallel scaling shape - {r['n_experts']} "
+            f"experts, top-{r['top_k']}, {r['devices']}-device "
+            f"{r['platform']} mesh, {r['host_cores']} host core(s)",
+            "",
+            "The EP analog of the dp/sp rows: fixed global batch and "
+            f"data (d{r['d_model']}/L{r['n_layers']} MoE LM, "
+            f"seq {r['seq_len']}), expert axis swept - experts shard "
+            "over the data axis (`train/lm.py`), each MoE layer paying "
+            "one all_to_all each way at ep>1 and none at ep=1 "
+            "(`parallel/moe.py`; `train/measure.py measure_ep_scaling`). "
+            "No-drop capacity (factor = E/top_k) makes every ep compute "
+            "the same step, so the loss column agrees to "
+            "blockwise-reduction tolerance.",
+            "",
+            fmt_row(["ep", "experts/device", "wall s", "tokens/s",
+                     "loss", "overhead vs ep=1"]),
+            fmt_row(["---"] * 6),
+        ]
+        for c in r["points"]:
+            out.append(fmt_row([
+                c["ep"], c["experts_per_device"], c["wall_s"],
+                f"{c['tokens_per_s']:,}", c["final_loss"],
+                c["overhead_vs_ep1"],
+            ]))
+        out += [""]
 
     zm = [r for r in rows if r.get("id", "").startswith("zero1_")
           and "optimizers" in r]

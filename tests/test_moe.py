@@ -248,3 +248,19 @@ class TestSortDispatch:
         _, aux0 = self._xy("sort", 6, z=0.0)
         _, aux1 = self._xy("sort", 6, z=0.5)
         assert float(aux1) > float(aux0)
+
+
+@pytest.mark.slow
+def test_measure_ep_scaling_loss_invariant(n_devices):
+    """`measure_ep_scaling` (the lm_moe_ep_scaling_cpu8 bench row):
+    with no-drop capacity every ep computes the same step - loss agrees
+    across mesh sizes to blockwise-reduction tolerance."""
+    from distributed_neural_network_tpu.train.measure import (
+        measure_ep_scaling,
+    )
+
+    r = measure_ep_scaling(eps=(1, 2, 8), seq_len=128, batch=8, steps=2)
+    losses = [p["final_loss"] for p in r["points"]]
+    assert len(losses) == 3
+    assert max(losses) - min(losses) < 2e-3
+    assert [p["experts_per_device"] for p in r["points"]] == [8, 4, 1]
