@@ -111,3 +111,4 @@ def test_cli_writes_loadable_tune_file(tmp_path, monkeypatch):
     )
     assert r2.returncode == 1 and "real" in r2.stdout
     assert json.loads(out.read_text()) == real
+

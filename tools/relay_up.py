@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""Exit 0 iff the axon relay's listener ports accept TCP connections.
+
+The relay (the container's only path to the TPU terminal) can die
+mid-session (r4 post-mortem in ROADMAP.md): every later RPC then blocks
+tens of minutes in retry before erroring, INCLUDING the jax matmul
+probes the measurement scripts poll with - a dead-relay poll cycle costs
+~50 minutes. This check costs milliseconds and claims nothing: a plain
+TCP connect to the relay's device port and its remote-compile port
+(immediately closed; the relay just logs an open/EOF pair). Gate the
+expensive jax probe on it:
+
+    python tools/relay_up.py && <jax probe>
+
+A listening relay does not guarantee a healthy terminal behind it - the
+jax probe stays the real health check; this only prevents probing into
+a dead transport.
+"""
+
+from __future__ import annotations
+
+import socket
+import sys
+
+# one device-traffic port and the remote-compile port (see the PORTS
+# list in the relay; these two are the ones measurement traffic needs)
+PORTS = (8082, 8113)
+
+
+def relay_up(timeout: float = 2.0) -> bool:
+    for port in PORTS:
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.settimeout(timeout)
+        try:
+            s.connect(("127.0.0.1", port))
+        except OSError:
+            return False
+        finally:
+            s.close()
+    return True
+
+
+if __name__ == "__main__":
+    # exit codes: 0 up, 1 down, 2 the gate itself broke - callers must
+    # treat 2 as "gate unusable, fall through to the real probe", never
+    # as "down", or a crashed gate silently pins a watcher at down
+    try:
+        up = relay_up()
+    except Exception as e:  # noqa: BLE001 - any crash must exit 2
+        print(f"relay gate error: {type(e).__name__}: {e}")
+        sys.exit(2)
+    print(f"relay {'up' if up else 'down'}")
+    sys.exit(0 if up else 1)

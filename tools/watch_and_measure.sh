@@ -18,6 +18,19 @@ done
 attempt=0
 while true; do
   attempt=$((attempt + 1))
+  # cheap TCP gate first: with the relay dead (r4 post-mortem), a jax
+  # probe blocks ~50 min in RPC retries; this check costs milliseconds
+  # and holds no claim, so the poll interval stays 60s. rc 2 = the gate
+  # itself crashed - log it and fall through to the real probe rather
+  # than silently spinning at "down" forever
+  gate_out=$(python tools/relay_up.py 2>&1); gate_rc=$?
+  if [ "$gate_rc" -eq 1 ]; then
+    echo "[watch] relay down (attempt ${attempt}) at $(date -u +%H:%M:%S); sleeping 60s"
+    sleep 60
+    continue
+  elif [ "$gate_rc" -ne 0 ]; then
+    echo "[watch] relay gate unusable (rc ${gate_rc}): ${gate_out} - falling through to the jax probe"
+  fi
   echo "[watch] probe attempt ${attempt} at $(date -u +%H:%M:%S)"
   if python -c "
 import time, jax, jax.numpy as jnp
