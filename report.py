@@ -25,6 +25,7 @@ from __future__ import annotations
 import argparse
 import datetime
 import json
+import re
 import sys
 
 # SURVEY.md section 6 (report Tables 1-2 + measured child train logs)
@@ -88,7 +89,7 @@ def main() -> int:
         # a busy claim was killed at its stage timeout, wedging the chip
         # for the rest of the session). Device identity comes from the
         # measured rows themselves.
-        proc_rows, bs_rows = _rows_from_matrix(epochs)
+        proc_rows, bs_rows, pending_bs = _rows_from_matrix(epochs)
         any_row = (proc_rows or bs_rows or [None])[0]
         if any_row is None:
             # still render: the LM/bubble/scaling sections and the
@@ -120,7 +121,7 @@ def main() -> int:
         procs = sorted({d for d in REF_PROC if d <= ndev} | {min(ndev, 8)})
         bss = [4, 16, 64] if args.quick else list(REF_BS)
 
-        proc_rows, bs_rows = [], []
+        proc_rows, bs_rows, pending_bs = [], [], []
         for n in procs:
             r = run_one(n, 16, epochs, data, syn)
             r["ref"] = REF_PROC.get(n)
@@ -196,12 +197,29 @@ def main() -> int:
                  "ref acc %", "ref train s", "speedup"]),
         fmt_row(["---"] * 6),
     ]
-    for r in bs_rows:
-        lines.append(fmt_row([
-            r["batch_size"], f"{r['val_acc']:.2f}", f"{r['train_s']:.2f}",
-            *ref_cells(r),
-        ]))
-    if not bs_rows:
+    # measured and pending rows merged in bs order so the sweep column
+    # stays monotonic whichever subset measured
+    merged = sorted(
+        [("row", r["batch_size"], r) for r in bs_rows]
+        + [("pending", bs, None) for bs in pending_bs],
+        key=lambda t: t[1],
+    )
+    for kind, bs, r in merged:
+        if kind == "row":
+            lines.append(fmt_row([
+                bs, f"{r['val_acc']:.2f}", f"{r['train_s']:.2f}",
+                *ref_cells(r),
+            ]))
+        else:
+            # unmeasured stub row: show the reference cells so the
+            # sweep's full bs range stays visible, value cells pending
+            ref = REF_BS.get(bs)
+            lines.append(fmt_row([
+                bs, "*pending*", "*pending (not yet measured)*",
+                f"{ref[0]:.2f}" if ref else "-",
+                f"{ref[1]:.0f}" if ref else "-", "-",
+            ]))
+    if not bs_rows and not pending_bs:
         lines.append(fmt_row(
             ["*pending measurement (chip unavailable)*"] + ["-"] * 5
         ))
@@ -422,7 +440,7 @@ def _oracle_fullscale_line() -> str:
 
 
 def _rows_from_matrix(epochs: int):
-    """(proc_rows, bs_rows) reconstructed from BENCH_MATRIX.json cnn rows.
+    """(proc_rows, bs_rows, pending_bs) from BENCH_MATRIX.json cnn rows.
 
     The bench matrix's cnn_dp_ep{epochs}_bs{N} rows carry exactly the
     fields `run_one` returns (devices/batch_size/val_acc/train_s/source),
@@ -437,12 +455,21 @@ def _rows_from_matrix(epochs: int):
         with open(path) as f:
             rows = json.load(f).get("rows", [])
     except (OSError, json.JSONDecodeError):
-        return [], []
+        return [], [], []
     by_bs = {}
+    pending_bs = []
     for r in rows:
-        if (r.get("id", "") == f"cnn_dp_ep{epochs}_bs{r.get('batch_size')}"
+        rid = r.get("id", "")
+        if (rid == f"cnn_dp_ep{epochs}_bs{r.get('batch_size')}"
                 and "train_s" in r):
             by_bs[r["batch_size"]] = dict(r)
+        else:
+            # error/skipped stubs of the plain bs sweep (no kernel/dtype
+            # suffix): Table 2 must show the reference's bs values as
+            # pending rather than silently shrinking the sweep
+            m = re.fullmatch(rf"cnn_dp_ep{epochs}_bs(\d+)", rid)
+            if m and "train_s" not in r:
+                pending_bs.append(int(m.group(1)))
     proc_rows = []
     if 16 in by_bs:
         r = dict(by_bs[16])
@@ -453,7 +480,16 @@ def _rows_from_matrix(epochs: int):
         r = dict(by_bs[bs])
         r["ref"] = REF_BS.get(bs)
         bs_rows.append(r)
-    return proc_rows, bs_rows
+    pending_bs = sorted(b for b in set(pending_bs) if b not in by_bs)
+    return proc_rows, bs_rows, pending_bs
+
+
+def _unmeasured_cell(r: dict) -> str:
+    """One cell for a row without a measured value: states the fact and
+    carries the recorded error - no claim about queue state (whether a
+    re-measure is scheduled lives in ROADMAP.md, not in the row)."""
+    why = r.get("error", r.get("skipped", "no measurement"))
+    return f"no measured value (error: {str(why)[:60]})"
 
 
 def _bench_matrix_sections() -> list[str]:
@@ -501,10 +537,8 @@ def _bench_matrix_sections() -> list[str]:
         ]
         for r in lm:
             if "tokens_per_s" not in r:
-                why = r.get("error", r.get("skipped", "no measurement"))
                 out.append(fmt_row([
-                    r["id"], "-", "-", "-", "-",
-                    f"FAILED: {str(why)[:60]}", "-",
+                    r["id"], "-", "-", "-", "-", _unmeasured_cell(r), "-",
                 ]))
                 continue
             # head geometry shown only for the non-default Dh (hd128 rows
@@ -544,10 +578,8 @@ def _bench_matrix_sections() -> list[str]:
         ]
         for r in dec:
             if "decode_tokens_per_s" not in r:
-                why = r.get("error", r.get("skipped", "no measurement"))
                 out.append(fmt_row([
-                    r["id"], "-", "-", f"FAILED: {str(why)[:60]}", "-",
-                    "-",
+                    r["id"], "-", "-", _unmeasured_cell(r), "-", "-",
                 ]))
                 continue
             cfgs = (f"d{r['d_model']}/L{r['n_layers']}"
