@@ -25,6 +25,7 @@ from __future__ import annotations
 import argparse
 import datetime
 import json
+import os
 import re
 import sys
 
@@ -42,6 +43,10 @@ REF_PROC = {  # procs -> (acc %, train_s)
 # measurement. Accuracy has no child-log counterpart, so it stays from
 # the published table.
 from bench import REFERENCE_BS_SWEEP_S as _REF_BS_S
+
+# artifact root: BENCH_MATRIX.json and tools/ tune files live beside
+# this script; module-level so tests can point it at a synthetic tree
+REPO = os.path.dirname(os.path.abspath(__file__))
 
 _REF_BS_ACC = {1: 56.54, 2: 61.3, 4: 63.48, 8: 65.19, 16: 63.59,
                32: 57.68, 64: 50.86}
@@ -286,7 +291,6 @@ def _mfu_ceiling_section() -> list[str]:
     flagship matrix row exist; all inputs are cited measured artifacts.
     """
     import glob
-    import os
 
     from distributed_neural_network_tpu.models.transformer import (
         TransformerConfig,
@@ -296,7 +300,7 @@ def _mfu_ceiling_section() -> list[str]:
         peak_flops,
     )
 
-    here = os.path.dirname(os.path.abspath(__file__))
+    here = REPO
     # the ceiling is only published for a flagship row that actually
     # exists in the matrix, with the model read FROM that row (a
     # hardcoded config could silently diverge from the bench spec)
@@ -405,10 +409,8 @@ def _mfu_ceiling_section() -> list[str]:
 
 def _oracle_fullscale_line() -> str:
     """One sentence summarizing tools/oracle_fullscale_result.json."""
-    import os
 
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "tools", "oracle_fullscale_result.json")
+    path = os.path.join(REPO, "tools", "oracle_fullscale_result.json")
     pending = ("`tools/oracle_fullscale.py` runs the same parity check at "
                "the reference's full scale (25 epochs x 50k rows x 8 "
                "workers); artifact pending.")
@@ -447,10 +449,8 @@ def _rows_from_matrix(epochs: int):
     measured by the same `measure_dp_training` - so the report can render
     from one bench run instead of re-measuring the whole sweep.
     """
-    import os
 
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "BENCH_MATRIX.json")
+    path = os.path.join(REPO, "BENCH_MATRIX.json")
     try:
         with open(path) as f:
             rows = json.load(f).get("rows", [])
@@ -500,10 +500,8 @@ def _bench_matrix_sections() -> list[str]:
     regenerable in one command. Rows with errors are listed as such -
     an honest artifact beats a silently dropped row.
     """
-    import os
 
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "BENCH_MATRIX.json")
+    path = os.path.join(REPO, "BENCH_MATRIX.json")
     if not os.path.exists(path):
         return []
     with open(path) as f:
@@ -852,12 +850,9 @@ def _flash_tune_sections() -> list[str]:
     ceiling argument is a table in the artifact, not a memory. Files are
     written by tools/tune_flash.py under honest value-fetch fencing."""
     import glob
-    import os
 
     out = []
-    paths = sorted(glob.glob(os.path.join(
-        os.path.dirname(os.path.abspath(__file__)),
-        "tools", "flash_tune_*.json")))
+    paths = sorted(glob.glob(os.path.join(REPO, "tools", "flash_tune_*.json")))
     for path in paths:
         try:
             with open(path) as f:

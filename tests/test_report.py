@@ -1,0 +1,142 @@
+"""report.py rendering from a synthetic artifact tree.
+
+REPORT.md is the judge-facing artifact; these tests pin its honesty
+mechanics without any measurement: pending Table-2 rows for unmeasured
+bs stubs (full reference sweep stays visible), 'no measured value'
+cells for errored LM/decode rows, the recovered-tune-file provenance
+note with dash rows, and both branches of the MFU-ceiling wording
+(kernel over vs under the 40% attention budget). All artifact reads go
+through report.REPO, monkeypatched to a tmp tree.
+"""
+
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import report  # noqa: E402
+
+
+FLAGSHIP = {
+    "id": "lm_flash_d512_L8_seq2048_bf16",
+    "d_model": 512, "n_layers": 8, "n_heads": 8, "d_ff": 2048,
+    "vocab": 32768, "seq_len": 2048, "batch": 16, "dtype": "bfloat16",
+    "attn": "flash", "remat": "none", "device_kind": "TPU v5 lite",
+    "tokens_per_s": 164468, "mfu_pct": 29.41, "wall_s": 2.0,
+    "final_loss": 5.0,
+}
+
+
+def _write_matrix(repo: Path, rows):
+    (repo / "BENCH_MATRIX.json").write_text(json.dumps({"rows": rows}))
+
+
+def _tune_payload(best_own_ms):
+    return {
+        "shape": {"batch": 16, "heads": 8, "seq": 2048, "head_dim": 64},
+        "device": "TPU_v5_lite",
+        "best_own": {"bq": 1024, "bk": 1024, "bq_dq": 1024, "bk_dq": 1024,
+                     "bq_dkv": 512, "bk_dkv": 1024},
+        "best_own_ms": best_own_ms,
+        "ablation": {
+            "own": {"fwd_ms": 5.68, "fwdbwd_ms": best_own_ms,
+                    "bwd_ms_derived": round(best_own_ms - 5.68, 2),
+                    "fwd_attn_tflops_per_s": 12.1,
+                    "bwd_attn_tflops_per_s": 28.0},
+            "lib": {"fwd_ms": None, "fwdbwd_ms": None,
+                    "bwd_ms_derived": None,
+                    "fwd_attn_tflops_per_s": None,
+                    "bwd_attn_tflops_per_s": None},
+            "xla": {"fwd_ms": None, "fwdbwd_ms": None,
+                    "bwd_ms_derived": None,
+                    "fwd_attn_tflops_per_s": None,
+                    "bwd_attn_tflops_per_s": None},
+        },
+        "recovered_from_log": True,
+    }
+
+
+@pytest.fixture
+def repo(tmp_path, monkeypatch):
+    (tmp_path / "tools").mkdir()
+    monkeypatch.setattr(report, "REPO", str(tmp_path))
+    return tmp_path
+
+
+def test_pending_bs_stubs_keep_reference_sweep_visible(repo):
+    _write_matrix(repo, [
+        {"id": "cnn_dp_ep25_bs16", "batch_size": 16, "devices": 1,
+         "epochs": 25, "val_acc": 100.0, "train_s": 19.1,
+         "platform": "tpu", "device_kind": "TPU v5 lite",
+         "source": "synthetic"},
+        {"id": "cnn_dp_ep25_bs32", "error": "backend unavailable"},
+        # suffixed variant stubs are NOT part of the plain bs sweep
+        {"id": "cnn_dp_ep25_bs16_pallas", "error": "backend unavailable"},
+    ])
+    proc_rows, bs_rows, pending = report._rows_from_matrix(25)
+    assert [r["batch_size"] for r in bs_rows] == [16]
+    assert pending == [32]
+    assert proc_rows and proc_rows[0]["ref"] == report.REF_PROC[8]
+
+
+def test_rows_from_matrix_degrades_to_empty(repo):
+    assert report._rows_from_matrix(25) == ([], [], [])
+    (repo / "BENCH_MATRIX.json").write_text("{corrupt")
+    assert report._rows_from_matrix(25) == ([], [], [])
+
+
+def test_unmeasured_lm_rows_state_the_fact(repo):
+    _write_matrix(repo, [
+        FLAGSHIP,
+        {"id": "lm_flash_d512_L8_seq8192_bf16",
+         "error": "skipped: a prior row was killed"},
+    ])
+    text = "\n".join(report._bench_matrix_sections())
+    assert "164,468" in text
+    assert "no measured value (error: skipped: a prior row" in text
+    assert "FAILED" not in text
+
+
+def test_scaling_rows_render_outside_lm_table(repo):
+    _write_matrix(repo, [
+        FLAGSHIP,
+        {"id": "lm_ring_sp_scaling_cpu8", "devices": 8, "platform": "cpu",
+         "attn_impl": "ring", "d_model": 128, "n_layers": 4,
+         "seq_len": 2048, "batch": 2, "steps": 3, "host_cores": 1,
+         "points": [{"sp": 1, "wall_s": 1.0, "tokens_per_s": 100,
+                     "final_loss": 8.0, "overhead_vs_sp1": 1.0}]},
+        {"id": "lm_moe_ep_scaling_cpu8", "devices": 8, "platform": "cpu",
+         "d_model": 128, "n_layers": 2, "seq_len": 256, "batch": 8,
+         "steps": 3, "n_experts": 8, "top_k": 2, "host_cores": 1,
+         "points": [{"ep": 1, "experts_per_device": 8, "wall_s": 1.0,
+                     "tokens_per_s": 100, "final_loss": 8.1,
+                     "overhead_vs_ep1": 1.0}]},
+    ])
+    text = "\n".join(report._bench_matrix_sections())
+    # scaling rows get their own sections and never leak into the LM
+    # throughput table as unmeasured stubs
+    assert "ring attention" in text and "Expert-parallel" in text
+    assert "no measured value" not in text
+
+
+def test_recovered_tune_note_and_mfu_branches(repo):
+    _write_matrix(repo, [FLAGSHIP])
+    tune = repo / "tools" / "flash_tune_TPU_v5_lite_s2048.json"
+
+    # kernel UNDER the 40% attention budget -> ceiling no longer binds
+    tune.write_text(json.dumps(_tune_payload(11.81)))
+    text = "\n".join(report._flash_tune_sections())
+    assert "Recovered from the measurement-session log" in text
+    assert "Implementations the sweep never reached: lib, xla" in text
+    assert "| lib | - | - | - | - | - |" in text
+    ceiling = "\n".join(report._mfu_ceiling_section())
+    assert "the tuned kernel is now UNDER it" in ceiling
+
+    # kernel OVER the budget -> the kernel is the binding constraint
+    tune.write_text(json.dumps(_tune_payload(16.24)))
+    ceiling = "\n".join(report._mfu_ceiling_section())
+    assert "x faster than measured" in ceiling
+    assert "UNDER" not in ceiling
