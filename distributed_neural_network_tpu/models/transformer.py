@@ -447,6 +447,7 @@ def generate(
     max_new_tokens: int,
     temperature: float = 0.0,
     top_k: int = 0,
+    top_p: float = 0.0,
     key: jax.Array | None = None,
 ):
     """Autoregressive decoding with per-layer KV caches.
@@ -454,7 +455,11 @@ def generate(
     prompt: (B, S_p) int32. Returns (B, S_p + max_new_tokens) int32 - the
     prompt followed by generated tokens. temperature 0 = greedy argmax;
     > 0 samples from softmax(logits / temperature) (requires `key`);
-    top_k > 0 restricts sampling to the k most likely tokens first.
+    top_k > 0 restricts sampling to the k most likely tokens first;
+    top_p in (0, 1) further restricts it to the nucleus - the smallest
+    set of tokens whose cumulative probability (at this temperature,
+    after any top-k cut) reaches top_p. Both filters always keep the
+    most likely token, so sampling never degenerates.
 
     TPU-shaped: one `lax.scan` over time steps (static total length
     S_p + max_new_tokens), an inner scan over the stacked layers, KV
@@ -474,6 +479,8 @@ def generate(
     """
     if temperature > 0.0 and key is None:
         raise ValueError("temperature > 0 sampling requires `key`")
+    if not 0.0 <= top_p <= 1.0:
+        raise ValueError(f"top_p must be in [0, 1], got {top_p}")
     dt = cfg.dtype
     b, s_p = prompt.shape
     total = s_p + max_new_tokens
@@ -538,6 +545,20 @@ def generate(
             if top_k > 0:
                 kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
                 logits = jnp.where(logits < kth, -jnp.inf, logits)
+            if 0.0 < top_p < 1.0:
+                # nucleus cut on the temperature-scaled distribution
+                # (ordering is temperature-invariant; the cumulative
+                # mass is not): keep tokens whose cumulative probability
+                # of STRICTLY more likely tokens is < top_p - the top-1
+                # always survives, and -inf (top-k-cut) entries sort
+                # last with zero mass
+                srt = jnp.sort(logits, axis=-1)[:, ::-1]
+                p_srt = jax.nn.softmax(srt / temperature, axis=-1)
+                keep = (jnp.cumsum(p_srt, axis=-1) - p_srt) < top_p
+                cutoff = jnp.min(
+                    jnp.where(keep, srt, jnp.inf), axis=-1, keepdims=True
+                )
+                logits = jnp.where(logits < cutoff, -jnp.inf, logits)
             k_rng, k_tok = jax.random.split(k_rng)
             nxt = jax.random.categorical(k_tok, logits / temperature, axis=-1)
         else:

@@ -142,6 +142,10 @@ def main() -> int:
     p.add_argument("--gen-top-k", type=int, default=0,
                    help="restrict --generate sampling to the k most likely "
                    "tokens (0 = no restriction)")
+    p.add_argument("--gen-top-p", type=float, default=0.0,
+                   help="nucleus sampling for --generate: restrict to the "
+                   "smallest token set with cumulative probability >= p "
+                   "(0 = no restriction; composes after --gen-top-k)")
     p.add_argument("--generate", type=int, default=0, metavar="N",
                    help="after training, greedy-decode N tokens from the "
                    "first sequences' prompts through the KV-cache path and "
@@ -160,6 +164,12 @@ def main() -> int:
         p.error("--gen-top-k only applies when sampling; set "
                 "--gen-temperature > 0 (temperature 0 is greedy and "
                 "ignores top-k)")
+    if not 0.0 <= args.gen_top_p <= 1.0:
+        p.error(f"--gen-top-p must be in [0, 1], got {args.gen_top_p}")
+    if args.gen_top_p and args.gen_temperature <= 0:
+        p.error("--gen-top-p only applies when sampling; set "
+                "--gen-temperature > 0 (temperature 0 is greedy and "
+                "ignores top-p)")
     if args.ema_decay and args.pp > 1:
         p.error("--ema-decay is unused under --pp (the pipeline path has "
                 "no --eval-every/--generate consumer for the averaged "
@@ -572,6 +582,7 @@ def main() -> int:
             out = tfm.generate(
                 host_params, prompt, cfg, max_new_tokens=args.generate,
                 temperature=args.gen_temperature, top_k=args.gen_top_k,
+                top_p=args.gen_top_p,
                 key=(jax.random.key(args.seed + 2)
                      if args.gen_temperature > 0 else None),
             )

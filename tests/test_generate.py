@@ -169,3 +169,35 @@ def test_measure_lm_decode_tiny(n_devices):
     assert r["n_params"] > 0
     # cpu has no HBM peak entry -> util is None there, a number on TPU
     assert r["hbm_util_pct"] is None or r["hbm_util_pct"] > 0
+
+
+def test_top_p_nucleus_sampling(n_devices):
+    """top_p tiny at high temperature collapses the nucleus to the top-1
+    token (exactly greedy, like top_k=1); top_p=1 leaves sampling
+    unrestricted yet valid; out-of-range top_p raises."""
+    params = tfm.init_params(jax.random.key(0), CFG)
+    prompt = jax.random.randint(jax.random.key(6), (2, 4), 0, 32, jnp.int32)
+    out = tfm.generate(params, prompt, CFG, max_new_tokens=8,
+                       temperature=5.0, top_p=1e-6, key=jax.random.key(9))
+    want = tfm.generate(params, prompt, CFG, max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(want))
+
+    # composes with top_k and stays in-vocab
+    out2 = tfm.generate(params, prompt, CFG, max_new_tokens=8,
+                        temperature=1.0, top_k=8, top_p=0.9,
+                        key=jax.random.key(10))
+    toks = np.asarray(out2)
+    assert toks.shape == (2, 4 + 8)
+    assert toks.min() >= 0 and toks.max() < CFG.vocab_size
+
+    # boundary: top_p=1.0 is accepted and exactly disables the filter
+    # (same key, same tokens as unrestricted sampling)
+    free = tfm.generate(params, prompt, CFG, max_new_tokens=8,
+                        temperature=1.0, key=jax.random.key(10))
+    p1 = tfm.generate(params, prompt, CFG, max_new_tokens=8,
+                      temperature=1.0, top_p=1.0, key=jax.random.key(10))
+    np.testing.assert_array_equal(np.asarray(p1), np.asarray(free))
+
+    with pytest.raises(ValueError, match="top_p"):
+        tfm.generate(params, prompt, CFG, max_new_tokens=2,
+                     temperature=1.0, top_p=1.5, key=jax.random.key(1))
