@@ -164,12 +164,20 @@ def main() -> int:
         p.error("--gen-top-k only applies when sampling; set "
                 "--gen-temperature > 0 (temperature 0 is greedy and "
                 "ignores top-k)")
+    if args.gen_temperature < 0:
+        p.error(f"--gen-temperature must be >= 0, got "
+                f"{args.gen_temperature}")
     if not 0.0 <= args.gen_top_p <= 1.0:
         p.error(f"--gen-top-p must be in [0, 1], got {args.gen_top_p}")
     if args.gen_top_p and args.gen_temperature <= 0:
         p.error("--gen-top-p only applies when sampling; set "
                 "--gen-temperature > 0 (temperature 0 is greedy and "
                 "ignores top-p)")
+    if args.generate <= 0 and (args.gen_temperature > 0 or args.gen_top_k
+                               or args.gen_top_p):
+        p.error("--gen-temperature/--gen-top-k/--gen-top-p configure "
+                "--generate N, which was not requested - add "
+                "--generate N or drop the sampling flags")
     if args.ema_decay and args.pp > 1:
         p.error("--ema-decay is unused under --pp (the pipeline path has "
                 "no --eval-every/--generate consumer for the averaged "

@@ -299,3 +299,17 @@ def test_lm_train_pp_interleave_resume_guard(tmp_path):
         capture_output=True, text=True, cwd=REPO, env=env, timeout=600,
     )
     assert proc.returncode == 0, proc.stderr[-3000:]
+
+
+def test_lm_train_rejects_orphan_sampling_flags(tmp_path):
+    """--gen-* flags without --generate error instead of silently doing
+    nothing (the r3-ADVICE class of silently-ignored flag combos)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "lm_train.py"),
+         "--steps", "1", "--gen-temperature", "0.8"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=120,
+    )
+    assert proc.returncode != 0
+    assert "--generate" in proc.stderr
