@@ -345,6 +345,15 @@ def _rows(epochs: int) -> list[dict]:
             },
             "args": {},
         },
+        # host-side native layer priced: the C++ batcher kernels vs the
+        # SAME numpy fallback they ship (native.fallback_*) - purely
+        # host CPU, no jax, no chip claim (measure_native_batcher)
+        {
+            "id": "native_batcher_host",
+            "kind": "native_batcher",
+            "env": {"JAX_PLATFORMS": "cpu"},
+            "args": {},
+        },
     ]
     return rows
 
@@ -412,6 +421,12 @@ def _run_worker(spec: dict) -> dict:
         )
 
         return measure_ep_scaling(**spec["args"])
+    if spec["kind"] == "native_batcher":
+        from distributed_neural_network_tpu.train.measure import (
+            measure_native_batcher,
+        )
+
+        return measure_native_batcher(**spec["args"])
     raise ValueError(f"unknown row kind {spec['kind']!r}")
 
 

@@ -122,6 +122,24 @@ def _f32ptr(a):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
 
 
+# Pure-numpy reference implementations: what the wrappers run with no
+# native lib, what the parity tests compare against, and the baseline
+# the bench row times the C++ kernels over (one source of truth).
+
+
+def fallback_cifar_decode_normalize(rows_u8, mean, std) -> np.ndarray:
+    x = rows_u8.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    return (x.astype(np.float32) / 255.0 - mean) / std
+
+
+def fallback_normalize_u8(images_u8, mean, std) -> np.ndarray:
+    return (images_u8.astype(np.float32) / 255.0 - mean) / std
+
+
+def fallback_gather_normalize_u8(images_u8, idx, mean, std) -> np.ndarray:
+    return (images_u8[idx].astype(np.float32) / 255.0 - mean) / std
+
+
 def cifar_decode_normalize(
     rows_u8: np.ndarray, mean: float, std: float, *, nthreads: int = 0
 ) -> np.ndarray:
@@ -135,8 +153,7 @@ def cifar_decode_normalize(
     a, b = _affine_coeffs(mean, std)
     lib = _load()
     if lib is None:
-        x = rows_u8.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
-        return (x.astype(np.float32) / 255.0 - mean) / std
+        return fallback_cifar_decode_normalize(rows_u8, mean, std)
     out = np.empty((n, 32, 32, 3), np.float32)
     lib.cifar_decode_chw_to_nhwc(
         _u8ptr(rows_u8), n, a, b, _f32ptr(out), nthreads
@@ -152,7 +169,7 @@ def normalize_u8(
     a, b = _affine_coeffs(mean, std)
     lib = _load()
     if lib is None:
-        return (images_u8.astype(np.float32) / 255.0 - mean) / std
+        return fallback_normalize_u8(images_u8, mean, std)
     out = np.empty(images_u8.shape, np.float32)
     lib.affine_u8_to_f32(
         _u8ptr(images_u8), images_u8.size, a, b, _f32ptr(out), nthreads
@@ -183,7 +200,7 @@ def gather_normalize_u8(
     a, b = _affine_coeffs(mean, std)
     lib = _load()
     if lib is None:
-        return (images_u8[idx].astype(np.float32) / 255.0 - mean) / std
+        return fallback_gather_normalize_u8(images_u8, idx, mean, std)
     row = int(np.prod(images_u8.shape[1:], dtype=np.int64))
     out = np.empty((idx.shape[0], *images_u8.shape[1:]), np.float32)
     lib.gather_affine_u8(

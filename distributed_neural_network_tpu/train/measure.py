@@ -992,3 +992,77 @@ def measure_ep_scaling(
             "semantics check."
         ),
     }
+
+
+def measure_native_batcher(
+    *,
+    n_rows: int = 20000,
+    batch: int = 4096,
+    reps: int = 5,
+) -> dict:
+    """Host-side input-pipeline kernels: the C++ batcher (`native/`) vs
+    its own pure-numpy fallback, per kernel, best-of-`reps` wall.
+
+    The native layer exists for the runtime *around* the XLA compute
+    path (SURVEY.md section 2: the reference's native layer is external
+    libmpi + ATen; here it is XLA plus these host kernels). This row
+    prices that choice on the actual host: fused single-pass C++
+    (decode+transpose+normalize; gather+normalize) against the multi-
+    pass numpy chain the wrappers fall back to - the exact same
+    functions (`native.fallback_*`), so the baseline cannot drift from
+    the shipped fallback. Parity of outputs is pinned by
+    tests/test_native.py; this measures only speed. Purely host CPU:
+    no jax, no chip claim.
+    """
+    import numpy as np
+
+    from .. import native
+
+    rng = np.random.default_rng(0)
+    rows = rng.integers(0, 256, (n_rows, 3072), dtype=np.uint8)
+    idx = rng.integers(0, n_rows, batch).astype(np.int64)
+
+    def best(f):
+        f()  # warm-up (first native call builds/loads the library)
+        b = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            f()
+            b = min(b, time.perf_counter() - t0)
+        return b
+
+    kernels = {
+        "cifar_decode_normalize": (
+            lambda: native.cifar_decode_normalize(rows, 0.5, 0.5),
+            lambda: native.fallback_cifar_decode_normalize(rows, 0.5, 0.5),
+            n_rows,
+        ),
+        "gather_normalize_u8": (
+            lambda: native.gather_normalize_u8(rows, idx, 0.5, 0.5),
+            lambda: native.fallback_gather_normalize_u8(
+                rows, idx, 0.5, 0.5),
+            batch,
+        ),
+    }
+    out = {}
+    for name, (nat, fb, images) in kernels.items():
+        tn, tf = best(nat), best(fb)
+        out[name] = {
+            "native_ms": round(tn * 1e3, 2),
+            "fallback_ms": round(tf * 1e3, 2),
+            "speedup_x": round(tf / max(tn, 1e-9), 2),
+            "native_images_per_s": round(images / max(tn, 1e-9)),
+        }
+    return {
+        "native_available": native.available(),
+        "host_cores": os.cpu_count(),
+        "n_rows": n_rows, "batch": batch, "reps": reps,
+        "kernels": out,
+        "note": (
+            "best-of-reps wall per kernel, native C++ vs the SAME "
+            "pure-numpy fallback the wrappers ship (native.fallback_*); "
+            "host-only, no chip claim. Speedup on one core is pure "
+            "fusion (single pass, no float32 intermediate churn); "
+            "multi-core hosts add the pthread fan-out on top."
+        ),
+    }

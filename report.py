@@ -786,6 +786,41 @@ def _bench_matrix_sections() -> list[str]:
             "",
         ]
 
+    nb = [r for r in rows if r.get("id", "").startswith("native_batcher")
+          and "kernels" in r]
+    if nb:
+        r = nb[-1]
+        out += [
+            "## Native host kernels - C++ batcher vs its numpy fallback",
+            "",
+            "The runtime around the XLA compute path is native where the "
+            "host input pipeline is hot (`native/batcher.cpp`, "
+            "build-on-import + ctypes). Best-of-"
+            f"{r['reps']} wall per kernel against the SAME pure-numpy "
+            "fallback the wrappers ship (`native.fallback_*` - one "
+            "source of truth, parity pinned by `tests/test_native.py`), "
+            f"on {r['host_cores']} host core(s); no jax, no chip claim "
+            "(`train/measure.py measure_native_batcher`).",
+            "",
+            fmt_row(["kernel", "native ms", "numpy ms", "speedup",
+                     "native images/s"]),
+            fmt_row(["---"] * 5),
+        ]
+        if not r.get("native_available"):
+            out += [
+                "**NOTE: the native library was unavailable when this "
+                "row measured** - both columns ran the numpy fallback, "
+                "so the speedups below are ~1x and price nothing; "
+                "re-measure on a host with a C++ toolchain.",
+                "",
+            ]
+        for name, k in r["kernels"].items():
+            out.append(fmt_row([
+                name, k["native_ms"], k["fallback_ms"],
+                f"{k['speedup_x']}x", f"{k['native_images_per_s']:,}",
+            ]))
+        out += [""]
+
     ft = [r for r in rows if r.get("id", "").startswith("cnn_fault")
           and "points" in r]
     if ft:

@@ -104,3 +104,23 @@ def test_fallback_matches_native(monkeypatch):
     monkeypatch.setattr(native, "_load", lambda: None)
     got = native.cifar_decode_normalize(rows, 0.5, 0.5)
     np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_measure_native_batcher_reports_both_paths():
+    """`measure_native_batcher` (the native_batcher_host bench row):
+    times each kernel against the SAME fallback function the wrappers
+    ship, and reports availability honestly."""
+    from distributed_neural_network_tpu.train.measure import (
+        measure_native_batcher,
+    )
+
+    r = measure_native_batcher(n_rows=512, batch=256, reps=2)
+    assert set(r["kernels"]) == {"cifar_decode_normalize",
+                                 "gather_normalize_u8"}
+    for k in r["kernels"].values():
+        assert k["native_ms"] > 0 and k["fallback_ms"] > 0
+        assert k["speedup_x"] > 0 and k["native_images_per_s"] > 0
+    # this suite hard-requires the compiled library (see the build test
+    # above): the row must have measured the NATIVE path, not a silent
+    # numpy-vs-numpy degradation
+    assert r["native_available"] is True
