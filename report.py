@@ -706,6 +706,17 @@ def _bench_matrix_sections() -> list[str]:
             "computes the same model step.",
             "",
         ]
+        if any(c["overhead_vs_sp1"] < 0.95 for c in r["points"]):
+            out += [
+                "Cells < 1 are real on this host: the sp=1 baseline "
+                "materializes the full (S, S) score matrix per head, "
+                "while the sharded path works in (S/sp)-tile blocks "
+                "that fit cache - tiling locality outweighing the "
+                "collective cost. On real chips the same locality "
+                "shows up inside flash attention instead, and the "
+                "collectives ride ICI.",
+                "",
+            ]
 
     epr = [r for r in rows if "_ep_scaling_" in r.get("id", "")
            and "points" in r]
