@@ -1,0 +1,27 @@
+#!/bin/bash
+# Relay-gated wrapper for the fill pass: poll the relay's TCP listeners
+# (milliseconds, no chip claim - tools/relay_up.py) and only hand off to
+# tools/fill_missing.sh once the transport exists. Without the gate a
+# dead relay costs ~50 minutes per blocked jax probe (ROADMAP r4
+# post-mortem). fill_missing.sh itself still does the real jax probe
+# and refuses to run beside another measurement session.
+# Run detached:  setsid nohup bash tools/fill_when_relay.sh \
+#                    > fill_when_relay.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+attempt=0
+while true; do
+  attempt=$((attempt + 1))
+  gate_out=$(python tools/relay_up.py 2>&1); gate_rc=$?
+  if [ "$gate_rc" -eq 0 ]; then
+    echo "[gate] relay up at $(date -u +%H:%M:%S) - starting fill"
+    exec bash tools/fill_missing.sh
+  elif [ "$gate_rc" -ne 1 ]; then
+    echo "[gate] relay gate unusable (rc ${gate_rc}): ${gate_out} - starting fill anyway"
+    exec bash tools/fill_missing.sh
+  fi
+  if [ $((attempt % 30)) -eq 1 ]; then
+    echo "[gate] relay down (attempt ${attempt}) at $(date -u +%H:%M:%S)"
+  fi
+  sleep 60
+done
