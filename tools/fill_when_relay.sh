@@ -7,18 +7,39 @@
 # and refuses to run beside another measurement session.
 # Run detached:  setsid nohup bash tools/fill_when_relay.sh \
 #                    > fill_when_relay.log 2>&1 &
+#
+# Lifetime note: this wrapper exists because fill_missing.sh cannot be
+# edited while a live bash process is still executing it (bash reads
+# scripts incrementally). Once no fill_missing.sh process survives,
+# inline the relay gate into fill_missing.sh's own probe loop (the
+# watch_and_measure.sh block is the template) and retire this file -
+# a relay death AFTER the handoff still costs ~50 min per blocked jax
+# probe, which only an in-loop gate fixes.
 set -u
 cd "$(dirname "$0")/.."
+
+handoff() {
+  # one watcher at a time: watch_and_measure's inline jax probe does not
+  # match fill_missing's python-script guard, so two gate-synchronized
+  # watchers would fire claimers at the same gate-open instant - the
+  # r4 wedge condition. Script-level pgrep sees both watchers reliably.
+  while pgrep -f "watch_and_measure\.sh|measure_all\.py" > /dev/null; do
+    echo "[gate] another chip watcher is running; sleeping 120s"
+    sleep 120
+  done
+  exec bash tools/fill_missing.sh
+}
+
 attempt=0
 while true; do
   attempt=$((attempt + 1))
   gate_out=$(python tools/relay_up.py 2>&1); gate_rc=$?
   if [ "$gate_rc" -eq 0 ]; then
     echo "[gate] relay up at $(date -u +%H:%M:%S) - starting fill"
-    exec bash tools/fill_missing.sh
+    handoff
   elif [ "$gate_rc" -ne 1 ]; then
     echo "[gate] relay gate unusable (rc ${gate_rc}): ${gate_out} - starting fill anyway"
-    exec bash tools/fill_missing.sh
+    handoff
   fi
   if [ $((attempt % 30)) -eq 1 ]; then
     echo "[gate] relay down (attempt ${attempt}) at $(date -u +%H:%M:%S)"
