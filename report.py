@@ -488,8 +488,8 @@ def _unmeasured_cell(r: dict) -> str:
     """One cell for a row without a measured value: states the fact and
     carries the recorded error - no claim about queue state (whether a
     re-measure is scheduled lives in ROADMAP.md, not in the row)."""
-    why = r.get("error", r.get("skipped", "no measurement"))
-    return f"no measured value (error: {str(why)[:60]})"
+    why = str(r.get("error", r.get("skipped", "no measurement")))
+    return f"no measured value (error: {why[:60].rstrip('; (')})"
 
 
 def _bench_matrix_sections() -> list[str]:
@@ -533,7 +533,8 @@ def _bench_matrix_sections() -> list[str]:
                      "tokens/s", "MFU %"]),
             fmt_row(["---"] * 7),
         ]
-        for r in lm:
+        # measured rows first; unmeasured stubs below them
+        for r in sorted(lm, key=lambda r: "tokens_per_s" not in r):
             if "tokens_per_s" not in r:
                 out.append(fmt_row([
                     r["id"], "-", "-", "-", "-", _unmeasured_cell(r), "-",
@@ -574,7 +575,8 @@ def _bench_matrix_sections() -> list[str]:
                      "HBM util %"]),
             fmt_row(["---"] * 6),
         ]
-        for r in dec:
+        # measured rows first, same as the LM table
+        for r in sorted(dec, key=lambda r: "decode_tokens_per_s" not in r):
             if "decode_tokens_per_s" not in r:
                 out.append(fmt_row([
                     r["id"], "-", "-", _unmeasured_cell(r), "-", "-",
