@@ -279,8 +279,13 @@ def test_measure_fault_tolerance_flat_wall_and_survival(n_devices):
         measure_fault_tolerance,
     )
 
+    # straggler_duration 1.0: the stall signal (epochs_degraded * 1 s)
+    # must dominate host-timing noise on the two ~15 s per-epoch loops -
+    # at the 0.25 s default the predicted 1 s stall sat inside +/-1.5 s
+    # loop noise and the bound below flaked (observed measured=-1.45)
     r = measure_fault_tolerance(probs=(0.0, 0.6), epochs=4,
-                                synthetic_size=800)
+                                synthetic_size=800,
+                                straggler_duration=1.0)
     p0, p6 = r["points"]
     assert p0["mean_live_frac"] == 1.0 and p0["epochs_degraded"] == 0
     assert p6["mean_live_frac"] < 0.8  # the sweep really dropped devices
