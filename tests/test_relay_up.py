@@ -6,6 +6,7 @@ seconds and must never be able to crash a watcher into a silent
 "down" loop (exit 2 = gate broke, callers fall through to the probe).
 """
 
+import os
 import socket
 import subprocess
 import sys
@@ -27,14 +28,19 @@ def test_relay_up_gate():
             s.listen(4)
             srvs.append(s)
             ports.append(s.getsockname()[1])
-        orig = ru.PORTS
-        ru.PORTS = tuple(ports)
+        prior = os.environ.get("RELAY_PORTS")
+        os.environ["RELAY_PORTS"] = ",".join(str(p) for p in ports)
         try:
             assert ru.relay_up() is True
             srvs[1].close()  # one dead port -> down
             assert ru.relay_up() is False
+            os.environ["RELAY_PORTS"] = ","  # separator-only -> defaults
+            assert ru._ports() == ru._DEFAULT_PORTS
         finally:
-            ru.PORTS = orig
+            if prior is None:
+                del os.environ["RELAY_PORTS"]
+            else:
+                os.environ["RELAY_PORTS"] = prior
     finally:
         for s in srvs:
             try:

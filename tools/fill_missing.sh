@@ -1,15 +1,38 @@
 #!/bin/bash
-# Follow-up measurement session: re-tune with the RTT-corrected timer and
-# fill every accelerator row the first pass lost to the wedge, under the
-# NEW single-claim group worker (bench.py --worker-multi; --only forces
-# re-measurement). Refuses to start while measure_all/bench is running
-# (two claimers wedge the chip), then probes patiently - a probe against
-# a wedged claim blocks tens of minutes before erroring, which IS the
-# polling interval; probes are never killed by this script.
+# Fill measurement session: on the first healthy chip, run the on-TPU
+# kernel-numerics parity check, re-measure the flagship LM row with the
+# already-tuned flash blocks (the r4 11.81 ms/layer config - the >=40%
+# MFU claim lands or falls on this row, so it goes FIRST), then the
+# RTT-corrected tunes, then every remaining error row, under the
+# single-claim group worker (bench.py --worker-multi; --only forces
+# re-measurement). Artifacts are committed as each stage lands so a
+# relay death or session end cannot lose measured data again (r4 lost
+# tune files exactly that way).
+#
+# Gate design (r4 VERDICT items 1-2): the cheap TCP relay gate
+# (tools/relay_up.py) runs INSIDE the probe loop, so a relay death at
+# any point between probes costs a 60 s poll, not a ~50 min blocked jax
+# RPC. rc 2 = the gate itself crashed - fall through to the real probe
+# rather than pinning at "down". Probes are never killed (killing a
+# claimer wedges the chip); a probe against a wedged claim blocks
+# 30-50 min before erroring, which IS the polling interval.
+#
 # Run detached:  setsid nohup bash tools/fill_missing.sh \
 #                    > fill_missing.log 2>&1 &
 set -u
 cd "$(dirname "$0")/.."
+
+# single-instance lock (shared with watch_and_measure.sh): two
+# gate-synchronized chip watchers would fire claimers at the same
+# gate-open instant - the r4 wedge condition. flock covers every copy of
+# either script (and survives bash's incremental script reads, which a
+# pgrep self-exclusion would not).
+exec 9>".chip_session.lock"
+if ! flock -n 9; then
+  echo "[fill] another chip watcher holds the lock; waiting for it"
+  flock 9
+  echo "[fill] lock acquired at $(date -u +%H:%M:%S)"
+fi
 
 ROWS="cnn_dp_ep25_bs32,cnn_dp_ep25_bs64,cnn_dp_ep25_bs16_pallas"
 ROWS="$ROWS,cnn_dp_ep25_bs16_bf16,cnn_dp_ep25_bs16_stream"
@@ -20,15 +43,50 @@ ROWS="$ROWS,lm_flash_d1024_L16_seq2048_bf16"
 ROWS="$ROWS,lm_xla_d512_L8_seq2048_bf16_rematattn"
 ROWS="$ROWS,lm_flash_d1024_L16_seq2048_bf16_remat_b8"
 ROWS="$ROWS,lm_flash_d512_L8_seq8192_bf16,lm_decode_d512_L8_b16_bf16"
+# the flagship row runs alone first (highest-leverage, r4 VERDICT item
+# 1); it stays in ROWS too so it re-measures after the fresh tunes -
+# merge-by-id keeps the newest record.
+FLAGSHIP="lm_flash_d512_L8_seq2048_bf16"
 
-# match ANY bench/tune invocation (a parent in its probe/backoff window
-# has no --worker child yet, and a plain `bench.py --refresh` has no
-# --deadline flag - missing those would start a second claimer). The
-# pattern is ANCHORED to a python first token: an unanchored
-# "bench\.py" also matches the build driver, whose argv embeds prompt
-# text naming these files, and the gate would never open
-while pgrep -f "^[^ ]*python[0-9.]* [^ ]*(bench|tune_flash|measure_all)\.py" \
-    > /dev/null; do
+# commit measured artifacts immediately (retry: the interactive session
+# may hold .git/index.lock briefly). Pathspecs are QUOTED (git expands
+# them and silently skips ignored files like tools/measure_all_log.json;
+# a shell-expanded ignored path makes git add exit 1) and the commit is
+# pathspec-limited so anything the interactive session pre-staged is
+# left alone. An unchanged tree is a no-op, not a failure.
+commit_artifacts() {
+  local msg="$1"
+  local paths=("tools/*.json" "BENCH_MATRIX.json" "REPORT.md")
+  for i in 1 2 3; do
+    if git add -- "${paths[@]}" 2>/dev/null; then
+      if git diff --cached --quiet -- "${paths[@]}"; then
+        echo "[fill] nothing new to commit for: $msg"
+        return 0
+      fi
+      if git commit --quiet -m "$msg" -- "${paths[@]}" 2>/dev/null; then
+        echo "[fill] committed: $msg"
+        return 0
+      fi
+    fi
+    sleep 5
+  done
+  echo "[fill] commit failed (non-fatal): $msg"
+  return 0
+}
+
+# match ANY bench/tune/parity invocation (a parent in its probe/backoff
+# window has no --worker child yet, and a plain `bench.py --refresh` has
+# no --deadline flag - missing those would start a second claimer). The
+# pattern is ANCHORED to a python first token: an unanchored "bench\.py"
+# also matches the build driver, whose argv embeds prompt text naming
+# these files, and the gate would never open. The second pgrep catches a
+# LEGACY watcher surviving from a pre-flock session while it is actively
+# probing ("probe ok: value" is the probe python's own argv); a legacy
+# watcher sleeping between probes is invisible here - bounded residual
+# race, gone once every live copy takes .chip_session.lock.
+while pgrep -f "^[^ ]*python[0-9.]* [^ ]*(bench|tune_flash|measure_all|flash_parity_check)\.py" \
+    > /dev/null \
+    || pgrep -f "probe ok: value" > /dev/null; do
   echo "[fill] a measurement session is still running; sleeping 120s"
   sleep 120
 done
@@ -36,6 +94,20 @@ done
 attempt=0
 while true; do
   attempt=$((attempt + 1))
+  # cheap TCP gate first: with the relay dead (r4 post-mortem), a jax
+  # probe blocks ~50 min in RPC retries; this check costs milliseconds
+  # and holds no claim, so the poll interval stays 60 s while the
+  # transport is down. rc 2 = gate crashed - fall through to the probe.
+  gate_out=$(python tools/relay_up.py 2>&1); gate_rc=$?
+  if [ "$gate_rc" -eq 1 ]; then
+    if [ $((attempt % 30)) -eq 1 ]; then
+      echo "[fill] relay down (attempt ${attempt}) at $(date -u +%H:%M:%S)"
+    fi
+    sleep 60
+    continue
+  elif [ "$gate_rc" -ne 0 ]; then
+    echo "[fill] relay gate unusable (rc ${gate_rc}): ${gate_out} - falling through to the jax probe"
+  fi
   echo "[fill] probe attempt ${attempt} at $(date -u +%H:%M:%S)"
   if python -c "
 import time, jax, jax.numpy as jnp
@@ -44,19 +116,37 @@ x = jnp.ones((512, 512), jnp.bfloat16)
 v = float((x @ x).sum())
 print('probe ok: value', v, 'in', round(time.time() - t0, 1), 's', flush=True)
 "; then
-    echo "[fill] chip healthy at $(date -u +%H:%M:%S) - re-tuning (RTT-corrected)"
+    echo "[fill] chip healthy at $(date -u +%H:%M:%S)"
+
+    # claim-cycle budget (r4: a hang was observed on the 4th consecutive
+    # claim/release cycle): highest-leverage stage takes the FIRST claim
+    # so a later wedge cannot cost it.
+    echo "[fill] stage 1: flagship LM row with the tuned flash blocks"
+    python bench.py --only "$FLAGSHIP" --deadline 3600
+    echo "[fill] flagship rc=$?"
+    commit_artifacts "measure: flagship LM row with tuned flash blocks"
+
+    echo "[fill] stage 2: on-TPU kernel numerics parity"
+    python tools/flash_parity_check.py; rc=$?
+    echo "[fill] parity rc=${rc}"
+    commit_artifacts "measure: on-TPU kernel numerics parity (rc=${rc})"
+
+    echo "[fill] stage 3: re-tune flash (RTT-corrected timer)"
     python tools/tune_flash.py; rc1=$?
     python tools/tune_flash.py --heads 4 --head-dim 128; rc2=$?
     if [ "$rc1" -ne 0 ] || [ "$rc2" -ne 0 ]; then
       echo "[fill] WARNING: tune rc=${rc1}/${rc2} - LM rows will run on" \
-           "whatever tune files exist (possibly stale pre-RTT-fix blocks)"
-    else
-      echo "[fill] tunes done - filling rows (one claim)"
+           "whatever tune files exist"
     fi
+    commit_artifacts "measure: flash tunes hd64/hd128 (rc=${rc1}/${rc2})"
+
+    echo "[fill] stage 4: filling all rows (one claim)"
     python bench.py --only "$ROWS" --deadline 14400
     echo "[fill] bench rc=$? - rendering report"
     python report.py --from-matrix
-    echo "[fill] done rc=$? at $(date -u +%H:%M:%S)"
+    echo "[fill] report rc=$?"
+    commit_artifacts "measure: fill pass rows + report re-render"
+    echo "[fill] done at $(date -u +%H:%M:%S)"
     break
   fi
   echo "[fill] probe failed; sleeping 180s before the next attempt"

@@ -19,16 +19,31 @@ a dead transport.
 
 from __future__ import annotations
 
+import os
 import socket
 import sys
 
 # one device-traffic port and the remote-compile port (see the PORTS
-# list in the relay; these two are the ones measurement traffic needs)
-PORTS = (8082, 8113)
+# list in the relay; these two are the ones measurement traffic needs).
+# Overridable via RELAY_PORTS="8082,8113" so a relay with a different
+# port layout doesn't pin every gated watcher at "down" forever (the
+# callers' rc-2 fall-through handles a *crashed* gate; this handles a
+# *wrong* one).
+_DEFAULT_PORTS = (8082, 8113)
+
+
+def _ports() -> tuple[int, ...]:
+    raw = os.environ.get("RELAY_PORTS", "").strip()
+    if not raw:
+        return _DEFAULT_PORTS
+    # a separator-only value must not yield an empty tuple: zero ports
+    # would make relay_up() vacuously True and report a dead relay "up"
+    return (tuple(int(p) for p in raw.replace(" ", "").split(",") if p)
+            or _DEFAULT_PORTS)
 
 
 def relay_up(timeout: float = 2.0) -> bool:
-    for port in PORTS:
+    for port in _ports():
         s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         s.settimeout(timeout)
         try:

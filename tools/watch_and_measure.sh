@@ -7,10 +7,19 @@
 #                    > watch_measure.log 2>&1 &
 set -u
 cd "$(dirname "$0")/.."
+# single-instance lock shared with fill_missing.sh: two gate-synchronized
+# chip watchers would fire claimers at the same gate-open instant (the r4
+# wedge condition)
+exec 9>".chip_session.lock"
+if ! flock -n 9; then
+  echo "[watch] another chip watcher holds the lock; waiting for it"
+  flock 9
+  echo "[watch] lock acquired at $(date -u +%H:%M:%S)"
+fi
 # refuse to start while another measurement session is live (two claimers
 # wedge the chip). Anchored to a python first token: an unanchored name
 # match also hits unrelated processes embedding these filenames in argv
-while pgrep -f "^[^ ]*python[0-9.]* [^ ]*(bench|tune_flash|measure_all)\.py" \
+while pgrep -f "^[^ ]*python[0-9.]* [^ ]*(bench|tune_flash|measure_all|flash_parity_check)\.py" \
     > /dev/null; do
   echo "[watch] a measurement session is still running; sleeping 120s"
   sleep 120
