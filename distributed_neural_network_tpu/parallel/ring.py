@@ -47,16 +47,30 @@ def attention(q, k, v, *, causal: bool = False, q_offset=0, k_offset=0, scale=No
 
     q: (B, Sq, H, D), k/v: (B, Sk, H, D) -> (B, Sq, H, D). Offsets give the
     global position of row 0 for causal masking across shards.
+
+    H == 1 takes a squeezed 3-D contraction: XLA:CPU lowers the size-1-head
+    4-D batched einsum ~2x SLOWER than the h=2 case despite half the FLOPs
+    (measured, tools/ulysses_diag.json) - this was the entire
+    lm_ulysses_sp_scaling_cpu8 sp=8 cliff (one head per device at H == sp;
+    overhead 1.923 vs 0.897 at sp=4). Same math, same outputs.
     """
     d = q.shape[-1]
     scale = (1.0 / jnp.sqrt(d)) if scale is None else scale
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    # all three must be single-head: squeezing on q alone would silently
+    # attend k/v head 0 where the generic einsum raises a shape error
+    squeeze = q.shape[2] == k.shape[2] == v.shape[2] == 1
+    if squeeze:
+        s = jnp.einsum("bqd,bkd->bqk", q[:, :, 0], k[:, :, 0]) * scale
+    else:
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     if causal:
         qpos = q_offset + jnp.arange(q.shape[1])
         kpos = k_offset + jnp.arange(k.shape[1])
         mask = qpos[:, None] >= kpos[None, :]
-        s = jnp.where(mask[None, None], s, _NEG_BIG)
+        s = jnp.where(mask[None] if squeeze else mask[None, None], s, _NEG_BIG)
     p = jax.nn.softmax(s, axis=-1)
+    if squeeze:
+        return jnp.einsum("bqk,bkd->bqd", p, v[:, :, 0])[:, :, None, :]
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 

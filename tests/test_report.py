@@ -122,6 +122,75 @@ def test_scaling_rows_render_outside_lm_table(repo):
     assert "no measured value" not in text
 
 
+def test_cnn_variants_section_pins_same_epoch_headline(repo):
+    """The variants table must ratio against the SAME-epoch headline
+    (rows from other --epochs runs persist in the matrix), gate the
+    stream-attribution paragraph on a measured stream row, and render
+    error stubs as unmeasured cells."""
+    _write_matrix(repo, [
+        {"id": "cnn_dp_ep2_bs16", "batch_size": 16, "train_s": 2.0,
+         "val_acc": 50.0, "epochs": 2, "source": "synthetic"},
+        {"id": "cnn_dp_ep25_bs16", "batch_size": 16, "train_s": 20.0,
+         "val_acc": 99.0, "epochs": 25, "source": "synthetic"},
+        {"id": "cnn_dp_ep25_bs16_bf16", "batch_size": 16, "train_s": 10.0,
+         "val_acc": 98.0, "epochs": 25, "source": "synthetic"},
+        {"id": "cnn_dp_ep25_bs16_stream", "error": "backend unavailable"},
+    ])
+    text = "\n".join(report._bench_matrix_sections())
+    assert "CNN variants" in text
+    # 20.0 / 10.0 against the ep25 headline - NOT 2.0/10.0 vs the ep2 row
+    assert "2.00x" in text and "0.20x" not in text
+    assert "no measured value (error: backend unavailable" in text
+    # stream row unmeasured -> no attribution guidance about its delta
+    assert "per-epoch engine path" not in text
+
+    # measured stream row -> the attribution note appears
+    _write_matrix(repo, [
+        {"id": "cnn_dp_ep25_bs16", "batch_size": 16, "train_s": 20.0,
+         "val_acc": 99.0, "epochs": 25, "source": "synthetic"},
+        {"id": "cnn_dp_ep25_bs16_stream", "batch_size": 16,
+         "train_s": 25.0, "val_acc": 99.0, "epochs": 25,
+         "source": "synthetic"},
+    ])
+    text = "\n".join(report._bench_matrix_sections())
+    assert "per-epoch engine path" in text
+
+
+def test_measured_bs_row_with_mismatched_field_is_not_dropped(repo):
+    """A bs-sweep row with train_s but a missing/mismatched batch_size
+    field renders with bs from the id (+ provenance note) instead of
+    silently vanishing from Table 2 (ADVICE r4)."""
+    _write_matrix(repo, [
+        {"id": "cnn_dp_ep25_bs32", "train_s": 21.0, "val_acc": 98.0,
+         "epochs": 25, "source": "synthetic"},  # no batch_size field
+    ])
+    _, bs_rows, pending = report._rows_from_matrix(25)
+    assert pending == []
+    assert [r["batch_size"] for r in bs_rows] == [32]
+    assert "bs taken from the row id" in bs_rows[0]["field_note"]
+
+
+def test_fault_sweep_without_p0_control_renders_honestly(repo):
+    """wall_vs_p0=None (custom sweep, no p=0 point) must not print a
+    literal None or claim a p=0 control; the wall_vs_first fallback is
+    shown and labelled."""
+    point = {"failure_probability": 0.3, "val_acc": 60.0,
+             "val_loss": 1.1, "mean_live_frac": 0.7,
+             "epochs_degraded": 3, "train_s": 5.0,
+             "wall_vs_p0": None, "wall_vs_first": 1.0}
+    _write_matrix(repo, [
+        {"id": "cnn_fault_sweep_cpu8", "epochs": 6, "batch_size": 16,
+         "devices": 8, "platform": "cpu",
+         "points": [point, {**point, "failure_probability": 0.6,
+                            "wall_vs_first": 1.02}]},
+    ])
+    text = "\n".join(report._bench_matrix_sections())
+    assert "None" not in text
+    assert "vs first point" in text
+    assert "no p=0 control" in text
+    assert "p=0 is the exact control" not in text
+
+
 def test_recovered_tune_note_and_mfu_branches(repo):
     _write_matrix(repo, [FLAGSHIP])
     tune = repo / "tools" / "flash_tune_TPU_v5_lite_s2048.json"

@@ -140,6 +140,52 @@ def test_ulysses_rejects_indivisible_heads(n_devices):
         _sharded(mesh, ulysses_attention, False)(q, q, q)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_attention_single_head_squeezed_path(n_devices, causal):
+    """H == 1 routes through the squeezed 3-D einsum (the ulysses sp == H
+    cliff fix); it must be numerically identical to the generic 4-D path,
+    including cross-shard causal offsets, in value and gradient."""
+    rng = np.random.default_rng(9)
+    mk = lambda: jnp.asarray(rng.normal(size=(B, S, 1, D)), jnp.float32)
+    q, k, v = mk(), mk(), mk()
+
+    def generic(q, k, v):  # the pre-fix 4-D einsum path, verbatim
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(D)
+        if causal:
+            qpos = 3 + jnp.arange(q.shape[1])
+            kpos = jnp.arange(k.shape[1])
+            s = jnp.where((qpos[:, None] >= kpos[None, :])[None, None],
+                          s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+    got = attention(q, k, v, causal=causal, q_offset=3 if causal else 0)
+    want = generic(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)
+    g_got = jax.grad(lambda *a: (attention(
+        *a, causal=causal, q_offset=3 if causal else 0) ** 2).sum())(q, k, v)
+    g_want = jax.grad(lambda *a: (generic(*a) ** 2).sum())(q, k, v)
+    np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_attention_single_head_q_with_multihead_kv_unchanged(n_devices):
+    """The squeeze path keys on ALL THREE head dims: q with 1 head
+    against multi-head k/v must keep the generic einsum's pre-fix
+    behavior (size-1 head broadcast, (B, S, Hkv, D) output) - routing
+    it through the squeeze would silently attend k/v head 0 only."""
+    rng = np.random.default_rng(11)
+    q = jnp.asarray(rng.normal(size=(B, S, 1, D)), jnp.float32)
+    kv = jnp.asarray(rng.normal(size=(B, S, 4, D)), jnp.float32)
+    got = attention(q, kv, kv)
+    assert got.shape == (B, S, 4, D)  # broadcast, not squeezed to 1
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, kv) / jnp.sqrt(D)
+    want = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1), kv)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)
+
+
 def test_ring_attention_single_device_degenerates(n_devices):
     """Mesh of 1: ring attention is exactly full attention."""
     q, k, v = _qkv(4)
