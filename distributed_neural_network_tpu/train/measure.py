@@ -344,6 +344,67 @@ def measure_sp_scaling(
     }
 
 
+def fit_tick_model(results, *, n_layers, mb_rows, seq_len, steps,
+                   pp_n: int = 4) -> dict:
+    """Fit T = ticks * (w*c + o) to measured pp-bubble configs.
+
+    Separates the schedule bubble from per-tick dispatch overhead: w =
+    layers/tick, c = per-layer cost, o = fixed per-tick overhead - two
+    unknowns over len(results) configs, least squares. Annotates each
+    result with `bubble_overhead_adjusted` = 1 - (v*M useful ticks of
+    model time) / MEASURED time (dividing model useful by model total
+    would cancel the fit and always reproduce the analytic number -
+    review r3 caught exactly that tautology), and returns the tick_model
+    dict.
+
+    The physical model requires c, o >= 0: when the unconstrained
+    optimum has a negative component, the constrained (NNLS) optimum is
+    one of the two single-parameter boundary fits (o=0 c-only, c=0
+    o-only) - the lower-SSE one is chosen rather than assuming which
+    coordinate went negative, and both optima are reported
+    (`boundary_solution`). A slightly negative unconstrained o is
+    expected on a shared host (later ticks run warmer caches), so the
+    o=0 boundary is a finding - per-tick overhead statistically zero -
+    not a fallback. Pure function of the measured configs: unit-tested
+    in tests/test_pipeline.py without running a measurement."""
+    import numpy as np
+
+    ticks = np.array([r["interleave"] * r["microbatches"] + pp_n - 1
+                      for r in results], np.float64)
+    work = np.array([n_layers / (r["interleave"] * pp_n)
+                     for r in results], np.float64)
+    t_meas = np.array([
+        r["microbatches"] * mb_rows * seq_len * steps / r["tokens_per_s"]
+        for r in results
+    ])
+    A = np.stack([ticks * work, ticks], axis=1)
+    (c_un, o_un), res, *_ = np.linalg.lstsq(A, t_meas, rcond=None)
+    c_fit, o_fit = float(c_un), float(o_un)
+    boundary = None
+    if o_fit < 0 or c_fit < 0:
+        tw = ticks * work
+        cands = [(max(float(tw @ t_meas / (tw @ tw)), 0.0), 0.0),
+                 (0.0, max(float(ticks @ t_meas / (ticks @ ticks)), 0.0))]
+        c_fit, o_fit = min(
+            cands, key=lambda co: float(
+                ((A @ np.array(co)) - t_meas) ** 2 @ np.ones_like(t_meas)))
+        boundary = {"per_layer_s_unconstrained": round(float(c_un), 6),
+                    "per_tick_overhead_s_unconstrained": round(
+                        float(o_un), 6)}
+    pred = A @ np.array([c_fit, o_fit])
+    fit_err = float(np.abs(pred - t_meas).max() / t_meas.max())
+    for r, w, t_i in zip(results, work, t_meas):
+        useful = r["interleave"] * r["microbatches"] * (w * c_fit + o_fit)
+        r["bubble_overhead_adjusted"] = round(1.0 - useful / t_i, 4)
+    return {
+        "per_layer_s": round(float(c_fit), 6),
+        "per_tick_overhead_s": round(float(o_fit), 6),
+        "rel_fit_err": round(fit_err, 4),
+        "n_configs": len(results),
+        **({"boundary_solution": boundary} if boundary else {}),
+    }
+
+
 def measure_pp_bubble(
     *,
     d_model: int = 256,
@@ -424,70 +485,16 @@ def measure_pp_bubble(
     for r in results:
         r["bubble_measured"] = round(1.0 - r["tokens_per_s"] / ideal, 4)
 
-    # separate schedule bubble from per-tick dispatch overhead: model
-    # step time as T_ticks * (w * c + o) with w = layers/tick, c =
-    # per-layer cost, o = fixed per-tick overhead - two unknowns, four
-    # configs, least squares. The overhead-adjusted bubble is then what
-    # the schedule itself wastes: 1 - (v*M ticks of useful work) / the
-    # modeled total, independent of the CPU mesh's dispatch cost (which
-    # inflates raw bubble_measured for long schedules).
-    import numpy as np
-
-    pp_n = 4
-    ticks = np.array([r["interleave"] * r["microbatches"] + pp_n - 1
-                      for r in results], np.float64)
-    work = np.array([n_layers / (r["interleave"] * pp_n)
-                     for r in results], np.float64)
-    t_meas = np.array([
-        r["microbatches"] * mb_rows * seq_len * steps / r["tokens_per_s"]
-        for r in results
-    ])
-    A = np.stack([ticks * work, ticks], axis=1)
-    (c_un, o_un), res, *_ = np.linalg.lstsq(A, t_meas, rcond=None)
-    c_fit, o_fit = float(c_un), float(o_un)
-    boundary = None
-    if o_fit < 0 or c_fit < 0:
-        # the physical model requires c, o >= 0: a negative component
-        # puts the constrained (NNLS) optimum on a boundary. For this
-        # 2-parameter model the candidates are the two single-parameter
-        # fits (o=0 c-only, c=0 o-only); pick the lower-SSE non-negative
-        # one rather than assuming which coordinate went negative. A
-        # slightly negative unconstrained o is expected on this host
-        # (later ticks run warmer caches), so the o=0 boundary is a
-        # FINDING - per-tick overhead statistically zero - not a
-        # fallback; both optima are reported.
-        tw = ticks * work
-        cands = [(max(float(tw @ t_meas / (tw @ tw)), 0.0), 0.0),
-                 (0.0, max(float(ticks @ t_meas / (ticks @ ticks)), 0.0))]
-        c_fit, o_fit = min(
-            cands, key=lambda co: float(
-                ((A @ np.array(co)) - t_meas) ** 2 @ np.ones_like(t_meas)))
-        boundary = {"per_layer_s_unconstrained": round(float(c_un), 6),
-                    "per_tick_overhead_s_unconstrained": round(
-                        float(o_un), 6)}
-    pred = A @ np.array([c_fit, o_fit])
-    fit_err = float(np.abs(pred - t_meas).max() / t_meas.max())
-    for r, tick_n, w, t_i in zip(results, ticks, work, t_meas):
-        # model time for the vM useful ticks over the MEASURED total: if
-        # the schedule is right this tracks bubble_analytic; a schedule
-        # paying extra ticks (broken lap indexing, say) inflates t_i and
-        # shows up here. (Dividing model useful by model total would
-        # cancel the fit entirely and always reproduce the analytic
-        # number - review r3 caught exactly that tautology.)
-        useful = r["interleave"] * r["microbatches"] * (w * c_fit + o_fit)
-        r["bubble_overhead_adjusted"] = round(1.0 - useful / t_i, 4)
+    tick_model = fit_tick_model(
+        results, n_layers=n_layers, mb_rows=mb_rows, seq_len=seq_len,
+        steps=steps,
+    )
     return {
         "pp": 4, "d_model": d_model, "n_layers": n_layers,
         "seq_len": seq_len, "mb_rows": mb_rows,
         "devices": jax.device_count(), "platform": jax.default_backend(),
         "configs": results,
-        "tick_model": {
-            "per_layer_s": round(float(c_fit), 6),
-            "per_tick_overhead_s": round(float(o_fit), 6),
-            "rel_fit_err": round(fit_err, 4),
-            "n_configs": len(results),
-            **({"boundary_solution": boundary} if boundary else {}),
-        },
+        "tick_model": tick_model,
         "note": (
             "bubble_measured compares raw tokens/s against the best "
             "config extrapolated by its analytic bubble; CPU-mesh "

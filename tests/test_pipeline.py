@@ -582,3 +582,69 @@ def test_pp_zero_interleaved_learns(n_devices):
         losses.append(float(loss))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0] - 1.0, losses[:: len(losses) - 1]
+
+
+# ------------------------- tick-model fit (pure, no measurement) ------
+
+
+def _tick_configs(c, o, *, n_layers=8, mb_rows=2, seq_len=128, steps=6,
+                  pp_n=4):
+    """Synthesize measured configs from exact tick-model parameters."""
+    out = []
+    for m, v in ((2, 1), (4, 1), (8, 1), (16, 1), (4, 2), (8, 2), (16, 2)):
+        ticks = v * m + pp_n - 1
+        w = n_layers / (v * pp_n)
+        t = ticks * (w * c + o)
+        out.append({
+            "microbatches": m, "interleave": v,
+            "tokens_per_s": m * mb_rows * seq_len * steps / t,
+            "bubble_analytic": round((pp_n - 1) / (v * m + pp_n - 1), 4),
+        })
+    return out
+
+
+def test_fit_tick_model_recovers_exact_parameters():
+    """Noiseless data: the fit recovers (c, o) and the overhead-adjusted
+    bubble collapses to the analytic bubble exactly (useful/total =
+    vM/ticks when the model is exact)."""
+    from distributed_neural_network_tpu.train.measure import fit_tick_model
+
+    results = _tick_configs(2.0, 0.1)
+    tm = fit_tick_model(results, n_layers=8, mb_rows=2, seq_len=128,
+                        steps=6)
+    assert abs(tm["per_layer_s"] - 2.0) < 1e-6
+    assert abs(tm["per_tick_overhead_s"] - 0.1) < 1e-6
+    assert tm["rel_fit_err"] < 1e-6
+    assert tm["n_configs"] == 7
+    assert "boundary_solution" not in tm
+    for r in results:
+        assert abs(r["bubble_overhead_adjusted"] - r["bubble_analytic"]) \
+            < 1e-3
+
+
+def test_fit_tick_model_negative_overhead_hits_o_boundary():
+    """Warm-cache-shaped data (unconstrained o < 0): the constrained fit
+    sits at o=0 with the unconstrained optimum reported."""
+    from distributed_neural_network_tpu.train.measure import fit_tick_model
+
+    results = _tick_configs(2.0, -0.15)
+    tm = fit_tick_model(results, n_layers=8, mb_rows=2, seq_len=128,
+                        steps=6)
+    assert tm["per_tick_overhead_s"] == 0.0
+    assert tm["per_layer_s"] > 0
+    bnd = tm["boundary_solution"]
+    assert bnd["per_tick_overhead_s_unconstrained"] < 0
+
+
+def test_fit_tick_model_negative_layer_cost_hits_c_boundary():
+    """Degenerate data where the per-layer component fits negative: the
+    constrained optimum must land on the c=0 boundary (o-only fit), not
+    the c-only fit (the review-caught wrong-boundary bug)."""
+    from distributed_neural_network_tpu.train.measure import fit_tick_model
+
+    results = _tick_configs(-0.05, 1.0)
+    tm = fit_tick_model(results, n_layers=8, mb_rows=2, seq_len=128,
+                        steps=6)
+    assert tm["per_layer_s"] == 0.0
+    assert tm["per_tick_overhead_s"] > 0
+    assert tm["boundary_solution"]["per_layer_s_unconstrained"] < 0
