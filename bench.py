@@ -794,6 +794,49 @@ def _probe_backend(timeout: float = 75.0) -> bool:
     return p.returncode == 0
 
 
+def _other_claimers() -> list[str]:
+    """Pids of OTHER measurement processes that may hold/acquire the
+    chip claim (tune/parity/measure_all or another bench). Anchored to a
+    python first token - an unanchored name match also hits the build
+    driver, whose argv embeds prompt text naming these files - and
+    excludes this process and its children (worker pids appear after the
+    group starts, which is after this gate). Among PEER bench parents,
+    only LOWER pids count: two concurrent benches must not mutually gate
+    (both sleeping out the probe budget and then probing at once - the
+    exact two-claimer wedge); the older session wins, the younger waits."""
+    pat = (r"^[^ ]*python[0-9.]* [^ ]*"
+           r"(bench|tune_flash|measure_all|flash_parity_check)\.py")
+    try:
+        out = subprocess.run(["pgrep", "-af", pat], capture_output=True,
+                             text=True, timeout=10).stdout
+    except Exception:  # noqa: BLE001 - a broken gate must not block rows
+        return []
+    me = {str(os.getpid()), str(os.getppid())}
+    pids = []
+    for line in out.splitlines():
+        pid, _, argv = line.partition(" ")
+        if pid in me:
+            continue
+        is_peer_bench = "bench.py" in argv and "--worker" not in argv
+        if is_peer_bench and int(pid) > os.getpid():
+            continue
+        pids.append(pid)
+    return pids
+
+
+def _wait_claimers(deadline_ts: float, *, sleep_s: float = 60.0) -> None:
+    """Wait for other measurement sessions to finish before probing.
+
+    The probe itself acquires the chip claim, so starting it beside a
+    live fill/tune session creates the two-claimer wedge (r4
+    post-mortem). Bounded by the caller's probe budget: on timeout the
+    normal probe path proceeds and reports honestly."""
+    while (pids := _other_claimers()) and time.time() + sleep_s < deadline_ts:
+        _log("[bench] another measurement session is running "
+             f"(pids {','.join(pids)}); sleeping {sleep_s:.0f}s")
+        time.sleep(sleep_s)
+
+
 def _wait_backend(deadline_ts: float, *, probe_timeout: float = 75.0,
                   sleep_s: float = 60.0) -> bool:
     """Probe until the backend answers or the deadline passes."""
@@ -936,6 +979,7 @@ def main() -> int:
     backend_ok = True
     if group_specs:
         probe_budget = t_start + min(args.deadline * 0.5, 600.0)
+        _wait_claimers(probe_budget)
         backend_ok = _wait_backend(probe_budget)
         if not backend_ok:
             _log("[bench] device backend unavailable after probing; "
