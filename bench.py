@@ -303,6 +303,19 @@ def _rows(epochs: int) -> list[dict]:
             },
             "args": {"attn_impl": "ulysses"},
         },
+        # third SP mode: zigzag ring - each device holds a (front, back)
+        # sequence-slice pair so causal work balances across the ring
+        # (plain ring gives early shards almost no causal work) - the
+        # trilogy's load-balance claim, measured
+        {
+            "id": "lm_zigzag_sp_scaling_cpu8",
+            "kind": "sp_scaling",
+            "env": {
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+            },
+            "args": {"attn_impl": "zigzag"},
+        },
         # expert-parallel scaling shape (the EP analog): fixed global
         # batch, experts sharded over 1..8 devices, no-drop capacity so
         # every ep computes the same step - the all_to_all dispatch

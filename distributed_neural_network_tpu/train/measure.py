@@ -261,6 +261,16 @@ def _lm_axis_sweep(
         tokens, targets = lmtrain.make_copy_task(
             jax.random.key(1), batch=batch, seq_len=seq_len, vocab=vocab
         )
+        if attn_impl == "zigzag" and n > 1:
+            # zigzag consumes tokens in zigzag SHARD order (the caller
+            # permutes - parallel/ring.py zigzag_order; pinned by
+            # tests/test_transformer.py): without this each sp trains a
+            # differently-permuted objective and the loss column - the
+            # sweep's semantics check - drifts per sp
+            from ..parallel.ring import zigzag_order
+
+            perm = zigzag_order(seq_len, n)
+            tokens, targets = tokens[:, perm], targets[:, perm]
         params, mom, loss = step(params, mom, tokens, targets)  # compile
         hard_block(loss)
         t0 = time.perf_counter()

@@ -821,6 +821,37 @@ def _bench_matrix_sections() -> list[str]:
                 "instead, and the collectives ride ICI.",
                 "",
             ]
+        if impl == "zigzag":
+            # the comparative claim is DERIVED from the sibling rows at
+            # render time, never hardcoded: host noise has swung these
+            # curves before, and prose must not outlive its data
+            def _ov(which):
+                row = next((x for x in sp_rows
+                            if x.get("attn_impl") == which), None)
+                return ({p["sp"]: p["overhead_vs_sp1"]
+                         for p in row["points"]} if row else {})
+
+            zig, ring_o, uly = _ov("zigzag"), _ov("ring"), _ov("ulysses")
+            comp_sps = [s for s in zig
+                        if s >= 2 and s in ring_o and s in uly]
+            beats = bool(comp_sps) and all(
+                zig[s] < min(ring_o[s], uly[s]) for s in comp_sps)
+            out += [
+                "Zigzag is the load-balanced causal ring: each device "
+                "holds a (front, back) slice pair (`parallel/ring.py "
+                "zigzag_order`), so causal work is even across the ring "
+                "instead of early shards sitting nearly idle."
+                + (" In the rows above it sits below both plain ring "
+                   "and Ulysses at every measured sp >= 2 - the "
+                   "load-balance claim, measured." if beats else "")
+                + " Tokens are fed "
+                "in zigzag shard order (the caller permutes; the sweep "
+                "does this per sp - without it each point trains a "
+                "differently-permuted objective and the loss column "
+                "drifts, which is exactly how a missing permute was "
+                "caught in round 5).",
+                "",
+            ]
         if impl == "ulysses":
             out += [
                 "History: the r4 measurement of this row showed a 2x "

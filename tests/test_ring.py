@@ -215,3 +215,21 @@ def test_measure_sp_scaling_tiny(n_devices):
     assert all(p["tokens_per_s"] > 0 for p in pts)
     with pytest.raises(ValueError, match="must start at 1"):
         measure_sp_scaling(sps=(2, 4), seq_len=128, batch=2, steps=1)
+
+
+def test_measure_sp_scaling_zigzag_feeds_zigzag_order(n_devices):
+    """Zigzag consumes tokens in zigzag shard order (the caller
+    permutes): the sweep must permute per sp or each point trains a
+    differently-permuted objective - caught live in round 5 when the
+    un-permuted zigzag row's loss drifted per sp. The semantics pin is
+    the same loss at every sp, equal to the sp=1 natural-order baseline."""
+    from distributed_neural_network_tpu.train.measure import (
+        measure_sp_scaling,
+    )
+
+    r = measure_sp_scaling(
+        sps=(1, 2, 4), d_model=32, n_layers=2, n_heads=4, d_ff=64,
+        vocab=64, seq_len=128, batch=2, steps=1, attn_impl="zigzag",
+    )
+    losses = {p["final_loss"] for p in r["points"]}
+    assert len(losses) == 1, r["points"]
