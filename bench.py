@@ -192,6 +192,22 @@ def _rows(epochs: int) -> list[dict]:
                      "n_heads": 4},
         },
         {
+            # hd128 at double batch: the hd128 geometry measured 38.5%
+            # MFU at b16 (r5) - 1.5 points under the target; doubling the
+            # batch amortizes per-step dispatch and grows every matmul's
+            # M dimension, the remaining efficiency lever at d512. The
+            # no-remat b32 program OOMs (512 MB stacked-scan temps,
+            # measured r5), so this row uses dots_saveable remat: matmul
+            # outputs stored, only elementwise recomputed - a few percent
+            # FLOP tax vs full remat's ~1/3
+            "id": "lm_flash_d512_L8_seq2048_bf16_hd128_dots_b32",
+            "kind": "lm",
+            "est_s": 600,
+            "args": {"attn": "flash", "dtype": "bfloat16", "steps": 20,
+                     "n_heads": 4, "batch": 32, "remat": True,
+                     "remat_policy": "dots_saveable"},
+        },
+        {
             # remat: the XLA path materializes (B, H, S, S) scores, which
             # OOMs a 16 GB v5e at these shapes without recompute (measured
             # r3); flash needs no remat - that contrast is the point
@@ -207,6 +223,12 @@ def _rows(epochs: int) -> list[dict]:
             "id": "lm_flash_d1024_L16_seq2048_bf16",
             "kind": "lm",
             "est_s": 900,
+            # deterministic failure on this backend (r5: axon
+            # remote-compile AllocateBuffer OOM on the b16 no-remat
+            # program); kept in the matrix as an honest error row, not
+            # re-attempted by full runs - the _b8/_remat_b8 rows are the
+            # measured fallbacks at this model size
+            "known_fail": True,
             "args": {"attn": "flash", "dtype": "bfloat16", "steps": 20,
                      "d_model": 1024, "n_layers": 16, "n_heads": 16,
                      "d_ff": 4096},
@@ -233,6 +255,59 @@ def _rows(epochs: int) -> list[dict]:
                      "d_ff": 4096, "batch": 8, "remat": True},
         },
         {
+            # d1024/b8 with dots_saveable remat: the b8 full-remat row
+            # measured 38.75% MFU while paying ~1/3 recompute (r5), and
+            # b8 no-remat OOMs (AllocateBuffer on 512 MB stacked-scan
+            # temps, r5) - storing just the matmul outputs fits the chip
+            # AND drops the recompute tax to elementwise-only, the
+            # cheapest shot at >=40% on the d1024 family
+            "id": "lm_flash_d1024_L16_seq2048_bf16_dots_b8",
+            "kind": "lm",
+            "est_s": 900,
+            "args": {"attn": "flash", "dtype": "bfloat16", "steps": 20,
+                     "d_model": 1024, "n_layers": 16, "n_heads": 16,
+                     "d_ff": 4096, "batch": 8, "remat": True,
+                     "remat_policy": "dots_saveable"},
+        },
+        {
+            # d1024 at the Dh=128 head geometry (H=8): model FLOPs are
+            # H-independent, but the hd128 kernel tunes 6.07 vs 9.49
+            # ms/layer at matching d512 shapes (r5) - the MXU's 128-wide
+            # contraction filled. Same dots remat as the 40.31% b8 row;
+            # any delta is pure kernel geometry
+            "id": "lm_flash_d1024_L16_seq2048_bf16_hd128_dots_b8",
+            "kind": "lm",
+            "est_s": 900,
+            "args": {"attn": "flash", "dtype": "bfloat16", "steps": 20,
+                     "d_model": 1024, "n_layers": 16, "n_heads": 8,
+                     "d_ff": 4096, "batch": 8, "remat": True,
+                     "remat_policy": "dots_saveable"},
+        },
+        {
+            # the 53.73% hd128/b8 row at double batch: more M-dim
+            # amortization if the dots storage still fits at b16
+            "id": "lm_flash_d1024_L16_seq2048_bf16_hd128_dots_b16",
+            "kind": "lm",
+            "est_s": 900,
+            "args": {"attn": "flash", "dtype": "bfloat16", "steps": 20,
+                     "d_model": 1024, "n_layers": 16, "n_heads": 8,
+                     "d_ff": 4096, "batch": 16, "remat": True,
+                     "remat_policy": "dots_saveable"},
+        },
+        {
+            # d1024/b16 with dots_saveable: b8 landed 40.31% MFU (r5);
+            # doubling the batch doubles every matmul's M dim - the
+            # no-remat b16 program OOMs but dots storage halves the live
+            # set, so this is the amortization headroom check
+            "id": "lm_flash_d1024_L16_seq2048_bf16_dots_b16",
+            "kind": "lm",
+            "est_s": 900,
+            "args": {"attn": "flash", "dtype": "bfloat16", "steps": 20,
+                     "d_model": 1024, "n_layers": 16, "n_heads": 16,
+                     "d_ff": 4096, "batch": 16, "remat": True,
+                     "remat_policy": "dots_saveable"},
+        },
+        {
             # long-context row: seq 8192 is where flash earns its keep
             # (round-1 XLA+remat measured 45.4k tok/s here, pre-fence-fix)
             "id": "lm_flash_d512_L8_seq8192_bf16",
@@ -240,6 +315,16 @@ def _rows(epochs: int) -> list[dict]:
             "est_s": 900,
             "args": {"attn": "flash", "dtype": "bfloat16", "steps": 10,
                      "batch": 4, "seq_len": 8192},
+        },
+        {
+            # long-context at the Dh=128 geometry: attention is the
+            # dominant FLOP fraction at seq 8192, so the hd128 kernel win
+            # (6.07 vs 9.49 ms/layer at s2048, r5) matters most here
+            "id": "lm_flash_d512_L8_seq8192_bf16_hd128",
+            "kind": "lm",
+            "est_s": 900,
+            "args": {"attn": "flash", "dtype": "bfloat16", "steps": 10,
+                     "batch": 4, "seq_len": 8192, "n_heads": 4},
         },
         {
             # KV-cache decode throughput (steady-state two-length diff;
@@ -462,10 +547,21 @@ def _run_worker_multi(job_path: str) -> int:
         os.environ.update(overlay)
         try:
             rec = {"id": spec["id"], "result": _run_worker(spec)}
-        except Exception:  # noqa: BLE001 - per-row isolation
+        except Exception as e:  # noqa: BLE001 - per-row isolation
             import traceback
 
-            rec = {"id": spec["id"], "error": traceback.format_exc()[-2000:]}
+            # summary FIRST (report cells render the head; a tail-only
+            # slice's first 60 chars were mid-OOM-dump column numbers -
+            # r5 review), traceback tail after: one field, so everything
+            # downstream (retry classification, _keep_prior, the matrix
+            # record) sees the full text including the cause chain.
+            rec = {
+                "id": spec["id"],
+                "error": (
+                    " ".join(f"{type(e).__name__}: {e}".split())[:300]
+                    + "\n" + traceback.format_exc()[-2000:]
+                ),
+            }
         finally:
             for k, v in saved.items():
                 if v is None:
@@ -488,6 +584,37 @@ def _measured_row(r: dict | None) -> bool:
     shared by the merge (stubs never replace measured rows) and the
     keep-previously-measured filter, which must agree."""
     return r is not None and "error" not in r and "skipped" not in r
+
+
+# markers of a failure that is a property of the PROGRAM, not the session:
+# a compile-time OOM reproduces on every healthy chip. Checked BEFORE the
+# transient markers because XLA spells compile OOMs RESOURCE_EXHAUSTED -
+# the same status a busy chip uses (r5 review).
+_DETERMINISTIC_FAIL = ("AllocateBuffer", "Ran out of memory",
+                       "ran out of memory", "Out of memory")
+
+
+def _keep_prior(spec: dict, prev: dict | None) -> bool:
+    """Full-matrix runs skip rows whose prior record already answers them:
+    measured rows always; known_fail rows with a recorded DETERMINISTIC
+    error too (re-attempting a compile failure - d1024/b16 no-remat
+    AllocateBuffer, r5 - burns minutes of the shared claim every run for
+    an outcome already on record). A transient record (busy backend,
+    dead-relay stub, cap-kill stub, skipped-after-kill) must NOT pin a
+    known_fail row: it would overwrite the informative failure forever
+    (r5 review). An error matching neither list pins - for a row marked
+    known_fail, an unrecognized failure is still a failure on record.
+    --only/--refresh still force the run."""
+    if _measured_row(prev):
+        return True
+    if not (spec.get("known_fail") and prev is not None and "error" in prev):
+        return False
+    err = str(prev["error"])
+    if any(m in err for m in _DETERMINISTIC_FAIL):
+        return True
+    transient = (_retryable(err) or "backend unavailable" in err
+                 or err.startswith("skipped:") or "killed at its" in err)
+    return not transient
 
 
 def _write_matrix(state: dict) -> None:
@@ -952,9 +1079,10 @@ def main() -> int:
         pass
     if not args.refresh and not args.only:
         # an explicit --only request always re-measures its rows; the
-        # keep filter applies only to full-matrix runs
+        # keep filter applies only to full-matrix runs (_keep_prior:
+        # measured rows, plus known_fail rows with a recorded error)
         kept = [r for r in rows if not r.get("headline")
-                and _measured_row(prior_rows.get(r["id"]))]
+                and _keep_prior(r, prior_rows.get(r["id"]))]
         if kept:
             _log("[bench] keeping previously measured rows (use --refresh "
                  "to re-measure): " + ", ".join(

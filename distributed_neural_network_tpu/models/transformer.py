@@ -69,6 +69,15 @@ class TransformerConfig:
     # ~1/3 more FLOPs for O(layers * seq^2) less activation memory - the
     # standard long-context/deep-stack memory lever on TPU
     remat: bool = False
+    # jax.checkpoint policy NAME (jax.checkpoint_policies.*) applied with
+    # remat=True; "" = save nothing (full recompute). "dots_saveable"
+    # stores every matmul output and recomputes only the elementwise ops
+    # (LN/gelu/residual) in backward - a few percent FLOP tax instead of
+    # full remat's ~1/3, while still dropping the non-dot intermediates
+    # that OOM the 16 GB chip at d1024/b8 no-remat (measured r5:
+    # AllocateBuffer on 512 MB stacked-scan temps). The canonical TPU
+    # memory/FLOP trade between "none" and "full".
+    remat_policy: str = ""
     # rematerialize ONLY the attention inner call (scores/softmax/values):
     # the (B, H, S, S) score tensor - the piece that actually OOMs at long
     # seq - is recomputed in backward while every matmul residual
@@ -353,7 +362,9 @@ def apply_hidden(
         )
 
     if cfg.remat:
-        block = jax.checkpoint(block)
+        policy = (getattr(jax.checkpoint_policies, cfg.remat_policy)
+                  if cfg.remat_policy else None)
+        block = jax.checkpoint(block, policy=policy)
     x, aux = jax.lax.scan(block, x, params["layers"])
     x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"]).astype(dt)
     return x, aux.mean()

@@ -190,7 +190,9 @@ def pipeline_lm_loss(
             return x, aux
 
         if cfg.remat:
-            block = jax.checkpoint(block)
+            policy = (getattr(jax.checkpoint_policies, cfg.remat_policy)
+                      if cfg.remat_policy else None)
+            block = jax.checkpoint(block, policy=policy)
         x, auxes = jax.lax.scan(block, x, layers)
         return x, jnp.sum(auxes)
 

@@ -643,6 +643,7 @@ def measure_lm_training(
     dtype: str = "bfloat16",
     remat: bool = False,
     remat_attn: bool = False,
+    remat_policy: str = "",
     loss_chunks: int = 0,
     lr: float = 0.01,
 ) -> dict:
@@ -666,6 +667,7 @@ def measure_lm_training(
         dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32,
         remat=remat,
         remat_attn=remat_attn,
+        remat_policy=remat_policy,
     )
     mesh = lmtrain.create_lm_mesh(1, 1, 1)
     params0 = tfm.init_params(jax.random.key(0), cfg)
@@ -701,6 +703,7 @@ def measure_lm_training(
         "d_ff": d_ff, "seq_len": seq_len,
         "vocab": vocab, "batch": batch, "steps": steps, "dtype": dtype,
         "attn": attn, "remat": remat, "remat_attn": remat_attn,
+        "remat_policy": remat_policy,
         # provenance: WHICH flash kernel measured this row (r3's numbers
         # were the library kernel; r4+ defaults to the own kernels)
         "attn_kernel": (

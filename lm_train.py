@@ -83,6 +83,11 @@ def main() -> int:
     p.add_argument("--remat", action="store_true",
                    help="rematerialize blocks in backward (jax.checkpoint): "
                    "~1/3 more FLOPs for far less activation memory")
+    p.add_argument("--remat-policy", default="",
+                   help="jax.checkpoint_policies name applied with --remat "
+                   "(e.g. dots_saveable: store matmul outputs, recompute "
+                   "only elementwise - a few percent FLOP tax instead of "
+                   "full remat's ~1/3); '' = save nothing")
     p.add_argument("--remat-attn", action="store_true",
                    help="rematerialize ONLY the attention scores/softmax in "
                    "backward: avoids storing the (B,H,S,S) tensor for a few "
@@ -157,6 +162,10 @@ def main() -> int:
         p.error("--checkpoint-every must be >= 1")
     if args.resume and not args.checkpoint_dir:
         p.error("--resume requires --checkpoint-dir")
+    if args.remat_policy and not args.remat:
+        p.error("--remat-policy only applies with --remat (the policy "
+                "picks WHAT checkpointed blocks save); the name is "
+                "validated against jax.checkpoint_policies after startup")
     if args.eval_every and not args.data_path:
         p.error("--eval-every requires --data-path (the held-out split "
                 "is the token stream's tail)")
@@ -209,6 +218,14 @@ def main() -> int:
     import jax
     import jax.numpy as jnp
 
+    if args.remat_policy and not hasattr(
+        jax.checkpoint_policies, args.remat_policy
+    ):
+        raise SystemExit(
+            f"--remat-policy {args.remat_policy!r} is not a "
+            "jax.checkpoint_policies name"
+        )
+
     from distributed_neural_network_tpu.models import transformer as tfm
     from distributed_neural_network_tpu.parallel import pipeline as ppl
     from distributed_neural_network_tpu.parallel.distributed import initialize
@@ -224,6 +241,7 @@ def main() -> int:
         dtype=jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32,
         remat=args.remat,
         remat_attn=args.remat_attn,
+        remat_policy=args.remat_policy,
         n_experts=args.experts,
     )
     if args.n_heads % max(args.tp, 1):

@@ -370,7 +370,35 @@ def _mfu_ceiling_section() -> list[str]:
     target_attn_ms = (step_flops / (0.40 * peak) - non_attn / peak) / L * 1e3
     achieved = flag.get("mfu_pct")
     ach = (f"measured {achieved}% on that row, " if achieved else "")
-    if attn_ms <= target_attn_ms:
+    # the config-level route past the d512 ceiling: best measured MFU
+    # over ALL LM rows (r5: d1024/hd128/dots_saveable landed 53.73%)
+    best = max((r for r in rows
+                if r.get("id", "").startswith("lm_")
+                and isinstance(r.get("mfu_pct"), (int, float))),
+               key=lambda r: r["mfu_pct"], default=None)
+    if best is not None and best["mfu_pct"] >= 40.0 \
+            and best["id"] != flag["id"]:
+        # the kernel-budget clause must track the actual comparison -
+        # this branch is selected on best-row MFU alone (r5 review)
+        kernel_clause = (
+            "the tuned kernel is UNDER it, and the remaining gap on "
+            "this row is matmul-side efficiency (d512 matmuls are "
+            "narrow for the MXU)"
+            if attn_ms <= target_attn_ms else
+            f"the tuned kernel ({attn_ms:.1f} ms/layer) is still OVER it"
+        )
+        tail = (
+            f"The 40% target at this shape implies an attention budget "
+            f"of <= {target_attn_ms:.1f} ms/layer; {kernel_clause}. The "
+            "config-level route closes it: the target is MET at "
+            f"**{best['mfu_pct']}% measured MFU** on `{best['id']}` "
+            f"(d{best.get('d_model')}, Dh="
+            f"{best.get('d_model', 0) // max(best.get('n_heads', 1), 1)} "
+            "head geometry"
+            + (", dots_saveable remat" if best.get("remat_policy") else "")
+            + " - the LM table row)."
+        )
+    elif attn_ms <= target_attn_ms:
         # the (re-)tuned kernel fits the 40% attention budget: the
         # ceiling no longer binds at the target - what remains is
         # matmul-side efficiency plus re-measuring the row with these
@@ -507,6 +535,9 @@ def _unmeasured_cell(r: dict) -> str:
     carries the recorded error - no claim about queue state (whether a
     re-measure is scheduled lives in ROADMAP.md, not in the row)."""
     why = str(r.get("error", r.get("skipped", "no measurement")))
+    # collapse whitespace (multi-line tracebacks break the markdown
+    # table at the first newline - r5 review) before truncating
+    why = " ".join(why.split())
     return f"no measured value (error: {why[:60].rstrip('; (')})"
 
 
@@ -624,7 +655,12 @@ def _bench_matrix_sections() -> list[str]:
                 hd = f"/hd{r['d_model'] // r['n_heads']}"
             cfgs = (f"d{r['d_model']}/L{r['n_layers']}{hd}"
                     f"/voc{r['vocab']//1000}k/{r['dtype']}")
-            remat = ("block" if r.get("remat")
+            # a remat policy qualifies block remat (dots_saveable stores
+            # matmul outputs; recompute is elementwise-only, so its FLOP
+            # tax is a few percent, not full remat's ~1/3)
+            remat = ("block/" + r["remat_policy"].replace("_saveable", "")
+                     if r.get("remat") and r.get("remat_policy")
+                     else "block" if r.get("remat")
                      else "attn" if r.get("remat_attn") else "none")
             out.append(fmt_row([
                 cfgs, r.get("attn_kernel", r["attn"]), remat,

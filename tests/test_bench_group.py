@@ -324,3 +324,58 @@ def test_peer_bench_tiebreak_only_older_session_gates(tmp_path):
         for p in (parent, worker):
             p.kill()
             p.wait()
+
+
+def test_keep_prior_measured_and_known_fail_rows():
+    """Full-matrix runs keep measured rows AND known_fail rows whose
+    deterministic failure is already on record (r5: d1024/b16 no-remat
+    AllocateBuffer re-attempted every run); unrecorded rows always run."""
+    plain = {"id": "a"}
+    kf = {"id": "b", "known_fail": True}
+    assert bench._keep_prior(plain, {"id": "a", "train_s": 1.0})
+    assert not bench._keep_prior(plain, {"id": "a", "error": "boom"})
+    assert not bench._keep_prior(plain, None)
+    assert bench._keep_prior(kf, {"id": "b", "error": "AllocateBuffer"})
+    assert not bench._keep_prior(kf, None)
+    # a known_fail row that somehow measured is kept as measured
+    assert bench._keep_prior(kf, {"id": "b", "tokens_per_s": 5})
+    # ... but a TRANSIENT record (busy backend, dead-relay stub, the
+    # cap-kill stub, skipped after a kill) must not pin the row: the
+    # deterministic-failure provenance would be lost forever (r5 review)
+    for transient in (
+        "backend unavailable: device claim wedged (probe timed out)",
+        "skipped: an earlier row was killed at its cap",
+        "UNAVAILABLE: connection refused",
+        "row killed at its 1500s in-group cap",
+        # retryable marker only in the cause chain's traceback tail -
+        # the error field carries summary + tail together
+        "RuntimeError: init failed\n...XlaRuntimeError: UNAVAILABLE: busy",
+    ):
+        assert not bench._keep_prior(kf, {"id": "b", "error": transient})
+    # a compile OOM is deterministic even though XLA spells it
+    # RESOURCE_EXHAUSTED (the busy-chip status): the OOM marker wins
+    assert bench._keep_prior(
+        kf, {"id": "b", "error": "XlaRuntimeError: RESOURCE_EXHAUSTED: "
+             "XLA:TPU compile permanent error. Ran out of memory"})
+
+
+def test_worker_error_record_leads_with_the_exception(tmp_path, monkeypatch):
+    """The recorded `error` field leads with a one-line exception summary
+    (report cells embed the head; a tail-only traceback slice's first 60
+    chars were mid-dump column numbers - r5 review) and carries the
+    traceback tail after it, in the SAME field, so retry classification
+    and _keep_prior see cause-chain markers too."""
+    job = {"specs": [{"id": "x", "kind": "nope", "args": {}}],
+           "out": str(tmp_path / "out.jsonl")}
+    jp = tmp_path / "job.json"
+    jp.write_text(json.dumps(job))
+
+    def boom(spec):
+        raise RuntimeError("first line\nsecond line")
+
+    monkeypatch.setattr(bench, "_run_worker", boom)
+    assert bench._run_worker_multi(str(jp)) == 0
+    rec = json.loads((tmp_path / "out.jsonl").read_text())
+    head, _, rest = rec["error"].partition("\n")
+    assert head == "RuntimeError: first line second line"
+    assert "Traceback" in rest

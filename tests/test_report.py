@@ -209,3 +209,19 @@ def test_recovered_tune_note_and_mfu_branches(repo):
     ceiling = "\n".join(report._mfu_ceiling_section())
     assert "x faster than measured" in ceiling
     assert "UNDER" not in ceiling
+
+
+def test_multiline_error_cell_stays_on_one_table_line(repo):
+    """A recorded error containing newlines (pre-r5 records carry raw
+    traceback slices) must not break the markdown table: the cell
+    collapses all whitespace before truncating."""
+    _write_matrix(repo, [
+        FLAGSHIP,
+        {"id": "lm_flash_d1024_L16_seq2048_bf16",
+         "error": "ll(),\n  custom_call_target=\"AllocateBuffer\"\nmore"},
+    ])
+    text = report._bench_matrix_sections()
+    cell_lines = [ln for ln in "\n".join(text).splitlines()
+                  if "no measured value" in ln]
+    assert len(cell_lines) == 1
+    assert "ll(), custom_call_target=" in cell_lines[0]
