@@ -77,6 +77,11 @@ def model_flops_per_token(cfg, seq_len: int) -> float:
     return 3.0 * (L * per_layer + 2 * d * v)
 
 
+# set after the first real span execution in this process: the backend
+# init it absorbs is session-level, not per-program (r5 measurement)
+_session_warm = False
+
+
 def measure_dp_training(
     *,
     nb_proc: int | None = None,
@@ -122,8 +127,21 @@ def measure_dp_training(
     if input_mode == "stream":
         fused = False  # streaming supports the per-epoch path only
     if fused:
-        # one dispatch for the whole run; AOT compile, then measure
+        # one dispatch for the whole run; AOT compile, then measure.
+        # The 1-epoch warm-up span absorbs SESSION-level first-execution
+        # cost (measured r5: ~22 s of backend/runtime init landed inside
+        # whichever row ran first in a claim session - the headline bs16
+        # row read 18.7 s first-in-session vs 3.2 s after any prior real
+        # execution; AOT compile alone does not trigger the init, a real
+        # execution does). Once per process: the init is session-level,
+        # so later rows in the same worker skip the throwaway epoch.
         engine.compile_span(epochs, eval_inside=False)
+        global _session_warm
+        if not _session_warm:
+            engine.compile_span(1, eval_inside=False)
+            engine.run_span(0, 1, eval_inside=False, timers=T.PhaseTimers())
+            engine.reset_state()
+            _session_warm = True
         engine.run_span(0, epochs, eval_inside=False, timers=timers)
         vl, va = engine._eval_fn(
             engine.params, engine.test_images, engine.test_labels,

@@ -595,8 +595,15 @@ def _bench_matrix_sections() -> list[str]:
             head = heads.get(ep)
             if "train_s" in r:
                 stream_measured |= kind == "stream"
-                vs = (f"{head['train_s'] / r['train_s']:.2f}x"
-                      if head and r["train_s"] > 0 else "-")
+                # sub-0.01 ratios (e.g. headline 4 s vs stream 964 s)
+                # rounded to "0.00x" - print the inverse as "Nx slower"
+                # so the comparison stays recoverable (r5 review)
+                if head and r["train_s"] > 0:
+                    ratio = head["train_s"] / r["train_s"]
+                    vs = (f"{ratio:.2f}x" if ratio >= 0.01
+                          else f"{1 / ratio:.0f}x slower")
+                else:
+                    vs = "-"
                 out.append(fmt_row([
                     desc[kind], ep, f"{r['val_acc']:.2f}",
                     f"{r['train_s']:.2f}", vs,
