@@ -647,8 +647,9 @@ def _bench_matrix_sections() -> list[str]:
                      "tokens/s", "MFU %"]),
             fmt_row(["---"] * 7),
         ]
-        # measured rows first; unmeasured stubs below them
-        for r in sorted(lm, key=lambda r: "tokens_per_s" not in r):
+        # measured rows first (best MFU at the top); unmeasured stubs below
+        for r in sorted(lm, key=lambda r: ("tokens_per_s" not in r,
+                                           -(r.get("mfu_pct") or 0))):
             if "tokens_per_s" not in r:
                 out.append(fmt_row([
                     r["id"], "-", "-", "-", "-", _unmeasured_cell(r), "-",
