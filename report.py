@@ -535,8 +535,10 @@ def _unmeasured_cell(r: dict) -> str:
     carries the recorded error - no claim about queue state (whether a
     re-measure is scheduled lives in ROADMAP.md, not in the row)."""
     why = str(r.get("error", r.get("skipped", "no measurement")))
+    # strip ANSI color codes (backend error strings embed them) and
     # collapse whitespace (multi-line tracebacks break the markdown
     # table at the first newline - r5 review) before truncating
+    why = re.sub(r"\x1b\[[0-9;]*m", "", why)
     why = " ".join(why.split())
     return f"no measured value (error: {why[:60].rstrip('; (')})"
 
