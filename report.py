@@ -618,10 +618,14 @@ def _bench_matrix_sections() -> list[str]:
             out += [
                 "The stream row runs the per-epoch engine path: "
                 "streaming input has no fused multi-epoch span "
-                "(`train/engine.py run` downgrades with a log line), so "
-                "its delta vs the headline includes per-epoch dispatch "
-                "the HBM-resident rows never pay - attribute only the "
-                "remainder to the input pipeline itself.",
+                "(`train/engine.py run` downgrades with a log line), and "
+                "every batch is a host->device transfer that pays the "
+                "tunnel round-trip the HBM-resident rows pay once for "
+                "the whole dataset (~78k transfers at 25 ep/bs 16 - the "
+                "dominant term on this tunneled backend; on a local TPU "
+                "host the same path is bounded by PCIe/DMA, not RTT). "
+                "Attribute only the remainder to the input pipeline "
+                "itself.",
                 "",
             ]
 
