@@ -313,3 +313,27 @@ def test_lm_train_rejects_orphan_sampling_flags(tmp_path):
     )
     assert proc.returncode != 0
     assert "--generate" in proc.stderr
+
+
+def test_lm_train_rejects_orphan_or_unknown_remat_policy(tmp_path):
+    """--remat-policy without --remat is a parse error; with --remat but
+    an unknown jax.checkpoint_policies name it fails after startup with
+    the name in the message (r5 feature)."""
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    base = [sys.executable, os.path.join(REPO, "lm_train.py"), "--steps", "1"]
+    orphan = subprocess.run(
+        base + ["--remat-policy", "dots_saveable"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=120,
+    )
+    assert orphan.returncode != 0
+    assert "--remat-policy only applies with --remat" in orphan.stderr
+    unknown = subprocess.run(
+        base + ["--remat", "--remat-policy", "not_a_policy"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=120,
+    )
+    assert unknown.returncode != 0
+    assert "not_a_policy" in unknown.stderr

@@ -350,11 +350,14 @@ def test_deep_interleave_pp2(n_devices, v, m):
     assert np.isclose(got, want, rtol=2e-5), (got, want)
 
 
-def test_interleave_with_remat_matches(n_devices):
-    """Block remat inside the lap-indexed chunk scan: same loss."""
-    cfg = tfm.TransformerConfig(
-        vocab_size=32, d_model=32, n_heads=4, n_layers=8, d_ff=64, remat=True
-    )
+@pytest.mark.parametrize("remat_policy", ["", "dots_saveable"])
+def test_interleave_with_remat_matches(n_devices, remat_policy):
+    """Block remat inside the lap-indexed chunk scan: same loss. The
+    dots_saveable parametrization pins that remat_policy reaches the
+    pipeline path too (r5 review: it was silently dropped there)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG8, remat=True, remat_policy=remat_policy)
     mesh = pp.create_pp_mesh(1, 4, 1)
     params = tfm.init_params(jax.random.key(3), cfg)
     tokens, targets = _data(batch=8, seed=4)
@@ -648,3 +651,4 @@ def test_fit_tick_model_negative_layer_cost_hits_c_boundary():
     assert tm["per_layer_s"] == 0.0
     assert tm["per_tick_overhead_s"] > 0
     assert tm["boundary_solution"]["per_layer_s_unconstrained"] < 0
+
