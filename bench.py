@@ -336,6 +336,18 @@ def _rows(epochs: int) -> list[dict]:
             "est_s": 900,
             "args": {"batch": 16, "dtype": "bfloat16"},
         },
+        {
+            # decode at the Dh=128 geometry: the per-step QK/AV matvecs
+            # contract over Dh, and Dh=64 half-fills the MXU's 128-deep
+            # contraction - measured r5: 1.43 vs 2.60 ms/step at b16
+            # (an explicit feature-major cache relayout was a no-op:
+            # XLA:TPU assigns physical layouts itself; head geometry is
+            # what moves decode)
+            "id": "lm_decode_d512_L8_b16_bf16_hd128",
+            "kind": "lm_decode",
+            "est_s": 900,
+            "args": {"batch": 16, "dtype": "bfloat16", "n_heads": 4},
+        },
         # measured pp=4 pipeline bubble (VERDICT r2 item 4): fixed
         # microbatch size, varying (M, interleave) -> tokens/s tracks
         # 1 - bubble. Runs on a 4-device virtual CPU mesh (the one real

@@ -543,6 +543,17 @@ def _unmeasured_cell(r: dict) -> str:
     return f"no measured value (error: {why[:60].rstrip('; (')})"
 
 
+
+def _hd_suffix(r: dict) -> str:
+    """Head-geometry label, shown only for the non-default Dh (suffixing
+    every row would split the r3/r4 A/B pairs that share the hd64
+    default). Used by both the LM and decode tables - decode per-step
+    cost and LM MFU are both geometry-bound."""
+    if r.get("n_heads") and r["d_model"] // r["n_heads"] != 64:
+        return f"/hd{r['d_model'] // r['n_heads']}"
+    return ""
+
+
 def _bench_matrix_sections() -> list[str]:
     """LM-throughput/MFU + pipeline-bubble sections from BENCH_MATRIX.json.
 
@@ -661,13 +672,7 @@ def _bench_matrix_sections() -> list[str]:
                     r["id"], "-", "-", "-", "-", _unmeasured_cell(r), "-",
                 ]))
                 continue
-            # head geometry shown only for the non-default Dh (hd128 rows
-            # vs the hd64 flagship are otherwise identically labelled;
-            # suffixing every row would split the r3/r4 A/B pairs)
-            hd = ""
-            if r.get("n_heads") and r["d_model"] // r["n_heads"] != 64:
-                hd = f"/hd{r['d_model'] // r['n_heads']}"
-            cfgs = (f"d{r['d_model']}/L{r['n_layers']}{hd}"
+            cfgs = (f"d{r['d_model']}/L{r['n_layers']}{_hd_suffix(r)}"
                     f"/voc{r['vocab']//1000}k/{r['dtype']}")
             # a remat policy qualifies block remat (dots_saveable stores
             # matmul outputs; recompute is elementwise-only, so its FLOP
@@ -708,7 +713,7 @@ def _bench_matrix_sections() -> list[str]:
                     r["id"], "-", "-", _unmeasured_cell(r), "-", "-",
                 ]))
                 continue
-            cfgs = (f"d{r['d_model']}/L{r['n_layers']}"
+            cfgs = (f"d{r['d_model']}/L{r['n_layers']}{_hd_suffix(r)}"
                     f"/voc{r['vocab'] // 1000}k/{r['dtype']}")
             caches = [c for c in (r.get("at_cache_short"),
                                   r.get("at_cache_long")) if c]
