@@ -326,6 +326,25 @@ def _rows(epochs: int) -> list[dict]:
             "args": {"attn": "flash", "dtype": "bfloat16", "steps": 10,
                      "batch": 4, "seq_len": 8192, "n_heads": 4},
         },
+        # long-context scaling curve at fixed tokens/step (32k): seq
+        # 2048 -> 16384 at the hd128 geometry, batch halving as seq
+        # doubles - how MFU holds as the attention fraction grows is THE
+        # long-context claim, measured (s2048 point: _hd128_dots_b32;
+        # s8192 point: the row above at half tokens/step)
+        {
+            "id": "lm_flash_d512_L8_seq4096_bf16_hd128",
+            "kind": "lm",
+            "est_s": 900,
+            "args": {"attn": "flash", "dtype": "bfloat16", "steps": 10,
+                     "batch": 8, "seq_len": 4096, "n_heads": 4},
+        },
+        {
+            "id": "lm_flash_d512_L8_seq16384_bf16_hd128",
+            "kind": "lm",
+            "est_s": 900,
+            "args": {"attn": "flash", "dtype": "bfloat16", "steps": 10,
+                     "batch": 2, "seq_len": 16384, "n_heads": 4},
+        },
         {
             # KV-cache decode throughput (steady-state two-length diff;
             # measure_lm_decode) - the inference surface's measured row.

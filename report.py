@@ -654,7 +654,14 @@ def _bench_matrix_sections() -> list[str]:
             "backend - numbers recorded before round 3's fence fix were "
             "dispatch time and have been discarded). MFU = model "
             "FLOPs/token x tokens/s / dtype-adjusted peak "
-            "(`train/measure.py`). Kernel provenance: `pallas-flash` "
+            "(`train/measure.py`; PaLM-appendix convention - causal "
+            "attention counted at full S, not halved. The flash kernel "
+            "skips fully-masked blocks, so at attention-dominated "
+            "lengths the convention credits that skipped work: this is "
+            "why MFU RISES with seq in the long-context rows; hardware "
+            "MXU occupancy is lower there, and cross-seq comparisons "
+            "hold on the stated convention, as published MFU numbers "
+            "do). Kernel provenance: `pallas-flash` "
             "(no suffix) = the LIBRARY kernel (rows measured in r3, "
             "before the own kernels existed); `pallas-flash-own` / "
             "`pallas-flash-lib` = this framework's vma-typed 3-D-grid "
