@@ -346,6 +346,16 @@ def _rows(epochs: int) -> list[dict]:
                      "batch": 2, "seq_len": 16384, "n_heads": 4},
         },
         {
+            # 32k context on ONE 16 GB chip, no remat - the single-chip
+            # long-context ceiling row (s16384 tuned blocks apply as the
+            # largest divisor)
+            "id": "lm_flash_d512_L8_seq32768_bf16_hd128",
+            "kind": "lm",
+            "est_s": 900,
+            "args": {"attn": "flash", "dtype": "bfloat16", "steps": 10,
+                     "batch": 1, "seq_len": 32768, "n_heads": 4},
+        },
+        {
             # KV-cache decode throughput (steady-state two-length diff;
             # measure_lm_decode) - the inference surface's measured row.
             # Utilization is reported against HBM bandwidth, the binding
