@@ -34,6 +34,7 @@ Design choices, TPU-first:
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 
 import jax
@@ -41,6 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from ..ops.decode_pallas import decode_cache_attention, decode_kernel_ok
 from ..parallel.moe import expert_capacity, moe_ffn
 from ..parallel.ring import (
     attention,
@@ -497,8 +499,29 @@ def generate(
     total = s_p + max_new_tokens
     L, H, Dh = cfg.n_layers, cfg.n_heads, cfg.head_dim
     prompt_pad = jnp.pad(prompt, ((0, 0), (0, max_new_tokens)))
-    cache_k = jnp.zeros((L, b, total, H, Dh), dt)
-    cache_v = jnp.zeros((L, b, total, H, Dh), dt)
+    # caches are (L, B, H, total, Dh): collapsing (B, H) for the decode
+    # kernel is then a free reshape. DNN_TPU_DECODE_IMPL selects the
+    # per-step attention: "auto"/"xla" (the XLA chain - measured FASTER
+    # than the fused kernel at d512/cache<=640: 2.59 vs 3.69 ms/step at
+    # b16/hd64, r5; XLA lowers the whole step as one well-tiled batched
+    # einsum and a per-layer pallas_call costs more than it fuses),
+    # "pallas" (the ops/decode_pallas.py kernel - kept selectable for
+    # larger caches where dead-block skipping should eventually win),
+    # "pallas-interpret" (CPU-testable kernel path).
+    impl = os.environ.get("DNN_TPU_DECODE_IMPL", "auto")
+    if impl not in ("auto", "xla", "pallas", "pallas-interpret"):
+        raise ValueError(f"unknown decode impl {impl!r} "
+                         "(DNN_TPU_DECODE_IMPL)")
+    use_kernel = impl in ("pallas", "pallas-interpret")
+    if use_kernel and not decode_kernel_ok(total):
+        # an explicitly requested kernel must not silently measure XLA
+        raise ValueError(
+            f"decode impl {impl!r} requested but cache size {total} "
+            "admits no sublane-legal k block (decode_kernel_ok); pad "
+            "prompt+max_new_tokens to a multiple of 8 or use impl=auto"
+        )
+    cache_k = jnp.zeros((L, b, H, total, Dh), dt)
+    cache_v = jnp.zeros((L, b, H, total, Dh), dt)
     pe_all = _sinusoid_pe(jnp.arange(total), cfg.d_model, dt)
     neg = jnp.asarray(-1e30, jnp.float32)
 
@@ -507,17 +530,29 @@ def generate(
         lp, ck, cv = lcaches
         h = _layer_norm(x, lp["ln1_scale"], lp["ln1_bias"]).astype(dt)
         q = (h @ lp["wq"].astype(dt)).reshape(b, 1, H, Dh)
-        k = (h @ lp["wk"].astype(dt)).reshape(b, 1, H, Dh)
-        v = (h @ lp["wv"].astype(dt)).reshape(b, 1, H, Dh)
-        ck = jax.lax.dynamic_update_slice_in_dim(ck, k, pos, axis=1)
-        cv = jax.lax.dynamic_update_slice_in_dim(cv, v, pos, axis=1)
-        # scores over the full static cache, future slots masked out
-        scores = jnp.einsum("bqhd,bshd->bhqs", q, ck).astype(jnp.float32)
-        scores = scores / np.sqrt(Dh)
-        live = (jnp.arange(total) <= pos)[None, None, None, :]
-        probs = jax.nn.softmax(jnp.where(live, scores, neg), axis=-1)
-        o = jnp.einsum("bhqs,bshd->bqhd", probs.astype(dt), cv)
-        x = x + o.reshape(b, 1, H * Dh) @ lp["wo"].astype(dt)
+        k = (h @ lp["wk"].astype(dt)).reshape(b, H, 1, Dh)
+        v = (h @ lp["wv"].astype(dt)).reshape(b, H, 1, Dh)
+        ck = jax.lax.dynamic_update_slice_in_dim(ck, k, pos, axis=2)
+        cv = jax.lax.dynamic_update_slice_in_dim(cv, v, pos, axis=2)
+        if use_kernel:
+            # fused single-query kernel: one pallas_call instead of the
+            # einsum/softmax/einsum chain, dead cache blocks skipped
+            # (ops/decode_pallas.py)
+            o = decode_cache_attention(
+                q.reshape(b, H, Dh), ck, cv, pos,
+                interpret=impl == "pallas-interpret",
+            ).reshape(b, 1, H * Dh)
+        else:
+            # scores over the full static cache, future slots masked out
+            scores = jnp.einsum(
+                "bqhd,bhsd->bhqs", q, ck
+            ).astype(jnp.float32)
+            scores = scores / np.sqrt(Dh)
+            live = (jnp.arange(total) <= pos)[None, None, None, :]
+            probs = jax.nn.softmax(jnp.where(live, scores, neg), axis=-1)
+            o = jnp.einsum("bhqs,bhsd->bqhd", probs.astype(dt), cv)
+            o = o.reshape(b, 1, H * Dh)
+        x = x + o @ lp["wo"].astype(dt)
         h2 = _layer_norm(x, lp["ln2_scale"], lp["ln2_bias"]).astype(dt)
         if cfg.n_experts:
             # dense dispatch at decode shapes (B tokens/step): capacity =
