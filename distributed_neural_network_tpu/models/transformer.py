@@ -517,8 +517,11 @@ def generate(
         # an explicitly requested kernel must not silently measure XLA
         raise ValueError(
             f"decode impl {impl!r} requested but cache size {total} "
-            "admits no sublane-legal k block (decode_kernel_ok); pad "
-            "prompt+max_new_tokens to a multiple of 8 or use impl=auto"
+            "admits no sublane-legal k block (decode_kernel_ok: the "
+            "largest divisor of the total at or under the k block size "
+            "must be a multiple of 16) - choose prompt+max_new_tokens "
+            "with such a divisor (any multiple of 128 works) or use "
+            "impl=auto"
         )
     cache_k = jnp.zeros((L, b, H, total, Dh), dt)
     cache_v = jnp.zeros((L, b, H, total, Dh), dt)
@@ -583,7 +586,12 @@ def generate(
         )
         x = params["embed"][tok].astype(dt)[:, None, :] + pe_all[pos][None, None]
         (x, _), (ck, cv) = jax.lax.scan(
-            layer_step, (x, pos), (params["layers"], ck, cv)
+            layer_step, (x, pos), (params["layers"], ck, cv),
+            # unrolling the (short) layer scan lets XLA overlap across
+            # layers inside one decode step - measured r5: 1.19 -> 0.82
+            # ms/step at cache 256, 2.59 -> 2.41 at cache 640 (b16/hd64).
+            # Chunked so deep stacks don't blow up compile time.
+            unroll=min(L, 8),
         )
         h = _layer_norm(x, params["lnf_scale"], params["lnf_bias"]).astype(dt)
         logits = (h[:, 0] @ params["head"].astype(dt)).astype(jnp.float32)

@@ -172,8 +172,10 @@ def decode_cache_attention(q, ck, cv, pos, *, block_k: int = 512,
 
 def decode_kernel_ok(total: int, block_k: int = 512) -> bool:
     """True when the kernel's block constraints hold at this cache size:
-    the chosen k block must be sublane-tileable (the head-dim block is
-    always the full axis, which Mosaic accepts at any size). Pass the
-    same block_k the kernel will run with - the gate validates the block
+    the chosen k block must be sublane-tileable for EVERY supported
+    cache dtype - bf16's Mosaic tile is (16, 128), f32's is (8, 128),
+    so the gate requires the stricter 16 (the head-dim block is always
+    the full axis, which Mosaic accepts at any size). Pass the same
+    block_k the kernel will run with - the gate validates the block
     actually used. Tiny or awkward totals fall back to the XLA path."""
-    return _divisor_block(block_k, total) % _SUBLANES == 0
+    return _divisor_block(block_k, total) % (2 * _SUBLANES) == 0

@@ -634,6 +634,13 @@ def measure_lm_decode(
         "gen_short": gen_short, "gen_long": gen_long, "dtype": dtype,
         "device_kind": dev.device_kind,
         "platform": jax.default_backend(),
+        # provenance: which per-step attention path produced this row -
+        # merge-by-id would otherwise let a DNN_TPU_DECODE_IMPL=pallas
+        # run silently replace the XLA numbers under the same row id
+        "decode_impl": (
+            "pallas" if os.environ.get("DNN_TPU_DECODE_IMPL", "auto")
+            in ("pallas", "pallas-interpret") else "xla"
+        ),
         # headline decode rate: per-step average at the LONG cache size
         # (conservative; the short-cache row shows the scaling)
         "decode_tokens_per_s": long_["tokens_per_s"],

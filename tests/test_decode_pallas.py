@@ -64,7 +64,12 @@ def test_kernel_ok_gate():
     assert decode_kernel_ok(640)       # bk 128
     assert decode_kernel_ok(256)
     assert decode_kernel_ok(4096)
-    assert not decode_kernel_ok(17)    # prime-ish: bk 17 % 8 != 0
+    assert not decode_kernel_ok(17)    # prime-ish: bk 17
+    # bf16's Mosaic tile is (16, 128): a bk that is a multiple of 8 but
+    # not 16 must be rejected (r5 review - e.g. total 1032 -> bk 344)
+    assert not decode_kernel_ok(1032)
+    # a multiple-of-8 total whose best divisor is not sublane-legal
+    assert not decode_kernel_ok(1736)  # bk 434
 
 
 def test_generate_kernel_path_matches_xla(monkeypatch):
