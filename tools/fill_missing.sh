@@ -1,4 +1,11 @@
 #!/bin/bash
+# STATUS (r5, 2026-08-01): the fill pass COMPLETED on the first
+# healthy chip - all four stages landed and their artifacts are
+# committed (flagship row, parity check ok, RTT-corrected tunes,
+# every error row). Re-running this script is safe but re-measures
+# its --only row lists; for routine round-end measurement use
+# `python bench.py` (keep-measured mode) instead.
+#
 # Fill measurement session: on the first healthy chip, run the on-TPU
 # kernel-numerics parity check, re-measure the flagship LM row with the
 # already-tuned flash blocks (the r4 11.81 ms/layer config - the >=40%
