@@ -153,3 +153,18 @@ def param_count(params) -> int:
     import jax
 
     return sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
+
+
+def flops_per_image(num_classes: int = 10) -> float:
+    """Analytic forward-pass FLOPs for one 32x32x3 image (2*MACs of the
+    convs + dense head; bias/relu/pool are noise at this scale).
+
+    The MFU fallback when the backend's `cost_analysis()` reports no FLOPs
+    (utils/tracing.py compiled_flops): training FLOPs ~ 3x this (fwd +
+    2x bwd, the PaLM-appendix convention used by
+    train/measure.py model_flops_per_token).
+    """
+    conv1 = 2 * 28 * 28 * 6 * (5 * 5 * 3)
+    conv2 = 2 * 10 * 10 * 16 * (5 * 5 * 6)
+    dense = 2 * (400 * 120 + 120 * 84 + 84 * num_classes)
+    return float(conv1 + conv2 + dense)

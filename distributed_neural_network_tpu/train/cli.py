@@ -18,6 +18,7 @@ import time
 
 from ..data.cifar10 import load_split
 from ..utils import timers as T
+from ..utils import tracing as TR
 from ..utils.logfiles import write_phase_logs
 from ..utils.metrics import init_run
 from .engine import Engine, TrainConfig
@@ -112,6 +113,22 @@ def add_common_flags(p: argparse.ArgumentParser, *, epochs: int, batch_size: int
         help="capture a jax.profiler trace of the training run into this dir "
         "(SURVEY.md sec. 5.1 - the reference had only wall-clock brackets)",
     )
+    # step-level telemetry (utils/tracing.py, docs/OBSERVABILITY.md)
+    p.add_argument(
+        "--trace-out",
+        default=None,
+        metavar="TRACE.json",
+        help="write a Chrome trace-event JSON of the run (span per "
+        "train_step/sync/eval, one track per phase) - open in Perfetto or "
+        "chrome://tracing, summarize with tools/trace_summary.py",
+    )
+    p.add_argument(
+        "--step-stats",
+        action="store_true",
+        help="collect per-step StepStats (compile vs steady-state step "
+        "time, images/s, device memory, collective bytes, MFU), print the "
+        "summary, and emit step/* series to --metrics-jsonl",
+    )
     return p
 
 
@@ -199,8 +216,12 @@ def run_training(args, regime: str, *, log=print) -> Engine:
     cfg = config_from_args(args, regime)
     timers = T.PhaseTimers()
 
+    trace_out = getattr(args, "trace_out", None)
+    want_stats = getattr(args, "step_stats", False)
+    tracer = TR.Tracer(enabled=bool(trace_out))
+
     syn = getattr(args, "synthetic_size", None)
-    with timers.phase(T.DATA_LOADING):
+    with tracer.span(TR.DATA_LOADING, track="host"), timers.phase(T.DATA_LOADING):
         train_split = load_split(
             True,
             root=args.data_root,
@@ -237,7 +258,31 @@ def run_training(args, regime: str, *, log=print) -> Engine:
     }
 
     t0 = time.perf_counter()
-    engine = Engine(cfg, train_split, test_split)
+    engine = Engine(cfg, train_split, test_split, tracer=tracer)
+
+    stats = None
+    if want_stats or trace_out:
+        import jax
+
+        from .measure import peak_flops
+
+        flops, flops_src = engine.flops_per_epoch()
+        stats = TR.StepStats(
+            item_label="images",
+            # step/* series ride the existing metrics sinks; without
+            # --step-stats the trace still embeds the aggregate summary
+            sink=run if want_stats else None,
+            n_devices=engine.n_workers,
+            comm_bytes_per_step=TR.collective_bytes_per_sync(
+                engine.params, engine.n_workers
+            ),
+            flops_per_step=flops,
+            flops_source=flops_src,
+            peak_flops_per_device=peak_flops(
+                jax.devices()[0].device_kind, cfg.compute_dtype
+            ),
+        )
+        engine.step_stats = stats
 
     checkpointer = None
     start_epoch = 0
@@ -297,15 +342,22 @@ def run_training(args, regime: str, *, log=print) -> Engine:
         if checkpointer is not None:
             checkpointer.close()
     wall = time.perf_counter() - t0
+
+    if stats is not None and want_stats:
+        for line in stats.report().splitlines():
+            log(line)
+    if trace_out:
+        tracer.export(trace_out, step_stats=stats)
+        log(
+            f"(Chrome trace written to {trace_out}; open in Perfetto / "
+            "chrome://tracing, or summarize with tools/trace_summary.py)"
+        )
     run.stop()
 
-    log(f"Train data loading time: {timers.get(T.DATA_LOADING)}")
-    log(f"Time spent on training: {timers.get(T.TRAINING)}")
-    log(f"Time spent on evaluation: {timers.get(T.EVALUATION)}")
-    log(
-        "Time spent on parent communication and param sync: "
-        f"{timers.get(T.COMMUNICATION)}"
-    )
+    # the canonical phase-summary block (utils/timers.py report(); the
+    # reference's stdout phrasing, shared with every other entry point)
+    for line in timers.report().splitlines():
+        log(line)
     log(f"Total wall-clock: {wall:.3f} s")
 
     if args.log_dir:
@@ -335,3 +387,42 @@ def run_training(args, regime: str, *, log=print) -> Engine:
     }
     log("SUMMARY " + json.dumps(summary))
     return engine
+
+
+def main(argv=None) -> int:
+    """`python -m distributed_neural_network_tpu.train.cli` - the smoke /
+    telemetry harness behind the three top-level scripts.
+
+    Same flag surface plus `--regime`; defaults are deliberately tiny
+    (synthetic data, 2048 rows, all available devices) so a bare
+    `python -m ... --epochs 1 --trace-out trace.json --step-stats` runs in
+    seconds on a CPU host. Full-scale runs use the top-level entry points
+    (single_proc_train.py / model_replication_train.py /
+    data_parallelism_train.py), whose defaults mirror the reference.
+    """
+    import argparse as _argparse
+
+    parser = _argparse.ArgumentParser(
+        prog="python -m distributed_neural_network_tpu.train.cli",
+        description=main.__doc__,
+        formatter_class=_argparse.RawDescriptionHelpFormatter,
+    )
+    add_common_flags(parser, epochs=2, batch_size=16)
+    add_distributed_flags(parser, nb_proc=None)
+    parser.add_argument(
+        "--regime",
+        choices=("single", "data_parallel", "replication"),
+        default="data_parallel",
+    )
+    # tiny-by-default: the module runner is for smoke runs and telemetry
+    # capture, not baseline numbers (--data/--synthetic-size override)
+    parser.set_defaults(data="synthetic", synthetic_size=2048)
+    args = parser.parse_args(argv)
+    run_training(args, args.regime)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -40,6 +40,7 @@ preserved as an optional host-side stall for wall-clock parity experiments.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 
 import jax
@@ -61,6 +62,7 @@ from ..parallel.fault import epoch_key, live_mask, straggler_sleep
 from ..parallel.mesh import DATA_AXIS, create_mesh
 from ..parallel.partition import shard_size
 from ..utils import timers as T
+from ..utils import tracing as TR
 
 REGIMES = ("single", "data_parallel", "replication")
 SYNC_MODES = ("epoch", "step")
@@ -125,7 +127,15 @@ class Engine:
         train_split: Split,
         test_split: Split | None,
         mesh: Mesh | None = None,
+        tracer: TR.Tracer | None = None,
+        step_stats: TR.StepStats | None = None,
     ):
+        # step-level telemetry (utils/tracing.py): NULL_TRACER costs one
+        # attribute check per span when disabled; step_stats is opt-in.
+        # Both are plain attributes - callers may also assign them after
+        # construction (the CLI builds StepStats from the live engine).
+        self.tracer = tracer if tracer is not None else TR.NULL_TRACER
+        self.step_stats = step_stats
         self.config = c = config
         if c.regime == "single":
             n_workers = 1
@@ -261,6 +271,42 @@ class Engine:
         shardings; inverse of checkpointing `state_tree()`."""
         self.params = jax.device_put(tree["params"], self._repl)
         self.mom = jax.device_put(tree["mom"], self._shard)
+
+    # ----------------------------------------------------------- telemetry
+
+    @property
+    def images_per_epoch(self) -> int:
+        """Images processed per epoch across the mesh (each device trains
+        its local rows once; replication regime counts every replica's
+        pass - it is work performed, not unique images)."""
+        return self.local_train_rows * self.n_workers
+
+    def flops_per_epoch(self) -> tuple[float | None, str | None]:
+        """(FLOPs of one train-epoch dispatch, source) for MFU accounting.
+
+        Preferred source is the compiled executable's own
+        `cost_analysis()` (utils/tracing.py compiled_flops); backends that
+        don't report FLOPs fall back to the analytic LeNet estimate
+        (models/cnn.py flops_per_image, fwd+2x-bwd), which also covers
+        stream mode (whose per-batch dispatch is not worth lowering here).
+        """
+        if self.config.input_mode != "stream" and self.train_images is not None:
+            flops = TR.compiled_flops(
+                self._train_fn,
+                self.params,
+                self.mom,
+                self.train_images,
+                self.train_labels,
+                jnp.uint32(0),
+            )
+            if flops is not None:
+                return flops, "cost_analysis"
+        try:
+            from ..models.cnn import flops_per_image
+
+            return 3.0 * flops_per_image() * self.images_per_epoch, "analytic"
+        except Exception:
+            return None, None
 
     # --------------------------------------------------------------- steps
 
@@ -583,10 +629,27 @@ class Engine:
             span, eval_inside
         )
         masks_dev = jax.device_put(masks, self._masks_sharding())
-        with timers.phase(T.TRAINING) as t:
-            out = fn(*self._span_args(epoch0, masks_dev, eval_inside))
-            self.params, self.mom = out[0], out[1]
-            t.value = out
+        t_step = time.perf_counter()
+        with self.tracer.span(
+            TR.TRAIN_SPAN, track="train", epoch0=epoch0, span=span,
+            eval_inside=eval_inside,
+        ):
+            with timers.phase(T.TRAINING) as t:
+                out = fn(*self._span_args(epoch0, masks_dev, eval_inside))
+                self.params, self.mom = out[0], out[1]
+                t.value = out
+        if self.step_stats is not None:
+            # one fused dispatch covers `span` epochs: a single record with
+            # the whole span's items; compile separation still applies (the
+            # first non-AOT-compiled dispatch pays tracing+compile)
+            self.step_stats.record(
+                epoch0,
+                time.perf_counter() - t_step,
+                items=span * self.images_per_epoch,
+                is_compile=(span, eval_inside) not in self._span_compiled
+                and not self.step_stats.records,
+            )
+            self.step_stats.capture_memory(self.tracer)
         if eval_inside:
             tl, vl, va, nl = (np.asarray(x) for x in out[2:])
         else:
@@ -653,15 +716,25 @@ class Engine:
             else assemble()
         )
         steps = 0
+        tracer = self.tracer
         for x, y, w in batches_it:
-            params_stacked, self.mom, loss_sums = self._stream_fn(
-                params_stacked,
-                self.mom,
-                loss_sums,
-                distribute_host_data(x, self.mesh, P(DATA_AXIS)),
-                distribute_host_data(y, self.mesh, P(DATA_AXIS)),
-                distribute_host_data(w, self.mesh, P(DATA_AXIS)),
-            )
+            # per-batch spans are NOT fenced (a fence per step would
+            # serialize the prefetch pipeline this mode exists for), so
+            # they measure host assembly + dispatch; fenced=false in the
+            # args marks that for trace readers. The fenced epoch-level
+            # train_step span in run_epoch stays the honest device time.
+            with tracer.span(
+                TR.TRAIN_STEP, track="train", step=steps, epoch=epoch,
+                input_mode="stream", fenced=False, rows=int(x.shape[0]),
+            ):
+                params_stacked, self.mom, loss_sums = self._stream_fn(
+                    params_stacked,
+                    self.mom,
+                    loss_sums,
+                    distribute_host_data(x, self.mesh, P(DATA_AXIS)),
+                    distribute_host_data(y, self.mesh, P(DATA_AXIS)),
+                    distribute_host_data(w, self.mesh, P(DATA_AXIS)),
+                )
             steps += 1
         n_batches = distribute_host_data(
             np.full(n, float(steps), np.float32), self.mesh, P(DATA_AXIS)
@@ -673,6 +746,7 @@ class Engine:
     ) -> EpochMetrics:
         c = self.config
         timers = timers if timers is not None else T.PhaseTimers()
+        tracer = self.tracer
 
         # fault injection at epoch top (parity: simulate_failure call sites
         # data_parallelism_train.py:117,141)
@@ -680,35 +754,57 @@ class Engine:
         mask_host = np.asarray(mask)
         straggler_sleep(mask_host, c.failure_duration)
 
-        with timers.phase(T.TRAINING) as t:
-            if c.input_mode == "stream":
-                params_stacked, loss_sums, n_batches = self._stream_epoch(epoch)
-            else:
-                params_stacked, self.mom, loss_sums, n_batches = self._train_fn(
-                    self.params,
-                    self.mom,
-                    self.train_images,
-                    self.train_labels,
-                    jnp.uint32(epoch),
-                )
-            t.value = params_stacked
-
-        with timers.phase(T.COMMUNICATION) as t:
-            mask_dev = distribute_host_data(mask_host, self.mesh, P(DATA_AXIS))
-            self.params, train_loss = self._sync_fn(
-                params_stacked, mask_dev, loss_sums, n_batches
+        # the tracer span closes AFTER timers.phase's hard_block fence, so
+        # span duration is device time, not dispatch time; step stats reuse
+        # the same fenced wall. One epoch dispatch == one train step here
+        # (the whole local-SGD epoch is a single compiled program).
+        t_step = time.perf_counter()
+        with tracer.span(
+            # stream mode emits its per-batch train_step spans inside
+            # _stream_epoch; the fenced epoch wrapper gets its own name so
+            # step spans are not double-counted by trace consumers
+            "train_epoch" if c.input_mode == "stream" else TR.TRAIN_STEP,
+            track="train", step=epoch,
+            regime=c.regime, input_mode=c.input_mode,
+        ):
+            with timers.phase(T.TRAINING) as t:
+                if c.input_mode == "stream":
+                    params_stacked, loss_sums, n_batches = self._stream_epoch(epoch)
+                else:
+                    params_stacked, self.mom, loss_sums, n_batches = self._train_fn(
+                        self.params,
+                        self.mom,
+                        self.train_images,
+                        self.train_labels,
+                        jnp.uint32(epoch),
+                    )
+                t.value = params_stacked
+        if self.step_stats is not None:
+            self.step_stats.record(
+                epoch, time.perf_counter() - t_step, items=self.images_per_epoch
             )
-            t.value = (self.params, train_loss)
+
+        with tracer.span(TR.SYNC, track="sync", step=epoch):
+            with timers.phase(T.COMMUNICATION) as t:
+                mask_dev = distribute_host_data(mask_host, self.mesh, P(DATA_AXIS))
+                self.params, train_loss = self._sync_fn(
+                    params_stacked, mask_dev, loss_sums, n_batches
+                )
+                t.value = (self.params, train_loss)
 
         val_loss = val_acc = None
         if do_eval and self._eval_fn is not None:
-            with timers.phase(T.EVALUATION) as t:
-                val_loss, val_acc = self._eval_fn(
-                    self.params, self.test_images, self.test_labels, self.test_weights
-                )
-                t.value = (val_loss, val_acc)
+            with tracer.span(TR.EVAL, track="eval", step=epoch):
+                with timers.phase(T.EVALUATION) as t:
+                    val_loss, val_acc = self._eval_fn(
+                        self.params, self.test_images, self.test_labels, self.test_weights
+                    )
+                    t.value = (val_loss, val_acc)
             val_loss = float(val_loss)
             val_acc = float(val_acc)
+
+        if self.step_stats is not None:
+            self.step_stats.capture_memory(tracer)
 
         m = EpochMetrics(
             epoch=epoch,
@@ -806,7 +902,8 @@ class Engine:
                 and e % eval_every == 0
             ):
                 t = timers if timers is not None else T.PhaseTimers()
-                with t.phase(T.EVALUATION) as ph:
+                with self.tracer.span(TR.EVAL, track="eval", step=e - 1), \
+                        t.phase(T.EVALUATION) as ph:
                     vl, va = self._eval_fn(
                         self.params,
                         self.test_images,

@@ -20,6 +20,7 @@ elementwise so it is layout-oblivious.
 from __future__ import annotations
 
 import os
+import time
 
 import jax
 import jax.numpy as jnp
@@ -404,6 +405,59 @@ def make_lm_train_step(
         ),
         donate_argnums=(0, 1),
     )
+
+
+def make_traced_step(
+    step_fn,
+    *,
+    tracer,
+    step_stats=None,
+    items_per_step: float = 0.0,
+    fence: bool = True,
+    first_step: int = 0,
+    compile_first: bool = True,
+):
+    """Wrap a compiled LM train step with span tracing + StepStats.
+
+    Each call opens a ``train_step`` span (utils/tracing.py) and, when
+    ``step_stats`` is given, records the step's wall time (first call =
+    the compile step). ``fence=True`` hard-blocks the returned loss before
+    the span closes so durations are device time, not dispatch time - the
+    observer effect is one scalar device->host fetch per step (sub-ms
+    locally, the tunnel RTT on axon; utils/timers.py hard_block). Pass
+    ``fence=False`` to keep fully async dispatch; spans then measure
+    dispatch only and carry ``fenced: false``.
+
+    The wrapper is transparent: same signature and return as ``step_fn``
+    (the trailing output is assumed to be the loss for fencing purposes,
+    matching every step builder in this module / parallel/pipeline.py).
+    ``compile_first=False`` marks every record steady-state - for callers
+    that already absorbed compilation in their own warm-up.
+    """
+    import itertools
+
+    from ..utils import tracing as _tracing
+    from ..utils.timers import hard_block
+
+    counter = itertools.count(first_step)
+
+    def traced_step(*args, **kwargs):
+        i = next(counter)
+        t0 = time.perf_counter()
+        with tracer.span(
+            _tracing.TRAIN_STEP, track="train", step=i, fenced=fence
+        ):
+            out = step_fn(*args, **kwargs)
+            if fence:
+                hard_block(out[-1] if isinstance(out, tuple) else out)
+        if step_stats is not None:
+            step_stats.record(
+                i, time.perf_counter() - t0, items=items_per_step,
+                is_compile=None if compile_first else False,
+            )
+        return out
+
+    return traced_step
 
 
 def make_copy_task(key, *, batch, seq_len, vocab):

@@ -671,6 +671,8 @@ def measure_lm_training(
     remat_policy: str = "",
     loss_chunks: int = 0,
     lr: float = 0.01,
+    tracer=None,
+    step_stats=None,
 ) -> dict:
     """Single-mesh LM throughput: tokens/s and MFU over `steps` timed steps.
 
@@ -678,7 +680,16 @@ def measure_lm_training(
     attention elsewhere - the returned dict records which path ran, so
     callers can fail loudly when the compiled kernel was required:
     VERDICT r2 weak #7). MFU follows `model_flops_per_token` with the
-    dtype-adjusted peak.
+    dtype-adjusted peak; `hw_flops_per_step` adds the compiled
+    executable's own cost_analysis() FLOPs when the backend reports them
+    (None otherwise - utils/tracing.py compiled_flops).
+
+    `tracer` (utils/tracing.py Tracer) records per-step `train_step` spans
+    inside the timed loop WITHOUT fencing (dispatch time; fencing each
+    step would change the measurement) plus a fenced `steady_window` span
+    around the whole loop; `step_stats` (StepStats) gets one steady
+    record per timed step from the same unfenced walls - trend data, not
+    the headline (which stays the fenced-window tokens/s below).
     """
     import jax.numpy as jnp
 
@@ -704,25 +715,52 @@ def measure_lm_training(
     tokens, targets = lmtrain.make_copy_task(
         jax.random.key(1), batch=batch, seq_len=seq_len, vocab=vocab
     )
+    from ..utils import tracing as tracing_mod
     from ..utils.timers import fence_rtt, hard_block
 
-    for _ in range(max(warmup, 1)):
-        params, mom, loss = step(params, mom, tokens, targets)
-    hard_block(loss)
+    if tracer is None:
+        tracer = tracing_mod.NULL_TRACER
+    hw_flops = tracing_mod.compiled_flops(step, params, mom, tokens, targets)
+
+    with tracer.span("warmup", track="train", steps=max(warmup, 1)):
+        for _ in range(max(warmup, 1)):
+            params, mom, loss = step(params, mom, tokens, targets)
+        hard_block(loss)
     # the fence is a value fetch (block_until_ready alone is a no-op on the
     # axon tunnel); subtract its pure round-trip cost so the ~60-70 ms
     # tunnel RTT is not charged to the steps (utils/timers.py fence_rtt)
     rtt = fence_rtt(loss)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, mom, loss = step(params, mom, tokens, targets)
-    hard_block(loss)
-    dt = max(time.perf_counter() - t0 - rtt, 1e-9)
+    timed = step
+    if tracer.enabled or step_stats is not None:
+        from . import lm as _lm
+
+        # compile_first=False: the warm-up above absorbed compilation, so
+        # every unfenced loop record is a steady-state dispatch wall
+        timed = _lm.make_traced_step(
+            step, tracer=tracer, step_stats=step_stats,
+            items_per_step=batch * seq_len, fence=False,
+            compile_first=False,
+        )
+    with tracer.span("steady_window", track="train", steps=steps):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, mom, loss = timed(params, mom, tokens, targets)
+        hard_block(loss)
+        dt = max(time.perf_counter() - t0 - rtt, 1e-9)
     tok_s = batch * seq_len * steps / dt
     flops_tok = model_flops_per_token(cfg, seq_len)
     dev = jax.devices()[0]
     peak = peak_flops(dev.device_kind, dtype)
     mfu = flops_tok * tok_s / peak * 100.0 if peak else None
+    if step_stats is not None:
+        step_stats.set_flops(
+            hw_flops if hw_flops is not None
+            else flops_tok * batch * seq_len,
+            "cost_analysis" if hw_flops is not None else "analytic",
+        )
+        if step_stats.peak_flops_per_device is None:
+            step_stats.peak_flops_per_device = peak
+        step_stats.capture_memory(tracer)
     return {
         "d_model": d_model, "n_layers": n_layers, "n_heads": n_heads,
         "d_ff": d_ff, "seq_len": seq_len,
@@ -742,6 +780,10 @@ def measure_lm_training(
         "wall_s": round(dt, 3),
         "model_tflops_per_s": round(flops_tok * tok_s / 1e12, 2),
         "mfu_pct": round(mfu, 2) if mfu is not None else None,
+        # provenance: hardware FLOPs per step straight from the compiled
+        # executable's cost_analysis() (includes remat recompute, unlike
+        # the model-FLOPs MFU numerator above); None where unreported
+        "hw_flops_per_step": hw_flops,
         "final_loss": float(loss),
     }
 

@@ -13,12 +13,37 @@ optional real Neptune sink if the library + env credentials are present.
 from __future__ import annotations
 
 import json
+import math
 import os
 import time
 
 TRAIN_LOSS = "train/loss"
 VAL_LOSS = "val/loss"
 VAL_ACC = "val/acc"
+
+
+def _sanitize(value):
+    """(json-safe value, invalid-repr-or-None) for one scalar.
+
+    `json.dumps(float("nan"))` emits a bare `NaN` token that strict JSON
+    parsers (and tools/plot_metrics.py / tools/trace_summary.py) reject;
+    non-finite floats serialize as null with the original repr preserved
+    in an "invalid" field so the event is still attributable.
+    """
+    if isinstance(value, float) and not math.isfinite(value):
+        return None, repr(value)
+    return value, None
+
+
+def _sanitize_tree(x):
+    """Recursively null non-finite floats inside set_value payloads."""
+    if isinstance(x, float):
+        return x if math.isfinite(x) else None
+    if isinstance(x, dict):
+        return {k: _sanitize_tree(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return [_sanitize_tree(v) for v in x]
+    return x
 
 
 class MetricsRun:
@@ -39,6 +64,11 @@ class MetricsRun:
         for s in self.sinks:
             s.append(series, float(value))
 
+    def flush(self) -> None:
+        """Push buffered events to durable storage (crash-safety point)."""
+        for s in self.sinks:
+            s.flush()
+
     def stop(self) -> None:
         for s in self.sinks:
             s.stop()
@@ -53,17 +83,28 @@ class JsonlSink:
         self._step: dict[str, int] = {}
 
     def set_value(self, key, value):
-        self._write({"t": time.time(), "series": key, "data": value})
+        self._write({"t": time.time(), "series": key, "data": _sanitize_tree(value)})
 
     def append(self, series, value):
         step = self._step.get(series, 0)
         self._step[series] = step + 1
-        self._write({"t": time.time(), "series": series, "step": step, "value": value})
+        v, invalid = _sanitize(float(value))
+        obj = {"t": time.time(), "series": series, "step": step, "value": v}
+        if invalid is not None:
+            obj["invalid"] = invalid
+        self._write(obj)
 
     def _write(self, obj):
-        self._f.write(json.dumps(obj) + "\n")
+        # allow_nan=False is the backstop: a non-finite float slipping past
+        # sanitization raises here instead of corrupting the file
+        self._f.write(json.dumps(obj, allow_nan=False) + "\n")
+
+    def flush(self):
+        if not self._f.closed:
+            self._f.flush()
 
     def stop(self):
+        self.flush()
         self._f.close()
 
 
@@ -71,6 +112,8 @@ class NullSink:
     def set_value(self, key, value): ...
 
     def append(self, series, value): ...
+
+    def flush(self): ...
 
     def stop(self): ...
 
@@ -89,6 +132,12 @@ class NeptuneSink:
 
     def append(self, series, value):
         self._run[series].append(value)
+
+    def flush(self):
+        # neptune buffers internally; sync() exists on recent clients
+        sync = getattr(self._run, "sync", None)
+        if sync is not None:
+            sync()
 
     def stop(self):
         self._run.stop()

@@ -26,6 +26,20 @@ TRAINING = "training"
 EVALUATION = "evaluation"
 COMMUNICATION = "communication"
 
+# Default report ordering: the reference's five accumulators - data loading,
+# training, evaluation, parent comm, children comm - with the two comm
+# accumulators merged (one mesh, no parent process), hence four names here.
+CANONICAL_PHASES = (DATA_LOADING, TRAINING, EVALUATION, COMMUNICATION)
+
+# the reference's stdout phrasing per phase (data_parallelism_train.py
+# prints; utils/logfiles.py keeps the byte-compatible *file* variants)
+REPORT_LABELS = {
+    DATA_LOADING: "Train data loading time",
+    TRAINING: "Time spent on training",
+    EVALUATION: "Time spent on evaluation",
+    COMMUNICATION: "Time spent on parent communication and param sync",
+}
+
 
 def hard_block(tree) -> None:
     """Fence that actually waits for device execution.
@@ -100,6 +114,30 @@ class PhaseTimers:
 
     def summary(self) -> dict[str, float]:
         return dict(self.totals)
+
+    def merge(self, other: "PhaseTimers") -> "PhaseTimers":
+        """Accumulate another timer set into this one (e.g. per-worker or
+        per-stage timers folded into a run total); returns self."""
+        for name, seconds in other.summary().items():
+            self.totals[name] += seconds
+        return self
+
+    def report(self) -> str:
+        """The canonical phase-summary block, one line per phase.
+
+        Canonical phases print first in the reference's order and phrasing
+        (always, so consumers can diff reports line-by-line even when a
+        phase never ran); any extra phases follow alphabetically as
+        ``<name>: <seconds>``. This is the ONE formatter behind the CLI /
+        measure printouts - entry points must not hand-roll their own.
+        """
+        lines = [
+            f"{REPORT_LABELS[name]}: {self.totals.get(name, 0.0)}"
+            for name in CANONICAL_PHASES
+        ]
+        for name in sorted(set(self.totals) - set(CANONICAL_PHASES)):
+            lines.append(f"{name}: {self.totals[name]}")
+        return "\n".join(lines)
 
 
 class _FenceHolder:

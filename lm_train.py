@@ -137,6 +137,16 @@ def main() -> int:
                    help="append train/loss (+ val/loss on --eval-every) "
                    "series to this JSONL file - the reference's metric "
                    "channel (utils/metrics.py), shared with the CNN engine")
+    p.add_argument("--trace-out", default=None, metavar="TRACE.json",
+                   help="write a Chrome trace-event JSON of the run (one "
+                   "train_step span per step, fenced - adds one scalar "
+                   "device fetch per step); open in Perfetto or summarize "
+                   "with tools/trace_summary.py (docs/OBSERVABILITY.md)")
+    p.add_argument("--step-stats", action="store_true",
+                   help="collect per-step StepStats (compile vs steady "
+                   "step time, tokens/s, device memory, collective bytes, "
+                   "MFU from cost_analysis with analytic fallback), print "
+                   "the summary, and emit step/* series to --metrics-jsonl")
     p.add_argument("--checkpoint-dir", default=None,
                    help="save params+momentum every --checkpoint-every steps")
     p.add_argument("--checkpoint-every", type=int, default=50)
@@ -495,6 +505,48 @@ def main() -> int:
         "seq_len": args.seq_len, "d_model": args.d_model,
         "n_layers": args.n_layers, "dtype": args.dtype,
     }
+    # step-level telemetry (utils/tracing.py; docs/OBSERVABILITY.md).
+    # The traced wrapper fences each step (hard_block on the loss), so the
+    # tokens/s this run reports includes one device->host fetch per step -
+    # opt-in observability, not the measurement path (train/measure.py).
+    from distributed_neural_network_tpu.utils import tracing as TRC
+
+    tracer = TRC.Tracer(enabled=bool(args.trace_out))
+    stats = None
+    if args.trace_out or args.step_stats:
+        from distributed_neural_network_tpu.train.measure import (
+            model_flops_per_token as _mfpt,
+            peak_flops as _peakf,
+        )
+
+        hw_flops = TRC.compiled_flops(
+            step, params, mom, tokens, targets,
+            *((jnp.int32(step0),)
+              if args.lr_schedule != "constant" else ()),
+        )
+        # gradient sync rides the data (and seq) axes; tensor-sharded
+        # leaves keep local grads - this over-counts those, an estimate
+        n_sync = mesh.shape.get("data", 1) * mesh.shape.get("seq", 1)
+        stats = TRC.StepStats(
+            item_label="tokens",
+            sink=run if args.step_stats else None,
+            n_devices=mesh.devices.size,
+            comm_bytes_per_step=TRC.collective_bytes_per_sync(params, n_sync),
+            flops_per_step=(
+                hw_flops if hw_flops is not None
+                else _mfpt(cfg, args.seq_len) * args.batch_size * args.seq_len
+            ),
+            flops_source="cost_analysis" if hw_flops is not None else "analytic",
+            peak_flops_per_device=_peakf(
+                jax.devices()[0].device_kind, args.dtype
+            ),
+        )
+        step = lmtrain.make_traced_step(
+            step, tracer=tracer, step_stats=stats,
+            items_per_step=args.batch_size * args.seq_len,
+            fence=True, first_step=step0,
+        )
+
     ema = ema_fn = None
     if args.ema_decay:
         from distributed_neural_network_tpu.ops.schedule import (
@@ -617,6 +669,15 @@ def main() -> int:
                 print(f"gen[{i}] prompt={row[:cut].tolist()} "
                       f"completion={row[cut:].tolist()}")
 
+    if stats is not None:
+        stats.capture_memory(tracer)
+        if args.step_stats:
+            print(stats.report())
+    if args.trace_out:
+        tracer.export(args.trace_out, step_stats=stats)
+        print(f"(Chrome trace written to {args.trace_out}; open in "
+              "Perfetto / chrome://tracing, or summarize with "
+              "tools/trace_summary.py)")
     run.stop()
     # pipeline bubble: (P-1)/(v*M+P-1) of tick-time processes garbage;
     # raise --microbatches or --pp-interleave to shrink it (the head is

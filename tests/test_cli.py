@@ -139,6 +139,94 @@ def test_dp_checkpoint_resume_and_profile(tmp_path):
     assert summary["epochs"] == 4
 
 
+def _strict_loads(text):
+    def reject(tok):
+        raise ValueError(f"non-strict token {tok}")
+
+    return json.loads(text, parse_constant=reject)
+
+
+def test_dp_trace_out_and_step_stats(tmp_path):
+    """--trace-out writes strict Chrome trace JSON with train_step spans
+    carrying step metadata; --step-stats emits step/* series and the
+    summary block (the PR's acceptance path)."""
+    trace = tmp_path / "trace.json"
+    summary, stdout, path = _run_script(
+        tmp_path, "data_parallelism_train.py", "--nb-proc", "4",
+        "--trace-out", str(trace), "--step-stats",
+    )
+    doc = _strict_loads(trace.read_text())  # STRICT json parse
+    events = doc["traceEvents"]
+    steps = [
+        e for e in events
+        if e.get("name") == "train_step" and e.get("ph") == "X"
+    ]
+    assert len(steps) == 2, "one fenced train_step span per epoch"
+    for ev in steps:
+        assert {"ts", "dur", "pid", "tid"} <= set(ev)
+        assert "step" in ev.get("args", {})
+    assert [e["args"]["step"] for e in steps] == [0, 1]
+    for phase in ("data_loading", "sync", "eval"):
+        assert any(e.get("name") == phase for e in events), phase
+    assert isinstance(doc.get("stepStats"), dict)
+    # step/* series landed in the metrics JSONL next to the classic ones
+    series = [
+        _strict_loads(line)["series"]
+        for line in open(path / "metrics.jsonl")
+    ]
+    assert series.count("step/wall_s") == 2
+    assert "step/images_per_s" in series
+    assert "Step stats (" in stdout
+    assert "MFU" in stdout
+    # the analysis tool round-trips the artifact without error
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_summary.py"),
+         str(trace), str(path / "metrics.jsonl")],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "train_step" in proc.stdout
+    assert "steady-state" in proc.stdout
+
+
+def test_module_cli_trace_smoke(tmp_path):
+    """`python -m distributed_neural_network_tpu.train.cli` is the tiny
+    telemetry harness: one epoch with --trace-out/--step-stats produces a
+    strict trace + step series (mirrors the acceptance command)."""
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    trace = tmp_path / "trace.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "distributed_neural_network_tpu.train.cli",
+         "--epochs", "1", "--trace-out", str(trace), "--step-stats",
+         "--metrics-jsonl", str(tmp_path / "m.jsonl"),
+         "--log-dir", str(tmp_path / "log")],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    doc = _strict_loads(trace.read_text())
+    steps = [
+        e for e in doc["traceEvents"]
+        if e.get("name") == "train_step" and e.get("ph") == "X"
+    ]
+    assert steps and all("step" in e.get("args", {}) for e in steps)
+    series = [
+        _strict_loads(line)["series"] for line in open(tmp_path / "m.jsonl")
+    ]
+    assert "step/wall_s" in series
+    assert "SUMMARY " in proc.stdout
+    proc2 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_summary.py"),
+         str(trace)],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert proc2.returncode == 0, proc2.stderr
+    assert "MFU" in proc2.stdout  # an estimate or the explicit fallback
+
+
 @pytest.mark.parametrize(
     "extra,mesh",
     [
@@ -171,6 +259,45 @@ def test_lm_train_entry_point(tmp_path, extra, mesh):
     )[len("SUMMARY "):])
     assert summary["mesh"] == mesh
     assert summary["final_loss"] < summary["first_loss"] - 1.0, summary
+
+
+def test_lm_train_trace_out_and_step_stats(tmp_path):
+    """lm_train.py --trace-out records one fenced train_step span per step
+    and the StepStats summary separates the compile step."""
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    trace = tmp_path / "lm_trace.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "lm_train.py"),
+         "--dp", "2", "--steps", "6", "--batch-size", "8", "--seq-len", "16",
+         "--d-model", "32", "--n-heads", "4", "--d-ff", "64", "--vocab", "32",
+         "--trace-out", str(trace), "--step-stats",
+         "--metrics-jsonl", str(tmp_path / "m.jsonl")],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    doc = _strict_loads(trace.read_text())
+    steps = [
+        e for e in doc["traceEvents"]
+        if e.get("name") == "train_step" and e.get("ph") == "X"
+    ]
+    assert [e["args"]["step"] for e in steps] == list(range(6))
+    assert all(e["args"]["fenced"] for e in steps)
+    stats = doc["stepStats"]
+    assert stats["steps"] == 6
+    assert stats["compile_steps"] == 1
+    assert stats["steady_steps"] == 5
+    assert stats["item_label"] == "tokens"
+    assert stats["flops_source"] in ("cost_analysis", "analytic")
+    assert "Step stats (" in proc.stdout
+    series = [
+        _strict_loads(line)["series"] for line in open(tmp_path / "m.jsonl")
+    ]
+    assert series.count("step/wall_s") == 6
+    assert series.count("step/tokens_per_s") == 5  # compile step excluded
 
 
 def test_lm_train_rejects_pp_with_sp(tmp_path):

@@ -51,3 +51,39 @@ def test_phase_timers_accumulate_and_fence():
         pass
     assert timers.get(T.TRAINING) > 0.0
     assert set(timers.summary()) == {T.TRAINING}
+
+
+def test_phase_timers_merge_accumulates_and_returns_self():
+    a = T.PhaseTimers()
+    a.add(T.TRAINING, 1.0)
+    a.add(T.COMMUNICATION, 0.5)
+    b = T.PhaseTimers()
+    b.add(T.TRAINING, 2.0)
+    b.add("custom_phase", 0.25)
+    out = a.merge(b)
+    assert out is a
+    assert a.get(T.TRAINING) == 3.0
+    assert a.get(T.COMMUNICATION) == 0.5
+    assert a.get("custom_phase") == 0.25
+    assert b.get(T.TRAINING) == 2.0  # merge source untouched
+
+
+def test_phase_timers_report_canonical_order_and_labels():
+    timers = T.PhaseTimers()
+    timers.add(T.COMMUNICATION, 0.5)
+    timers.add(T.TRAINING, 2.0)
+    timers.add("zz_extra", 0.1)
+    lines = timers.report().splitlines()
+    # canonical phases lead in the reference's order/phrasing, always all
+    # of them (evaluation/data_loading print 0.0 even though never timed)
+    assert lines[0] == "Train data loading time: 0.0"
+    assert lines[1] == "Time spent on training: 2.0"
+    assert lines[2] == "Time spent on evaluation: 0.0"
+    assert lines[3] == (
+        "Time spent on parent communication and param sync: 0.5"
+    )
+    assert lines[4] == "zz_extra: 0.1"
+    assert len(lines) == 5
+    assert tuple(T.CANONICAL_PHASES) == (
+        T.DATA_LOADING, T.TRAINING, T.EVALUATION, T.COMMUNICATION
+    )

@@ -1,0 +1,161 @@
+"""tools/trace_summary.py round trip + tools/plot_metrics.py robustness.
+
+Tier-1 (fast): generates a tiny trace through the tracer API - no training
+run, no subprocess engine - and asserts the summary table carries every
+canonical phase, the steady-state step time, throughput, and the explicit
+MFU fallback. Also pins the plot-metrics satellite: malformed JSONL lines
+are skipped with a stderr count instead of crashing mid-file.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from distributed_neural_network_tpu.utils import metrics as M
+from distributed_neural_network_tpu.utils import timers as T
+from distributed_neural_network_tpu.utils import tracing as tr
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SUMMARY_TOOL = os.path.join(REPO, "tools", "trace_summary.py")
+
+
+def _make_trace(tmp_path, *, with_stats=True):
+    tracer = tr.Tracer()
+    # one span per canonical phase name, plus the per-step spans
+    for phase in T.CANONICAL_PHASES:
+        with tracer.span(phase, track="host"):
+            pass
+    for i in range(4):
+        with tracer.span("train_step", track="train", step=i):
+            pass
+    stats = None
+    if with_stats:
+        stats = tr.StepStats(
+            item_label="images", n_devices=4, comm_bytes_per_step=60,
+            flops_per_step=1e6, flops_source="analytic",
+            peak_flops_per_device=None,  # CPU: MFU must say "unavailable"
+        )
+        stats.record(0, 1.0, items=400)
+        for i in range(1, 4):
+            stats.record(i, 0.25, items=400)
+    path = str(tmp_path / "trace.json")
+    tracer.export(path, step_stats=stats)
+    return path
+
+
+def _run_tool(*argv):
+    return subprocess.run(
+        [sys.executable, SUMMARY_TOOL, *argv],
+        capture_output=True, text=True, cwd=REPO, timeout=60,
+    )
+
+
+def test_summary_round_trip_contains_every_canonical_phase(tmp_path):
+    path = _make_trace(tmp_path)
+    proc = _run_tool(path)
+    assert proc.returncode == 0, proc.stderr
+    for phase in T.CANONICAL_PHASES:
+        assert phase in proc.stdout, (phase, proc.stdout)
+    assert "train_step" in proc.stdout
+    assert "steady-state step time" in proc.stdout
+    assert "0.2500" in proc.stdout  # steady mean from StepStats
+    assert "1,600.0 images/s" in proc.stdout  # 3*400 items / 0.75 s
+    assert "MFU: unavailable" in proc.stdout  # explicit fallback, no crash
+
+
+def test_summary_with_metrics_jsonl_pair_and_malformed_lines(tmp_path):
+    trace = _make_trace(tmp_path)
+    jsonl = tmp_path / "metrics.jsonl"
+    run = M.MetricsRun([M.JsonlSink(str(jsonl))])
+    stats = tr.StepStats(item_label="images", sink=run)
+    stats.record(0, 1.0, items=100)
+    stats.record(1, 0.5, items=100)
+    run.stop()
+    with open(jsonl, "a") as f:
+        f.write('{"series": "step/wall_s", "value": 0.5\n')  # truncated tail
+    proc = _run_tool(trace, str(jsonl))
+    assert proc.returncode == 0, proc.stderr
+    assert "step/wall_s" in proc.stdout
+    assert "step/images_per_s" in proc.stdout
+    assert "1 malformed JSONL line(s) skipped" in proc.stderr
+
+
+def test_summary_without_stats_derives_from_spans(tmp_path):
+    path = _make_trace(tmp_path, with_stats=False)
+    proc = _run_tool(path)
+    assert proc.returncode == 0, proc.stderr
+    assert "derived from train_step spans" in proc.stdout
+    assert "MFU: unavailable" in proc.stdout
+
+
+def test_summary_rejects_bare_nan_token(tmp_path):
+    path = tmp_path / "bad.json"
+    path.write_text('{"traceEvents": [{"name": "x", "ph": "X", "ts": NaN, '
+                    '"dur": 1, "pid": 0, "tid": 0}]}')
+    proc = _run_tool(str(path))
+    assert proc.returncode == 1
+    assert "non-strict JSON" in proc.stderr
+
+
+def _load_plot_metrics():
+    spec = importlib.util.spec_from_file_location(
+        "plot_metrics", os.path.join(REPO, "tools", "plot_metrics.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_plot_metrics_skips_malformed_lines(tmp_path, capsys):
+    pm = _load_plot_metrics()
+    path = tmp_path / "m.jsonl"
+    path.write_text(
+        '{"series": "train/loss", "step": 0, "value": 2.0}\n'
+        "this line is garbage\n"
+        '{"series": "train/loss", "step": 1, "value": 1.5}\n'
+        '{"series": "train/loss", "step": 2, "value": null, "invalid": "nan"}\n'
+        '[1, 2, 3]\n'
+        '{"series": "train/loss", "step": 3, "va'  # killed mid-write
+    )
+    series, params = pm.load_series(str(path))
+    err = capsys.readouterr().err
+    # garbage text + non-dict array + mid-write truncation = 3 bad lines
+    assert "3 malformed JSONL line(s) skipped" in err
+    xs, ys = series["train/loss"]
+    # the null (sanitized-NaN) sample is dropped, finite ones survive
+    assert xs == [0, 1] and ys == [2.0, 1.5]
+
+
+def test_plot_metrics_reads_sanitized_sink_output(tmp_path):
+    pm = _load_plot_metrics()
+    path = str(tmp_path / "m.jsonl")
+    run = M.MetricsRun([M.JsonlSink(path)])
+    run["parameters"] = {"lr": 0.1}
+    run.append("train/loss", 2.0)
+    run.append("train/loss", float("nan"))
+    run.append("val/acc", 51.0)
+    run.stop()
+    series, params = pm.load_series(path)
+    assert params == {"lr": 0.1}
+    assert series["train/loss"][1] == [2.0]
+    assert series["val/acc"][1] == [51.0]
+
+
+def test_step_stats_trace_embed_is_strict_json(tmp_path):
+    """A StepStats carrying non-finite values must still export strictly."""
+    tracer = tr.Tracer()
+    with tracer.span("train_step", step=0):
+        pass
+    stats = tr.StepStats(flops_per_step=float("inf"), flops_source="bogus")
+    stats.record(0, 0.1, items=10)
+    path = tracer.export(str(tmp_path / "t.json"), step_stats=stats)
+
+    def reject(tok):
+        raise ValueError(tok)
+
+    doc = json.loads(open(path).read(), parse_constant=reject)
+    assert doc["stepStats"]["flops_per_step"] is None

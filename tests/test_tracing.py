@@ -1,0 +1,340 @@
+"""Step-level telemetry (utils/tracing.py) + the NaN-safe JSONL sink.
+
+Pins the tentpole contracts: span nesting/ordering, Chrome trace-event
+schema validity (strict JSON, required ph/ts/dur/pid/tid keys, monotonic
+ts), StepStats compile-vs-steady separation and throughput math, the MFU
+fallback chain when cost_analysis() is absent/raises, and the metrics
+sink's non-finite serialization (satellite: a bare NaN token used to make
+the JSONL unreadable by strict parsers).
+"""
+
+import json
+import threading
+
+import pytest
+
+from distributed_neural_network_tpu.utils import metrics as M
+from distributed_neural_network_tpu.utils import tracing as tr
+
+
+def _strict_loads(text):
+    def reject(tok):
+        raise ValueError(f"non-strict token {tok}")
+
+    return json.loads(text, parse_constant=reject)
+
+
+# ------------------------------------------------------------------ tracer
+
+
+def test_span_nesting_records_parent_and_ordering():
+    t = tr.Tracer()
+    with t.span("outer", track="train", step=0):
+        with t.span("inner", track="train", step=0):
+            pass
+    events = t.events()
+    # inner exits (and records) first; both are X spans
+    assert [e.name for e in events] == ["inner", "outer"]
+    assert events[0].args["parent"] == "outer"
+    assert "parent" not in events[1].args
+    # inner lies within outer's [ts, ts+dur] window
+    inner, outer = events
+    assert outer.ts <= inner.ts
+    assert inner.ts + inner.dur <= outer.ts + outer.dur + 1e-3
+
+
+def test_disabled_tracer_is_noop_and_exports_empty(tmp_path):
+    t = tr.Tracer(enabled=False)
+    with t.span("x", step=1) as s:
+        pass
+    assert s is tr.NULL_SPAN
+    t.instant("i")
+    t.counter("c", {"v": 1})
+    assert t.events() == []
+    path = t.export(str(tmp_path / "empty.json"))
+    doc = _strict_loads(open(path).read())
+    assert [e for e in doc["traceEvents"] if e["ph"] == "X"] == []
+
+
+def test_chrome_trace_schema(tmp_path):
+    t = tr.Tracer()
+    for i in range(3):
+        with t.span("train_step", track="train", step=i):
+            pass
+    with t.span("eval", track="eval", step=0):
+        pass
+    t.instant("marker", track="train", note="hi")
+    t.counter("mem", {"dev0": 123}, track="memory")
+    path = t.export(str(tmp_path / "trace.json"))
+    doc = _strict_loads(open(path).read())  # strict: no bare NaN/Inf
+    events = doc["traceEvents"]
+    assert events, "no events exported"
+    for ev in events:
+        for key in ("name", "ph", "ts", "pid", "tid"):
+            assert key in ev, (key, ev)
+        if ev["ph"] == "X":
+            assert "dur" in ev and ev["dur"] >= 0
+    xs = [e for e in events if e["ph"] == "X"]
+    ts = [e["ts"] for e in xs]
+    assert ts == sorted(ts), "X events must be exported in ts order"
+    # one named track per phase: train/eval/memory metadata present
+    names = {
+        e["args"]["name"] for e in events if e["name"] == "thread_name"
+    }
+    assert {"train", "eval", "memory"} <= names
+    # step metadata survives into args
+    assert [e["args"]["step"] for e in xs if e["name"] == "train_step"] == [0, 1, 2]
+
+
+def test_tracer_thread_safety_and_per_thread_tracks():
+    t = tr.Tracer()
+
+    def worker():
+        for i in range(50):
+            with t.span("w", step=i):
+                pass
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    events = t.events()
+    assert len(events) == 200
+    assert len({e.tid for e in events}) == 4  # default track = thread name
+
+
+def test_span_handle_exposes_duration():
+    t = tr.Tracer()
+    with t.span("x") as s:
+        pass
+    assert s.dur_s >= 0.0
+    assert t.events()[0].dur == pytest.approx(s.dur_s * 1e6, rel=1e-3)
+
+
+def test_nonfinite_span_args_export_as_null(tmp_path):
+    t = tr.Tracer()
+    with t.span("x", bad=float("nan"), good=1.5):
+        pass
+    doc = _strict_loads(open(t.export(str(tmp_path / "t.json"))).read())
+    ev = [e for e in doc["traceEvents"] if e["ph"] == "X"][0]
+    assert ev["args"]["bad"] is None
+    assert ev["args"]["good"] == 1.5
+
+
+# --------------------------------------------------------------- StepStats
+
+
+def test_step_stats_compile_vs_steady_and_throughput():
+    s = tr.StepStats(item_label="images", n_devices=4)
+    s.record(0, 2.0, items=400)  # first record defaults to compile
+    for i in range(1, 5):
+        s.record(i, 0.1, items=400)
+    out = s.summary()
+    assert out["compile_steps"] == 1
+    assert out["compile_s"] == pytest.approx(2.0)
+    assert out["steady_steps"] == 4
+    assert not out["steady_includes_compile"]
+    assert out["steady_mean_s"] == pytest.approx(0.1)
+    assert out["steady_p50_s"] == pytest.approx(0.1)
+    assert out["steady_p95_s"] == pytest.approx(0.1)
+    # throughput counts steady items over steady time only
+    assert out["throughput_items_per_s"] == pytest.approx(4000.0, rel=1e-6)
+
+
+def test_step_stats_single_step_falls_back_with_flag():
+    s = tr.StepStats()
+    s.record(0, 1.5, items=10)
+    out = s.summary()
+    assert out["steady_includes_compile"]
+    assert out["steady_steps"] == 1
+    assert out["steady_mean_s"] == pytest.approx(1.5)
+    # the report never raises on the degenerate single-dispatch run
+    assert "single-dispatch" in s.report()
+
+
+def test_step_stats_mfu_math_and_fallback_notes():
+    s = tr.StepStats(
+        n_devices=2, flops_per_step=1e9, flops_source="analytic",
+        peak_flops_per_device=1e12,
+    )
+    s.record(0, 1.0, is_compile=True)
+    s.record(1, 0.01)
+    out = s.summary()
+    # 1e9 FLOPs / 0.01 s / (1e12 * 2) = 5%
+    assert out["mfu_pct"] == pytest.approx(5.0)
+    assert out["mfu_note"] is None
+
+    s2 = tr.StepStats(flops_per_step=None)
+    s2.record(0, 0.1)
+    out2 = s2.summary()
+    assert out2["mfu_pct"] is None
+    assert "unavailable" in out2["mfu_note"]
+    assert "MFU: unavailable" in s2.report()
+
+    s3 = tr.StepStats(flops_per_step=1e9, peak_flops_per_device=None)
+    s3.record(0, 0.1)
+    assert s3.summary()["mfu_pct"] is None
+
+
+def test_step_stats_streams_step_series_to_sink(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    run = M.MetricsRun([M.JsonlSink(path)])
+    s = tr.StepStats(item_label="images", sink=run)
+    s.record(0, 1.0, items=100)
+    s.record(1, 0.5, items=100)
+    run.stop()
+    events = [_strict_loads(l) for l in open(path)]
+    series = [e["series"] for e in events]
+    assert series.count("step/wall_s") == 2
+    # compile step gets no throughput sample; steady does
+    assert series.count("step/images_per_s") == 1
+    thr = next(e for e in events if e["series"] == "step/images_per_s")
+    assert thr["value"] == pytest.approx(200.0)
+
+
+def test_collective_bytes_ring_and_naive():
+    import numpy as np
+
+    tree = {"a": np.zeros((10,), np.float32), "b": np.zeros((5,), np.float32)}
+    assert tr.param_bytes(tree) == 60
+    assert tr.collective_bytes_per_sync(tree, 1) == 0
+    assert tr.collective_bytes_per_sync(tree, 4) == int(60 * 2 * 3 / 4)
+    assert tr.collective_bytes_per_sync(tree, 4, "naive") == 120
+    with pytest.raises(ValueError):
+        tr.collective_bytes_per_sync(tree, 4, "magic")
+
+
+def test_compiled_flops_real_jit():
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x @ x)
+    flops = tr.compiled_flops(f, jnp.ones((4, 4)))
+    assert flops == pytest.approx(128.0)  # 2 * 4^3
+
+
+def test_compiled_flops_graceful_fallbacks():
+    class NoLower:
+        pass
+
+    assert tr.compiled_flops(NoLower()) is None
+
+    class Raises:
+        def lower(self, *a, **k):
+            raise RuntimeError("backend says no")
+
+    assert tr.compiled_flops(Raises()) is None
+
+    class Chain:
+        def __init__(self, analysis):
+            self._a = analysis
+
+        def lower(self, *a, **k):
+            return self
+
+        def compile(self):
+            return self
+
+        def cost_analysis(self):
+            return self._a
+
+    assert tr.compiled_flops(Chain({"flops": 42.0})) == 42.0
+    assert tr.compiled_flops(Chain([{"flops": 7.0}])) == 7.0  # old-jax list
+    assert tr.compiled_flops(Chain([])) is None
+    assert tr.compiled_flops(Chain({"flops": -1.0})) is None
+    assert tr.compiled_flops(Chain({})) is None
+    assert tr.compiled_flops(Chain(None)) is None
+
+
+def test_device_memory_snapshot_never_raises():
+    snap = tr.device_memory_snapshot()  # CPU backend: None or a dict
+    assert snap is None or isinstance(snap, dict)
+    s = tr.StepStats()
+    s.capture_memory()  # must not raise on backends without memory_stats
+
+
+# ------------------------------------------------------- traced LM wrapper
+
+
+def test_make_traced_step_wraps_transparently():
+    import jax.numpy as jnp
+
+    from distributed_neural_network_tpu.train import lm as lmtrain
+
+    calls = []
+
+    def step_fn(params, mom, tokens, targets):
+        calls.append((params, mom))
+        return params + 1, mom, jnp.float32(0.5)
+
+    tracer = tr.Tracer()
+    stats = tr.StepStats(item_label="tokens")
+    traced = lmtrain.make_traced_step(
+        step_fn, tracer=tracer, step_stats=stats, items_per_step=64,
+        fence=True, first_step=3,
+    )
+    p, m, loss = traced(jnp.float32(0.0), None, None, None)
+    p, m, loss = traced(p, m, None, None)
+    assert float(p) == 2.0 and float(loss) == 0.5
+    assert len(calls) == 2
+    spans = [e for e in tracer.events() if e.name == "train_step"]
+    assert [e.args["step"] for e in spans] == [3, 4]
+    assert all(e.args["fenced"] for e in spans)
+    out = stats.summary()
+    assert out["steps"] == 2 and out["compile_steps"] == 1
+    assert out["steady_steps"] == 1
+
+
+def test_make_traced_step_compile_first_false_records_all_steady():
+    from distributed_neural_network_tpu.train import lm as lmtrain
+
+    stats = tr.StepStats()
+    traced = lmtrain.make_traced_step(
+        lambda x: x, tracer=tr.NULL_TRACER, step_stats=stats,
+        fence=False, compile_first=False,
+    )
+    traced(1.0)
+    traced(2.0)
+    out = stats.summary()
+    assert out["compile_steps"] == 0
+    assert out["steady_steps"] == 2
+    assert not out["steady_includes_compile"]
+
+
+# ---------------------------------------------------- metrics sink (NaN fix)
+
+
+def test_jsonl_sink_serializes_nonfinite_as_null(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    run = M.MetricsRun([M.JsonlSink(path)])
+    run.append("train/loss", float("nan"))
+    run.append("train/loss", float("inf"))
+    run.append("train/loss", 1.25)
+    run["parameters"] = {"lr": 0.1, "bad": float("-inf"), "nested": [float("nan")]}
+    run.stop()
+    lines = open(path).read().splitlines()
+    events = [_strict_loads(l) for l in lines]  # every line strict-parses
+    nan_ev, inf_ev, ok_ev, params_ev = events
+    assert nan_ev["value"] is None and nan_ev["invalid"] == "nan"
+    assert inf_ev["value"] is None and inf_ev["invalid"] == "inf"
+    assert ok_ev["value"] == 1.25 and "invalid" not in ok_ev
+    assert params_ev["data"]["bad"] is None
+    assert params_ev["data"]["nested"] == [None]
+    assert params_ev["data"]["lr"] == 0.1
+
+
+def test_jsonl_sink_flush_makes_events_durable(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    run = M.MetricsRun([M.JsonlSink(path)])
+    run.append("train/loss", 2.0)
+    run.flush()
+    # durable BEFORE stop: a crash after flush loses nothing
+    assert len(open(path).read().splitlines()) == 1
+    run.stop()
+    run.stop()  # idempotent: second stop must not raise on the closed file
+
+
+def test_null_sink_has_flush():
+    M.NullSink().flush()
+    M.MetricsRun([M.NullSink()]).flush()

@@ -13,26 +13,54 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import sys
 from collections import defaultdict
 
 
 def load_series(path: str):
+    """Parse a metrics JSONL file into {series: (steps, values)}.
+
+    Malformed lines (a run killed mid-write leaves a truncated tail; older
+    files may carry bare NaN tokens) are skipped and counted to stderr
+    instead of crashing the plot; non-numeric values (the null a
+    sanitized NaN/Inf serializes to, utils/metrics.py) are skipped too.
+    """
     series = defaultdict(lambda: ([], []))
     params = None
+    malformed = 0
     with open(path) as f:
         for line in f:
             line = line.strip()
             if not line:
                 continue
-            ev = json.loads(line)
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                malformed += 1
+                continue
+            if not isinstance(ev, dict):
+                malformed += 1
+                continue
             if ev.get("series") == "parameters":
                 params = ev.get("data")
                 continue
-            if "value" in ev:
+            if "value" in ev and isinstance(ev.get("series"), str):
+                v = ev["value"]
+                if (
+                    not isinstance(v, (int, float))
+                    or isinstance(v, bool)
+                    or not math.isfinite(v)
+                ):
+                    continue  # null/NaN/invalid sample: not plottable
                 xs, ys = series[ev["series"]]
                 xs.append(ev.get("step", len(xs)))
-                ys.append(ev["value"])
+                ys.append(v)
+    if malformed:
+        print(
+            f"({malformed} malformed JSONL line(s) skipped in {path})",
+            file=sys.stderr,
+        )
     return dict(series), params
 
 

@@ -1,0 +1,274 @@
+#!/usr/bin/env python
+"""Summarize a Chrome trace-event JSON (and optional metrics JSONL pair).
+
+Reads a trace written by `--trace-out` (utils/tracing.py Tracer.export) -
+or any Chrome trace-event file - and prints a phase breakdown table
+(count, total, p50/p95/max per span name), the step-level statistics
+(compile vs steady-state step time, throughput, comm bytes/step, device
+memory, MFU or an explicit "unavailable" reason), and, when a metrics
+JSONL file is also given, the `step/*` series it carries.
+
+Strictness: the file must be STRICT JSON - a bare NaN/Infinity token
+(which `json.dumps` emits by default and utils/metrics.py/tracing.py are
+careful never to write) is rejected with a clear error instead of being
+silently accepted. Stdlib-only: no jax, no repo imports - runs anywhere.
+
+Usage:
+  python tools/trace_summary.py trace.json [metrics.jsonl]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+from collections import defaultdict
+
+# phase rows print in this order when present; anything else follows by
+# descending total time (mirrors utils/timers.py CANONICAL_PHASES plus the
+# tracer's own span names)
+PREFERRED_ORDER = (
+    "data_loading",
+    "train_step",
+    "train_span",
+    "train_epoch",
+    "training",
+    "sync",
+    "communication",
+    "eval",
+    "evaluation",
+)
+
+
+def _reject_constant(name: str):
+    raise ValueError(
+        f"non-strict JSON token {name!r} (bare NaN/Infinity); the writer "
+        "must serialize non-finite floats as null"
+    )
+
+
+def strict_loads(text: str):
+    return json.loads(text, parse_constant=_reject_constant)
+
+
+def percentile(xs, p: float) -> float:
+    ys = sorted(xs)
+    k = max(0, min(len(ys) - 1, int(math.ceil(p / 100.0 * len(ys))) - 1))
+    return ys[k]
+
+
+def load_trace(path: str) -> dict:
+    with open(path) as f:
+        doc = strict_loads(f.read())
+    if isinstance(doc, list):  # the bare-array Chrome trace variant
+        doc = {"traceEvents": doc}
+    if not isinstance(doc, dict) or "traceEvents" not in doc:
+        raise ValueError(f"{path}: not a Chrome trace-event document")
+    return doc
+
+
+def phase_table(events) -> str:
+    spans = defaultdict(list)
+    for ev in events:
+        if ev.get("ph") == "X" and "dur" in ev:
+            spans[ev.get("name", "?")].append(float(ev["dur"]) / 1e6)
+    if not spans:
+        return "(no complete spans in trace)"
+    names = [n for n in PREFERRED_ORDER if n in spans]
+    names += sorted(
+        (n for n in spans if n not in PREFERRED_ORDER),
+        key=lambda n: -sum(spans[n]),
+    )
+    w = max(12, max(len(n) for n in names))
+    head = (
+        f"{'phase':<{w}}  {'count':>5}  {'total_s':>9}  "
+        f"{'p50_ms':>9}  {'p95_ms':>9}  {'max_ms':>9}"
+    )
+    lines = [head, "-" * len(head)]
+    for n in names:
+        xs = spans[n]
+        lines.append(
+            f"{n:<{w}}  {len(xs):>5}  {sum(xs):>9.3f}  "
+            f"{percentile(xs, 50) * 1e3:>9.2f}  "
+            f"{percentile(xs, 95) * 1e3:>9.2f}  {max(xs) * 1e3:>9.2f}"
+        )
+    return "\n".join(lines)
+
+
+def step_stats_from_spans(events) -> dict | None:
+    """Fallback aggregation straight from train_step spans (traces written
+    by other tools, or runs without the StepStats embed)."""
+    recs = []
+    for ev in events:
+        if ev.get("ph") == "X" and ev.get("name") in ("train_step", "train_span"):
+            args = ev.get("args") or {}
+            recs.append(
+                {
+                    "wall_s": float(ev.get("dur", 0.0)) / 1e6,
+                    "step": args.get("step", args.get("epoch0", len(recs))),
+                    "items": float(args.get("items", 0.0) or 0.0),
+                }
+            )
+    if not recs:
+        return None
+    recs.sort(key=lambda r: r["step"])
+    steady = recs[1:] or recs
+    walls = [r["wall_s"] for r in steady]
+    total = sum(walls)
+    items = sum(r["items"] for r in steady)
+    return {
+        "steps": len(recs),
+        "compile_steps": 1,
+        "compile_s": recs[0]["wall_s"],
+        "steady_steps": len(steady),
+        "steady_includes_compile": steady is recs,
+        "steady_mean_s": total / len(walls),
+        "steady_p50_s": percentile(walls, 50),
+        "steady_p95_s": percentile(walls, 95),
+        "steady_total_s": total,
+        "throughput_items_per_s": items / total if total > 0 and items else None,
+        "item_label": "items",
+        "n_devices": None,
+        "comm_bytes_per_step": None,
+        "device_memory_peak_bytes": None,
+        "mfu_pct": None,
+        "mfu_note": "unavailable: trace carries no stepStats embed "
+        "(FLOPs/peak unknown)",
+        "flops_source": None,
+    }
+
+
+def fmt_step_stats(s: dict, source: str) -> str:
+    lines = [f"Step stats ({source}):"]
+    lines.append(
+        f"  steps: {s.get('steps')} "
+        f"({s.get('compile_steps')} compile + {s.get('steady_steps')} steady"
+        + (", single-dispatch: steady includes compile)"
+           if s.get("steady_includes_compile") else ")")
+    )
+    if s.get("compile_s") is not None:
+        lines.append(f"  compile step: {s['compile_s']:.4f} s")
+    if s.get("steady_mean_s") is not None:
+        lines.append(
+            f"  steady-state step time: mean {s['steady_mean_s']:.4f} s, "
+            f"p50 {s['steady_p50_s']:.4f} s, p95 {s['steady_p95_s']:.4f} s"
+        )
+    else:
+        lines.append("  steady-state step time: unavailable (no steps)")
+    thr = s.get("throughput_items_per_s")
+    label = s.get("item_label") or "items"
+    lines.append(
+        "  steady-state throughput: "
+        + (f"{thr:,.1f} {label}/s" if thr else "unavailable")
+    )
+    if s.get("comm_bytes_per_step") is not None:
+        lines.append(
+            f"  collective payload: {s['comm_bytes_per_step']:,} bytes/step"
+        )
+    mem = s.get("device_memory_peak_bytes")
+    if mem:
+        lines.append(
+            "  device memory peak: "
+            + ", ".join(f"{k}={v:,} B" for k, v in sorted(mem.items()))
+        )
+    if s.get("mfu_pct") is not None:
+        lines.append(
+            f"  est. MFU: {s['mfu_pct']:.2f}% "
+            f"(FLOPs source: {s.get('flops_source')})"
+        )
+    else:
+        lines.append(
+            f"  est. MFU: {s.get('mfu_note') or 'unavailable'}"
+        )
+    return "\n".join(lines)
+
+
+def jsonl_step_series(path: str) -> str:
+    series = defaultdict(list)
+    bad = 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = strict_loads(line)
+            except ValueError:
+                bad += 1
+                continue
+            if not isinstance(ev, dict):
+                bad += 1
+                continue
+            if "value" in ev and isinstance(ev.get("series"), str):
+                v = ev["value"]
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    series[ev["series"]].append(float(v))
+    if bad:
+        print(
+            f"({bad} malformed JSONL line(s) skipped in {path})",
+            file=sys.stderr,
+        )
+    steps = {k: v for k, v in series.items() if k.startswith("step/")}
+    if not steps:
+        return f"(no step/* series in {path})"
+    lines = [f"Metrics step series ({path}):"]
+    for k in sorted(steps):
+        xs = steps[k]
+        lines.append(
+            f"  {k}: n={len(xs)} last={xs[-1]:.6g} "
+            f"p50={percentile(xs, 50):.6g} p95={percentile(xs, 95):.6g}"
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("trace", help="Chrome trace-event JSON (--trace-out)")
+    ap.add_argument(
+        "jsonl", nargs="?", default=None,
+        help="optional metrics JSONL pair (--metrics-jsonl)",
+    )
+    args = ap.parse_args(argv)
+
+    try:
+        doc = load_trace(args.trace)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    events = doc["traceEvents"]
+    n_tracks = len({(e.get("pid"), e.get("tid")) for e in events})
+    span_ts = [
+        float(e["ts"]) for e in events if e.get("ph") == "X" and "ts" in e
+    ]
+    extent = (
+        (max(
+            float(e["ts"]) + float(e.get("dur", 0.0))
+            for e in events if e.get("ph") == "X"
+        ) - min(span_ts)) / 1e6
+        if span_ts else 0.0
+    )
+    print(
+        f"Trace: {args.trace} ({len(events)} events, {n_tracks} tracks, "
+        f"{extent:.3f} s span)"
+    )
+    print()
+    print(phase_table(events))
+    print()
+    stats = doc.get("stepStats")
+    if isinstance(stats, dict) and stats:
+        print(fmt_step_stats(stats, "trace metadata"))
+    else:
+        derived = step_stats_from_spans(events)
+        if derived is not None:
+            print(fmt_step_stats(derived, "derived from train_step spans"))
+        else:
+            print("Step stats: unavailable (no train_step spans, no embed)")
+    if args.jsonl:
+        print()
+        print(jsonl_step_series(args.jsonl))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
