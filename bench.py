@@ -207,6 +207,35 @@ def _rows(epochs: int) -> list[dict]:
                      "n_heads": 4, "batch": 32, "remat": True,
                      "remat_policy": "dots_saveable"},
         },
+        # gradient-sync schedule A/B at the flagship shape, k=4
+        # accumulation (microbatch 4 rows): the end row is the baseline,
+        # the overlap rows move the per-microbatch collective inside the
+        # scan bucketed at 4 / 16 MiB (ops/schedule.py
+        # accumulate_fwd_bwd_overlap) - step-time delta is the
+        # latency-hiding win, mem_peak_bytes the accumulator delta
+        {
+            "id": "lm_flash_d512_L8_seq2048_bf16_accum4_end",
+            "kind": "lm",
+            "est_s": 600,
+            "args": {"attn": "flash", "dtype": "bfloat16", "steps": 20,
+                     "accum_steps": 4},
+        },
+        {
+            "id": "lm_flash_d512_L8_seq2048_bf16_accum4_overlap_b4",
+            "kind": "lm",
+            "est_s": 600,
+            "args": {"attn": "flash", "dtype": "bfloat16", "steps": 20,
+                     "accum_steps": 4, "grad_sync": "overlap",
+                     "bucket_mb": 4},
+        },
+        {
+            "id": "lm_flash_d512_L8_seq2048_bf16_accum4_overlap_b16",
+            "kind": "lm",
+            "est_s": 600,
+            "args": {"attn": "flash", "dtype": "bfloat16", "steps": 20,
+                     "accum_steps": 4, "grad_sync": "overlap",
+                     "bucket_mb": 16},
+        },
         {
             # remat: the XLA path materializes (B, H, S, S) scores, which
             # OOMs a 16 GB v5e at these shapes without recompute (measured
@@ -630,9 +659,18 @@ def _measured_row(r: dict | None) -> bool:
 # markers of a failure that is a property of the PROGRAM, not the session:
 # a compile-time OOM reproduces on every healthy chip. Checked BEFORE the
 # transient markers because XLA spells compile OOMs RESOURCE_EXHAUSTED -
-# the same status a busy chip uses (r5 review).
-_DETERMINISTIC_FAIL = ("AllocateBuffer", "Ran out of memory",
-                       "ran out of memory", "Out of memory")
+# the same status a busy chip uses (r5 review). COMPILE-TIME signatures
+# only: a bare "Out of memory"/"Ran out of memory" also appears in
+# transient co-tenant ALLOCATION failures at run time, and matching those
+# here would pin a known_fail row on a one-off busy-HBM session forever
+# (recovery from a mis-pinned row either way: `--refresh` re-measures
+# everything, `--only <row-id>` re-measures one row).
+_DETERMINISTIC_FAIL = (
+    "AllocateBuffer",                     # remote-compile buffer OOM (r5)
+    "compile permanent error",            # XLA:TPU compile-status marker
+    "Ran out of memory in memory space",  # program-allocation (compile) OOM
+    "while lowering",                     # lowering-stage failures
+)
 
 
 def _keep_prior(spec: dict, prev: dict | None) -> bool:

@@ -121,12 +121,21 @@ def decode_cache_attention(q, ck, cv, pos, *, block_k: int = 512,
     q (B, H, Dh) - the current position's query rows;
     ck/cv (B, H, total, Dh) - the static KV caches;
     pos - scalar int32, the current position (cols > pos are dead).
-    Returns o (B, H, Dh). Caller contracts: `total` must admit a
-    sublane-legal block (use `decode_kernel_ok(total)`), scale is
-    1/sqrt(Dh) applied here.
+    Returns o (B, H, Dh). `total` must admit a sublane-legal block
+    (gate with `decode_kernel_ok(total)`; enforced here too, so a direct
+    caller gets the documented ValueError instead of a Mosaic tiling
+    failure deep in the compile); scale is 1/sqrt(Dh) applied here.
     """
     b, h, total, d = ck.shape
     bk = _divisor_block(block_k, total)
+    if not decode_kernel_ok(total, block_k):
+        raise ValueError(
+            f"decode_cache_attention: cache size {total} admits no "
+            f"sublane-legal k block at block_k={block_k} (largest "
+            f"divisor {bk} is not a multiple of 16, bf16's Mosaic "
+            "sublane tile) - pick a total with such a divisor (any "
+            "multiple of 128 works) or fall back to the XLA decode path"
+        )
     q8 = jnp.broadcast_to(
         q.reshape(b * h, 1, d), (b * h, _SUBLANES, d)
     )

@@ -168,6 +168,75 @@ def accumulate_fwd_bwd(fwd_bwd_one, accum_steps: int):
     return fwd_bwd
 
 
+def accumulate_fwd_bwd_overlap(
+    fwd_bwd_one, accum_steps: int, *, reduce_fn, finalize_fn
+):
+    """Gradient accumulation with the sync collective INSIDE the scan.
+
+    `accumulate_fwd_bwd` is compute-then-communicate: the carry holds the
+    full local gradient tree and the cross-device reduction fires once,
+    after the last microbatch's backward, so the interconnect idles for
+    the entire scan. This variant moves the reduction into the scan body:
+    each microbatch's gradients are immediately handed to `reduce_fn`
+    (a bucketed psum for plain DP, a bucketed reduce-scatter for the ZeRO
+    shard-carry - parallel/collectives.py) and the carry accumulates the
+    REDUCED form, which XLA's latency-hiding scheduler can overlap with
+    the next microbatch's backward - and which for reduce-scatter is
+    1/N-th the accumulator memory. After the scan, `finalize_fn` maps the
+    averaged reduced carry back to a full gradient tree (identity for
+    psum buckets, the invariant-typed bucket all-gather for shards).
+
+    fwd_bwd_one(params, tokens, targets) -> (loss, grads) with grads
+    LOCAL (the caller suppresses the implicit typed-autodiff psum by
+    differentiating w.r.t. device-varying params - see train/lm.py);
+    reduce_fn(grads) -> reduced (any fixed pytree of arrays);
+    finalize_fn(reduced_avg) -> grads tree. The schedule matches the
+    end-sync result up to float reassociation. Requires accum_steps >= 2:
+    at k=1 there is nothing to overlap and callers keep the end schedule
+    (whose result is then bitwise identical by construction).
+    """
+    if accum_steps < 2:
+        raise ValueError(
+            f"overlap accumulation needs accum_steps >= 2, got "
+            f"{accum_steps} (at k=1 the schedules coincide - use the end "
+            "path, which is bitwise identical)"
+        )
+
+    def fwd_bwd(params, tokens, targets):
+        b_local = tokens.shape[0]
+        if b_local % accum_steps:
+            raise ValueError(
+                f"per-device batch ({b_local}) must divide by accum_steps "
+                f"({accum_steps})"
+            )
+        mb = b_local // accum_steps
+        tok_k = tokens.reshape(accum_steps, mb, -1)
+        tgt_k = targets.reshape(accum_steps, mb, -1)
+        loss0, g0 = fwd_bwd_one(params, tok_k[0], tgt_k[0])
+        first = (loss0, reduce_fn(g0))
+
+        def body(carry, tt):
+            loss_acc, red_acc = carry
+            loss, grads = fwd_bwd_one(params, *tt)
+            red = reduce_fn(grads)
+            return (
+                loss_acc + loss,
+                jax.tree.map(jnp.add, red_acc, red),
+            ), None
+
+        (loss_sum, red_sum), _ = jax.lax.scan(
+            body, first, (tok_k[1:], tgt_k[1:])
+        )
+        k = jnp.float32(accum_steps)
+        red_avg = jax.tree.map(lambda x: (x / k).astype(x.dtype), red_sum)
+        return loss_sum / k, finalize_fn(red_avg)
+
+    return fwd_bwd
+
+
+GRAD_SYNCS = ("end", "overlap")
+
+
 def make_ema_update(decay: float):
     """Compiled EMA tracker: ema <- decay*ema + (1-decay)*params.
 

@@ -35,6 +35,30 @@ def make_batch_loss(apply_fn):
     return batch_loss
 
 
+def sync_grads(grads, axis: str, *, grad_sync: str = "end",
+               bucket_bytes: int | None = None):
+    """Per-step gradient pmean over `axis`, as one collective per leaf
+    ("end", the default) or one per size-capped contiguous leaf bucket
+    ("overlap" - parallel/collectives.py bucketing; the bucketed form
+    hands XLA's latency-hiding scheduler independent collectives it can
+    start while the backward of still-unsynced buckets runs). Values are
+    identical either way - bucketing repartitions the same elementwise
+    mean. Shared by the HBM epoch scan and the streaming per-batch step
+    so the two paths cannot drift."""
+    if grad_sync != "overlap":
+        return jax.tree.map(lambda g: jax.lax.pmean(g, axis), grads)
+    from ..parallel.collectives import (
+        DEFAULT_BUCKET_BYTES,
+        bucketed_psum,
+        plan_buckets,
+    )
+
+    layout = plan_buckets(
+        grads, bucket_bytes=bucket_bytes or DEFAULT_BUCKET_BYTES
+    )
+    return bucketed_psum(grads, layout, axis, mean=True)
+
+
 def make_train_epoch(
     apply_fn,
     *,
@@ -44,6 +68,8 @@ def make_train_epoch(
     batch_size: int,
     reset_momentum: bool = True,
     grad_sync_axis: str | None = None,
+    grad_sync: str = "end",
+    bucket_bytes: int | None = None,
 ):
     """Build f(params, mom, images, labels, key) -> (params, mom, loss_sum, n_batches).
 
@@ -51,7 +77,9 @@ def make_train_epoch(
     reference child's `total_loss`/`total_batches` accounting
     (`data_parallelism_train.py:201-202`) - per-batch mean losses summed, and
     the *batch count* as denominator material (the reference's key-count bug,
-    SURVEY.md section 2, is fixed downstream).
+    SURVEY.md section 2, is fixed downstream). With `grad_sync_axis` set
+    (per-step gradient-pmean DP), `grad_sync`/`bucket_bytes` select the
+    collective granularity (`sync_grads`).
     """
     batch_loss = make_batch_loss(apply_fn)
     grad_fn = jax.value_and_grad(batch_loss)
@@ -67,8 +95,9 @@ def make_train_epoch(
             x, y = gather_batch(images, labels, bidx)
             loss, grads = grad_fn(params, x, y, bw)
             if grad_sync_axis is not None:
-                grads = jax.tree.map(
-                    lambda g: jax.lax.pmean(g, grad_sync_axis), grads
+                grads = sync_grads(
+                    grads, grad_sync_axis, grad_sync=grad_sync,
+                    bucket_bytes=bucket_bytes,
                 )
             params, mom = sgd_step(params, mom, grads, lr, momentum)
             return (params, mom), loss

@@ -15,10 +15,18 @@ plain mean over all devices (no division by zero, no NaN poisoning).
 
 from __future__ import annotations
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 
 from .mesh import DATA_AXIS
+
+# default gradient-bucket payload cap (bytes): large enough that a bucket's
+# collective amortizes launch latency, small enough that XLA's latency-hiding
+# scheduler can start bucket j's collective while later buckets' backward
+# compute is still running (the Xu et al. / pjit-overlap discipline)
+DEFAULT_BUCKET_BYTES = 4 * 2**20
 
 
 def vary_like(x, *refs, extra=()):
@@ -103,6 +111,209 @@ def masked_pmean_tree(tree, live: jax.Array, axis_name: str = DATA_AXIS):
         return jax.lax.psum(x * w.astype(x.dtype), axis_name) / denom.astype(x.dtype)
 
     return jax.tree.map(avg, tree)
+
+
+# --------------------------------------------------------- leaf bucketing
+#
+# The overlapped gradient-sync schedule (ops/schedule.py
+# accumulate_fwd_bwd_overlap; train/lm.py grad_sync="overlap") issues one
+# collective per LEAF GROUP per microbatch instead of relying on one bulk
+# tree-wide sync after the accumulation scan. The grouping lives here as a
+# deterministic layout object so that the reduce-scatter issued inside the
+# scan and the all-gather that reassembles full gradients after it agree
+# bit-for-bit on where every leaf's elements sit.
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketLayout:
+    """Deterministic size-capped contiguous grouping of a pytree's leaves.
+
+    Leaves keep their flatten order; a bucket is a contiguous [start, end)
+    run of leaf indices whose raveled concatenation forms one flat buffer.
+    Buckets never mix dtypes or caller-supplied group keys (e.g. leaves
+    with different PartitionSpecs, whose collectives need different mesh
+    axes or vma types), and close when the payload cap is reached - a
+    single leaf larger than the cap gets its own bucket. The layout is a
+    pure function of (tree structure, leaf shapes/dtypes, cap, keys), so
+    every device plans the identical layout and the packed element order
+    is shared by psum, reduce-scatter, and all-gather.
+    """
+
+    treedef: object
+    shapes: tuple
+    dtypes: tuple
+    buckets: tuple  # ((start, end), ...) leaf-index ranges
+
+    @property
+    def n_buckets(self) -> int:
+        return len(self.buckets)
+
+    def leaf_sizes(self) -> tuple:
+        import numpy as np
+
+        return tuple(int(np.prod(s, dtype=np.int64)) for s in self.shapes)
+
+    def bucket_elems(self) -> tuple:
+        sizes = self.leaf_sizes()
+        return tuple(
+            sum(sizes[i] for i in range(lo, hi)) for lo, hi in self.buckets
+        )
+
+    def bucket_bytes(self) -> tuple:
+        sizes = self.leaf_sizes()
+        return tuple(
+            sum(
+                sizes[i] * jnp.dtype(self.dtypes[i]).itemsize
+                for i in range(lo, hi)
+            )
+            for lo, hi in self.buckets
+        )
+
+    def shard_sizes(self, n_shards: int) -> tuple:
+        """Per-device shard length of each bucket, ceil-padded to n."""
+        return tuple(
+            -(-e // n_shards) for e in self.bucket_elems()
+        )
+
+
+def plan_buckets(tree, *, bucket_bytes: int = DEFAULT_BUCKET_BYTES,
+                 group_keys=None) -> BucketLayout:
+    """Plan the contiguous leaf buckets for `tree` (abstract or concrete).
+
+    `group_keys`: optional leaf-aligned sequence (or pytree) of hashables;
+    a bucket never spans a key change - pass e.g. str(PartitionSpec) per
+    leaf so tensor/pipe-sharded leaves (whose gradients carry different
+    vma types and sync axes) never share a buffer with replicated ones.
+    Only shapes/dtypes are read, so tracers work - the layout can be
+    planned inside jit from the parameter tree itself.
+    """
+    if bucket_bytes < 1:
+        raise ValueError(f"bucket_bytes must be >= 1, got {bucket_bytes}")
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if group_keys is None:
+        keys = [None] * len(leaves)
+    else:
+        keys = (
+            treedef.flatten_up_to(group_keys)
+            if not isinstance(group_keys, (list, tuple))
+            else list(group_keys)
+        )
+        if len(keys) != len(leaves):
+            raise ValueError(
+                f"group_keys has {len(keys)} entries for {len(leaves)} leaves"
+            )
+    shapes = tuple(tuple(p.shape) for p in leaves)
+    dtypes = tuple(jnp.dtype(p.dtype).name for p in leaves)
+    buckets = []
+    start, acc = 0, 0
+    for i, p in enumerate(leaves):
+        nbytes = int(p.size) * jnp.dtype(p.dtype).itemsize
+        if i > start and (
+            dtypes[i] != dtypes[start]
+            or keys[i] != keys[start]
+            or acc + nbytes > bucket_bytes
+        ):
+            buckets.append((start, i))
+            start, acc = i, 0
+        acc += nbytes
+    if len(leaves):
+        buckets.append((start, len(leaves)))
+    return BucketLayout(
+        treedef=treedef, shapes=shapes, dtypes=dtypes,
+        buckets=tuple(buckets),
+    )
+
+
+def pack_buckets(layout: BucketLayout, tree) -> list:
+    """Pack `tree`'s leaves into one flat 1-D buffer per bucket."""
+    leaves = layout.treedef.flatten_up_to(tree)
+    out = []
+    for lo, hi in layout.buckets:
+        parts = [leaves[i].reshape(-1) for i in range(lo, hi)]
+        out.append(parts[0] if len(parts) == 1 else jnp.concatenate(parts))
+    return out
+
+
+def unpack_buckets(layout: BucketLayout, bufs) -> object:
+    """Inverse of `pack_buckets`; buffers longer than the bucket's element
+    count (ceil-padded reduce-scatter/all-gather round trips) are
+    truncated, so the same layout serves padded and unpadded paths."""
+    if len(bufs) != layout.n_buckets:
+        raise ValueError(
+            f"got {len(bufs)} buffers for {layout.n_buckets} buckets"
+        )
+    sizes = layout.leaf_sizes()
+    leaves = [None] * len(layout.shapes)
+    for (lo, hi), buf in zip(layout.buckets, bufs):
+        off = 0
+        for i in range(lo, hi):
+            leaves[i] = buf[off:off + sizes[i]].reshape(layout.shapes[i])
+            off += sizes[i]
+    return jax.tree_util.tree_unflatten(layout.treedef, leaves)
+
+
+def bucketed_psum(tree, layout: BucketLayout, axes, *, mean: bool = False):
+    """psum (or pmean) of a pytree issued as one collective per bucket.
+
+    Call inside shard_map. Equivalent elementwise to a per-leaf psum; the
+    bucketed form gives XLA's latency-hiding scheduler independent
+    collectives it can overlap with compute between buckets.
+    """
+    op = jax.lax.pmean if mean else jax.lax.psum
+    return unpack_buckets(
+        layout, [op(b, axes) for b in pack_buckets(layout, tree)]
+    )
+
+
+def reduce_scatter_buckets(tree, layout: BucketLayout, axis_name: str, *,
+                           axis_size: int, extra_axes=()):
+    """Reduce-scatter each bucket over `axis_name`: returns one (S_b,)
+    shard per bucket (bucket ceil-padded to axis_size * S_b; layout order).
+
+    `extra_axes` are additionally psummed on the shard (e.g. the seq axis
+    when ZeRO shards over data but gradients also reduce over seq) - the
+    full reduction at 1/N of the buffer footprint. Call inside shard_map;
+    `axis_size` is the static mesh-axis size (passed in so the helper
+    needs no version-sensitive axis introspection).
+    """
+    out = []
+    for buf in pack_buckets(layout, tree):
+        s = -(-buf.shape[0] // axis_size)
+        pad = s * axis_size - buf.shape[0]
+        if pad:
+            buf = jnp.concatenate([buf, jnp.zeros((pad,), buf.dtype)])
+        sh = jax.lax.psum_scatter(
+            buf, axis_name, scatter_dimension=0, tiled=True
+        )
+        if extra_axes:
+            sh = jax.lax.psum(sh, tuple(extra_axes))
+        out.append(sh)
+    return tuple(out)
+
+
+def all_gather_buckets(shards, layout: BucketLayout, axis_name: str, *,
+                       axis_size: int):
+    """Reassemble `reduce_scatter_buckets` shards into the full tree.
+
+    Implemented as the one-hot psum (each device scatters its shard into
+    zeros and the psum fills every position exactly once): all-gather
+    semantics whose output is *invariant*-typed over `axis_name`, so the
+    result passes shard_map's vma checker as a replicated gradient - XLA
+    lowers it to an all-gather-class collective (same trick as
+    parallel/zero.py zero_sgd_step's reassembly).
+    """
+    me = jax.lax.axis_index(axis_name)
+    bufs = []
+    for sh in shards:
+        s = sh.shape[0]
+        full = jax.lax.psum(
+            jax.lax.dynamic_update_slice(
+                jnp.zeros((s * axis_size,), sh.dtype), sh, (me * s,)
+            ),
+            axis_name,
+        )
+        bufs.append(full)
+    return unpack_buckets(layout, bufs)
 
 
 def weighted_mean_scalar(

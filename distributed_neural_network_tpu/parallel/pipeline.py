@@ -412,6 +412,8 @@ def make_pp_train_step(
     weight_decay: float = 0.0,
     optimizer: str = "sgd",
     accum_steps: int = 1,
+    grad_sync: str = "end",
+    bucket_mb: float = 4.0,
 ):
     """Compiled pipeline-parallel (params, mom, tokens, targets) ->
     (params, mom, loss) over a (data, pipe, model) mesh.
@@ -446,6 +448,17 @@ def make_pp_train_step(
     `pp_optimizer_state_specs`; not with tp, and not with expert
     parallelism - expert leaves vary over exactly the data axis the
     per-leaf layout shards state over).
+
+    grad_sync="overlap" (with accum_steps >= 2) moves the data-axis
+    gradient reduction inside the accumulation scan, one collective per
+    size-capped leaf bucket (cap bucket_mb MiB; leaves grouped by
+    PartitionSpec so pipe-sharded layer chunks never share a buffer with
+    the replicated embed/head) - same schedule as train/lm.py's mesh
+    path. The pipe-axis psums for stage-replicated leaves stay with
+    typed autodiff (per microbatch, unchanged); only the data-axis sync
+    is bucketed/overlapped. ZeRO variants reduce-scatter per bucket and
+    carry the 1/dp shard. Matches "end" up to float reassociation; not
+    compatible with expert parallelism.
     """
     pp = mesh.shape.get(PIPE_AXIS, 1)
     v = interleave
@@ -487,6 +500,20 @@ def make_pp_train_step(
         )
     data_spec = P(DATA_AXIS)
 
+    from ..ops.schedule import GRAD_SYNCS
+
+    if grad_sync not in GRAD_SYNCS:
+        raise ValueError(
+            f"unknown grad_sync {grad_sync!r} (use one of {GRAD_SYNCS})"
+        )
+    if grad_sync == "overlap" and ep:
+        raise ValueError(
+            "grad_sync='overlap' psums every gradient bucket over the "
+            "data axis, but expert-sharded leaves VARY over that axis - "
+            "use grad_sync='end' with expert parallelism (same rule as "
+            "the mesh path)"
+        )
+
     def fwd_bwd_one(params, tokens, targets):
         return jax.value_and_grad(pipeline_lm_loss)(
             params, tokens, targets, cfg,
@@ -497,7 +524,56 @@ def make_pp_train_step(
 
     from ..ops.schedule import accumulate_fwd_bwd
 
-    fwd_bwd = accumulate_fwd_bwd(fwd_bwd_one, accum_steps)
+    if grad_sync == "overlap" and accum_steps > 1:
+        from ..ops.schedule import accumulate_fwd_bwd_overlap
+        from .collectives import (
+            pack_buckets,
+            plan_buckets,
+            unpack_buckets,
+        )
+        from .zero import make_overlap_grad_reducers
+
+        bucket_bytes = max(int(bucket_mb * 2**20), 1)
+        spec_keys = [
+            str(s)
+            for s in jax.tree.leaves(
+                specs, is_leaf=lambda s: isinstance(s, P)
+            )
+        ]
+        dp_size = mesh.shape.get(DATA_AXIS, 1)
+
+        def fwd_bwd(params, tokens, targets):
+            layout = plan_buckets(
+                params, bucket_bytes=bucket_bytes, group_keys=spec_keys
+            )
+            # vary over the data axis only: grads w.r.t. params_v are
+            # local over 'data' (the explicit bucket collective below is
+            # the only data-axis sync) while the pipe-axis psums for
+            # stage-replicated embed/head stay with typed autodiff
+            params_v = jax.tree.map(
+                lambda p: vary_like(p, extra=sync), params
+            )
+            if optimizer.startswith("zero"):
+                reduce_fn, finalize_fn = make_overlap_grad_reducers(
+                    layout, DATA_AXIS, dp_size
+                )
+            else:
+                def reduce_fn(grads):
+                    return tuple(
+                        jax.lax.psum(b, sync)
+                        for b in pack_buckets(layout, grads)
+                    )
+
+                def finalize_fn(bufs):
+                    return unpack_buckets(layout, list(bufs))
+
+            inner = accumulate_fwd_bwd_overlap(
+                lambda _p, tok, tgt: fwd_bwd_one(params_v, tok, tgt),
+                accum_steps, reduce_fn=reduce_fn, finalize_fn=finalize_fn,
+            )
+            return inner(params, tokens, targets)
+    else:
+        fwd_bwd = accumulate_fwd_bwd(fwd_bwd_one, accum_steps)
 
     def step(params, mom, tokens, targets, step_i=None):
         loss, grads = fwd_bwd(params, tokens, targets)

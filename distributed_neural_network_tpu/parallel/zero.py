@@ -220,6 +220,40 @@ def zero_adam_step_sharded(
     return new_p, {"m": new_m, "v": new_v, "t": t}
 
 
+def make_overlap_grad_reducers(layout, axis_name: str, n_shards: int, *,
+                               extra_axes=()):
+    """(reduce_fn, finalize_fn) for the ZeRO shard-carry overlap schedule.
+
+    Feeds `ops/schedule.py accumulate_fwd_bwd_overlap`: the scan body
+    reduce-scatters each microbatch's local gradients per bucket
+    (parallel/collectives.py `reduce_scatter_buckets`), so the
+    accumulation carry holds only this device's 1/N bucket shards -
+    O(D/N) instead of the end schedule's O(D) full-tree carry, which
+    makes k-step accumulation memory-neutral with the ZeRO-1 state
+    sharding. `finalize_fn` reassembles the averaged shards into the full
+    replicated gradient tree with the invariant-typed bucket all-gather
+    (`all_gather_buckets`), so the existing per-leaf optimizer path
+    (`grads_presummed=True` slice in `_sharded_leaf_step`) consumes it
+    unchanged. `extra_axes`: mesh axes beyond `axis_name` the gradients
+    also reduce over (the seq axis on a dp x sp mesh) - psummed on the
+    shard, at shard cost.
+    """
+    from .collectives import all_gather_buckets, reduce_scatter_buckets
+
+    def reduce_fn(grads):
+        return reduce_scatter_buckets(
+            grads, layout, axis_name, axis_size=n_shards,
+            extra_axes=tuple(extra_axes),
+        )
+
+    def finalize_fn(shards):
+        return all_gather_buckets(
+            shards, layout, axis_name, axis_size=n_shards
+        )
+
+    return reduce_fn, finalize_fn
+
+
 def make_zero_split_step(
     *,
     mesh,

@@ -44,6 +44,30 @@ def add_common_flags(p: argparse.ArgumentParser, *, epochs: int, batch_size: int
         help="keep momentum across epochs (reference re-creates SGD per epoch)",
     )
     p.add_argument(
+        "--grad-sync",
+        choices=("end", "overlap"),
+        default="end",
+        help="per-step gradient-sync granularity under --sync-mode step: "
+        "end = one pmean per leaf; overlap = one pmean per size-capped "
+        "leaf bucket (--bucket-mb), independent collectives XLA can "
+        "overlap with backward compute (no effect in epoch mode)",
+    )
+    p.add_argument(
+        "--bucket-mb",
+        type=float,
+        default=4.0,
+        help="gradient-bucket payload cap in MiB for --grad-sync overlap",
+    )
+    p.add_argument(
+        "--compilation-cache-dir",
+        default=None,
+        help="persistent XLA compilation cache directory "
+        "(jax_compilation_cache_dir): repeat runs of the same program "
+        "deserialize instead of recompiling - the --step-stats compile "
+        "field then records the cache-hit time, and the StepStats "
+        "summary carries the cache dir for provenance",
+    )
+    p.add_argument(
         "--input-mode",
         choices=("hbm", "stream"),
         default="hbm",
@@ -182,7 +206,32 @@ def config_from_args(args, regime: str) -> TrainConfig:
         reference_compat=getattr(args, "reference_compat", False),
         input_mode=getattr(args, "input_mode", "hbm"),
         stream_prefetch=getattr(args, "stream_prefetch", 2),
+        grad_sync=getattr(args, "grad_sync", "end"),
+        bucket_mb=getattr(args, "bucket_mb", 4.0),
     )
+
+
+def enable_compilation_cache(path: str) -> bool:
+    """Point jax's persistent compilation cache at `path` (created on
+    first write). Compile-time floor/size gates are zeroed so even the
+    tiny smoke programs cache - the point here is measuring cache-hit
+    compile time via StepStats, not saving only the big programs.
+    Returns False (never raises) on jax versions without the knobs."""
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+    except Exception:
+        return False
+    for knob, val in (
+        ("jax_persistent_cache_min_compile_time_secs", 0.0),
+        ("jax_persistent_cache_min_entry_size_bytes", -1),
+    ):
+        try:
+            jax.config.update(knob, val)
+        except Exception:
+            pass  # optional tuning knobs; the cache dir alone suffices
+    return True
 
 
 def honor_platform_env() -> None:
@@ -213,6 +262,16 @@ def run_training(args, regime: str, *, log=print) -> Engine:
             f"(Multi-host: process {jax.process_index()}/{jax.process_count()}, "
             f"{jax.device_count()} global devices)"
         )
+    cache_dir = getattr(args, "compilation_cache_dir", None)
+    if cache_dir:
+        if enable_compilation_cache(cache_dir):
+            log(f"(Persistent compilation cache: {cache_dir})")
+        else:
+            log(
+                "(WARNING: this jax version has no persistent compilation "
+                "cache config; --compilation-cache-dir ignored)"
+            )
+            cache_dir = None
     cfg = config_from_args(args, regime)
     timers = T.PhaseTimers()
 
@@ -281,8 +340,23 @@ def run_training(args, regime: str, *, log=print) -> Engine:
             peak_flops_per_device=peak_flops(
                 jax.devices()[0].device_kind, cfg.compute_dtype
             ),
+            grad_sync=cfg.grad_sync if cfg.sync_mode == "step" else None,
+            compilation_cache_dir=cache_dir,
         )
         engine.step_stats = stats
+        if cfg.sync_mode == "step" and cfg.grad_sync == "overlap":
+            # put the bucket plan in-band in the trace (the collectives
+            # run inside the compiled epoch where spans can't see them)
+            from ..parallel.collectives import plan_buckets
+
+            layout = plan_buckets(
+                engine.params, bucket_bytes=int(cfg.bucket_mb * 2**20)
+            )
+            stats.comm_bucket_bytes = [int(b) for b in layout.bucket_bytes()]
+            TR.record_bucket_plan(
+                tracer, stats.comm_bucket_bytes, schedule="overlap",
+                op="pmean", axis_size=engine.n_workers,
+            )
 
     checkpointer = None
     start_epoch = 0

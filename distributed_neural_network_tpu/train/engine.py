@@ -95,6 +95,13 @@ class TrainConfig:
     # stream mode: batches assembled this many steps ahead on a background
     # thread (2 = double buffering); 0 = synchronous (debugging)
     stream_prefetch: int = 2
+    # per-step gradient-sync granularity under sync_mode="step": "end" =
+    # one pmean per leaf (the existing schedule); "overlap" = one pmean
+    # per size-capped contiguous leaf bucket (ops/train.py sync_grads -
+    # independent collectives XLA's scheduler can overlap with backward
+    # compute). Identical values either way; no effect in "epoch" mode.
+    grad_sync: str = "end"
+    bucket_mb: float = 4.0
 
     def __post_init__(self):
         if self.regime not in REGIMES:
@@ -103,6 +110,14 @@ class TrainConfig:
             raise ValueError(
                 f"sync_mode must be one of {SYNC_MODES}, got {self.sync_mode}"
             )
+        from ..ops.schedule import GRAD_SYNCS
+
+        if self.grad_sync not in GRAD_SYNCS:
+            raise ValueError(
+                f"grad_sync must be one of {GRAD_SYNCS}, got {self.grad_sync}"
+            )
+        if self.bucket_mb <= 0:
+            raise ValueError(f"bucket_mb must be > 0, got {self.bucket_mb}")
         if self.kernels not in ("xla", "pallas"):
             raise ValueError(f"kernels must be 'xla' or 'pallas', got {self.kernels}")
         if self.input_mode not in ("hbm", "stream"):
@@ -321,6 +336,8 @@ class Engine:
             batch_size=c.batch_size,
             reset_momentum=c.reset_momentum,
             grad_sync_axis=DATA_AXIS if c.sync_mode == "step" else None,
+            grad_sync=c.grad_sync,
+            bucket_bytes=int(c.bucket_mb * 2**20),
         )
         data_spec = self._train_data_spec
         seed = c.seed
@@ -377,7 +394,12 @@ class Engine:
             mom_l = jax.tree.map(lambda m: m[0], mom)
             loss, grads = batch_grad(params, x, y, w)
             if step_sync:
-                grads = jax.tree.map(lambda g: jax.lax.pmean(g, DATA_AXIS), grads)
+                from ..ops.train import sync_grads
+
+                grads = sync_grads(
+                    grads, DATA_AXIS, grad_sync=c.grad_sync,
+                    bucket_bytes=int(c.bucket_mb * 2**20),
+                )
             params, mom_l = sgd_step(params, mom_l, grads, c.lr, c.momentum)
             stack = lambda t: jax.tree.map(lambda v: v[None], t)
             # loss accumulates ON DEVICE across the epoch's steps: no

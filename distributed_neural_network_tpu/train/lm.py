@@ -239,6 +239,8 @@ def make_lm_train_step(
     clip_norm: float = 0.0,
     accum_steps: int = 1,
     weight_decay: float = 0.0,
+    grad_sync: str = "end",
+    bucket_mb: float = 4.0,
 ):
     """Compiled (params, mom, tokens, targets) -> (params, mom, loss).
 
@@ -264,6 +266,25 @@ def make_lm_train_step(
       optimizer - Adam applies it inside adam_leaf_update; SGD applies
       p -= lr_t * wd * p after the momentum update (never folded into
       the gradient, so momentum stays decay-free).
+    - grad_sync: WHEN the cross-device gradient reduction happens under
+      accumulation. "end" (default) is the existing schedule - typed
+      autodiff's psums after each backward, the accumulator carrying the
+      full gradient tree. "overlap" moves the collective INSIDE the
+      accumulation scan (ops/schedule.py accumulate_fwd_bwd_overlap):
+      gradients are taken w.r.t. device-varying params (local, no
+      implicit psum) and each microbatch issues one explicit collective
+      per size-capped leaf bucket (parallel/collectives.py, cap
+      bucket_mb MiB, leaves grouped by PartitionSpec) so XLA's
+      latency-hiding scheduler can run bucket j's collective under
+      microbatch i+1's backward. For 'zero'/'zero-adam' the per-bucket
+      collective is a reduce-scatter and the scan carry holds only this
+      device's 1/dp shard - O(D/dp) accumulator instead of O(D) - with
+      one invariant-typed bucket all-gather after the scan feeding the
+      unchanged per-leaf optimizer. Matches "end" up to float
+      reassociation; at accum_steps=1 there is nothing to overlap and
+      the end schedule runs (bitwise identical). Not compatible with
+      expert parallelism (expert leaves vary over exactly the data axis
+      the overlap psum reduces over).
     """
     sp = SEQ_AXIS if mesh.shape.get(SEQ_AXIS, 1) > 1 else None
     tp = TP_AXIS if mesh.shape.get(TP_AXIS, 1) > 1 else None
@@ -286,6 +307,19 @@ def make_lm_train_step(
 
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    from ..ops.schedule import GRAD_SYNCS
+
+    if grad_sync not in GRAD_SYNCS:
+        raise ValueError(
+            f"unknown grad_sync {grad_sync!r} (use one of {GRAD_SYNCS})"
+        )
+    if grad_sync == "overlap" and ep:
+        raise ValueError(
+            "grad_sync='overlap' psums every gradient bucket over the "
+            "data axis, but expert-sharded leaves VARY over that axis "
+            f"(ep_axis={ep!r}) - their gradients must stay local; use "
+            "grad_sync='end' with expert parallelism"
+        )
 
     def fwd_bwd_one(params, tokens, targets):
         return jax.value_and_grad(lm_loss)(
@@ -303,7 +337,62 @@ def make_lm_train_step(
 
     from ..ops.schedule import accumulate_fwd_bwd
 
-    fwd_bwd = accumulate_fwd_bwd(fwd_bwd_one, accum_steps)
+    if grad_sync == "overlap" and accum_steps > 1:
+        from ..ops.schedule import accumulate_fwd_bwd_overlap
+        from ..parallel.collectives import (
+            pack_buckets,
+            plan_buckets,
+            unpack_buckets,
+        )
+
+        bucket_bytes = max(int(bucket_mb * 2**20), 1)
+        # leaves grouped by PartitionSpec: tensor-sharded leaves (whose
+        # grads stay varying over 'model') never share a buffer with
+        # replicated ones - each bucket has one vma type and one layout
+        spec_keys = [
+            str(s)
+            for s in jax.tree.leaves(
+                specs,
+                is_leaf=lambda s: isinstance(s, jax.sharding.PartitionSpec),
+            )
+        ]
+        dp_size = mesh.shape.get(DATA_AXIS, 1)
+
+        def fwd_bwd(params, tokens, targets):
+            layout = plan_buckets(
+                params, bucket_bytes=bucket_bytes, group_keys=spec_keys
+            )
+            # differentiate w.r.t. ALREADY-varied params: the implicit
+            # typed-autodiff psum is suppressed and each microbatch's
+            # grads are this device's local contribution - the explicit
+            # per-bucket collective below is the only sync
+            params_v = jax.tree.map(
+                lambda p: vary_like(p, extra=sync_axes), params
+            )
+            if optimizer.startswith("zero"):
+                reduce_fn, finalize_fn = zero.make_overlap_grad_reducers(
+                    layout, DATA_AXIS, dp_size,
+                    extra_axes=tuple(
+                        a for a in sync_axes if a != DATA_AXIS
+                    ),
+                )
+            else:
+                def reduce_fn(grads):
+                    return tuple(
+                        jax.lax.psum(b, sync_axes)
+                        for b in pack_buckets(layout, grads)
+                    )
+
+                def finalize_fn(bufs):
+                    return unpack_buckets(layout, list(bufs))
+
+            inner = accumulate_fwd_bwd_overlap(
+                lambda _p, tok, tgt: fwd_bwd_one(params_v, tok, tgt),
+                accum_steps, reduce_fn=reduce_fn, finalize_fn=finalize_fn,
+            )
+            return inner(params, tokens, targets)
+    else:
+        fwd_bwd = accumulate_fwd_bwd(fwd_bwd_one, accum_steps)
 
     def transform_grads(grads):
         if clip_norm > 0.0:

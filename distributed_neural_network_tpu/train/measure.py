@@ -671,6 +671,9 @@ def measure_lm_training(
     remat_policy: str = "",
     loss_chunks: int = 0,
     lr: float = 0.01,
+    accum_steps: int = 1,
+    grad_sync: str = "end",
+    bucket_mb: float = 4.0,
     tracer=None,
     step_stats=None,
 ) -> dict:
@@ -710,7 +713,8 @@ def measure_lm_training(
     params, _ = lmtrain.shard_params(params0, cfg, mesh)
     mom = lmtrain.init_lm_momentum(params, mesh)
     step = lmtrain.make_lm_train_step(
-        cfg, mesh, lr=lr, attn_impl=attn, loss_chunks=loss_chunks
+        cfg, mesh, lr=lr, attn_impl=attn, loss_chunks=loss_chunks,
+        accum_steps=accum_steps, grad_sync=grad_sync, bucket_mb=bucket_mb,
     )
     tokens, targets = lmtrain.make_copy_task(
         jax.random.key(1), batch=batch, seq_len=seq_len, vocab=vocab
@@ -761,12 +765,24 @@ def measure_lm_training(
         if step_stats.peak_flops_per_device is None:
             step_stats.peak_flops_per_device = peak
         step_stats.capture_memory(tracer)
+    # committed-memory delta column for the grad_sync variant rows: the
+    # overlap schedule's shard-carry should show up here (CPU returns None)
+    snap = tracing_mod.device_memory_snapshot()
+    mem_peak = (
+        max(
+            s.get("peak_bytes_in_use", s.get("bytes_in_use", 0))
+            for s in snap.values()
+        )
+        if snap else None
+    )
     return {
         "d_model": d_model, "n_layers": n_layers, "n_heads": n_heads,
         "d_ff": d_ff, "seq_len": seq_len,
         "vocab": vocab, "batch": batch, "steps": steps, "dtype": dtype,
         "attn": attn, "remat": remat, "remat_attn": remat_attn,
         "remat_policy": remat_policy,
+        "accum_steps": accum_steps, "grad_sync": grad_sync,
+        "mem_peak_bytes": mem_peak,
         # provenance: WHICH flash kernel measured this row (r3's numbers
         # were the library kernel; r4+ defaults to the own kernels)
         "attn_kernel": (

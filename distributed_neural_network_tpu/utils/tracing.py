@@ -312,6 +312,9 @@ class StepStats:
         flops_per_step: float | None = None,
         flops_source: str | None = None,
         peak_flops_per_device: float | None = None,
+        grad_sync: str | None = None,
+        comm_bucket_bytes: list | tuple | None = None,
+        compilation_cache_dir: str | None = None,
     ):
         self.item_label = item_label
         self.sink = sink
@@ -321,6 +324,17 @@ class StepStats:
         self.flops_per_step = flops_per_step
         self.flops_source = flops_source
         self.peak_flops_per_device = peak_flops_per_device
+        # gradient-sync schedule attribution: which schedule produced
+        # comm_bytes_per_step, and (overlap) the per-bucket payloads so a
+        # trace reader can match collective cost to the bucket plan
+        self.grad_sync = grad_sync
+        self.comm_bucket_bytes = (
+            [int(b) for b in comm_bucket_bytes]
+            if comm_bucket_bytes is not None else None
+        )
+        # persistent-compilation-cache provenance: compile_s with a warm
+        # cache is the cache-hit (deserialize) time, not a fresh compile
+        self.compilation_cache_dir = compilation_cache_dir
         self.records: list[StepRecord] = []
         self.memory_peak: dict[str, int] = {}
         self._lock = threading.Lock()
@@ -404,6 +418,15 @@ class StepStats:
             "steady_steps": len(steady),
             "steady_includes_compile": steady_includes_compile,
             "comm_bytes_per_step": self.comm_bytes_per_step,
+            "grad_sync": self.grad_sync,
+            "comm_buckets": (
+                {
+                    "count": len(self.comm_bucket_bytes),
+                    "bytes_per_bucket": list(self.comm_bucket_bytes),
+                }
+                if self.comm_bucket_bytes is not None else None
+            ),
+            "compilation_cache_dir": self.compilation_cache_dir,
             "flops_per_step": self.flops_per_step,
             "flops_source": self.flops_source,
             "peak_flops_per_device": self.peak_flops_per_device,
@@ -473,9 +496,16 @@ class StepStats:
             + (f"{thr:,.1f} {s['item_label']}/s" if thr else "n/a")
         )
         if s["comm_bytes_per_step"] is not None:
+            sched = f", schedule: {s['grad_sync']}" if s["grad_sync"] else ""
             lines.append(
                 f"  collective payload: {s['comm_bytes_per_step']:,} "
-                "bytes/step (ring all-reduce estimate)"
+                f"bytes/step (ring all-reduce estimate{sched})"
+            )
+        if s["comm_buckets"]:
+            bb = s["comm_buckets"]["bytes_per_bucket"]
+            lines.append(
+                f"  gradient buckets: {len(bb)} per microbatch "
+                f"({min(bb):,}-{max(bb):,} B each)"
             )
         mem = s["device_memory_peak_bytes"]
         lines.append(
@@ -526,6 +556,54 @@ def collective_bytes_per_sync(tree, n_devices: int, algorithm: str = "ring") -> 
     if algorithm == "naive":
         return 2 * pb
     raise ValueError(f"unknown algorithm {algorithm!r} (ring | naive)")
+
+
+def overlapped_collective_bytes(
+    bucket_bytes, n_devices: int, accum_steps: int = 1,
+    algorithm: str = "ring",
+) -> int:
+    """Per-device payload bytes of one train step under the OVERLAPPED
+    gradient-sync schedule: every microbatch fires one collective per
+    bucket, so the step total is accum_steps x the bucketed tree's ring
+    cost. Same ring bound as `collective_bytes_per_sync` (a bucketed
+    reduce-scatter + the post-scan all-gather together move the same
+    2*(n-1)/n of the tree a bucketed psum does); the point of reporting
+    it separately is that the trace shows it OVERLAPPED with backward
+    compute instead of serialized after it."""
+    if n_devices <= 1:
+        return 0
+    total = int(sum(bucket_bytes))
+    if algorithm == "ring":
+        per = int(total * 2 * (n_devices - 1) / n_devices)
+    elif algorithm == "naive":
+        per = 2 * total
+    else:
+        raise ValueError(f"unknown algorithm {algorithm!r} (ring | naive)")
+    return per * max(int(accum_steps), 1)
+
+
+GRAD_BUCKET = "grad_bucket"
+
+
+def record_bucket_plan(
+    tracer: Tracer, bucket_bytes, *, schedule: str, op: str,
+    axis_size: int, accum_steps: int = 1, track: str = "collective",
+) -> None:
+    """Emit one `grad_bucket` instant event per bucket of the gradient-sync
+    plan (payload bytes, collective op, schedule, mesh-axis size).
+
+    The collectives themselves execute inside the compiled step where
+    host-side spans cannot see them; these plan events put the schedule
+    in-band in the Chrome trace, on their own track next to the fenced
+    train_step spans, so a Perfetto reader (and the trace-schema tests)
+    can attribute per-bucket collective bytes without device profiling.
+    """
+    for i, b in enumerate(bucket_bytes):
+        tracer.instant(
+            GRAD_BUCKET, track=track, bucket=i, bytes=int(b), op=op,
+            schedule=schedule, axis_size=int(axis_size),
+            per_microbatch=int(accum_steps),
+        )
 
 
 def device_memory_snapshot() -> dict[str, dict] | None:

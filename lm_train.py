@@ -110,6 +110,26 @@ def main() -> int:
                    "must divide by dp * accum-steps; under --pp also by "
                    "microbatches per pass - prefer raising --microbatches "
                    "until activation memory binds, then accumulate)")
+    p.add_argument("--grad-sync", choices=("end", "overlap"), default="end",
+                   help="gradient-sync schedule under --accum-steps k>1: "
+                   "end = one bulk sync after the accumulation scan "
+                   "(existing behavior); overlap = one collective per "
+                   "size-capped leaf bucket (--bucket-mb) PER MICROBATCH "
+                   "inside the scan, so the interconnect works while the "
+                   "next microbatch's backward runs - with zero/zero-adam "
+                   "the scan carries only this device's 1/dp gradient "
+                   "shard (reduce-scatter), shrinking the accumulator "
+                   "from O(D) to O(D/dp). Same result up to float "
+                   "reassociation; identical at --accum-steps 1. Not "
+                   "compatible with --experts at dp>1")
+    p.add_argument("--bucket-mb", type=float, default=4.0,
+                   help="gradient-bucket payload cap in MiB for "
+                   "--grad-sync overlap")
+    p.add_argument("--compilation-cache-dir", default=None,
+                   help="persistent XLA compilation cache dir "
+                   "(jax_compilation_cache_dir): repeat runs deserialize "
+                   "instead of recompiling; the --step-stats compile "
+                   "field then shows the cache-hit time")
     p.add_argument("--ema-decay", type=float, default=0.0,
                    help="track an exponential moving average of params "
                    "(e.g. 0.999) and use it for --eval-every/--generate; "
@@ -221,10 +241,30 @@ def main() -> int:
             "with --dp/--tp (own vma-typed Pallas kernels, round 4); a "
             "sequence axis needs --attn ring/ulysses/zigzag"
         )
+    if args.grad_sync == "overlap" and args.experts and args.dp > 1:
+        p.error(
+            "--grad-sync overlap psums gradient buckets over the data "
+            "axis; expert-sharded leaves (--experts with --dp > 1) vary "
+            "over that axis - use --grad-sync end"
+        )
+    if args.bucket_mb <= 0:
+        p.error(f"--bucket-mb must be > 0, got {args.bucket_mb}")
 
-    from distributed_neural_network_tpu.train.cli import honor_platform_env
+    from distributed_neural_network_tpu.train.cli import (
+        enable_compilation_cache,
+        honor_platform_env,
+    )
 
     honor_platform_env()
+    if args.compilation_cache_dir:
+        if enable_compilation_cache(args.compilation_cache_dir):
+            print(f"(persistent compilation cache: "
+                  f"{args.compilation_cache_dir})")
+        else:
+            print("(WARNING: this jax version has no persistent "
+                  "compilation cache config; --compilation-cache-dir "
+                  "ignored)")
+            args.compilation_cache_dir = None
     import jax
     import jax.numpy as jnp
 
@@ -313,7 +353,8 @@ def main() -> int:
             loss_chunks=args.loss_chunks, interleave=args.pp_interleave,
             lr_schedule=pp_lr_schedule, clip_norm=args.clip_norm,
             weight_decay=args.weight_decay, optimizer=args.optimizer,
-            accum_steps=args.accum_steps,
+            accum_steps=args.accum_steps, grad_sync=args.grad_sync,
+            bucket_mb=args.bucket_mb,
         )
     else:
         mesh = lmtrain.create_lm_mesh(args.dp, args.sp, args.tp)
@@ -339,7 +380,8 @@ def main() -> int:
             attn_impl=args.attn, optimizer=args.optimizer,
             loss_chunks=args.loss_chunks, lr_schedule=lr_schedule,
             clip_norm=args.clip_norm, accum_steps=args.accum_steps,
-            weight_decay=args.weight_decay,
+            weight_decay=args.weight_decay, grad_sync=args.grad_sync,
+            bucket_mb=args.bucket_mb,
         )
 
     param_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
@@ -527,11 +569,38 @@ def main() -> int:
         # gradient sync rides the data (and seq) axes; tensor-sharded
         # leaves keep local grads - this over-counts those, an estimate
         n_sync = mesh.shape.get("data", 1) * mesh.shape.get("seq", 1)
+        overlap = args.grad_sync == "overlap" and args.accum_steps > 1
+        bucket_bytes_list = None
+        if overlap:
+            # the same deterministic plan the compiled step uses (leaf
+            # buckets grouped by PartitionSpec) - per-bucket bytes go to
+            # the StepStats summary and, below, in-band into the trace
+            from distributed_neural_network_tpu.parallel.collectives import (
+                plan_buckets,
+            )
+
+            layout = plan_buckets(
+                params, bucket_bytes=int(args.bucket_mb * 2**20),
+                group_keys=[
+                    str(s) for s in jax.tree.leaves(
+                        specs, is_leaf=lambda s: isinstance(s, P)
+                    )
+                ],
+            )
+            bucket_bytes_list = [int(b) for b in layout.bucket_bytes()]
+            comm_bytes = TRC.overlapped_collective_bytes(
+                bucket_bytes_list, n_sync, args.accum_steps
+            )
+        else:
+            comm_bytes = TRC.collective_bytes_per_sync(params, n_sync)
         stats = TRC.StepStats(
             item_label="tokens",
             sink=run if args.step_stats else None,
             n_devices=mesh.devices.size,
-            comm_bytes_per_step=TRC.collective_bytes_per_sync(params, n_sync),
+            comm_bytes_per_step=comm_bytes,
+            grad_sync=args.grad_sync,
+            comm_bucket_bytes=bucket_bytes_list,
+            compilation_cache_dir=args.compilation_cache_dir,
             flops_per_step=(
                 hw_flops if hw_flops is not None
                 else _mfpt(cfg, args.seq_len) * args.batch_size * args.seq_len
@@ -541,6 +610,13 @@ def main() -> int:
                 jax.devices()[0].device_kind, args.dtype
             ),
         )
+        if overlap and tracer.enabled:
+            TRC.record_bucket_plan(
+                tracer, bucket_bytes_list, schedule="overlap",
+                op=("reduce_scatter" if args.optimizer.startswith("zero")
+                    else "psum"),
+                axis_size=n_sync, accum_steps=args.accum_steps,
+            )
         step = lmtrain.make_traced_step(
             step, tracer=tracer, step_stats=stats,
             items_per_step=args.batch_size * args.seq_len,
@@ -693,6 +769,7 @@ def main() -> int:
     print("SUMMARY " + json.dumps({
         "mesh": mesh_desc, "steps": args.steps, "start_step": step0,
         "dtype": args.dtype, "pp_bubble_frac": bubble,
+        "grad_sync": args.grad_sync, "accum_steps": args.accum_steps,
         "data_source": stream.source if stream is not None else "copy-task",
         "eval": last_eval,
         "first_loss": first_loss, "final_loss": float(loss),

@@ -357,6 +357,12 @@ def test_keep_prior_measured_and_known_fail_rows():
     assert bench._keep_prior(
         kf, {"id": "b", "error": "XlaRuntimeError: RESOURCE_EXHAUSTED: "
              "XLA:TPU compile permanent error. Ran out of memory"})
+    # ...but a RUNTIME allocation OOM (co-tenant pressure, no compile
+    # marker) is transient: it must NOT permanently pin a known_fail row
+    # (recovery from a mis-pin either way: --refresh / --only re-measure)
+    assert not bench._keep_prior(
+        kf, {"id": "b", "error": "XlaRuntimeError: RESOURCE_EXHAUSTED: "
+             "Out of memory allocating 1073741824 bytes on device"})
 
 
 def test_worker_error_record_leads_with_the_exception(tmp_path, monkeypatch):

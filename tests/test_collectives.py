@@ -92,3 +92,117 @@ def test_straggler_sleep_logs(capsys):
     ]
     fault.straggler_sleep(np.array([1.0, 1.0]), 0.01, log=logs.append)
     assert len(logs) == 2  # no failures -> no logs
+
+
+# ------------------------------------------------- gradient leaf bucketing
+
+
+def _compat_shard_map(fn, mesh, in_specs, out_specs):
+    """shard_map without replication checking (explicit collectives only),
+    on whichever API this jax version carries - the bucketing helpers are
+    version-portable and tested as such."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(
+        fn, mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
+    )
+
+
+def _bucket_tree():
+    return {
+        "a": jnp.arange(6.0).reshape(2, 3),
+        "b": {"c": jnp.arange(4.0) + 10.0, "d": jnp.ones((3, 3))},
+    }
+
+
+def test_bucket_layout_roundtrip_and_determinism():
+    tree = _bucket_tree()
+    lay = collectives.plan_buckets(tree, bucket_bytes=40)
+    # 40 B cap: a(24B)+c(16B) fill bucket 0, d(36B) gets its own
+    assert lay.buckets == ((0, 2), (2, 3))
+    assert lay.bucket_elems() == (10, 9)
+    assert lay.bucket_bytes() == (40, 36)
+    assert lay.shard_sizes(4) == (3, 3)  # ceil-padded per bucket
+    # deterministic: identical plan from an identical tree
+    assert collectives.plan_buckets(tree, bucket_bytes=40).buckets == lay.buckets
+    out = collectives.unpack_buckets(lay, collectives.pack_buckets(lay, tree))
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(a, b), tree, out)
+    # padded buffers (reduce-scatter/all-gather round trips) truncate back
+    padded = [
+        jnp.concatenate([b, jnp.zeros(2, b.dtype)])
+        for b in collectives.pack_buckets(lay, tree)
+    ]
+    out = collectives.unpack_buckets(lay, padded)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(a, b), tree, out)
+
+
+def test_bucket_layout_group_keys_and_dtype_split():
+    tree = _bucket_tree()
+    # group keys split leaves that may not share a buffer (e.g. different
+    # PartitionSpecs), even under a cap that would merge them
+    lay = collectives.plan_buckets(
+        tree, bucket_bytes=1 << 20, group_keys=["x", "y", "y"]
+    )
+    assert lay.buckets == ((0, 1), (1, 3))
+    # dtype changes split too
+    mixed = {"a": jnp.zeros(4, jnp.float32), "b": jnp.zeros(4, jnp.bfloat16)}
+    lay = collectives.plan_buckets(mixed, bucket_bytes=1 << 20)
+    assert lay.n_buckets == 2
+    out = collectives.unpack_buckets(lay, collectives.pack_buckets(lay, mixed))
+    assert out["b"].dtype == jnp.bfloat16
+    # planning is shape-only: abstract leaves work (in-jit planning)
+    import jax.tree_util as jtu
+
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), tree
+    )
+    assert collectives.plan_buckets(abstract, bucket_bytes=40).buckets == (
+        (0, 2), (2, 3),
+    )
+    del jtu
+
+
+def test_bucketed_collectives_match_tree_psum(n_devices):
+    """bucketed_psum and the reduce-scatter + invariant all-gather round
+    trip both equal the per-leaf psum - the deterministic layout is
+    shared by both sides, so every element lands back where it left."""
+    mesh = create_mesh(4)
+    tree = _bucket_tree()
+
+    def f(_):
+        me = jax.lax.axis_index(DATA_AXIS)
+        local = jax.tree.map(lambda x: x * (1.0 + me), tree)
+        lay = collectives.plan_buckets(local, bucket_bytes=40)
+        summed = collectives.bucketed_psum(local, lay, (DATA_AXIS,))
+        meaned = collectives.bucketed_psum(
+            local, lay, (DATA_AXIS,), mean=True
+        )
+        shards = collectives.reduce_scatter_buckets(
+            local, lay, DATA_AXIS, axis_size=4
+        )
+        assert all(s.shape == (ss,) for s, ss in zip(shards, lay.shard_sizes(4)))
+        gathered = collectives.all_gather_buckets(
+            shards, lay, DATA_AXIS, axis_size=4
+        )
+        return summed, meaned, gathered
+
+    summed, meaned, gathered = jax.jit(
+        _compat_shard_map(f, mesh, (P(),), (P(), P(), P()))
+    )(jnp.zeros(()))
+    want = jax.tree.map(lambda x: x * 10.0, tree)  # 1+2+3+4
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6), summed, want
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b * 0.25, rtol=1e-6),
+        meaned, want,
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6),
+        gathered, want,
+    )

@@ -72,10 +72,26 @@ def test_kernel_ok_gate():
     assert not decode_kernel_ok(1736)  # bk 434
 
 
+def test_direct_call_enforces_kernel_contract():
+    """Calling the kernel directly at a sublane-illegal cache size gets
+    the documented ValueError from decode_cache_attention itself, not a
+    Mosaic tiling failure (total 17: largest divisor 17, not a multiple
+    of 16)."""
+    b, h, total, d = 1, 1, 17, 64
+    q = jnp.zeros((b, h, d), jnp.float32)
+    ck = jnp.zeros((b, h, total, d), jnp.float32)
+    cv = jnp.zeros((b, h, total, d), jnp.float32)
+    assert not decode_kernel_ok(total)
+    with pytest.raises(ValueError, match="sublane-legal"):
+        decode_cache_attention(q, ck, cv, 0, interpret=True)
+
+
 def test_generate_kernel_path_matches_xla(monkeypatch):
     """End-to-end: generate() with DNN_TPU_DECODE_IMPL=pallas-interpret
     produces the same greedy tokens as the XLA decode path (total = 32
-    is kernel-legal: bk 32, 32 % 8 == 0 - asserted below)."""
+    is kernel-legal: bk 32, and 32 % 16 == 0 - the block must tile
+    bf16's (16, 128) Mosaic sublane rule, decode_kernel_ok's gate -
+    asserted below)."""
     from distributed_neural_network_tpu.models import transformer as tfm
 
     cfg = tfm.TransformerConfig(

@@ -305,3 +305,294 @@ def test_clip_and_schedule_under_sequence_parallel(n_devices):
         losses.append(float(loss))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0] - 0.5, losses[:: len(losses) - 1]
+
+
+# ------------------------------------------- overlapped gradient sync
+
+
+requires_shard_map = pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="needs jax.shard_map with vma-typed autodiff",
+)
+
+
+def test_overlap_schedule_matches_end_schedule_toy(n_devices):
+    """Version-portable pin of the overlap schedule's math: on a real
+    4-device mesh with a toy quadratic loss, in-scan bucketed psum
+    accumulation and in-scan reduce-scatter (shard carry) accumulation
+    both reproduce end-sync gradients, and the shard carry really is
+    1/N-sized."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from distributed_neural_network_tpu.parallel import (
+        collectives as C,
+        zero as Z,
+    )
+
+    mesh = Mesh(
+        np.asarray(jax.devices()[:4]).reshape(4), (lmtrain.DATA_AXIS,)
+    )
+
+    def compat_shard_map(fn, in_specs, out_specs):
+        if hasattr(jax, "shard_map"):
+            return jax.shard_map(
+                fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=False,
+            )
+        from jax.experimental.shard_map import shard_map
+
+        return shard_map(
+            fn, mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )
+
+    params = {"w": jnp.arange(5.0), "b": jnp.ones((3,))}
+    tokens = jax.random.normal(jax.random.key(0), (16, 5))
+    targets = jax.random.normal(jax.random.key(1), (16, 5))
+
+    def fwd_bwd_one(p, tok, tgt):
+        def loss_fn(p):
+            pred = tok * p["w"] + p["b"].sum()
+            local = jnp.sum((pred - tgt) ** 2)
+            return jax.lax.psum(local, lmtrain.DATA_AXIS) / (
+                4.0 * tok.shape[0]
+            )
+
+        # grads stay LOCAL (no implicit psum under check_rep/vma=False):
+        # the explicit reducers below are the only sync - the overlap
+        # contract (train/lm.py varies params for the same effect)
+        return jax.value_and_grad(loss_fn)(p)
+
+    def end_path(p, tok, tgt):
+        loss, grads = S.accumulate_fwd_bwd(fwd_bwd_one, 4)(p, tok, tgt)
+        return loss, jax.tree.map(
+            lambda g: jax.lax.psum(g, lmtrain.DATA_AXIS), grads
+        )
+
+    def overlap_path(p, tok, tgt):
+        lay = C.plan_buckets(p, bucket_bytes=16)
+
+        def reduce_fn(g):
+            return tuple(
+                jax.lax.psum(b, (lmtrain.DATA_AXIS,))
+                for b in C.pack_buckets(lay, g)
+            )
+
+        return S.accumulate_fwd_bwd_overlap(
+            fwd_bwd_one, 4, reduce_fn=reduce_fn,
+            finalize_fn=lambda bufs: C.unpack_buckets(lay, list(bufs)),
+        )(p, tok, tgt)
+
+    def shard_path(p, tok, tgt):
+        lay = C.plan_buckets(p, bucket_bytes=16)
+        reduce_fn, finalize_fn = Z.make_overlap_grad_reducers(
+            lay, lmtrain.DATA_AXIS, 4
+        )
+        carry = reduce_fn(jax.tree.map(jnp.zeros_like, p))
+        assert sum(s.size for s in carry) == sum(
+            lay.shard_sizes(4)
+        ), "shard carry must be 1/N per bucket"
+        return S.accumulate_fwd_bwd_overlap(
+            fwd_bwd_one, 4, reduce_fn=reduce_fn, finalize_fn=finalize_fn
+        )(p, tok, tgt)
+
+    specs = (P(), P(lmtrain.DATA_AXIS), P(lmtrain.DATA_AXIS))
+    run = lambda f: jax.jit(  # noqa: E731
+        compat_shard_map(f, specs, (P(), P()))
+    )(params, tokens, targets)
+    loss_end, g_end = run(end_path)
+    loss_ov, g_ov = run(overlap_path)
+    loss_sh, g_sh = run(shard_path)
+    assert np.isclose(float(loss_end), float(loss_ov), rtol=1e-6)
+    assert np.isclose(float(loss_end), float(loss_sh), rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+        ),
+        g_end, g_ov,
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+        ),
+        g_end, g_sh,
+    )
+
+
+def test_overlap_requires_two_microbatches():
+    with pytest.raises(ValueError, match="accum_steps >= 2"):
+        S.accumulate_fwd_bwd_overlap(
+            lambda p, a, b: (0.0, p), 1,
+            reduce_fn=lambda g: g, finalize_fn=lambda g: g,
+        )
+
+
+def test_overlap_rejects_expert_parallelism(n_devices):
+    cfg = tfm.TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        n_experts=4,
+    )
+    mesh = lmtrain.create_lm_mesh(2, 1, 1)
+    with pytest.raises(ValueError, match="overlap.*expert|expert.*overlap"):
+        lmtrain.make_lm_train_step(
+            cfg, mesh, attn_impl="full", grad_sync="overlap", accum_steps=2
+        )
+    with pytest.raises(ValueError, match="grad_sync"):
+        lmtrain.make_lm_train_step(
+            CFG, mesh, attn_impl="full", grad_sync="sometimes"
+        )
+
+
+def _step_params(mesh, optimizer="sgd", **kw):
+    params0 = tfm.init_params(jax.random.key(0), CFG)
+    params, _ = lmtrain.shard_params(params0, CFG, mesh)
+    mom = lmtrain.init_lm_momentum(params, mesh, optimizer)
+    step = lmtrain.make_lm_train_step(
+        CFG, mesh, lr=0.1, attn_impl="full", optimizer=optimizer, **kw
+    )
+    return step, params, mom
+
+
+@requires_shard_map
+@pytest.mark.parametrize("accum", [1, 2, 4])
+def test_overlap_matches_end_dp(n_devices, accum):
+    """dp2, k in {1,2,4}: overlap == end up to float reassociation; at
+    k=1 the schedules coincide and results are bitwise identical."""
+    mesh = lmtrain.create_lm_mesh(2, 1, 1)
+    tokens, targets = lmtrain.make_copy_task(
+        jax.random.key(8), batch=8, seq_len=16, vocab=CFG.vocab_size
+    )
+
+    def run(grad_sync):
+        step, params, mom = _step_params(
+            mesh, accum_steps=accum, grad_sync=grad_sync, bucket_mb=0.001
+        )
+        params, mom, loss = step(params, mom, tokens, targets)
+        return float(loss), params
+
+    l_end, p_end = run("end")
+    l_ov, p_ov = run("overlap")
+    assert np.isclose(l_end, l_ov, rtol=1e-5), (l_end, l_ov)
+    if accum == 1:
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)
+            ),
+            p_end, p_ov,
+        )
+    else:
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6
+            ),
+            p_end, p_ov,
+        )
+
+
+@requires_shard_map
+@pytest.mark.parametrize("optimizer", ["zero", "zero-adam"])
+@pytest.mark.parametrize("accum", [1, 2, 4])
+def test_overlap_matches_end_zero(n_devices, optimizer, accum):
+    """ZeRO shard-carry overlap vs end on dp4: bitwise at k=1 (the
+    schedules coincide - the acceptance contract), reassociation-level
+    at k>1; momentum shards must agree too (the optimizer consumed the
+    same gradients)."""
+    mesh = lmtrain.create_lm_mesh(4, 1, 1)
+    tokens, targets = lmtrain.make_copy_task(
+        jax.random.key(9), batch=8, seq_len=16, vocab=CFG.vocab_size
+    )
+
+    def run(grad_sync):
+        step, params, mom = _step_params(
+            mesh, optimizer=optimizer, accum_steps=accum,
+            grad_sync=grad_sync, bucket_mb=0.001,
+        )
+        params, mom, loss = step(params, mom, tokens, targets)
+        return float(loss), params, mom
+
+    l_end, p_end, m_end = run("end")
+    l_ov, p_ov, m_ov = run("overlap")
+    assert np.isclose(l_end, l_ov, rtol=1e-5), (l_end, l_ov)
+    if accum == 1:
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)
+            ),
+            (p_end, m_end), (p_ov, m_ov),
+        )
+    else:
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=5e-4, atol=1e-6
+            ),
+            (p_end, m_end), (p_ov, m_ov),
+        )
+
+
+@requires_shard_map
+@pytest.mark.slow
+def test_overlap_matches_end_with_tp_and_clip(n_devices):
+    """dp2 x tp2 + clip: the spec-grouped buckets keep tensor-sharded
+    leaves in their own buffers (their grads stay varying over 'model'),
+    and the sharding-aware clip sees identical global norms."""
+    mesh = lmtrain.create_lm_mesh(2, 1, 2)
+    tokens, targets = lmtrain.make_copy_task(
+        jax.random.key(10), batch=8, seq_len=16, vocab=CFG.vocab_size
+    )
+
+    def run(grad_sync):
+        step, params, mom = _step_params(
+            mesh, accum_steps=2, grad_sync=grad_sync, bucket_mb=0.001,
+            clip_norm=1.0,
+        )
+        params, mom, loss = step(params, mom, tokens, targets)
+        return float(loss), params
+
+    l_end, p_end = run("end")
+    l_ov, p_ov = run("overlap")
+    assert np.isclose(l_end, l_ov, rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=1e-6
+        ),
+        p_end, p_ov,
+    )
+
+
+@requires_shard_map
+@pytest.mark.slow
+def test_overlap_matches_end_pipeline(n_devices):
+    """pp2 (x dp2) pipeline path: data-axis bucketed overlap under the
+    microbatch schedule matches end-sync accumulation."""
+    from distributed_neural_network_tpu.parallel import pipeline as ppl
+
+    cfg = tfm.TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64
+    )
+    mesh = ppl.create_pp_mesh(2, 2, 1)
+    tokens, targets = lmtrain.make_copy_task(
+        jax.random.key(11), batch=8, seq_len=16, vocab=cfg.vocab_size
+    )
+
+    def run(grad_sync):
+        params0 = tfm.init_params(jax.random.key(0), cfg)
+        params, _ = ppl.shard_pp_params(params0, cfg, mesh)
+        from distributed_neural_network_tpu.ops.sgd import init_momentum
+
+        mom = init_momentum(params)
+        step = ppl.make_pp_train_step(
+            cfg, mesh, n_microbatches=2, lr=0.1, accum_steps=2,
+            grad_sync=grad_sync, bucket_mb=0.001,
+        )
+        params, mom, loss = step(params, mom, tokens, targets)
+        return float(loss), params
+
+    l_end, p_end = run("end")
+    l_ov, p_ov = run("overlap")
+    assert np.isclose(l_end, l_ov, rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=1e-6
+        ),
+        p_end, p_ov,
+    )

@@ -338,3 +338,96 @@ def test_jsonl_sink_flush_makes_events_durable(tmp_path):
 def test_null_sink_has_flush():
     M.NullSink().flush()
     M.MetricsRun([M.NullSink()]).flush()
+
+
+# -------------------------------------- gradient-sync schedule telemetry
+
+
+def test_record_bucket_plan_lands_per_bucket_events_in_chrome_trace(tmp_path):
+    """The overlap schedule's bucket plan must be readable from the trace
+    file alone: one grad_bucket event per bucket with payload bytes, op,
+    schedule, and mesh-axis size in args, on its own 'collective' track -
+    planned from a REAL parameter tree through the same layout helper the
+    compiled step uses."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_neural_network_tpu.parallel.collectives import (
+        plan_buckets,
+    )
+
+    params = {
+        "embed": jnp.zeros((64, 16)),
+        "layers": {"w1": jnp.zeros((2, 16, 32)), "w2": jnp.zeros((2, 32, 16))},
+        "head": jnp.zeros((16, 64)),
+    }
+    layout = plan_buckets(params, bucket_bytes=4096)
+    bucket_bytes = [int(b) for b in layout.bucket_bytes()]
+    assert len(bucket_bytes) >= 2  # the cap actually split the tree
+
+    tracer = tr.Tracer()
+    with tracer.span(tr.TRAIN_STEP, track="train", step=0):
+        pass
+    tr.record_bucket_plan(
+        tracer, bucket_bytes, schedule="overlap", op="reduce_scatter",
+        axis_size=4, accum_steps=2,
+    )
+    path = tracer.export(str(tmp_path / "trace.json"))
+    doc = _strict_loads(open(path).read())
+    events = [
+        e for e in doc["traceEvents"] if e.get("name") == tr.GRAD_BUCKET
+    ]
+    assert len(events) == len(bucket_bytes)
+    for i, ev in enumerate(events):
+        assert ev["ph"] == "i"
+        assert ev["args"]["bucket"] == i
+        assert ev["args"]["bytes"] == bucket_bytes[i]
+        assert ev["args"]["op"] == "reduce_scatter"
+        assert ev["args"]["schedule"] == "overlap"
+        assert ev["args"]["axis_size"] == 4
+        assert ev["args"]["per_microbatch"] == 2
+    # the bucket events ride their own named track, beside train_step
+    tracks = {
+        e["args"]["name"]: e["tid"]
+        for e in doc["traceEvents"] if e["ph"] == "M" and e["name"] == "thread_name"
+    }
+    assert "collective" in tracks
+    assert all(e["tid"] == tracks["collective"] for e in events)
+    del jax
+
+
+def test_step_stats_reports_bucketed_schedule():
+    """StepStats carries the schedule attribution: summary exposes
+    grad_sync + per-bucket bytes, report() prints them, and the
+    overlapped per-step byte estimate scales with accumulation."""
+    s = tr.StepStats(
+        n_devices=4,
+        comm_bytes_per_step=tr.overlapped_collective_bytes(
+            [1000, 500], 4, accum_steps=2
+        ),
+        grad_sync="overlap",
+        comm_bucket_bytes=[1000, 500],
+    )
+    s.record(0, 0.5)
+    summ = s.summary()
+    assert summ["grad_sync"] == "overlap"
+    assert summ["comm_buckets"] == {
+        "count": 2, "bytes_per_bucket": [1000, 500],
+    }
+    # ring cost of the bucketed tree, once per microbatch: 2 * 3/4 * 1500 * 2
+    assert summ["comm_bytes_per_step"] == 4500
+    rep = s.report()
+    assert "schedule: overlap" in rep
+    assert "2 per microbatch" in rep
+    # end-schedule stats stay exactly as before (no bucket line)
+    s2 = tr.StepStats(comm_bytes_per_step=100)
+    s2.record(0, 0.5)
+    assert s2.summary()["grad_sync"] is None
+    assert "gradient buckets" not in s2.report()
+    assert tr.overlapped_collective_bytes([100], 1) == 0  # single device
+
+
+def test_step_stats_records_compilation_cache_provenance():
+    s = tr.StepStats(compilation_cache_dir="/tmp/jaxcache")
+    assert s.summary()["compilation_cache_dir"] == "/tmp/jaxcache"
+    assert tr.StepStats().summary()["compilation_cache_dir"] is None
