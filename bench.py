@@ -237,6 +237,17 @@ def _rows(epochs: int) -> list[dict]:
                      "bucket_mb": 16},
         },
         {
+            # guard-overhead A/B at the flagship shape: guard off vs
+            # --guard warn (health bundle in-jit + one-step-lagged host
+            # observation, train/guard.py). The row asserts two matrix
+            # facts: within_budget (<1% steady-step overhead) and
+            # final_loss_bitwise_equal (warn mode is observation-only)
+            "id": "lm_guard_overhead_d512_L8_seq2048_bf16",
+            "kind": "guard_overhead",
+            "est_s": 600,
+            "args": {"attn": "flash", "dtype": "bfloat16", "steps": 20},
+        },
+        {
             # remat: the XLA path materializes (B, H, S, S) scores, which
             # OOMs a 16 GB v5e at these shapes without recompute (measured
             # r3); flash needs no remat - that contrast is the point
@@ -547,6 +558,12 @@ def _run_worker(spec: dict) -> dict:
         )
 
         return measure_lm_training(**spec["args"])
+    if spec["kind"] == "guard_overhead":
+        from distributed_neural_network_tpu.train.measure import (
+            measure_guard_overhead,
+        )
+
+        return measure_guard_overhead(**spec["args"])
     if spec["kind"] == "lm_decode":
         from distributed_neural_network_tpu.train.measure import (
             measure_lm_decode,

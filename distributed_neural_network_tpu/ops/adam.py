@@ -74,3 +74,29 @@ def adam_step(
     inner = jax.tree.structure((0, 0, 0))
     p_new, m, v = jax.tree.transpose(outer, inner, new)
     return p_new, {"m": m, "v": v, "t": t}
+
+
+def guarded_adam_step(
+    params,
+    state,
+    grads,
+    lr,
+    *,
+    ok,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+):
+    """`adam_step` gated on the traced scalar `ok`: when False the whole
+    update passes through unchanged - params, both moments, AND the step
+    counter `t` (a skipped step must not advance the bias correction) -
+    the guard's in-jit 'skip' (train/guard.py). With `ok=True` the result
+    is bitwise identical to the unguarded path."""
+    from .schedule import tree_where
+
+    new_p, new_s = adam_step(
+        params, state, grads, lr, b1=b1, b2=b2, eps=eps,
+        weight_decay=weight_decay,
+    )
+    return tree_where(ok, new_p, params), tree_where(ok, new_s, state)

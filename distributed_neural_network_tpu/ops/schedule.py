@@ -123,6 +123,40 @@ def apply_decoupled_weight_decay(params, lr_t, weight_decay: float):
     return jax.tree.map(lambda p: p - lr_t * weight_decay * p, params)
 
 
+def health_bundle(loss, grad_norm):
+    """O(1) in-jit health signals for the guard layer (train/guard.py).
+
+    Both inputs are scalars the step already computed - the loss and the
+    global gradient norm (`clip_by_global_norm` returns it; unclipped
+    guarded steps call `global_norm` once). The all-finite flag is DERIVED
+    from them: a NaN/Inf anywhere in the gradient tree makes the global
+    norm non-finite (squares and sums propagate it), so no second pass
+    over the parameters is needed. All three values are replicated across
+    the mesh (loss and the sharding-aware norm already are), so every
+    device - and the host policy loop - sees the same verdict.
+    """
+    loss32 = jnp.asarray(loss, jnp.float32)
+    norm32 = jnp.asarray(grad_norm, jnp.float32)
+    return {
+        "loss": loss32,
+        "grad_norm": norm32,
+        "all_finite": jnp.isfinite(loss32) & jnp.isfinite(norm32),
+    }
+
+
+def tree_where(ok, new_tree, old_tree):
+    """Per-leaf `jnp.where(ok, new, old)` on a traced scalar predicate.
+
+    The guard's in-jit 'skip': when `ok` is False the whole update
+    (params AND optimizer state, including Adam's step counter) passes
+    through unchanged - one select per leaf, no host round-trip, no
+    recompile, so a NaN'd step costs one wasted fwd/bwd and nothing else.
+    """
+    return jax.tree.map(
+        lambda a, b: jnp.where(ok, a, b), new_tree, old_tree
+    )
+
+
 def accumulate_fwd_bwd(fwd_bwd_one, accum_steps: int):
     """Wrap a per-micro-batch (params, tokens, targets) -> (loss, grads)
     into a k-step gradient-accumulation scan over B/k-row slices.

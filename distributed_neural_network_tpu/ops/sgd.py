@@ -26,3 +26,18 @@ def sgd_step(params, mom, grads, lr: float, momentum: float):
     mom = jax.tree.map(lambda m, g: momentum * m + g, mom, grads)
     params = jax.tree.map(lambda p, m: p - lr * m, params, mom)
     return params, mom
+
+
+def guarded_sgd_step(
+    params, mom, grads, lr, momentum, *, ok, weight_decay: float = 0.0
+):
+    """`sgd_step` (+ optional decoupled decay) gated on the traced scalar
+    `ok`: when False the entire update - params AND momentum - is dropped
+    inside the compiled step (ops/schedule.py tree_where), which is the
+    guard's in-jit 'skip' for non-finite gradients (train/guard.py). With
+    `ok=True` the result is bitwise identical to the unguarded path."""
+    from .schedule import apply_decoupled_weight_decay, tree_where
+
+    new_p, new_m = sgd_step(params, mom, grads, lr, momentum)
+    new_p = apply_decoupled_weight_decay(new_p, lr, weight_decay)
+    return tree_where(ok, new_p, params), tree_where(ok, new_m, mom)

@@ -1,4 +1,5 @@
-"""Fault injection: seeded per-epoch device failure simulation.
+"""Fault injection: seeded per-epoch device failure simulation plus
+step-granularity chaos injectors for the guard layer.
 
 Parity with `simulate_failure` (`data_parallelism_train.py:41-46`): each
 epoch, each worker fails independently with probability
@@ -13,11 +14,22 @@ so the original straggler wall-clock semantics remain reproducible.
 
 All randomness is explicit JAX PRNG (the reference's `np.random.rand()` at
 `:43` is unseeded - SURVEY.md section 5.2 calls for seeding as the fix).
+
+Step-granularity injectors (this repo's addition, for `train/guard.py`):
+`StepFaultPlan` corrupts gradients/loss INSIDE the compiled step at chosen
+step indices (so the guard's in-jit skip path is exercised under jit, not
+simulated), and `ChaosMonkey` perturbs the host-side observation stream /
+delivers a real SIGTERM at a step boundary - each host fault fires exactly
+once, so a rollback that replays the step does not re-trip it (the
+transient-fault model; a recurring fault is what the retry budget is for).
 """
 
 from __future__ import annotations
 
+import os
+import signal as _signal
 import time
+from dataclasses import dataclass, field
 
 import jax
 import jax.numpy as jnp
@@ -43,21 +55,141 @@ def epoch_key(seed: int, epoch: int) -> jax.Array:
     return jax.random.fold_in(jax.random.key(seed ^ 0x5EED_FA17), epoch)
 
 
-def straggler_sleep(mask_host, failure_duration: float, *, log=print) -> None:
+def straggler_sleep(mask_host, failure_duration: float, *, log=print,
+                    tracer=None) -> None:
     """Optional host-side sleep preserving the reference's straggler timing.
 
     The reference sleeps inside the worker process (`:44`); here the epoch
-    dispatch stalls for `failure_duration` seconds per failed device's epoch
-    if the caller opts in (duration > 0), logging the same fail/wake lines.
+    dispatch stalls for `failure_duration` seconds per failed EPOCH (one
+    sleep total, however many devices failed), logging the same fail/wake
+    lines per device. That matches the reference's observable wall-clock:
+    its workers sleep CONCURRENTLY (each in its own process), so k
+    simultaneous failures stall the epoch by one duration, not k - the
+    per-device log lines describe who failed, not serialized stalls.
+
+    `tracer` (utils/tracing.py Tracer) surfaces the stall as a
+    ``straggler`` span on the ``fault`` track, so a Perfetto reader sees
+    the dead time attributed to fault simulation instead of an
+    unexplained gap between epochs (it is host wall time by construction
+    - nothing is dispatched during the sleep).
     """
     if failure_duration <= 0.0:
         return
     failed = [d for d, live in enumerate(mask_host) if not live]
+    if not failed:
+        return
     for d in failed:
         log(
             f"Device {d} failed! Sleeping for {failure_duration} seconds."
         )
-    if failed:
+    if tracer is None:
+        from ..utils import tracing as _tracing
+
+        tracer = _tracing.NULL_TRACER
+    with tracer.span(
+        "straggler", track="fault", failed_devices=failed,
+        duration_s=float(failure_duration),
+    ):
         time.sleep(failure_duration)
-        for d in failed:
-            log(f"Device {d} woke up!")
+    for d in failed:
+        log(f"Device {d} woke up!")
+
+
+# ---------------------------------------------------- step-level injectors
+
+
+@dataclass(frozen=True)
+class StepFaultPlan:
+    """Compile-time plan for in-jit step faults (train/lm.py wires it into
+    `make_lm_train_step(fault_plan=...)`; the step then requires the traced
+    step index argument).
+
+    nan_grads_at: step indices whose gradient tree is replaced with NaN
+      AFTER the backward - the all-finite health flag drops and the 'skip'
+      policy's in-jit `tree_where` must pass params/momentum through.
+    spike_loss_at: step indices whose (reported) loss is multiplied by
+      `spike_scale` inside the step - the EMA spike detector's in-band
+      trigger. The gradients are left untouched (the simulated failure is
+      a diverging loss signal, not a corrupted backward).
+    """
+
+    nan_grads_at: tuple = ()
+    spike_loss_at: tuple = ()
+    spike_scale: float = 100.0
+
+    def __post_init__(self):
+        object.__setattr__(
+            self, "nan_grads_at", tuple(int(s) for s in self.nan_grads_at)
+        )
+        object.__setattr__(
+            self, "spike_loss_at", tuple(int(s) for s in self.spike_loss_at)
+        )
+
+    def __bool__(self):
+        return bool(self.nan_grads_at or self.spike_loss_at)
+
+
+def _at(step_i, steps: tuple):
+    """Traced predicate: step_i is one of the (static) `steps`."""
+    return (jnp.asarray(steps, jnp.int32) == jnp.asarray(step_i, jnp.int32)).any()
+
+
+def inject_step_faults(step_i, loss, grads, plan: StepFaultPlan):
+    """Apply `plan` to one step's (loss, grads) under jit/shard_map.
+
+    `step_i` is the traced step index (invariant across the mesh), so the
+    same fault fires on every device - no divergence. Returns (loss,
+    grads) unchanged at un-listed steps; the fault-free program with an
+    empty plan is the unmodified one (callers pass plan=None to compile
+    nothing at all).
+    """
+    if plan.nan_grads_at:
+        bad = _at(step_i, plan.nan_grads_at)
+        grads = jax.tree.map(
+            lambda g: jnp.where(bad, jnp.asarray(jnp.nan, g.dtype), g), grads
+        )
+    if plan.spike_loss_at:
+        spike = _at(step_i, plan.spike_loss_at)
+        loss = jnp.where(spike, loss * plan.spike_scale, loss)
+    return loss, grads
+
+
+@dataclass
+class ChaosMonkey:
+    """Host-side chaos for the guard's observation path and the
+    preemption handler - each listed fault fires EXACTLY ONCE, so a
+    rollback that replays the step sees a healthy re-run (the transient
+    model the rollback policy is designed for; in-jit `StepFaultPlan`
+    faults, by contrast, recur on replay and exercise the retry budget).
+
+    spike_at: step indices whose OBSERVED loss is multiplied by
+      `spike_scale` before the guard sees it (plug `perturb` into
+      `train/guard.py HealthPipe(perturb=...)`).
+    sigterm_after: after this step completes, deliver a real SIGTERM to
+      this process (`after_step`), driving the PreemptionGuard ->
+      emergency-checkpoint -> exact-resume path end to end.
+    """
+
+    spike_at: tuple = ()
+    spike_scale: float = 100.0
+    sigterm_after: int | None = None
+    log: object = print
+    _fired: set = field(default_factory=set)
+
+    def perturb(self, step, loss, grad_norm, all_finite):
+        if step in self.spike_at and ("spike", step) not in self._fired:
+            self._fired.add(("spike", step))
+            self.log(f"(chaos: spiking observed loss at step {step} "
+                     f"x{self.spike_scale:g})")
+            loss = loss * self.spike_scale
+        return loss, grad_norm, all_finite
+
+    def after_step(self, step) -> None:
+        if (
+            self.sigterm_after is not None
+            and step == self.sigterm_after
+            and "sigterm" not in self._fired
+        ):
+            self._fired.add("sigterm")
+            self.log(f"(chaos: delivering SIGTERM after step {step})")
+            os.kill(os.getpid(), _signal.SIGTERM)

@@ -269,6 +269,9 @@ def make_zero_split_step(
     clip_fn=None,
     axis_name: str = "data",
     check_vma: bool = True,
+    with_health: bool = False,
+    skip_nonfinite: bool = False,
+    fault_plan=None,
 ):
     """Shared two-shard_map ZeRO-1 step orchestration.
 
@@ -287,6 +290,16 @@ def make_zero_split_step(
     doubles as Adam's b1 so a single --momentum flag drives every
     optimizer. Returns the jitted (params, mom, tokens, targets[, step])
     -> (params, mom, loss) with params/mom donated.
+
+    Guard hooks (train/guard.py, mirroring train/lm.py's mesh path):
+    zero forbids tp/ep, so between the two shard_maps the gradients are
+    full replicated arrays at the jit level - the health bundle (loss,
+    global grad-norm, derived finite flag), the in-jit skip gate, and
+    fault injection all happen there with plain (non-collective) ops.
+    One O(D) float32 norm reduction is added when health is on without
+    clipping (with clip_fn the norm runs inside the optimizer shard_map
+    regardless; the health norm is the same value computed where the
+    bundle needs it). `fault_plan` forces the step-index signature.
     """
     import jax.numpy as _jnp
     from jax.sharding import PartitionSpec as _P
@@ -325,15 +338,37 @@ def make_zero_split_step(
         check_vma=False,
     )
 
+    if fault_plan is not None and not fault_plan:
+        fault_plan = None
+    want_health = with_health or skip_nonfinite
+
     def zero_step(params, mom, tokens, targets, step_i=None):
         loss, grads = grad_fn(params, tokens, targets)
+        if fault_plan is not None:
+            from .fault import inject_step_faults
+
+            loss, grads = inject_step_faults(step_i, loss, grads, fault_plan)
+        health = None
+        if want_health:
+            from ..ops.schedule import global_norm, health_bundle
+
+            health = health_bundle(loss, global_norm(grads))
         lr_t = _jnp.float32(lr) if lr_schedule is None else _jnp.float32(
             lr_schedule(step_i)
         )
-        params, mom = opt_fn(params, mom, grads, lr_t)
-        return params, mom, loss
+        new_p, new_m = opt_fn(params, mom, grads, lr_t)
+        if want_health:
+            if skip_nonfinite:
+                from ..ops.schedule import tree_where
 
-    if lr_schedule is not None:
+                ok = health["all_finite"]
+                new_p = tree_where(ok, new_p, params)
+                new_m = tree_where(ok, new_m, mom)
+            return new_p, new_m, loss, health
+        return new_p, new_m, loss
+
+    has_step = lr_schedule is not None or fault_plan is not None
+    if has_step:
         return jax.jit(
             lambda p, m, a, b, s: zero_step(p, m, a, b, s),
             donate_argnums=(0, 1),

@@ -131,6 +131,46 @@ def add_common_flags(p: argparse.ArgumentParser, *, epochs: int, batch_size: int
         "with --failure-duration > 0 (straggler sleeps can only interleave "
         "between epochs) or --input-mode stream",
     )
+    # self-healing guard layer (train/guard.py, docs/ROBUSTNESS.md)
+    p.add_argument(
+        "--guard",
+        choices=("off", "warn", "skip", "rollback", "abort"),
+        default="off",
+        help="per-epoch training guard: warn = count/log anomalies "
+        "(non-finite loss, EMA loss spikes); skip = drop an anomalous "
+        "epoch's update (pre-epoch snapshot restored); rollback = restore "
+        "the rolling snapshot and retry with LR backoff (bounded by "
+        "--max-retries); abort = stop with an actionable error",
+    )
+    p.add_argument(
+        "--guard-spike-zscore",
+        type=float,
+        default=6.0,
+        help="loss-spike threshold in EMA standard deviations "
+        "(anomaly when loss > mean + z*sigma; non-finite always counts)",
+    )
+    p.add_argument(
+        "--snapshot-every",
+        type=int,
+        default=1,
+        help="epochs between the guard's rolling in-memory host snapshots "
+        "(a rollback rewinds at most this far)",
+    )
+    p.add_argument(
+        "--max-retries",
+        type=int,
+        default=3,
+        help="guard rollback budget before abort (refills after a stretch "
+        "of healthy epochs)",
+    )
+    p.add_argument(
+        "--on-sigterm",
+        choices=("checkpoint", "ignore"),
+        default="checkpoint",
+        help="checkpoint = on SIGTERM/SIGINT finish the current epoch, "
+        "write an emergency checkpoint (when --checkpoint-dir is set) and "
+        "exit cleanly for exact resume; ignore = default signal behavior",
+    )
     p.add_argument(
         "--profile-dir",
         default=None,
@@ -383,6 +423,28 @@ def run_training(args, regime: str, *, log=print) -> Engine:
                     "--checkpoint-backend match the original run)"
                 )
 
+    # self-healing layer (train/guard.py): per-epoch policy guard +
+    # cooperative preemption -> emergency checkpoint at the epoch boundary
+    from .guard import GuardConfig, PreemptionGuard, TrainingGuard
+
+    guard = None
+    if getattr(args, "guard", "off") != "off":
+        guard = TrainingGuard(
+            GuardConfig(
+                policy=args.guard,
+                spike_zscore=getattr(args, "guard_spike_zscore", 6.0),
+                snapshot_every=getattr(args, "snapshot_every", 1),
+                max_retries=getattr(args, "max_retries", 3),
+                # one observation per epoch: arm the spike detector after
+                # a few epochs rather than the step-scale default
+                warmup_steps=3,
+            ),
+            tracer=tracer, step_stats=stats, log=log,
+        )
+    preemption = None
+    if getattr(args, "on_sigterm", "ignore") == "checkpoint":
+        preemption = PreemptionGuard(log=log).install()
+
     profile_dir = getattr(args, "profile_dir", None)
     if profile_dir:
         import jax
@@ -397,6 +459,8 @@ def run_training(args, regime: str, *, log=print) -> Engine:
             checkpointer=checkpointer,
             start_epoch=start_epoch,
             fused=getattr(args, "fused", False),
+            guard=guard,
+            preemption=preemption,
         )
     finally:
         if profile_dir:
@@ -415,7 +479,12 @@ def run_training(args, regime: str, *, log=print) -> Engine:
             log(f"(Profiler trace written to {profile_dir})")
         if checkpointer is not None:
             checkpointer.close()
+        if preemption is not None:
+            preemption.uninstall()
     wall = time.perf_counter() - t0
+
+    if guard is not None:
+        log(f"(guard summary: {json.dumps(guard.summary())})")
 
     if stats is not None and want_stats:
         for line in stats.report().splitlines():
@@ -453,6 +522,8 @@ def run_training(args, regime: str, *, log=print) -> Engine:
     summary = {
         "regime": regime,
         "epochs": cfg.epochs,
+        "guard": getattr(args, "guard", "off"),
+        "preempted": bool(preemption.requested) if preemption else False,
         "final_train_loss": engine.history[-1].train_loss if engine.history else None,
         "final_val_acc": engine.history[-1].val_acc if engine.history else None,
         "best_val_acc": best.val_acc if best else None,

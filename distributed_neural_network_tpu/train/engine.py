@@ -774,7 +774,7 @@ class Engine:
         # data_parallelism_train.py:117,141)
         mask = live_mask(epoch_key(c.seed, epoch), self.n_workers, c.failure_probability)
         mask_host = np.asarray(mask)
-        straggler_sleep(mask_host, c.failure_duration)
+        straggler_sleep(mask_host, c.failure_duration, tracer=tracer)
 
         # the tracer span closes AFTER timers.phase's hard_block fence, so
         # span duration is device time, not dispatch time; step stats reuse
@@ -848,6 +848,8 @@ class Engine:
         checkpointer=None,
         start_epoch: int = 0,
         fused: bool = False,
+        guard=None,
+        preemption=None,
     ) -> list[EpochMetrics]:
         """Full training run; `run` is a MetricsRun-like sink (utils.metrics);
         `checkpointer` a utils.checkpoint.Checkpointer saving at epoch edges;
@@ -856,7 +858,22 @@ class Engine:
         split only at checkpoint/eval boundaries) instead of one dispatch per
         phase per epoch - the fast path. Straggler sleeps (`failure_duration`)
         force the per-epoch path, which is the only mode where they can
-        interleave with epochs."""
+        interleave with epochs.
+
+        `guard` (train/guard.py TrainingGuard) makes the run self-checking
+        at epoch granularity - one engine dispatch IS one step here, so the
+        guard observes each epoch's global train loss: 'warn' counts/logs,
+        'skip' drops an anomalous epoch's whole update (pre-epoch snapshot
+        restored, training continues at the next epoch), 'rollback'
+        restores the rolling snapshot, scales the LR down (rebuilding the
+        compiled steps - a recompile per retry, bounded by the budget) and
+        re-runs from the snapshot epoch, 'abort' raises GuardAbort. The
+        guard forces the per-epoch path (a fused span cannot be observed
+        mid-dispatch). `preemption` (PreemptionGuard): when a SIGTERM/
+        SIGINT flag is up at an epoch boundary, an emergency checkpoint of
+        the completed epochs is written (when `checkpointer` is given) and
+        the run returns early - resume replays the exact remaining epochs.
+        """
         if fused and self.config.input_mode == "stream":
             log(
                 "(fused mode needs HBM-resident data; input_mode=stream "
@@ -869,6 +886,12 @@ class Engine:
                 "sleeps; using the per-epoch path)"
             )
             fused = False
+        if fused and guard is not None:
+            log(
+                "(fused mode cannot observe per-epoch health inside one "
+                "dispatch; --guard uses the per-epoch path)"
+            )
+            fused = False
         if fused:
             return self._run_fused(
                 timers=timers,
@@ -877,11 +900,50 @@ class Engine:
                 eval_every=eval_every,
                 checkpointer=checkpointer,
                 start_epoch=start_epoch,
+                preemption=preemption,
             )
-        for epoch in range(start_epoch, self.config.epochs):
+        base_lr = self.config.lr
+        epoch = start_epoch
+        while epoch < self.config.epochs:
+            if preemption is not None and preemption.requested:
+                self._emergency_save(
+                    epoch - 1, checkpointer, preemption, log
+                )
+                break
+            if guard is not None:
+                guard.maybe_snapshot(
+                    epoch, self.state_tree(), first_step=start_epoch
+                )
             log(f"Starting epoch  {epoch}")
             do_eval = eval_every > 0 and (epoch + 1) % eval_every == 0
             m = self.run_epoch(epoch, timers=timers, do_eval=do_eval)
+            if guard is not None:
+                v = guard.observe(epoch, m.train_loss)
+                if v.action == "skip" and guard.has_snapshot:
+                    # drop this epoch's whole update: restore the pre-epoch
+                    # params/momentum and move on (the anomalous metrics
+                    # stay in history - they describe what happened)
+                    snap_epoch, state = guard.peek_snapshot()
+                    self.load_state_tree(state)
+                    log(f"(guard: epoch {epoch} update dropped; params "
+                        f"restored to epoch {snap_epoch} snapshot)")
+                elif v.action == "rollback":
+                    rb = guard.rollback()  # raises GuardAbort on budget
+                    if rb is not None:
+                        snap_epoch, state = rb
+                        self.load_state_tree(state)
+                        # LR backoff is compile-time here: rebuild the
+                        # step functions at the scaled LR (one recompile
+                        # per retry, bounded by max_retries)
+                        self.config.lr = base_lr * guard.lr_scale
+                        self._build_steps()
+                        self.history = [
+                            h for h in self.history if h.epoch < snap_epoch
+                        ]
+                        epoch = snap_epoch
+                        continue
+                    log("(guard: rollback requested but no snapshot yet; "
+                        "continuing with a warning)")
             log(f"Global Average Training Loss: {m.train_loss}")
             if run is not None:
                 run.append("train/loss", m.train_loss)
@@ -893,7 +955,24 @@ class Engine:
                     run.append("val/acc", m.val_acc)
             if checkpointer is not None:
                 checkpointer.maybe_save(epoch, self)
+            epoch += 1
         return self.history
+
+    def _emergency_save(self, last_epoch, checkpointer, preemption, log):
+        if last_epoch >= 0 and checkpointer is not None:
+            checkpointer.save(last_epoch, self)
+            log(
+                f"({preemption.signame}: emergency checkpoint written at "
+                f"epoch {last_epoch}; resume with --resume to continue "
+                "bit-exactly)"
+            )
+        else:
+            log(
+                f"({preemption.signame}: stopping before the next epoch"
+                + ("; no checkpointer configured - progress since the "
+                   "last checkpoint is lost)" if checkpointer is None
+                   else "; nothing completed yet)")
+            )
 
     def _run_fused(
         self,
@@ -904,11 +983,16 @@ class Engine:
         eval_every: int,
         checkpointer,
         start_epoch: int,
+        preemption=None,
     ) -> list[EpochMetrics]:
         epochs = self.config.epochs
         eval_in = eval_every == 1 and self._local_eval is not None
         e = start_epoch
         while e < epochs:
+            if preemption is not None and preemption.requested:
+                # span boundaries are the fused path's step boundaries
+                self._emergency_save(e - 1, checkpointer, preemption, log)
+                return self.history
             span = epochs - e
             if checkpointer is not None and checkpointer.every > 0:
                 span = min(span, checkpointer.every - (e % checkpointer.every))

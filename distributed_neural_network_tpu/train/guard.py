@@ -1,0 +1,505 @@
+"""Self-healing training: in-step health signals, guard policies, rollback,
+and preemption-safe exact resume.
+
+The reference's only robustness story is the epoch-granularity fault
+simulator (`data_parallelism_train.py:41-46`), upgraded by this repo to
+seeded drop-and-continue masking (`parallel/fault.py`) plus epoch-boundary
+checkpointing (`utils/checkpoint.py`) - but nothing there detects or
+survives a failure *inside* a step: a NaN'd gradient or a diverging loss
+silently corrupts the run, and a SIGTERM mid-epoch loses it. Production TPU
+training (pjit-at-scale, arxiv 2204.06514) treats step-level health and
+exact resume as table stakes; this module is that layer.
+
+Three pieces, host-side (the in-jit halves live next to the code they
+guard):
+
+- **Health signals** (`ops/schedule.py health_bundle`): every guarded train
+  step returns a tiny replicated bundle - loss, global grad-norm (reused
+  from `clip_by_global_norm` when clipping is on), and an all-finite flag
+  derived from those two scalars (a NaN/Inf anywhere in the gradient tree
+  makes the global norm non-finite, so the flag costs O(1), not a second
+  pass over the parameters). `HealthPipe` consumes the bundle one step
+  late, so observation never fences the dispatch pipeline.
+- **Policy loop** (`TrainingGuard`): an EMA loss-spike detector plus
+  non-finite detection, mapped through a policy -
+  ``warn`` (count + log), ``skip`` (non-finite updates are dropped INSIDE
+  the compiled step via `ops/schedule.py tree_where` - the step stays
+  compiled, params/momentum simply pass through), ``rollback`` (restore
+  the rolling in-memory snapshot - or the newest on-disk checkpoint - and
+  retry with LR backoff under a bounded budget), ``abort`` (raise
+  `GuardAbort` with an actionable message). Anomaly counters flow into
+  `utils/tracing.py StepStats` and ``guard`` instant events into the
+  Chrome trace.
+- **Preemption** (`PreemptionGuard`): SIGTERM/SIGINT set a cooperative
+  flag checked at step boundaries; the training loop then writes an
+  emergency checkpoint whose versioned meta carries the exact data cursor
+  (step, seed - every PRNG/shuffle stream in this repo is a pure function
+  of those), so resume replays from the exact batch, bit-identical.
+
+Used by `lm_train.py` (per-step granularity) and `train/engine.py` /
+`train/cli.py` (per-epoch granularity - one engine dispatch IS one step
+there). Fault injectors that exercise every policy path live in
+`parallel/fault.py` (`StepFaultPlan`, `ChaosMonkey`).
+"""
+
+from __future__ import annotations
+
+import math
+import signal
+import threading
+from dataclasses import dataclass, field
+
+POLICIES = ("off", "warn", "skip", "rollback", "abort")
+
+# bump when the checkpoint meta/cursor schema changes shape; resume rejects
+# newer-versioned metas with a clear message instead of misreading them
+GUARD_META_VERSION = 1
+
+
+class GuardAbort(RuntimeError):
+    """Training aborted by the guard policy; the message says why and what
+    to do (inspect the trace's guard events, resume from the newest
+    checkpoint with a lower LR, or rerun with --guard warn to observe)."""
+
+
+@dataclass
+class GuardConfig:
+    """Knobs for `TrainingGuard`; CLI surface maps 1:1 (--guard,
+    --guard-spike-zscore, --snapshot-every, --max-retries)."""
+
+    policy: str = "warn"
+    # a loss more than this many EMA standard deviations above the EMA mean
+    # is a spike; non-finite loss/grad-norm is always an anomaly
+    spike_zscore: float = 6.0
+    # EMA decay for the spike detector's running mean/variance
+    ema_decay: float = 0.9
+    # observations before the spike detector arms (the first steps of a run
+    # legitimately move fast); non-finite detection is active from step 0
+    warmup_steps: int = 10
+    # rollback retry budget; exhausted -> GuardAbort. The budget refills
+    # after `warmup_steps` consecutive healthy observations, so isolated
+    # incidents hours apart don't share one budget
+    max_retries: int = 3
+    # LR multiplier applied on each rollback (cumulative: scale *= backoff)
+    lr_backoff: float = 0.5
+    # steps between rolling in-memory snapshots (host copies); a rollback
+    # rewinds at most this many steps
+    snapshot_every: int = 50
+
+    def __post_init__(self):
+        if self.policy not in POLICIES:
+            raise ValueError(
+                f"guard policy must be one of {POLICIES}, got {self.policy!r}"
+            )
+        if self.spike_zscore <= 0:
+            raise ValueError(
+                f"spike_zscore must be > 0, got {self.spike_zscore}"
+            )
+        if not 0.0 < self.ema_decay < 1.0:
+            raise ValueError(f"ema_decay must be in (0,1), got {self.ema_decay}")
+        if not 0.0 < self.lr_backoff <= 1.0:
+            raise ValueError(
+                f"lr_backoff must be in (0,1], got {self.lr_backoff}"
+            )
+        if self.max_retries < 0:
+            raise ValueError(f"max_retries must be >= 0, got {self.max_retries}")
+        if self.snapshot_every < 1:
+            raise ValueError(
+                f"snapshot_every must be >= 1, got {self.snapshot_every}"
+            )
+
+
+@dataclass
+class Verdict:
+    """One observation's outcome. `action` is what the caller must do:
+    'ok' / 'warn' (continue), 'skip' (the in-jit guard already dropped the
+    update; bookkeeping only), 'rollback' (call `TrainingGuard.rollback()`
+    and restore), 'abort' (raise - `observe` already raised GuardAbort for
+    the abort policy; this action only appears via rollback exhaustion)."""
+
+    action: str
+    step: int
+    reason: str | None = None
+    zscore: float | None = None
+
+
+class SpikeDetector:
+    """EMA mean/variance loss-spike detector.
+
+    `check(loss)` returns the z-score of the observation against the
+    running EMA (None while warming up); `accept(loss)` folds a HEALTHY
+    observation into the EMA - anomalous losses are never folded in, so a
+    spike cannot poison the baseline it is judged against. `reset()`
+    re-warms after a rollback (the restored trajectory's loss level differs
+    from the post-anomaly EMA, which would otherwise re-trigger)."""
+
+    def __init__(self, *, decay: float = 0.9, warmup: int = 10):
+        self.decay = decay
+        self.warmup = warmup
+        self.reset()
+
+    def reset(self) -> None:
+        self.mean = 0.0
+        self.var = 0.0
+        self.count = 0
+
+    def check(self, loss: float) -> float | None:
+        if self.count < self.warmup:
+            return None
+        sd = math.sqrt(max(self.var, 1e-12))
+        return (loss - self.mean) / sd
+
+    def accept(self, loss: float) -> None:
+        if self.count == 0:
+            self.mean = loss
+            self.var = 0.0
+        else:
+            d = self.decay
+            delta = loss - self.mean
+            self.mean = d * self.mean + (1.0 - d) * loss
+            self.var = d * (self.var + (1.0 - d) * delta * delta)
+        self.count += 1
+
+
+class TrainingGuard:
+    """Host-side guard policy: consumes per-step health, keeps the rolling
+    snapshot, and decides warn/skip/rollback/abort. Thread-compatible with
+    the single-threaded training loops here (no internal locking needed -
+    observation and rollback happen on the loop thread)."""
+
+    def __init__(
+        self,
+        config: GuardConfig | None = None,
+        *,
+        tracer=None,
+        step_stats=None,
+        log=print,
+    ):
+        self.cfg = config if config is not None else GuardConfig()
+        self.tracer = tracer
+        self.step_stats = step_stats
+        self.log = log
+        self.detector = SpikeDetector(
+            decay=self.cfg.ema_decay, warmup=self.cfg.warmup_steps
+        )
+        self.counters = {
+            "nonfinite": 0, "spikes": 0, "skipped": 0,
+            "rollbacks": 0, "warnings": 0,
+        }
+        self.retries_used = 0
+        self.lr_scale = 1.0
+        self._healthy_streak = 0
+        self._snapshot = None  # (step, host_state_tree)
+
+    # ---------------------------------------------------------- snapshots
+
+    @property
+    def has_snapshot(self) -> bool:
+        return self._snapshot is not None
+
+    @property
+    def snapshot_step(self) -> int | None:
+        return self._snapshot[0] if self._snapshot else None
+
+    def snapshot(self, step: int, state) -> None:
+        """Store a host copy of `state` (any pytree of arrays) as the
+        last-good rollback point. One device_get per call - size the
+        cadence (`snapshot_every`) to what the host link affords."""
+        import jax
+        import numpy as np
+
+        self._snapshot = (
+            int(step),
+            jax.tree.map(lambda x: np.asarray(jax.device_get(x)), state),
+        )
+
+    def maybe_snapshot(self, step: int, state, *, first_step: int = 0) -> bool:
+        """Snapshot at the configured cadence (always on the first call)."""
+        if self._snapshot is not None and (
+            (step - first_step) % self.cfg.snapshot_every
+        ):
+            return False
+        self.snapshot(step, state)
+        return True
+
+    def peek_snapshot(self):
+        """(step, host_state) of the rolling snapshot, or None - the
+        no-budget accessor (epoch-level 'skip' restores without consuming
+        a retry)."""
+        return self._snapshot
+
+    # -------------------------------------------------------- observation
+
+    def observe(
+        self,
+        step: int,
+        loss: float,
+        *,
+        grad_norm: float | None = None,
+        all_finite: bool | None = None,
+    ) -> Verdict:
+        """Judge one step's health; returns the policy's Verdict.
+
+        Raises GuardAbort directly under the 'abort' policy so the failure
+        cannot be ignored by a caller that drops the verdict."""
+        loss = float(loss)
+        finite = math.isfinite(loss)
+        if grad_norm is not None:
+            finite = finite and math.isfinite(float(grad_norm))
+        if all_finite is not None:
+            finite = finite and bool(all_finite)
+
+        if not finite:
+            return self._anomaly(
+                step, "nonfinite",
+                f"non-finite step (loss={loss}, grad_norm={grad_norm}, "
+                f"all_finite={all_finite})",
+                None,
+            )
+        z = self.detector.check(loss)
+        if z is not None and z > self.cfg.spike_zscore:
+            return self._anomaly(
+                step, "spikes",
+                f"loss spike: {loss:.6g} is {z:.1f} EMA sigma above the "
+                f"running mean {self.detector.mean:.6g} "
+                f"(threshold {self.cfg.spike_zscore})",
+                z,
+            )
+        self.detector.accept(loss)
+        self._healthy_streak += 1
+        if self.retries_used and self._healthy_streak >= self.cfg.warmup_steps:
+            self.retries_used = 0  # incident closed: refill the budget
+        return Verdict(action="ok", step=step)
+
+    def _anomaly(self, step, kind, reason, zscore) -> Verdict:
+        self.counters[kind] += 1
+        self._healthy_streak = 0
+        policy = self.cfg.policy
+        action = {
+            "warn": "warn", "skip": "skip",
+            "rollback": "rollback", "abort": "abort",
+        }.get(policy, "warn")
+        if action == "skip" and kind == "spikes":
+            # the in-jit skip gates on the finite flag only; a finite spike
+            # has no compiled drop path, so the skip policy warns on it
+            action = "warn"
+        if action == "skip":
+            self.counters["skipped"] += 1
+        elif action == "warn":
+            self.counters["warnings"] += 1
+        if self.tracer is not None:
+            self.tracer.instant(
+                "guard", track="guard", step=int(step), action=action,
+                kind=kind, zscore=zscore,
+            )
+        if self.step_stats is not None:
+            self.step_stats.count_anomaly(kind)
+        self.log(f"(guard: step {step} {kind} -> {action}: {reason})")
+        if action == "abort":
+            raise GuardAbort(
+                f"guard policy 'abort': {reason} at step {step}. "
+                "Inspect the run's guard trace events "
+                "(tools/trace_summary.py), resume from the newest "
+                "checkpoint with a lower LR, or rerun with --guard warn "
+                "to observe without stopping."
+            )
+        return Verdict(action=action, step=step, reason=reason, zscore=zscore)
+
+    # ----------------------------------------------------------- rollback
+
+    def rollback(self):
+        """Consume one retry and return (step, host_state) of the rolling
+        snapshot - or None when no snapshot exists yet (the caller then
+        falls back to the newest on-disk checkpoint). Applies the LR
+        backoff (`lr_scale *= lr_backoff`) and emits a `guard` rollback
+        event. Raises GuardAbort when the retry budget is exhausted."""
+        self.retries_used += 1
+        if self.retries_used > self.cfg.max_retries:
+            raise GuardAbort(
+                f"guard retry budget exhausted ({self.cfg.max_retries} "
+                f"rollback(s) without {self.cfg.warmup_steps} consecutive "
+                "healthy steps between incidents). The anomaly recurs "
+                "after restore + LR backoff - likely a data or numerics "
+                "problem, not a transient: check the input batch at the "
+                "failing step, lower the base LR, or enable gradient "
+                "clipping (--clip-norm)."
+            )
+        self.counters["rollbacks"] += 1
+        self.lr_scale *= self.cfg.lr_backoff
+        self.detector.reset()  # re-warm against the restored trajectory
+        if self.step_stats is not None:
+            self.step_stats.count_anomaly("rollbacks")
+        if self._snapshot is None:
+            return None
+        step, state = self._snapshot
+        if self.tracer is not None:
+            self.tracer.instant(
+                "guard", track="guard", step=step, action="restore",
+                kind="rollback", lr_scale=self.lr_scale,
+                retries_used=self.retries_used,
+            )
+        self.log(
+            f"(guard: rolling back to snapshot at step {step}, "
+            f"lr_scale={self.lr_scale:g}, "
+            f"retry {self.retries_used}/{self.cfg.max_retries})"
+        )
+        return step, state
+
+    def summary(self) -> dict:
+        return {
+            "policy": self.cfg.policy,
+            "lr_scale": self.lr_scale,
+            "retries_used": self.retries_used,
+            **{k: int(v) for k, v in self.counters.items()},
+        }
+
+
+class HealthPipe:
+    """One-step-lagged health consumption.
+
+    Fetching the health bundle synchronously would fence every dispatch -
+    the exact overhead the guard must not add. The pipe holds step i's
+    on-device bundle while step i+1 dispatches and only then blocks on it
+    (by which time it has long been computed), so steady-state overhead is
+    one tiny host transfer per step off the critical path. The price is
+    that warn/rollback act one step late - the rolling snapshot cadence
+    already absorbs that; the non-finite 'skip' drop is in-jit and never
+    waits for the host at all.
+
+    `perturb(step, loss, grad_norm, all_finite) -> (loss, grad_norm,
+    all_finite)` hooks host-side fault injection (parallel/fault.py
+    ChaosMonkey) into the observation path.
+    """
+
+    def __init__(self, guard: TrainingGuard, *, perturb=None):
+        self.guard = guard
+        self.perturb = perturb
+        self._pending = None
+
+    def push(self, step: int, health) -> Verdict | None:
+        """Stash step's on-device bundle; returns the PREVIOUS step's
+        verdict (None on the first call)."""
+        v = self.flush()
+        self._pending = (int(step), health)
+        return v
+
+    def flush(self) -> Verdict | None:
+        """Observe the pending bundle now (blocks on its device values)."""
+        if self._pending is None:
+            return None
+        import jax
+
+        step, health = self._pending
+        self._pending = None
+        vals = jax.device_get(health)
+        loss = float(vals["loss"])
+        gn = float(vals["grad_norm"])
+        ok = bool(vals["all_finite"])
+        if self.perturb is not None:
+            loss, gn, ok = self.perturb(step, loss, gn, ok)
+        return self.guard.observe(step, loss, grad_norm=gn, all_finite=ok)
+
+    def clear(self) -> None:
+        """Drop the pending bundle (after a rollback the in-flight step's
+        health belongs to the abandoned trajectory)."""
+        self._pending = None
+
+
+# ------------------------------------------------------------- preemption
+
+
+class PreemptionGuard:
+    """Cooperative SIGTERM/SIGINT handling for step-boundary emergency
+    checkpoints.
+
+    `install()` replaces the handlers with a flag-setter; the training loop
+    checks `requested` at each step boundary, writes an emergency
+    checkpoint, and exits cleanly - so a preempted run resumes from the
+    exact step instead of losing the partial epoch. A second signal
+    restores the previous handler and re-delivers (the escape hatch when
+    the loop is wedged). Use as a context manager; handlers are restored
+    on exit. Signal handlers can only be installed on the main thread -
+    `install()` is a no-op elsewhere (requested stays False)."""
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT), *, log=print):
+        self.signals = tuple(signals)
+        self.log = log
+        self.requested = False
+        self.signame: str | None = None
+        self._prev = {}
+
+    def _handler(self, signum, frame):
+        if self.requested:
+            # second delivery: restore + re-raise via the original handler
+            self.uninstall()
+            signal.raise_signal(signum)
+            return
+        self.requested = True
+        self.signame = signal.Signals(signum).name
+        self.log(
+            f"({self.signame} received: finishing the current step, then "
+            "writing an emergency checkpoint and exiting; send again to "
+            "force)"
+        )
+
+    def install(self) -> "PreemptionGuard":
+        if threading.current_thread() is not threading.main_thread():
+            return self
+        for s in self.signals:
+            self._prev[s] = signal.signal(s, self._handler)
+        return self
+
+    def uninstall(self) -> None:
+        for s, prev in self._prev.items():
+            signal.signal(s, prev)
+        self._prev = {}
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False
+
+
+# ------------------------------------------------------- resume exactness
+
+
+def resume_cursor(*, step: int, seed: int, **extra) -> dict:
+    """The versioned checkpoint-meta block that makes resume EXACT.
+
+    Every data/PRNG stream in this repo is a pure function of (seed, step)
+    - `data/tokens.py sample_batch(step=...)`, the engine's
+    fold_in(fold_in(key(seed), epoch), device) shuffle keys, the fault
+    masks' `epoch_key(seed, epoch)` - so recording the two integers pins
+    the exact batch sequence and PRNG stream the continuation must replay.
+    """
+    return {
+        "meta_version": GUARD_META_VERSION,
+        "cursor": {"step": int(step), "seed": int(seed), **extra},
+    }
+
+
+def check_cursor(meta: dict, *, seed: int, what: str = "run") -> None:
+    """Validate a restored meta's cursor against this run's settings.
+
+    Old checkpoints without a cursor pass (they predate exact-resume and
+    carry no claim); a seed mismatch raises - resuming a seeded run under
+    a different seed silently changes the data order mid-trajectory, which
+    is exactly the corruption exact resume exists to prevent."""
+    ver = meta.get("meta_version")
+    if ver is not None and ver > GUARD_META_VERSION:
+        raise ValueError(
+            f"checkpoint meta_version {ver} is newer than this build's "
+            f"{GUARD_META_VERSION} - resume with the build that wrote it"
+        )
+    cur = meta.get("cursor")
+    if not isinstance(cur, dict):
+        return
+    if "seed" in cur and int(cur["seed"]) != int(seed):
+        raise ValueError(
+            f"checkpoint was written with seed={cur['seed']}, this {what} "
+            f"has seed={seed} - the data order and PRNG streams would "
+            "diverge from the recorded trajectory; resume with the "
+            "original seed"
+        )

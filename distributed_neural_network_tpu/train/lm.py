@@ -241,6 +241,9 @@ def make_lm_train_step(
     weight_decay: float = 0.0,
     grad_sync: str = "end",
     bucket_mb: float = 4.0,
+    with_health: bool = False,
+    skip_nonfinite: bool = False,
+    fault_plan=None,
 ):
     """Compiled (params, mom, tokens, targets) -> (params, mom, loss).
 
@@ -285,6 +288,25 @@ def make_lm_train_step(
       the end schedule runs (bitwise identical). Not compatible with
       expert parallelism (expert leaves vary over exactly the data axis
       the overlap psum reduces over).
+
+    Guard hooks (train/guard.py; all default-off, and the default-off
+    program is the UNCHANGED one - bitwise identical step):
+    - with_health: the step additionally returns a replicated health
+      bundle {loss, grad_norm, all_finite} (ops/schedule.py
+      health_bundle). The grad norm is the one clip_by_global_norm
+      already computes when clip_norm > 0; otherwise one sharding-aware
+      global_norm is added. The finite flag derives from the two scalars
+      - no extra pass over the parameters.
+    - skip_nonfinite: gate the whole update (params AND optimizer state,
+      including Adam's t) on the finite flag inside the compiled step
+      (ops/sgd.py guarded_sgd_step / ops/adam.py guarded_adam_step): a
+      NaN'd gradient costs one wasted fwd/bwd, corrupts nothing, and
+      never leaves the device. Implies the health output.
+    - fault_plan (parallel/fault.py StepFaultPlan): compile chaos
+      injection (NaN grads / loss spike at chosen steps) into the step
+      for tests and the bench chaos row. Requires the step-index
+      argument: the compiled fn takes (params, mom, tokens, targets,
+      step) whenever a fault_plan is given, as with lr_schedule.
     """
     sp = SEQ_AXIS if mesh.shape.get(SEQ_AXIS, 1) > 1 else None
     tp = TP_AXIS if mesh.shape.get(TP_AXIS, 1) > 1 else None
@@ -394,34 +416,68 @@ def make_lm_train_step(
     else:
         fwd_bwd = accumulate_fwd_bwd(fwd_bwd_one, accum_steps)
 
-    def transform_grads(grads):
-        if clip_norm > 0.0:
-            from ..ops.schedule import clip_by_global_norm
-
-            grads, _ = clip_by_global_norm(
-                grads, clip_norm, specs=specs,
-                axes=tuple(mesh.axis_names),
-            )
-        return grads
+    if fault_plan is not None and not fault_plan:
+        fault_plan = None  # empty plan compiles nothing
+    want_health = with_health or skip_nonfinite
+    all_axes = tuple(mesh.axis_names)
 
     def step(params, mom, tokens, targets, step_i=None):
         loss, grads = fwd_bwd(params, tokens, targets)
-        grads = transform_grads(grads)
+        if fault_plan is not None:
+            from ..parallel.fault import inject_step_faults
+
+            loss, grads = inject_step_faults(step_i, loss, grads, fault_plan)
+        norm = None
+        if clip_norm > 0.0:
+            from ..ops.schedule import clip_by_global_norm
+
+            # pre-clip norm: the health signal must see the anomaly the
+            # clip is about to rescale (clipping a NaN tree yields NaN
+            # anyway - the flag still drops)
+            grads, norm = clip_by_global_norm(
+                grads, clip_norm, specs=specs, axes=all_axes,
+            )
+        elif want_health:
+            from ..ops.schedule import global_norm
+
+            norm = global_norm(grads, specs=specs, axes=all_axes)
+        health = None
+        if want_health:
+            from ..ops.schedule import health_bundle
+
+            health = health_bundle(loss, norm)
         lr_t = lr if lr_schedule is None else lr_schedule(step_i)
         if optimizer == "adam":
-            from ..ops.adam import adam_step
-
             # momentum doubles as Adam's b1 (its momentum analog), so the
             # CLI --momentum flag takes effect for every optimizer
-            params, mom = adam_step(
-                params, mom, grads, lr_t, b1=momentum,
-                weight_decay=weight_decay,
+            if skip_nonfinite:
+                from ..ops.adam import guarded_adam_step
+
+                params, mom = guarded_adam_step(
+                    params, mom, grads, lr_t, ok=health["all_finite"],
+                    b1=momentum, weight_decay=weight_decay,
+                )
+            else:
+                from ..ops.adam import adam_step
+
+                params, mom = adam_step(
+                    params, mom, grads, lr_t, b1=momentum,
+                    weight_decay=weight_decay,
+                )
+        elif skip_nonfinite:
+            from ..ops.sgd import guarded_sgd_step
+
+            params, mom = guarded_sgd_step(
+                params, mom, grads, lr_t, momentum,
+                ok=health["all_finite"], weight_decay=weight_decay,
             )
         else:
             params, mom = sgd_step(params, mom, grads, lr_t, momentum)
             from ..ops.schedule import apply_decoupled_weight_decay
 
             params = apply_decoupled_weight_decay(params, lr_t, weight_decay)
+        if want_health:
+            return params, mom, loss, health
         return params, mom, loss
 
     # attn='flash' composes with dp x tp meshes since round 4: the own
@@ -452,7 +508,9 @@ def make_lm_train_step(
             # the check is vacuous (no cross-device gradients exist)
             check_vma = False
 
-    has_step = lr_schedule is not None
+    # fault injection fires on a step index, so a fault_plan forces the
+    # step-taking signature even under a constant lr
+    has_step = lr_schedule is not None or fault_plan is not None
     if optimizer.startswith("zero"):
         # Shared two-shard_map ZeRO-1 orchestration (parallel/zero.py
         # make_zero_split_step; the pipeline path uses the same helper).
@@ -470,16 +528,18 @@ def make_lm_train_step(
             data_spec=data_spec, optimizer=optimizer, lr=lr,
             momentum=momentum, weight_decay=weight_decay,
             lr_schedule=lr_schedule, clip_fn=clip_fn, axis_name=DATA_AXIS,
-            check_vma=check_vma,
+            check_vma=check_vma, with_health=with_health,
+            skip_nonfinite=skip_nonfinite, fault_plan=fault_plan,
         )
 
+    out_specs = (specs, mom_spec, P()) + ((P(),) if want_health else ())
     if has_step:
         return jax.jit(
             jax.shard_map(
                 step,
                 mesh=mesh,
                 in_specs=(specs, mom_spec, data_spec, data_spec, P()),
-                out_specs=(specs, mom_spec, P()),
+                out_specs=out_specs,
                 check_vma=check_vma,
             ),
             donate_argnums=(0, 1),
@@ -489,7 +549,7 @@ def make_lm_train_step(
             lambda p, m, a, b: step(p, m, a, b),
             mesh=mesh,
             in_specs=(specs, mom_spec, data_spec, data_spec),
-            out_specs=(specs, mom_spec, P()),
+            out_specs=out_specs,
             check_vma=check_vma,
         ),
         donate_argnums=(0, 1),
@@ -518,8 +578,10 @@ def make_traced_step(
     dispatch only and carry ``fenced: false``.
 
     The wrapper is transparent: same signature and return as ``step_fn``
-    (the trailing output is assumed to be the loss for fencing purposes,
-    matching every step builder in this module / parallel/pipeline.py).
+    (the trailing output - the loss, or the health bundle on guarded
+    steps (with_health=True) - is what the fence blocks on; either way
+    it data-depends on the whole step, matching every step builder in
+    this module / parallel/pipeline.py).
     ``compile_first=False`` marks every record steady-state - for callers
     that already absorbed compilation in their own warm-up.
     """

@@ -804,6 +804,100 @@ def measure_lm_training(
     }
 
 
+def measure_guard_overhead(
+    *,
+    d_model: int = 512,
+    n_layers: int = 8,
+    n_heads: int = 8,
+    d_ff: int = 2048,
+    vocab: int = 32768,
+    seq_len: int = 2048,
+    batch: int = 16,
+    steps: int = 20,
+    warmup: int = 2,
+    attn: str = "flash",
+    dtype: str = "bfloat16",
+    budget_pct: float = 1.0,
+) -> dict:
+    """Guard-overhead A/B: the identical LM config with guard off vs
+    ``--guard warn`` (health bundle compiled into the step + one-step-
+    lagged host observation, train/guard.py HealthPipe).
+
+    Two claims, both asserted into the returned row:
+    - ``within_budget``: the warn-mode steady-state step-time overhead is
+      under `budget_pct` (default 1%) - the health bundle costs one O(1)
+      finite-check on scalars the step already computes (plus one global
+      grad-norm reduction when clipping is off, as here - the honest
+      worst case) and the observation never fences the dispatch pipeline.
+    - ``final_loss_bitwise_equal``: warn mode is observation-only - the
+      guarded run's final loss is BIT-IDENTICAL to the unguarded run's
+      (same seeds, same data, same update math).
+    """
+    import jax.numpy as jnp
+
+    from ..models import transformer as tfm
+    from . import lm as lmtrain
+    from .guard import GuardConfig, HealthPipe, TrainingGuard
+
+    cfg = tfm.TransformerConfig(
+        vocab_size=vocab, d_model=d_model, n_heads=n_heads,
+        n_layers=n_layers, d_ff=d_ff,
+        dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32,
+    )
+    mesh = lmtrain.create_lm_mesh(1, 1, 1)
+    params0 = tfm.init_params(jax.random.key(0), cfg)
+    tokens, targets = lmtrain.make_copy_task(
+        jax.random.key(1), batch=batch, seq_len=seq_len, vocab=vocab
+    )
+    from ..utils.timers import fence_rtt, hard_block
+
+    def run(guard_on: bool):
+        params, _ = lmtrain.shard_params(params0, cfg, mesh)
+        mom = lmtrain.init_lm_momentum(params, mesh)
+        step = lmtrain.make_lm_train_step(
+            cfg, mesh, lr=0.01, attn_impl=attn, with_health=guard_on,
+        )
+        pipe = None
+        if guard_on:
+            pipe = HealthPipe(TrainingGuard(
+                GuardConfig(policy="warn"), log=lambda *_: None,
+            ))
+        loss = None
+        for i in range(max(warmup, 1)):
+            out = step(params, mom, tokens, targets)
+            params, mom, loss = out[0], out[1], out[2]
+        hard_block(loss)
+        rtt = fence_rtt(loss)
+        t0 = time.perf_counter()
+        for i in range(steps):
+            out = step(params, mom, tokens, targets)
+            params, mom, loss = out[0], out[1], out[2]
+            if pipe is not None:
+                pipe.push(i, out[3])
+        if pipe is not None:
+            pipe.flush()
+        hard_block(loss)
+        dt = max(time.perf_counter() - t0 - rtt, 1e-9)
+        return dt, float(loss)
+
+    base_dt, base_loss = run(False)
+    guard_dt, guard_loss = run(True)
+    overhead_pct = (guard_dt / base_dt - 1.0) * 100.0
+    tok = batch * seq_len * steps
+    return {
+        "d_model": d_model, "n_layers": n_layers, "seq_len": seq_len,
+        "batch": batch, "steps": steps, "dtype": dtype, "attn": attn,
+        "device_kind": jax.devices()[0].device_kind,
+        "base_tokens_per_s": round(tok / base_dt),
+        "guard_tokens_per_s": round(tok / guard_dt),
+        "overhead_pct": round(overhead_pct, 3),
+        "budget_pct": budget_pct,
+        "within_budget": overhead_pct < budget_pct,
+        "final_loss": guard_loss,
+        "final_loss_bitwise_equal": base_loss == guard_loss,
+    }
+
+
 def measure_zero_memory(
     *,
     d_model: int = 256,

@@ -46,6 +46,18 @@ def _host_tree(tree):
     return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
 
 
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint failed validation against the restore template (missing
+    files, wrong leaf count, shape/dtype mismatch, unreadable archive).
+    `restore_latest` catches this and falls back to the previous step."""
+
+    def __init__(self, step: int, detail: str):
+        super().__init__(
+            f"corrupt/truncated checkpoint (step {step}): {detail}"
+        )
+        self.step = step
+
+
 class _OrbaxBackend:
     def __init__(self, directory: str, keep: int):
         self._mgr = ocp.CheckpointManager(
@@ -95,6 +107,13 @@ class _NpzBackend:
         self.dir = os.path.abspath(directory)
         self.keep = keep
         os.makedirs(self.dir, exist_ok=True)
+        # sweep stale step_*.tmp staging dirs: a crash between the tmp
+        # write and the atomic rename leaves one behind, and nothing else
+        # ever touches it again - it would leak forever (and a later save
+        # of the same step would makedirs into the half-written remnant)
+        for name in os.listdir(self.dir):
+            if self._STEP_RE.match(name[:-4]) and name.endswith(".tmp"):
+                shutil.rmtree(os.path.join(self.dir, name), ignore_errors=True)
 
     def _step_dir(self, step: int) -> str:
         return os.path.join(self.dir, f"step_{step}")
@@ -130,16 +149,46 @@ class _NpzBackend:
         return steps[-1] if steps else None
 
     def restore(self, step: int, template=None):
+        """Load + validate one checkpoint. With a `template`, the leaf
+        count, every shape, and every dtype are checked BEFORE unflatten,
+        so a truncated archive or a layout from a different run raises a
+        clear `CheckpointCorruptError` instead of a cryptic unflatten /
+        device_put failure deep in the restore path."""
         d = self._step_dir(step)
-        with np.load(os.path.join(d, "state.npz")) as z:
-            leaves = [z[f"leaf_{i}"] for i in range(len(z.files))]
-        with open(os.path.join(d, "meta.json")) as f:
-            meta = json.load(f)
-        state = (
-            jax.tree.unflatten(jax.tree.structure(template), leaves)
-            if template is not None
-            else leaves
-        )
+        try:
+            with np.load(os.path.join(d, "state.npz")) as z:
+                leaves = [z[f"leaf_{i}"] for i in range(len(z.files))]
+            with open(os.path.join(d, "meta.json")) as f:
+                meta = json.load(f)
+        except CheckpointCorruptError:
+            raise
+        except Exception as e:  # unreadable zip, missing file, bad json
+            raise CheckpointCorruptError(
+                step, f"{type(e).__name__}: {e}"
+            ) from e
+        if template is None:
+            return leaves, meta
+        want = jax.tree.leaves(template)
+        if len(leaves) != len(want):
+            raise CheckpointCorruptError(
+                step,
+                f"{len(leaves)} stored leaves, template has {len(want)} - "
+                "truncated archive or a different model/optimizer layout",
+            )
+        for i, (got, ref) in enumerate(zip(leaves, want)):
+            if tuple(got.shape) != tuple(np.shape(ref)):
+                raise CheckpointCorruptError(
+                    step,
+                    f"leaf_{i} shape {tuple(got.shape)} != template "
+                    f"{tuple(np.shape(ref))}",
+                )
+            ref_dt = np.dtype(getattr(ref, "dtype", np.asarray(ref).dtype))
+            if np.dtype(got.dtype) != ref_dt:
+                raise CheckpointCorruptError(
+                    step,
+                    f"leaf_{i} dtype {got.dtype} != template {ref_dt}",
+                )
+        state = jax.tree.unflatten(jax.tree.structure(template), leaves)
         return state, meta
 
     def close(self) -> None:
@@ -175,19 +224,33 @@ class TreeCheckpointer:
     def latest_step(self):
         return self._b.latest_step()
 
-    def restore_latest(self, template, shardings=None):
-        """(state, meta, step) from the newest checkpoint, or None.
+    def restore_latest(self, template, shardings=None, *, log=print):
+        """(state, meta, step) from the newest VALID checkpoint, or None.
 
         `template` supplies the tree structure (its leaf values are unused);
-        `shardings` re-places each restored leaf via device_put.
+        `shardings` re-places each restored leaf via device_put. A newest
+        checkpoint that fails validation (CheckpointCorruptError - e.g. the
+        writer was killed mid-save on a filesystem without atomic rename)
+        is skipped with a warning and the previous step is tried, oldest
+        last; only if every retained checkpoint is corrupt does the error
+        propagate.
         """
-        step = self._b.latest_step()
-        if step is None:
+        steps = self._b.all_steps()
+        if not steps:
             return None
-        state, meta = self._b.restore(step, template)
-        if shardings is not None:
-            state = jax.tree.map(jax.device_put, state, shardings)
-        return state, meta, step
+        last_err = None
+        for step in reversed(steps):
+            try:
+                state, meta = self._b.restore(step, template)
+            except CheckpointCorruptError as e:
+                log(f"(WARNING: {e}; falling back to the previous "
+                    "checkpoint)")
+                last_err = e
+                continue
+            if shardings is not None:
+                state = jax.tree.map(jax.device_put, state, shardings)
+            return state, meta, step
+        raise last_err
 
     def close(self) -> None:
         self._b.close()
@@ -221,12 +284,18 @@ class Checkpointer:
         return True
 
     def save(self, epoch: int, engine) -> None:
+        from ..train.guard import resume_cursor
+
         state = _host_tree(engine.state_tree())
         meta = {
             "epoch": epoch,
             "n_workers": engine.n_workers,
             "regime": engine.config.regime,
             "history": [dataclasses.asdict(m) for m in engine.history],
+            # versioned exact-resume cursor: every shuffle/fault stream is
+            # a pure function of (seed, epoch), so these two pin the
+            # continuation's data order bit-exactly (train/guard.py)
+            **resume_cursor(step=epoch, seed=engine.config.seed),
         }
         self._b.save(epoch, state, meta)
 
@@ -235,13 +304,26 @@ class Checkpointer:
     def latest_epoch(self):
         return self._b.latest_step()
 
-    def restore_latest(self, engine) -> int:
-        """Load the newest checkpoint into `engine`; returns the next epoch
-        to run (0 if no checkpoint exists)."""
-        step = self._b.latest_step()
-        if step is None:
+    def restore_latest(self, engine, *, log=print) -> int:
+        """Load the newest VALID checkpoint into `engine`; returns the next
+        epoch to run (0 if no checkpoint exists). A corrupt newest
+        checkpoint is skipped with a warning (same fallback semantics as
+        `TreeCheckpointer.restore_latest`)."""
+        steps = self._b.all_steps()
+        if not steps:
             return 0
-        state, meta = self._b.restore(step, engine.state_tree())
+        state = meta = None
+        last_err = None
+        for step in reversed(steps):
+            try:
+                state, meta = self._b.restore(step, engine.state_tree())
+                break
+            except CheckpointCorruptError as e:
+                log(f"(WARNING: {e}; falling back to the previous "
+                    "checkpoint)")
+                last_err = e
+        if meta is None:
+            raise last_err
         if meta["n_workers"] != engine.n_workers:
             raise ValueError(
                 f"checkpoint was written with n_workers={meta['n_workers']}, "
@@ -253,6 +335,9 @@ class Checkpointer:
                 f"run, engine is {engine.config.regime!r} - resuming would "
                 "silently change the data-placement policy mid-trajectory"
             )
+        from ..train.guard import check_cursor
+
+        check_cursor(meta, seed=engine.config.seed, what="engine")
         engine.load_state_tree(state)
         from ..train.engine import EpochMetrics
 

@@ -52,6 +52,10 @@ TRAIN_SPAN = "train_span"
 SYNC = "sync"
 EVAL = "eval"
 DATA_LOADING = "data_loading"
+# instant events from the guard layer (train/guard.py: one per anomaly /
+# restore) and the fault simulator's straggler stall span (parallel/fault.py)
+GUARD = "guard"
+STRAGGLER = "straggler"
 
 
 class _NullSpan:
@@ -337,6 +341,9 @@ class StepStats:
         self.compilation_cache_dir = compilation_cache_dir
         self.records: list[StepRecord] = []
         self.memory_peak: dict[str, int] = {}
+        # guard-layer anomaly counters (train/guard.py observe/rollback):
+        # kind -> count; lands in summary()/report() and the trace embed
+        self.anomalies: dict[str, int] = {}
         self._lock = threading.Lock()
 
     # ----------------------------------------------------------- recording
@@ -365,6 +372,15 @@ class StepStats:
                     f"{p}/{self.item_label}_per_s", rec.items / rec.wall_s
                 )
         return rec
+
+    def count_anomaly(self, kind: str, n: int = 1) -> None:
+        """Bump a guard anomaly counter (and stream it when sinking)."""
+        with self._lock:
+            self.anomalies[kind] = self.anomalies.get(kind, 0) + int(n)
+        if self.sink is not None:
+            self.sink.append(
+                f"{self.series_prefix}/anomaly_{kind}", self.anomalies[kind]
+            )
 
     def set_flops(self, flops_per_step: float | None, source: str | None) -> None:
         self.flops_per_step = flops_per_step
@@ -427,6 +443,7 @@ class StepStats:
                 if self.comm_bucket_bytes is not None else None
             ),
             "compilation_cache_dir": self.compilation_cache_dir,
+            "anomalies": dict(self.anomalies) or None,
             "flops_per_step": self.flops_per_step,
             "flops_source": self.flops_source,
             "peak_flops_per_device": self.peak_flops_per_device,
@@ -506,6 +523,13 @@ class StepStats:
             lines.append(
                 f"  gradient buckets: {len(bb)} per microbatch "
                 f"({min(bb):,}-{max(bb):,} B each)"
+            )
+        if s["anomalies"]:
+            lines.append(
+                "  guard anomalies: "
+                + ", ".join(
+                    f"{k}={v}" for k, v in sorted(s["anomalies"].items())
+                )
             )
         mem = s["device_memory_peak_bytes"]
         lines.append(

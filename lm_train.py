@@ -172,6 +172,51 @@ def main() -> int:
     p.add_argument("--checkpoint-every", type=int, default=50)
     p.add_argument("--resume", action="store_true",
                    help="resume from the latest checkpoint in --checkpoint-dir")
+    p.add_argument("--guard", choices=("off", "warn", "skip", "rollback",
+                                       "abort"),
+                   default="off",
+                   help="self-healing step guard (train/guard.py, "
+                   "docs/ROBUSTNESS.md): the compiled step emits a health "
+                   "bundle (loss, global grad-norm, all-finite flag) "
+                   "observed one step behind the dispatch pipeline. "
+                   "warn = count/log anomalies; skip = additionally drop "
+                   "non-finite updates INSIDE the compiled step (params/"
+                   "momentum pass through unchanged); rollback = restore "
+                   "the rolling in-memory snapshot (or newest checkpoint) "
+                   "and retry with LR backoff; abort = stop with an "
+                   "actionable error. Mesh path only (not --pp)")
+    p.add_argument("--guard-spike-zscore", type=float, default=6.0,
+                   help="loss-spike threshold in EMA standard deviations; "
+                   "non-finite steps always count as anomalies")
+    p.add_argument("--snapshot-every", type=int, default=50,
+                   help="steps between the guard's rolling host snapshots "
+                   "(one device_get of params+momentum each; a rollback "
+                   "rewinds at most this many steps)")
+    p.add_argument("--max-retries", type=int, default=3,
+                   help="guard rollback budget before abort (refills after "
+                   "a stretch of healthy steps)")
+    p.add_argument("--on-sigterm", choices=("checkpoint", "ignore"),
+                   default="checkpoint",
+                   help="checkpoint = on SIGTERM/SIGINT finish the current "
+                   "step, write an emergency checkpoint (when "
+                   "--checkpoint-dir is set) and exit cleanly; resume "
+                   "replays from the exact batch, bit-identical. "
+                   "ignore = default signal behavior")
+    p.add_argument("--chaos-nan-step", type=int, action="append",
+                   default=None, metavar="N",
+                   help="fault injection (parallel/fault.py): NaN the "
+                   "gradient tree at step N inside the compiled step "
+                   "(repeatable); exercises the guard's in-jit skip path")
+    p.add_argument("--chaos-spike-step", type=int, action="append",
+                   default=None, metavar="N",
+                   help="fault injection: multiply the OBSERVED loss at "
+                   "step N by 100 (host-side, fires once, so a rollback "
+                   "replay sees a healthy step)")
+    p.add_argument("--chaos-sigterm-after", type=int, default=None,
+                   metavar="N",
+                   help="fault injection: deliver a real SIGTERM to this "
+                   "process after step N completes (drives the emergency-"
+                   "checkpoint -> exact-resume path end to end)")
     p.add_argument("--gen-temperature", type=float, default=0.0,
                    help="sampling temperature for --generate (0 = greedy)")
     p.add_argument("--gen-top-k", type=int, default=0,
@@ -249,6 +294,21 @@ def main() -> int:
         )
     if args.bucket_mb <= 0:
         p.error(f"--bucket-mb must be > 0, got {args.bucket_mb}")
+    chaos_injected = bool(
+        args.chaos_nan_step or args.chaos_spike_step
+        or args.chaos_sigterm_after is not None
+    )
+    if args.pp > 1 and (args.guard != "off" or chaos_injected):
+        p.error(
+            "--guard / --chaos-* are wired through the dp x sp x tp mesh "
+            "step's health bundle (train/lm.py make_lm_train_step); the "
+            "pipeline path has no health output yet - drop --pp or the "
+            "guard flags"
+        )
+    if args.snapshot_every < 1:
+        p.error(f"--snapshot-every must be >= 1, got {args.snapshot_every}")
+    if args.max_retries < 0:
+        p.error(f"--max-retries must be >= 0, got {args.max_retries}")
 
     from distributed_neural_network_tpu.train.cli import (
         enable_compilation_cache,
@@ -301,6 +361,11 @@ def main() -> int:
 
     params = tfm.init_params(jax.random.key(args.seed), cfg)
     pipe = args.pp > 1
+    # guard defaults for the pipeline branch (pp + guard/chaos is rejected
+    # at argparse; these keep the shared loop code below uniform)
+    guard_on = False
+    fault_plan = None
+    build_step = None
     if pipe:
         if args.sp > 1:
             raise SystemExit(
@@ -368,27 +433,60 @@ def main() -> int:
 
         from distributed_neural_network_tpu.ops import schedule as sched
 
-        lr_schedule = None
-        if args.lr_schedule == "cosine":
-            lr_schedule = functools.partial(
-                sched.warmup_cosine, base_lr=args.lr,
-                total_steps=args.steps, warmup_steps=args.warmup_steps,
-                min_lr_frac=args.min_lr_frac,
+        guard_on = args.guard != "off"
+        if args.chaos_nan_step:
+            from distributed_neural_network_tpu.parallel.fault import (
+                StepFaultPlan,
             )
-        step = lmtrain.make_lm_train_step(
-            cfg, mesh, lr=args.lr, momentum=args.momentum,
-            attn_impl=args.attn, optimizer=args.optimizer,
-            loss_chunks=args.loss_chunks, lr_schedule=lr_schedule,
-            clip_norm=args.clip_norm, accum_steps=args.accum_steps,
-            weight_decay=args.weight_decay, grad_sync=args.grad_sync,
-            bucket_mb=args.bucket_mb,
-        )
+
+            fault_plan = StepFaultPlan(
+                nan_grads_at=tuple(args.chaos_nan_step)
+            )
+
+        def build_step(lr_scale: float = 1.0):
+            """The compiled mesh step at `lr * lr_scale` - the guard's LR
+            backoff rebuilds it (one recompile per rollback retry, bounded
+            by --max-retries; the schedule's base LR scales too)."""
+            lr_schedule = None
+            if args.lr_schedule == "cosine":
+                lr_schedule = functools.partial(
+                    sched.warmup_cosine, base_lr=args.lr * lr_scale,
+                    total_steps=args.steps, warmup_steps=args.warmup_steps,
+                    min_lr_frac=args.min_lr_frac,
+                )
+            return lmtrain.make_lm_train_step(
+                cfg, mesh, lr=args.lr * lr_scale, momentum=args.momentum,
+                attn_impl=args.attn, optimizer=args.optimizer,
+                loss_chunks=args.loss_chunks, lr_schedule=lr_schedule,
+                clip_norm=args.clip_norm, accum_steps=args.accum_steps,
+                weight_decay=args.weight_decay, grad_sync=args.grad_sync,
+                bucket_mb=args.bucket_mb,
+                with_health=guard_on,
+                skip_nonfinite=args.guard == "skip",
+                fault_plan=fault_plan,
+            )
+
+        step = build_step()
 
     param_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
 
     mesh_desc = "x".join(
         f"{k}{v}" for k, v in mesh.shape.items() if v > 1
     ) or "single"
+
+    from distributed_neural_network_tpu.train.guard import (
+        check_cursor,
+        resume_cursor,
+    )
+
+    def ckpt_meta(i: int, loss_val):
+        """Checkpoint meta incl. the versioned exact-resume cursor: every
+        batch/PRNG stream here is a pure function of (seed, step), so the
+        cursor pins the continuation's data order bit-exactly."""
+        return {"mesh": mesh_desc, "optimizer": args.optimizer,
+                "mom_format": MOM_FORMAT, "loss": loss_val,
+                "pp_interleave": args.pp_interleave,
+                **resume_cursor(step=i, seed=args.seed)}
 
     ck = None
     step0 = 0
@@ -448,6 +546,10 @@ def main() -> int:
                                 if key_ == "mom_format" else ""
                             )
                         )
+                try:
+                    check_cursor(meta, seed=args.seed)
+                except ValueError as e:
+                    raise SystemExit(str(e))
                 params, mom = state["params"], state["mom"]
                 step0 = last + 1
                 print(f"(Resumed from step {last}; continuing at {step0})")
@@ -536,7 +638,6 @@ def main() -> int:
     first_loss = None
     t_compile = time.perf_counter()
     t0 = None
-    steps_run = range(step0, step0 + args.steps)
     from distributed_neural_network_tpu.utils import metrics as M
 
     run = M.init_run(jsonl_path=args.metrics_jsonl) if args.metrics_jsonl \
@@ -564,7 +665,8 @@ def main() -> int:
         hw_flops = TRC.compiled_flops(
             step, params, mom, tokens, targets,
             *((jnp.int32(step0),)
-              if args.lr_schedule != "constant" else ()),
+              if args.lr_schedule != "constant" or fault_plan is not None
+              else ()),
         )
         # gradient sync rides the data (and seq) axes; tensor-sharded
         # leaves keep local grads - this over-counts those, an estimate
@@ -617,11 +719,48 @@ def main() -> int:
                     else "psum"),
                 axis_size=n_sync, accum_steps=args.accum_steps,
             )
-        step = lmtrain.make_traced_step(
-            step, tracer=tracer, step_stats=stats,
+
+    def wrap_step(fn, first_step):
+        """Span tracing + StepStats around a compiled step (identity when
+        telemetry is off); re-applied after a guard LR-backoff rebuild."""
+        if stats is None:
+            return fn
+        return lmtrain.make_traced_step(
+            fn, tracer=tracer, step_stats=stats,
             items_per_step=args.batch_size * args.seq_len,
-            fence=True, first_step=step0,
+            fence=True, first_step=first_step,
         )
+
+    step = wrap_step(step, step0)
+
+    # self-healing layer (train/guard.py; docs/ROBUSTNESS.md)
+    from distributed_neural_network_tpu.train import guard as G
+
+    monkey = None
+    if args.chaos_spike_step or args.chaos_sigterm_after is not None:
+        from distributed_neural_network_tpu.parallel.fault import ChaosMonkey
+
+        monkey = ChaosMonkey(
+            spike_at=tuple(args.chaos_spike_step or ()),
+            sigterm_after=args.chaos_sigterm_after,
+        )
+    guard = hpipe = None
+    if guard_on:
+        guard = G.TrainingGuard(
+            G.GuardConfig(
+                policy=args.guard,
+                spike_zscore=args.guard_spike_zscore,
+                snapshot_every=args.snapshot_every,
+                max_retries=args.max_retries,
+            ),
+            tracer=tracer, step_stats=stats,
+        )
+        hpipe = G.HealthPipe(
+            guard, perturb=monkey.perturb if monkey is not None else None
+        )
+    preempt = None
+    if args.on_sigterm == "checkpoint":
+        preempt = G.PreemptionGuard().install()
 
     ema = ema_fn = None
     if args.ema_decay:
@@ -632,20 +771,70 @@ def main() -> int:
         ema_fn = make_ema_update(args.ema_decay)
         ema = jax.tree.map(jnp.array, params)
     scheduled = args.lr_schedule != "constant"
+    takes_step = scheduled or fault_plan is not None
     last_eval = None
     eval_s = 0.0
-    for i in steps_run:
+    preempted = False
+    timed_steps = 0
+    end_step = step0 + args.steps
+    i = last_step = step0
+
+    def handle_verdict(v) -> bool:
+        """Apply a guard verdict; True = rolled back (the loop restarts at
+        the snapshot step with the rebuilt backed-off step fn)."""
+        nonlocal params, mom, step, i
+        if v is None or v.action in ("ok", "warn", "skip"):
+            return False
+        rb = guard.rollback()  # raises GuardAbort when budget exhausted
+        if rb is None and ck is not None:
+            # no in-memory snapshot yet: fall back to the newest on-disk
+            # checkpoint (same exact-resume contract)
+            restored = ck.restore_latest(
+                {"params": params, "mom": mom},
+                {"params": param_shardings, "mom": mom_shardings},
+            )
+            if restored is not None:
+                state, _meta, last = restored
+                rb = (last + 1, state)
+                print(f"(guard: no snapshot yet; restored the on-disk "
+                      f"checkpoint at step {last})")
+        if rb is None:
+            raise G.GuardAbort(
+                "guard rollback requested before any snapshot or on-disk "
+                "checkpoint exists - lower the LR, enable --checkpoint-dir,"
+                " or start with --guard warn to observe first"
+            )
+        snap_step, state = rb
+        params = jax.device_put(state["params"], param_shardings)
+        mom = jax.device_put(state["mom"], mom_shardings)
+        step = wrap_step(build_step(guard.lr_scale), snap_step)
+        print(f"(guard: resuming from step {snap_step} at "
+              f"lr_scale={guard.lr_scale:g} [one recompile])")
+        hpipe.clear()
+        i = snap_step
+        return True
+
+    while i < end_step:
+        if guard is not None and (i - step0) % args.snapshot_every == 0:
+            # settle the in-flight observation BEFORE snapshotting, so the
+            # rolling snapshot only ever captures guard-verified state
+            if handle_verdict(hpipe.flush()):
+                continue
+            guard.maybe_snapshot(
+                i, {"params": params, "mom": mom}, first_step=step0
+            )
         if stream is not None:
             # refresh at EVERY step (including step0): on resume the
             # pre-loop batch is batch_at(0), not batch_at(step0), and a
             # continuous run must see the same stream as a fresh one
             tokens, targets = batch_at(i)
-        if scheduled:
-            params, mom, loss = step(
-                params, mom, tokens, targets, jnp.int32(i)
-            )
+        if takes_step:
+            out = step(params, mom, tokens, targets, jnp.int32(i))
         else:
-            params, mom, loss = step(params, mom, tokens, targets)
+            out = step(params, mom, tokens, targets)
+        params, mom, loss = out[0], out[1], out[2]
+        if hpipe is not None and handle_verdict(hpipe.push(i, out[3])):
+            continue
         if ema_fn is not None:
             ema = ema_fn(ema, params)
         if eval_fn is not None and (i + 1) % args.eval_every == 0:
@@ -668,36 +857,60 @@ def main() -> int:
             print(f"step {i:>5}  eval_loss {ev:.4f}  "
                   f"ppl {last_eval['ppl']:.2f}")
             run.append(M.VAL_LOSS, ev)
-        if i == step0:
+        if i == step0 and first_loss is None:
             jax.block_until_ready(loss)
             first_loss = float(loss)
             print(f"(first step incl. compile: "
                   f"{time.perf_counter() - t_compile:.1f}s)")
             t0 = time.perf_counter()
-        if (i - step0) % args.log_every == 0 or i == steps_run[-1]:
+        elif t0 is not None:
+            timed_steps += 1
+        if (i - step0) % args.log_every == 0 or i == end_step - 1:
             print(f"step {i:>5}  loss {float(loss):.4f}")
             run.append(M.TRAIN_LOSS, float(loss))
         if ck is not None and (i + 1) % args.checkpoint_every == 0:
             ck.save(i, {"params": params, "mom": mom},
-                    {"mesh": mesh_desc, "optimizer": args.optimizer,
-                     "mom_format": MOM_FORMAT, "loss": float(loss),
-                     "pp_interleave": args.pp_interleave})
+                    ckpt_meta(i, float(loss)))
+        last_step = i
+        if monkey is not None:
+            monkey.after_step(i)
+        if preempt is not None and preempt.requested:
+            preempted = True
+            if ck is not None:
+                ck.save(i, {"params": params, "mom": mom},
+                        ckpt_meta(i, float(loss)))
+                print(f"(emergency checkpoint at step {i}; resume with "
+                      "--resume to continue bit-exactly)")
+            else:
+                print(f"({preempt.signame}: stopping after step {i}; no "
+                      "--checkpoint-dir, progress is lost)")
+            break
+        i += 1
     from distributed_neural_network_tpu.utils.timers import hard_block
 
     hard_block(loss)  # value-fetch fence; block_until_ready no-ops on axon
+    if preempt is not None:
+        preempt.uninstall()
+    if hpipe is not None:
+        # settle the last step's observation (counters/trace completeness;
+        # a final-step rollback has nothing left to re-run, and the abort
+        # policy still raises from here)
+        hpipe.flush()
     if ck is not None:
-        ck.save(steps_run[-1], {"params": params, "mom": mom},
-                {"mesh": mesh_desc, "optimizer": args.optimizer,
-                 "mom_format": MOM_FORMAT, "loss": float(loss),
-                 "pp_interleave": args.pp_interleave})
+        if not preempted:
+            ck.save(last_step, {"params": params, "mom": mom},
+                    ckpt_meta(last_step, float(loss)))
         ck.close()
     from distributed_neural_network_tpu.train.measure import (
         model_flops_per_token,
         peak_flops,
     )
 
-    dt = time.perf_counter() - t0 - eval_s if args.steps > 1 else 0.0
-    tok_s = args.batch_size * args.seq_len * (args.steps - 1) / dt if dt else 0.0
+    # timed_steps counts post-compile steps actually executed (guard
+    # replays included, preempted tails excluded), so tokens/s stays
+    # honest under rollbacks and early exits
+    dt = time.perf_counter() - t0 - eval_s if timed_steps else 0.0
+    tok_s = args.batch_size * args.seq_len * timed_steps / dt if dt else 0.0
     flops_tok = model_flops_per_token(cfg, args.seq_len)
     model_flops_s = flops_tok * tok_s
     n_dev = mesh.devices.size
@@ -766,8 +979,13 @@ def main() -> int:
         )
         if pipe else None
     )
+    if guard is not None:
+        print("(guard summary: " + json.dumps(guard.summary()) + ")")
     print("SUMMARY " + json.dumps({
         "mesh": mesh_desc, "steps": args.steps, "start_step": step0,
+        "last_step": last_step, "preempted": preempted,
+        "guard": args.guard,
+        "guard_summary": guard.summary() if guard is not None else None,
         "dtype": args.dtype, "pp_bubble_frac": bubble,
         "grad_sync": args.grad_sync, "accum_steps": args.accum_steps,
         "data_source": stream.source if stream is not None else "copy-task",
@@ -781,4 +999,15 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    try:
+        sys.exit(main())
+    except SystemExit:
+        raise
+    except Exception as e:
+        from distributed_neural_network_tpu.train.guard import GuardAbort
+
+        if isinstance(e, GuardAbort):
+            # actionable one-liner instead of a traceback: the message
+            # already says what happened and what to do next
+            raise SystemExit(f"GUARD ABORT: {e}")
+        raise

@@ -110,6 +110,99 @@ def test_regime_mismatch_raises(tmp_path, n_devices):
         ck.restore_latest(other)
 
 
+def _tree():
+    import jax.numpy as jnp
+
+    return {"a": jnp.arange(8.0).reshape(4, 2), "b": jnp.ones((3,))}
+
+
+def test_corrupt_newest_checkpoint_falls_back(tmp_path):
+    """A truncated newest step raises a clear 'corrupt/truncated' error
+    internally and restore_latest falls back to the previous step."""
+    from distributed_neural_network_tpu.utils.checkpoint import (
+        CheckpointCorruptError,
+        TreeCheckpointer,
+    )
+
+    tree = _tree()
+    ck = TreeCheckpointer(str(tmp_path / "c"), backend="npz", keep=0)
+    ck.save(1, tree, {"note": "one"})
+    ck.save(2, tree, {"note": "two"})
+    # truncate step 2's archive mid-file (crash during write on a
+    # filesystem without atomic rename semantics)
+    p = tmp_path / "c" / "step_2" / "state.npz"
+    p.write_bytes(p.read_bytes()[:20])
+    with pytest.raises(CheckpointCorruptError, match=r"step 2"):
+        ck._b.restore(2, tree)
+    logs = []
+    state, meta, step = ck.restore_latest(tree, log=logs.append)
+    assert step == 1 and meta["note"] == "one"
+    assert any("corrupt/truncated checkpoint (step 2)" in s for s in logs)
+    ck.close()
+
+
+def test_wrong_layout_is_corrupt_not_cryptic(tmp_path):
+    """Leaf-count / shape / dtype mismatches against the template raise
+    CheckpointCorruptError with the failing leaf named, instead of a
+    cryptic unflatten failure."""
+    import jax.numpy as jnp
+
+    from distributed_neural_network_tpu.utils.checkpoint import (
+        CheckpointCorruptError,
+        TreeCheckpointer,
+    )
+
+    tree = _tree()
+    ck = TreeCheckpointer(str(tmp_path / "c"), backend="npz")
+    ck.save(1, tree, {})
+    with pytest.raises(CheckpointCorruptError, match="stored leaves"):
+        ck._b.restore(1, {**tree, "c": jnp.zeros((2,))})
+    with pytest.raises(CheckpointCorruptError, match="shape"):
+        ck._b.restore(1, {"a": jnp.zeros((2, 2)), "b": jnp.ones((3,))})
+    with pytest.raises(CheckpointCorruptError, match="dtype"):
+        ck._b.restore(
+            1, {"a": jnp.zeros((4, 2)), "b": jnp.ones((3,), jnp.int32)}
+        )
+    ck.close()
+
+
+def test_all_checkpoints_corrupt_raises(tmp_path):
+    from distributed_neural_network_tpu.utils.checkpoint import (
+        CheckpointCorruptError,
+        TreeCheckpointer,
+    )
+
+    tree = _tree()
+    ck = TreeCheckpointer(str(tmp_path / "c"), backend="npz")
+    ck.save(1, tree, {})
+    (tmp_path / "c" / "step_1" / "state.npz").write_bytes(b"not a zip")
+    with pytest.raises(CheckpointCorruptError):
+        ck.restore_latest(tree, log=lambda *_: None)
+    ck.close()
+
+
+def test_stale_tmp_dirs_swept_on_init(tmp_path):
+    """A crash between the tmp write and the atomic rename leaks a
+    step_*.tmp dir forever; backend init sweeps it."""
+    import os
+
+    from distributed_neural_network_tpu.utils.checkpoint import (
+        TreeCheckpointer,
+    )
+
+    d = tmp_path / "c"
+    stale = d / "step_7.tmp"
+    stale.mkdir(parents=True)
+    (stale / "state.npz").write_bytes(b"partial")
+    live = d / "step_3"
+    live.mkdir()
+    ck = TreeCheckpointer(str(d), backend="npz")
+    assert not stale.exists()
+    assert live.exists()  # only *.tmp staging dirs are swept
+    assert ck.latest_step() == 3
+    ck.close()
+
+
 def test_tree_checkpointer_roundtrip(tmp_path, n_devices):
     """TreeCheckpointer: arbitrary pytree + meta, sharded re-placement."""
     import jax

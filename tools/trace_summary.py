@@ -172,6 +172,12 @@ def fmt_step_stats(s: dict, source: str) -> str:
             "  device memory peak: "
             + ", ".join(f"{k}={v:,} B" for k, v in sorted(mem.items()))
         )
+    anom = s.get("anomalies")
+    if anom:
+        lines.append(
+            "  guard anomalies: "
+            + ", ".join(f"{k}={v}" for k, v in sorted(anom.items()))
+        )
     if s.get("mfu_pct") is not None:
         lines.append(
             f"  est. MFU: {s['mfu_pct']:.2f}% "
@@ -182,6 +188,29 @@ def fmt_step_stats(s: dict, source: str) -> str:
             f"  est. MFU: {s.get('mfu_note') or 'unavailable'}"
         )
     return "\n".join(lines)
+
+
+def guard_events_table(events) -> str | None:
+    """One line per guard action with counts, from the `guard` instant
+    events the policy loop emits (train/guard.py; docs/ROBUSTNESS.md) -
+    None when the trace carries none."""
+    by_action = defaultdict(int)
+    by_kind = defaultdict(int)
+    for ev in events:
+        if ev.get("name") != "guard" or ev.get("ph") != "i":
+            continue
+        args = ev.get("args") or {}
+        by_action[str(args.get("action", "?"))] += 1
+        by_kind[str(args.get("kind", "?"))] += 1
+    if not by_action:
+        return None
+    return (
+        "Guard events: "
+        + ", ".join(f"{k}={v}" for k, v in sorted(by_action.items()))
+        + "  (kinds: "
+        + ", ".join(f"{k}={v}" for k, v in sorted(by_kind.items()))
+        + ")"
+    )
 
 
 def jsonl_step_series(path: str) -> str:
@@ -254,6 +283,10 @@ def main(argv=None) -> int:
     )
     print()
     print(phase_table(events))
+    guard_line = guard_events_table(events)
+    if guard_line:
+        print()
+        print(guard_line)
     print()
     stats = doc.get("stepStats")
     if isinstance(stats, dict) and stats:
