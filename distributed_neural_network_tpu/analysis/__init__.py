@@ -1,0 +1,62 @@
+"""shardlint: static sharding, collective, and donation analysis.
+
+Every sharding invariant in this framework used to be enforced only by
+RUNNING the code - a wrong PartitionSpec, a dropped ``donate_argnums``, or
+an accidental O(D) all-gather in the ZeRO path surfaced as a slow or OOM
+run on real hardware. This package is the correctness gate that runs on
+CPU, before a TPU ever sees the change (docs/STATIC_ANALYSIS.md):
+
+- ``trace``    - abstractly trace a `StepProgram` (train/program.py) via
+  ``jax.make_jaxpr`` - no execution, no devices beyond the host - and
+  walk the closed jaxpr (descending into scan/while/cond/pjit/shard_map/
+  remat sub-jaxprs) collecting every collective with its axes, payload
+  bytes, and static multiplicity, every dtype upcast, and every scan
+  carry footprint.
+- ``lint``     - spec lint (axes exist, no duplicate axis, divisible
+  dims; parallel/partition.py validators), donation audit (state args
+  donated and aliasable), ZeRO replication-leak check (the in-scan
+  gradient carry really is O(D/dp)), and precision lint (no f64 on the
+  hot path).
+- ``manifest`` - the expected-collectives contract: a checked-in JSON
+  per canonical config (analysis/manifests/*.json) that ``--check``
+  diffs fresh traces against, so an extra all-gather or a de-bucketed
+  reduce fails statically with the op, axes, and byte count named.
+- ``configs``  - the canonical train-step configs (dp/tp/zero/zero-adam/
+  pp x grad_sync end/overlap, plus the CNN engine's epoch program).
+- ``runner``   - the library API behind tools/shardlint.py
+  (``run_shardlint``).
+"""
+
+from .configs import CANONICAL_CONFIGS, build_program, config_names
+from .lint import Finding, lint_program
+from .manifest import (
+    MANIFEST_SCHEMA,
+    build_manifest,
+    default_manifest_dir,
+    diff_manifests,
+    load_manifest,
+    manifest_path,
+    save_manifest,
+)
+from .runner import analyze_program, run_shardlint
+from .trace import CollectiveSite, TraceFacts, collect_trace
+
+__all__ = [
+    "CANONICAL_CONFIGS",
+    "CollectiveSite",
+    "Finding",
+    "MANIFEST_SCHEMA",
+    "TraceFacts",
+    "analyze_program",
+    "build_manifest",
+    "build_program",
+    "collect_trace",
+    "config_names",
+    "default_manifest_dir",
+    "diff_manifests",
+    "lint_program",
+    "load_manifest",
+    "manifest_path",
+    "run_shardlint",
+    "save_manifest",
+]

@@ -1,0 +1,159 @@
+"""Canonical train-step configs the static analyzer traces.
+
+One entry per parallel regime x gradient-sync schedule the framework
+ships: dp / tp / zero / zero-adam / pp, each under the end and (where it
+exists) overlap schedules, plus the CNN engine's fused epoch program. Each
+builder returns a `StepProgram` (train/program.py) over a TINY model - the
+analyzer pins collective STRUCTURE (which ops, which axes, how many, in
+what ratio to the parameter bytes), not production shapes, so traces stay
+sub-second on a laptop CPU and the manifests stay readable.
+
+All builders run under ``compat.trace_compat()`` so they work on jax
+builds without ``jax.shard_map`` (the step is only traced, never
+executed - compat.py).
+
+Meshes use 8 devices (the repo-standard
+``--xla_force_host_platform_device_count=8`` virtual CPU mesh; tests get
+it from conftest.py, tools/shardlint.py sets it before importing jax).
+"""
+
+from __future__ import annotations
+
+import jax
+
+from .. import compat
+
+# tiny trace model: big enough that every leaf family (embed/head/norms/
+# attention/mlp) is present and dims divide an 8-device mesh, small enough
+# to trace in well under a second
+TRACE_VOCAB = 64
+TRACE_D_MODEL = 32
+TRACE_HEADS = 4
+TRACE_LAYERS = 2
+TRACE_D_FF = 64
+TRACE_BATCH = 8
+TRACE_SEQ = 16
+# small cap so the tiny tree still splits into >1 bucket per spec group -
+# the overlap manifests then pin the BUCKETED shape of the schedule
+TRACE_BUCKET_MB = 0.002
+
+
+def _trace_cfg():
+    from ..models import transformer as tfm
+
+    return tfm.TransformerConfig(
+        vocab_size=TRACE_VOCAB, d_model=TRACE_D_MODEL, n_heads=TRACE_HEADS,
+        n_layers=TRACE_LAYERS, d_ff=TRACE_D_FF,
+    )
+
+
+def _require_devices(n: int):
+    if jax.device_count() < n:
+        raise RuntimeError(
+            f"shardlint configs need {n} devices, have {jax.device_count()} "
+            "- run with XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+            "set BEFORE jax is imported (tools/shardlint.py does this)"
+        )
+
+
+def _lm(name, *, dp=4, sp=1, tp=1, optimizer="sgd", **kw):
+    from ..train import lm as lmtrain
+
+    def build():
+        _require_devices(dp * sp * tp)
+        cfg = _trace_cfg()
+        mesh = lmtrain.create_lm_mesh(dp, sp, tp)
+        with compat.trace_compat():
+            return lmtrain.lm_step_program(
+                cfg, mesh, batch=TRACE_BATCH, seq_len=TRACE_SEQ, name=name,
+                optimizer=optimizer, bucket_mb=TRACE_BUCKET_MB, **kw,
+            )
+
+    return build
+
+
+def _pp(name, *, dp=2, pp=2, optimizer="sgd", **kw):
+    from ..parallel import pipeline as ppl
+
+    def build():
+        _require_devices(dp * pp)
+        cfg = _trace_cfg()
+        mesh = ppl.create_pp_mesh(dp, pp, 1)
+        with compat.trace_compat():
+            return ppl.pp_step_program(
+                cfg, mesh, batch=TRACE_BATCH, seq_len=TRACE_SEQ, name=name,
+                optimizer=optimizer, n_microbatches=2,
+                bucket_mb=TRACE_BUCKET_MB, **kw,
+            )
+
+    return build
+
+
+def _cnn(name, phase):
+    def build():
+        _require_devices(4)
+        from ..data.cifar10 import load_split
+        from ..train.engine import Engine, TrainConfig
+
+        with compat.trace_compat():
+            engine = Engine(
+                TrainConfig(nb_proc=4, batch_size=8, epochs=1),
+                load_split(True, source="synthetic", synthetic_size=64),
+                None,
+            )
+            progs = {p.name: p for p in engine.step_programs()}
+        if phase not in progs:
+            raise RuntimeError(
+                f"{name}: engine exposed no {phase!r} program "
+                f"(has {list(progs)})"
+            )
+        prog = progs[phase]
+        object.__setattr__(prog, "name", name)
+        return prog
+
+    return build
+
+
+OVERLAP = dict(accum_steps=2, grad_sync="overlap")
+
+CANONICAL_CONFIGS = {
+    # dp: replicated params, grad sync over 'data' (+ the end/overlap pair)
+    "lm_dp": _lm("lm_dp"),
+    "lm_dp_overlap": _lm("lm_dp_overlap", **OVERLAP),
+    # adam: same sync, 2x state in the donation contract
+    "lm_adam": _lm("lm_adam", optimizer="adam"),
+    # tp: per-block forward psums over 'model'
+    "lm_tp": _lm("lm_tp", dp=2, tp=2),
+    # ZeRO-1 family: per-leaf all_gather reassembly; overlap adds the
+    # in-scan bucketed reduce-scatter with the O(D/dp) shard carry
+    "lm_zero": _lm("lm_zero", optimizer="zero"),
+    "lm_zero_overlap": _lm("lm_zero_overlap", optimizer="zero", **OVERLAP),
+    "lm_zero_adam": _lm("lm_zero_adam", optimizer="zero-adam"),
+    "lm_zero_adam_overlap": _lm(
+        "lm_zero_adam_overlap", optimizer="zero-adam", **OVERLAP
+    ),
+    # pipeline: per-tick ppermute ring + the exit all_to_all
+    "pp_gpipe": _pp("pp_gpipe"),
+    "pp_overlap": _pp("pp_overlap", **OVERLAP),
+    "pp_zero": _pp("pp_zero", optimizer="zero"),
+    # the CNN engine: the sharded local-SGD epoch (no collectives by
+    # design - local training) and the fault-masked parameter-average
+    # sync phase (where the epoch-edge psums live)
+    "cnn_dp": _cnn("cnn_dp", "cnn_train_epoch"),
+    "cnn_sync": _cnn("cnn_sync", "cnn_sync"),
+}
+
+
+def config_names() -> list:
+    return list(CANONICAL_CONFIGS)
+
+
+def build_program(name: str):
+    try:
+        build = CANONICAL_CONFIGS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown shardlint config {name!r}; known configs: "
+            f"{', '.join(CANONICAL_CONFIGS)}"
+        ) from None
+    return build()

@@ -1,0 +1,229 @@
+"""Lint rules over a StepProgram and its TraceFacts.
+
+Four families, mirroring the failure classes that used to need real
+hardware to surface (docs/STATIC_ANALYSIS.md):
+
+- **spec lint** - every PartitionSpec in the program's wiring references
+  only mesh axes that exist, never uses an axis twice, and shards only
+  divisible dims (parallel/partition.py validators, applied to the
+  abstract shapes).
+- **donation audit** - the state arguments the builder promises to donate
+  (params, optimizer state) are actually donated at the jit boundary, and
+  every donated buffer has a shape/dtype-matching output XLA can alias
+  (a donated-but-unaliasable arg silently doubles peak memory).
+- **replication-leak check** - under the ZeRO overlap schedule the in-scan
+  gradient accumulator must be O(D/dp): a full-size carry means the
+  reduce-scatter sharding leaked back to replicated.
+- **precision lint** - no f64 anywhere on the step (an accidental Python
+  float promotion upcasts a whole tree); float upcasts (bf16->f32 etc.)
+  are not errors but are pinned in the manifest, so growth fails --check.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class Finding:
+    severity: str  # "error" | "warn"
+    code: str
+    message: str
+
+    def __str__(self):
+        return f"[{self.severity}] {self.code}: {self.message}"
+
+
+def lint_program(program, facts) -> list:
+    """All lint findings for one traced program (errors first)."""
+    findings = []
+    findings += spec_lint(program)
+    findings += donation_audit(program, facts)
+    findings += replication_leak_lint(program, facts)
+    findings += precision_lint(program, facts)
+    return sorted(findings, key=lambda f: (f.severity != "error", f.code))
+
+
+# ------------------------------------------------------------- spec lint
+
+
+def spec_lint(program) -> list:
+    """Validate the program's PartitionSpec wiring against its mesh and
+    abstract shapes (parallel/partition.py)."""
+    from ..parallel.partition import validate_spec_tree
+
+    mesh_axes = dict(program.mesh.shape)
+    findings = []
+    shaped = {
+        "params": program.abstract_args[0] if program.abstract_args else None,
+        "opt": program.abstract_args[1]
+        if len(program.abstract_args) > 1 else None,
+        "data": program.abstract_args[2]
+        if len(program.abstract_args) > 2 else None,
+    }
+    for label, specs in (program.specs or {}).items():
+        try:
+            validate_spec_tree(
+                specs, mesh_axes, shapes=shaped.get(label), root=label
+            )
+        except ValueError as e:
+            findings.append(Finding("error", "spec-lint", str(e)))
+    return findings
+
+
+# -------------------------------------------------------- donation audit
+
+
+def donation_audit(program, facts) -> list:
+    """Donated-state coverage + XLA aliasability of every donated buffer."""
+    findings = []
+    donated = facts.donated_invars
+    if donated is None:
+        findings.append(
+            Finding(
+                "warn", "donation",
+                f"{program.name}: no jit boundary with donated_invars found "
+                "in the trace - donation cannot be audited",
+            )
+        )
+        return findings
+    import jax
+
+    counts = program.arg_leaf_counts()
+    if sum(counts) != len(donated):
+        findings.append(
+            Finding(
+                "warn", "donation",
+                f"{program.name}: trace has {len(donated)} flat args, the "
+                f"program signature has {sum(counts)} - argument mapping "
+                "out of sync, donation audit skipped",
+            )
+        )
+        return findings
+    offsets = [0]
+    for c in counts:
+        offsets.append(offsets[-1] + c)
+    want = set(program.donate)
+    for argnum, label in zip(
+        range(len(counts)),
+        list(program.donate_labels)
+        + ["arg%d" % i for i in range(len(program.donate_labels), len(counts))],
+    ):
+        flags = donated[offsets[argnum]:offsets[argnum + 1]]
+        if argnum in want and not all(flags):
+            leaves = jax.tree_util.tree_flatten_with_path(
+                program.abstract_args[argnum]
+            )[0]
+            bad = [
+                jax.tree_util.keystr(p)
+                for (p, _), f in zip(leaves, flags) if not f
+            ]
+            findings.append(
+                Finding(
+                    "error", "donation",
+                    f"{program.name}: {label} (arg {argnum}) must be "
+                    f"donated but {len(bad)}/{len(flags)} leaves are not "
+                    f"(e.g. {bad[:3]}) - the step double-buffers its own "
+                    "state; restore donate_argnums",
+                )
+            )
+        if argnum not in want and any(flags):
+            findings.append(
+                Finding(
+                    "warn", "donation",
+                    f"{program.name}: arg {argnum} ({label}) is donated "
+                    "but not part of the builder's donation contract",
+                )
+            )
+    # aliasability: every donated input aval needs a matching output aval
+    out_pool = {}
+    for aval in facts.out_avals:
+        if aval is not None and hasattr(aval, "shape"):
+            key = (tuple(aval.shape), np.dtype(aval.dtype).name)
+            out_pool[key] = out_pool.get(key, 0) + 1
+    for flag, aval in zip(donated, facts.in_avals):
+        if not flag or aval is None or not hasattr(aval, "shape"):
+            continue
+        key = (tuple(aval.shape), np.dtype(aval.dtype).name)
+        if out_pool.get(key, 0) > 0:
+            out_pool[key] -= 1
+        else:
+            # deliberate non-aliased donations exist (frees the buffer
+            # early without in-place reuse - e.g. the engine's stacked
+            # sync input); a program opts out of the error with
+            # meta["expect_alias"] = False
+            severity = (
+                "error"
+                if (program.meta or {}).get("expect_alias", True)
+                else "warn"
+            )
+            findings.append(
+                Finding(
+                    severity, "donation-alias",
+                    f"{program.name}: donated buffer {key[0]} {key[1]} has "
+                    "no shape/dtype-matching output - XLA cannot alias it "
+                    "in place (the donation only frees it early)",
+                )
+            )
+    return findings
+
+
+# -------------------------------------------------- replication-leak lint
+
+
+def replication_leak_lint(program, facts) -> list:
+    """ZeRO overlap schedule: the gradient-accumulation scan must carry the
+    1/dp reduce-scattered shard, never the full O(D) tree."""
+    meta = program.meta or {}
+    if not (
+        str(meta.get("optimizer", "")).startswith("zero")
+        and meta.get("grad_sync") == "overlap"
+        and int(meta.get("accum_steps", 1)) > 1
+    ):
+        return []
+    dp = int(meta.get("dp", 1))
+    d_bytes = program.param_bytes()
+    carry = facts.reduce_scatter_carry_bytes
+    if carry is None:
+        return [
+            Finding(
+                "error", "zero-leak",
+                f"{program.name}: optimizer={meta.get('optimizer')!r} with "
+                "grad_sync='overlap' but no scan with an in-body "
+                "reduce_scatter was found - the ZeRO shard-carry schedule "
+                "is not running",
+            )
+        ]
+    # shard carry ~= D/dp (+ per-bucket ceil padding + the loss scalar);
+    # anything at half the full tree or more means the sharding leaked
+    if carry >= d_bytes // 2 and dp > 1:
+        return [
+            Finding(
+                "error", "zero-leak",
+                f"{program.name}: in-scan gradient accumulator carries "
+                f"{carry:,} B but the full parameter tree is only "
+                f"{d_bytes:,} B (dp={dp}) - the ZeRO reduce-scatter carry "
+                f"should be ~{d_bytes // max(dp, 1):,} B; a full-size "
+                "intermediate has leaked into the scan",
+            )
+        ]
+    return []
+
+
+# --------------------------------------------------------- precision lint
+
+
+def precision_lint(program, facts) -> list:
+    if facts.f64_sites:
+        return [
+            Finding(
+                "error", "precision-f64",
+                f"{program.name}: {facts.f64_sites} float64 value(s) in "
+                "the step - an accidental f32->f64 promotion doubles "
+                "bytes and runs off the MXU; cast the offending constant "
+                "or disable x64",
+            )
+        ]
+    return []

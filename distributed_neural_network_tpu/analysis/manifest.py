@@ -1,0 +1,181 @@
+"""Expected-collectives manifests: the checked-in contract per config.
+
+A manifest (analysis/manifests/<config>.json) pins, for one canonical
+train-step config, exactly which collectives the compiled step issues -
+op, mesh axes, payload bytes per call, static call count - plus the dtype
+upcasts, the donation contract, and the scan-carry footprints.
+``--check`` re-traces the config and diffs against the manifest: an
+accidental extra all-gather, a de-bucketed reduce, or a dropped donation
+fails statically with the op, axes, and byte count named.
+
+Manifests are jax-version-stamped: the traced program differs across jax
+generations (pre-``jax.shard_map`` builds trace without the vma-typed
+autodiff psums - see compat.py), so a version change requires
+regenerating with ``--write-manifest`` (docs/STATIC_ANALYSIS.md). CI pins
+the version for exactly this reason.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+MANIFEST_SCHEMA = 1
+
+
+def default_manifest_dir() -> str:
+    return os.path.join(os.path.dirname(__file__), "manifests")
+
+
+def manifest_path(name: str, manifest_dir: str | None = None) -> str:
+    return os.path.join(manifest_dir or default_manifest_dir(), f"{name}.json")
+
+
+def build_manifest(program, facts) -> dict:
+    """The manifest document for one traced program."""
+    import jax
+
+    donated = facts.donated_invars
+    return {
+        "schema": MANIFEST_SCHEMA,
+        "config": program.name,
+        "jax_version": jax.__version__,
+        "trace_mode": _trace_mode(),
+        "mesh": {k: int(v) for k, v in program.mesh.shape.items()},
+        "meta": _jsonable(program.meta),
+        "param_bytes": program.param_bytes(),
+        "collectives": [
+            {
+                "op": c.op,
+                "axes": list(c.axes),
+                "bytes_per_call": int(c.bytes_per_call),
+                "count": int(c.count),
+                "total_bytes": int(c.total_bytes),
+                **({"dynamic": True} if c.dynamic else {}),
+            }
+            for c in facts.collectives
+        ],
+        "collective_totals": facts.op_totals(),
+        "total_collective_bytes": facts.total_collective_bytes(),
+        "upcasts": {
+            k: dict(v) for k, v in sorted(facts.upcasts.items())
+        },
+        "donation": {
+            "argnums": list(program.donate),
+            "n_donated": int(sum(donated)) if donated is not None else None,
+            "n_args": len(donated) if donated is not None else None,
+        },
+        "scan_carry_max_bytes": int(facts.scan_carry_max_bytes),
+        "reduce_scatter_carry_bytes": (
+            int(facts.reduce_scatter_carry_bytes)
+            if facts.reduce_scatter_carry_bytes is not None else None
+        ),
+        "has_dynamic_loop": bool(facts.has_dynamic_loop),
+    }
+
+
+def save_manifest(doc: dict, name: str, manifest_dir: str | None = None) -> str:
+    path = manifest_path(name, manifest_dir)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True, allow_nan=False)
+        f.write("\n")
+    return path
+
+
+def load_manifest(name: str, manifest_dir: str | None = None) -> dict:
+    path = manifest_path(name, manifest_dir)
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"no manifest for config {name!r} at {path} - generate one "
+            f"with: python tools/shardlint.py --config {name} "
+            "--write-manifest"
+        )
+    with open(path) as f:
+        return json.load(f)
+
+
+def _coll_key(c: dict) -> tuple:
+    return (c["op"], tuple(c["axes"]), int(c["bytes_per_call"]),
+            bool(c.get("dynamic", False)))
+
+
+def _fmt_coll(c: dict) -> str:
+    dyn = " (dynamic trip count)" if c.get("dynamic") else ""
+    return (
+        f"{c['op']} over axes {tuple(c['axes'])}, "
+        f"{c['bytes_per_call']:,} B/call x{c['count']}{dyn}"
+    )
+
+
+def diff_manifests(expected: dict, actual: dict) -> list:
+    """Human-actionable differences (empty list == conforming).
+
+    Environment mismatches (jax version / trace mode) short-circuit with a
+    regenerate instruction instead of producing a confusing byte diff.
+    """
+    msgs = []
+    for key in ("jax_version", "trace_mode"):
+        if expected.get(key) != actual.get(key):
+            return [
+                f"manifest for {expected.get('config')!r} was written under "
+                f"{key}={expected.get(key)!r} but this run has "
+                f"{key}={actual.get(key)!r}: the traced program is not "
+                "comparable across jax generations - regenerate with "
+                "--write-manifest (docs/STATIC_ANALYSIS.md)"
+            ]
+    if expected.get("mesh") != actual.get("mesh"):
+        return [
+            f"mesh mismatch: manifest {expected.get('mesh')} vs traced "
+            f"{actual.get('mesh')} - regenerate or fix the config"
+        ]
+    exp = {_coll_key(c): c for c in expected.get("collectives", [])}
+    act = {_coll_key(c): c for c in actual.get("collectives", [])}
+    for key in sorted(set(exp) | set(act), key=str):
+        e, a = exp.get(key), act.get(key)
+        if e is None:
+            msgs.append(f"EXTRA collective not in manifest: {_fmt_coll(a)}")
+        elif a is None:
+            msgs.append(f"MISSING collective from manifest: {_fmt_coll(e)}")
+        elif e["count"] != a["count"]:
+            msgs.append(
+                f"collective count changed: {_fmt_coll(e)} -> x{a['count']}"
+            )
+    if expected.get("upcasts") != actual.get("upcasts"):
+        msgs.append(
+            f"dtype upcasts changed: manifest {expected.get('upcasts')} vs "
+            f"traced {actual.get('upcasts')}"
+        )
+    eb = expected.get("total_collective_bytes")
+    ab = actual.get("total_collective_bytes")
+    if eb != ab and not any(m.startswith(("EXTRA", "MISSING", "collective"))
+                            for m in msgs):
+        msgs.append(
+            f"total collective bytes changed: {eb:,} -> {ab:,} per step"
+        )
+    ed, ad = expected.get("donation") or {}, actual.get("donation") or {}
+    if ed != ad:
+        msgs.append(f"donation contract changed: manifest {ed} vs traced {ad}")
+    er = expected.get("reduce_scatter_carry_bytes")
+    ar = actual.get("reduce_scatter_carry_bytes")
+    if er != ar:
+        msgs.append(
+            f"ZeRO in-scan carry changed: manifest {er} B vs traced {ar} B"
+        )
+    return msgs
+
+
+def _trace_mode() -> str:
+    from .. import compat
+
+    return compat.trace_mode()
+
+
+def _jsonable(x):
+    if isinstance(x, dict):
+        return {str(k): _jsonable(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return [_jsonable(v) for v in x]
+    if isinstance(x, (str, int, float, bool)) or x is None:
+        return x
+    return str(x)

@@ -1,0 +1,125 @@
+"""The shardlint driver: build -> trace -> lint -> manifest write/check.
+
+Library API behind tools/shardlint.py and tests/test_shardlint.py:
+
+    result = analyze_program(program)        # one StepProgram
+    rc, report = run_shardlint(["lm_zero_overlap"], mode="check")
+
+``run_shardlint`` returns a process-style exit code (0 conforming,
+1 findings/diffs, 2 config could not be built/traced) plus a printable
+report, so the CLI is a thin argv wrapper.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from .configs import build_program, config_names
+from .lint import lint_program
+from .manifest import (
+    build_manifest,
+    diff_manifests,
+    load_manifest,
+    save_manifest,
+)
+from .trace import collect_trace
+
+
+@dataclass
+class AnalysisResult:
+    program: object
+    facts: object
+    manifest: dict
+    findings: list = field(default_factory=list)
+
+    @property
+    def errors(self) -> list:
+        return [f for f in self.findings if f.severity == "error"]
+
+
+def analyze_program(program) -> AnalysisResult:
+    """Trace one StepProgram and run every lint family over it."""
+    facts = collect_trace(program.make_jaxpr())
+    return AnalysisResult(
+        program=program,
+        facts=facts,
+        manifest=build_manifest(program, facts),
+        findings=lint_program(program, facts),
+    )
+
+
+def run_shardlint(
+    names=None,
+    *,
+    mode: str = "lint",
+    manifest_dir: str | None = None,
+    verbose: bool = True,
+):
+    """Analyze configs; mode: 'lint' (no manifest I/O), 'write' (regenerate
+    manifests), 'check' (diff against checked-in manifests). Returns
+    (exit_code, report_str)."""
+    if mode not in ("lint", "write", "check"):
+        raise ValueError(f"mode must be lint/write/check, got {mode!r}")
+    names = list(names) if names else config_names()
+    lines = []
+    worst = 0
+
+    def fail(rc):
+        nonlocal worst
+        worst = max(worst, rc)
+
+    for name in names:
+        t0 = time.perf_counter()
+        try:
+            program = build_program(name)
+            result = analyze_program(program)
+        except Exception as e:
+            fail(2)
+            lines.append(f"{name}: TRACE FAILED - {type(e).__name__}: {e}")
+            continue
+        dt = time.perf_counter() - t0
+        facts = result.facts
+        summary = (
+            f"{name}: {sum(c.count for c in facts.collectives)} collective "
+            f"call(s), {facts.total_collective_bytes():,} B/step, "
+            f"{len(result.findings)} finding(s) [{dt:.1f}s]"
+        )
+        if verbose:
+            lines.append(summary)
+            for c in facts.collectives:
+                dyn = " DYNAMIC" if c.dynamic else ""
+                lines.append(
+                    f"    {c.op:<16} axes={','.join(c.axes) or '-'}  "
+                    f"x{c.count:<4} {c.bytes_per_call:>10,} B/call{dyn}"
+                )
+        for f in result.findings:
+            lines.append(f"    {f}")
+        if result.errors:
+            fail(1)
+        if mode == "write":
+            if result.errors:
+                lines.append(
+                    f"    {name}: NOT writing manifest while lint errors "
+                    "are outstanding"
+                )
+            else:
+                path = save_manifest(result.manifest, name, manifest_dir)
+                lines.append(f"    wrote {path}")
+        elif mode == "check":
+            try:
+                expected = load_manifest(name, manifest_dir)
+            except FileNotFoundError as e:
+                fail(1)
+                lines.append(f"    {e}")
+                continue
+            diffs = diff_manifests(expected, result.manifest)
+            if diffs:
+                fail(1)
+                lines.append(f"    {name}: MANIFEST MISMATCH:")
+                lines.extend(f"      - {d}" for d in diffs)
+            else:
+                lines.append(f"    manifest conforms ({name}.json)")
+    status = {0: "OK", 1: "FAIL", 2: "TRACE ERROR"}[worst]
+    lines.append(f"shardlint: {len(names)} config(s), {status}")
+    return worst, "\n".join(lines)

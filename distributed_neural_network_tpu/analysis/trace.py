@@ -1,0 +1,229 @@
+"""Abstract jaxpr tracing: enumerate collectives, upcasts, scan carries.
+
+``collect_trace(closed_jaxpr)`` walks a step program's jaxpr recursively -
+scan, while, cond, pjit, shard_map, remat, custom_{jvp,vjp} sub-jaxprs all
+descend - and returns `TraceFacts`:
+
+- every collective primitive (psum / all_gather / reduce_scatter /
+  ppermute / all_to_all) with its mesh axes, per-call payload bytes, and
+  STATIC multiplicity (scan bodies multiply by trip count; while bodies
+  have no static count and are flagged ``dynamic``); ``pbroadcast`` /
+  ``pcast`` are type casts that move no data and are not counted;
+- every float-widening ``convert_element_type`` (bf16->f32, f32->f64, ...)
+  with the same multiplicity accounting, plus any f64 result anywhere;
+- each ``scan`` carry's byte footprint, and separately the carries of
+  scans whose bodies issue a reduce_scatter (the ZeRO in-scan gradient
+  accumulators - the replication-leak check compares them to D/dp);
+- the jit boundary's ``donated_invars`` and flat input/output avals for
+  the donation audit.
+
+Byte convention (documented, so manifests are comparable): payload =
+sum of INPUT aval bytes, except all_gather which counts its OUTPUT (the
+materialized gathered buffer). These are logical payload bytes per call
+per device shard-view, not wire bytes - a ring all-reduce moves
+~2(n-1)/n of them (utils/tracing.py collective_bytes_per_sync).
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+import numpy as np
+
+# primitive name -> canonical op name; jax renamed some across versions
+# (the vma-era invariant variants, the pre-vma check_rep rewrite's psum2)
+COLLECTIVE_PRIMS = {
+    "psum": "psum",
+    "psum2": "psum",
+    "psum_invariant": "psum",
+    "all_gather": "all_gather",
+    "all_gather_invariant": "all_gather",
+    "reduce_scatter": "reduce_scatter",
+    "ppermute": "ppermute",
+    "pswapaxes": "all_to_all",
+    "all_to_all": "all_to_all",
+}
+
+
+@dataclass(frozen=True)
+class CollectiveSite:
+    """One collective call site, multiplicity-weighted."""
+
+    op: str
+    axes: tuple  # sorted mesh axis names
+    bytes_per_call: int
+    count: int  # static multiplicity (scan trip counts folded in)
+    dynamic: bool = False  # under a while loop: count is per-iteration
+
+    @property
+    def total_bytes(self) -> int:
+        return self.bytes_per_call * self.count
+
+
+@dataclass
+class TraceFacts:
+    collectives: list = field(default_factory=list)  # CollectiveSite, merged
+    upcasts: dict = field(default_factory=dict)  # "bf16->f32" -> {count, bytes}
+    f64_sites: int = 0
+    scan_carry_max_bytes: int = 0
+    reduce_scatter_carry_bytes: int | None = None  # ZeRO in-scan accumulator
+    donated_invars: tuple | None = None
+    in_avals: list = field(default_factory=list)
+    out_avals: list = field(default_factory=list)
+    has_dynamic_loop: bool = False
+
+    def total_collective_bytes(self) -> int:
+        return sum(c.total_bytes for c in self.collectives)
+
+    def op_totals(self) -> dict:
+        out = {}
+        for c in self.collectives:
+            t = out.setdefault(c.op, {"count": 0, "bytes": 0})
+            t["count"] += c.count
+            t["bytes"] += c.total_bytes
+        return out
+
+
+def _np_dtype(dt):
+    """numpy dtype or None (jax extended dtypes like key<fry> have none)."""
+    try:
+        return np.dtype(dt)
+    except TypeError:
+        return None
+
+
+def _aval_bytes(v) -> int:
+    aval = getattr(v, "aval", None)
+    if aval is None or not hasattr(aval, "shape"):
+        return 0
+    dt = _np_dtype(getattr(aval, "dtype", None))
+    if dt is None:
+        return 0
+    return int(np.prod(aval.shape, dtype=np.int64)) * dt.itemsize
+
+
+def _axes_of(params) -> tuple:
+    axes = params.get("axes", params.get("axis_name", ()))
+    if isinstance(axes, str):
+        axes = (axes,)
+    return tuple(sorted(str(a) for a in axes))
+
+
+def _sub_jaxprs(eqn):
+    """(sub_jaxpr, kind) pairs for every jaxpr-valued param of an eqn."""
+    out = []
+    for k, v in eqn.params.items():
+        vs = v if isinstance(v, (tuple, list)) else (v,)
+        for x in vs:
+            core = getattr(x, "jaxpr", x)
+            if hasattr(core, "eqns"):
+                out.append((core, k))
+    return out
+
+
+def _is_float(dt) -> bool:
+    # jnp.issubdtype, not np: ml_dtypes floats (bfloat16, fp8) are not in
+    # numpy's own type lattice
+    import jax.numpy as jnp
+
+    return jnp.issubdtype(dt, jnp.floating)
+
+
+def collect_trace(closed_jaxpr) -> TraceFacts:
+    """Walk a ClosedJaxpr (e.g. ``jax.make_jaxpr(step)(*abstract_args)``)
+    and collect `TraceFacts`. Purely structural - nothing executes."""
+    facts = TraceFacts()
+    top = closed_jaxpr.jaxpr
+    facts.in_avals = [getattr(v, "aval", None) for v in top.invars]
+    facts.out_avals = [getattr(v, "aval", None) for v in top.outvars]
+
+    # the jit boundary: the top-level eqn carrying donated_invars (there is
+    # exactly one for a jitted step; pick the widest if several)
+    best = None
+    for eqn in top.eqns:
+        if "donated_invars" in eqn.params:
+            if best is None or len(eqn.invars) > len(best.invars):
+                best = eqn
+    if best is not None:
+        facts.donated_invars = tuple(best.params["donated_invars"])
+        facts.out_avals = [getattr(v, "aval", None) for v in best.outvars]
+
+    raw = defaultdict(int)  # (op, axes, bytes, dynamic) -> count
+
+    def walk(jaxpr, mult: int, dynamic: bool):
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            op = COLLECTIVE_PRIMS.get(name)
+            if op is not None:
+                if op == "all_gather":
+                    nbytes = sum(_aval_bytes(v) for v in eqn.outvars)
+                else:
+                    nbytes = sum(_aval_bytes(v) for v in eqn.invars)
+                raw[(op, _axes_of(eqn.params), nbytes, dynamic)] += mult
+            elif name == "convert_element_type":
+                src_aval = getattr(eqn.invars[0], "aval", None)
+                src = _np_dtype(getattr(src_aval, "dtype", None))
+                dst = _np_dtype(eqn.params.get("new_dtype"))
+                if (
+                    src is not None and dst is not None
+                    and _is_float(src) and _is_float(dst)
+                    and dst.itemsize > src.itemsize
+                ):
+                    key = f"{src.name}->{dst.name}"
+                    rec = facts.upcasts.setdefault(
+                        key, {"count": 0, "bytes": 0}
+                    )
+                    rec["count"] += mult
+                    rec["bytes"] += mult * sum(
+                        _aval_bytes(v) for v in eqn.outvars
+                    )
+            for v in eqn.outvars:
+                aval = getattr(v, "aval", None)
+                dt = _np_dtype(getattr(aval, "dtype", None))
+                if dt is not None and dt == np.float64:
+                    facts.f64_sites += mult
+
+            if name == "scan":
+                body = eqn.params["jaxpr"].jaxpr
+                nc, nk = eqn.params["num_consts"], eqn.params["num_carry"]
+                carry = sum(
+                    _aval_bytes(v) for v in eqn.invars[nc:nc + nk]
+                )
+                facts.scan_carry_max_bytes = max(
+                    facts.scan_carry_max_bytes, carry
+                )
+                if _contains_op(body, "reduce_scatter"):
+                    prev = facts.reduce_scatter_carry_bytes or 0
+                    facts.reduce_scatter_carry_bytes = max(prev, carry)
+                walk(body, mult * int(eqn.params["length"]), dynamic)
+            elif name == "while":
+                facts.has_dynamic_loop = True
+                for sub, _ in _sub_jaxprs(eqn):
+                    walk(sub, mult, True)
+            else:
+                for sub, _ in _sub_jaxprs(eqn):
+                    walk(sub, mult, dynamic)
+
+    walk(top, 1, False)
+    facts.collectives = sorted(
+        (
+            CollectiveSite(
+                op=op, axes=axes, bytes_per_call=nbytes, count=count,
+                dynamic=dyn,
+            )
+            for (op, axes, nbytes, dyn), count in raw.items()
+        ),
+        key=lambda c: (c.op, c.axes, -c.bytes_per_call, c.dynamic),
+    )
+    return facts
+
+
+def _contains_op(jaxpr, op: str) -> bool:
+    for eqn in jaxpr.eqns:
+        if COLLECTIVE_PRIMS.get(eqn.primitive.name) == op:
+            return True
+        for sub, _ in _sub_jaxprs(eqn):
+            if _contains_op(sub, op):
+                return True
+    return False

@@ -79,6 +79,8 @@ def pvary_tree(tree, axis_name: str = DATA_AXIS):
             return jax.lax.pcast(x, axis_name, to="varying")
         except ValueError:  # already varying along axis_name
             return x
+        except AttributeError:  # vma-less jax version: typing is vacuous
+            return x
 
     return jax.tree.map(vary, tree)
 

@@ -20,6 +20,111 @@ from __future__ import annotations
 import numpy as np
 
 
+def validate_partition_spec(spec, mesh_axes, *, shape=None, name="array"):
+    """Validate one PartitionSpec against a mesh's axes, failing EARLY.
+
+    Without this, a spec naming a nonexistent mesh axis (or doubling up an
+    axis) surfaces deep inside pjit/shard_map lowering as an opaque
+    internal error; here it raises a ``ValueError`` that names the bad
+    axis, the leaf, and the axes the mesh actually has. Reused by the
+    static analyzer's spec lint (``analysis/lint.py``) and by the step
+    builders (train/lm.py, parallel/pipeline.py) before any compilation.
+
+    ``mesh_axes``: mapping of axis name -> axis size (``dict(mesh.shape)``).
+    ``shape``: optional array shape; when given, additionally checks that
+    the spec is not longer than the rank and that every sharded dim is
+    divisible by the product of its axes' sizes. Specs SHORTER than the
+    rank are valid (trailing dims unsharded - jax's None-padding rule).
+    """
+    entries = tuple(spec)
+    available = tuple(mesh_axes)
+    seen = []
+    for d, entry in enumerate(entries):
+        if entry is None:
+            continue
+        axes = (entry,) if isinstance(entry, str) else tuple(entry)
+        for a in axes:
+            if a not in mesh_axes:
+                raise ValueError(
+                    f"PartitionSpec for {name} names mesh axis {a!r} (dim "
+                    f"{d} of {spec}), but the mesh only has axes "
+                    f"{available} - fix the spec or build the mesh with "
+                    f"that axis"
+                )
+            if a in seen:
+                raise ValueError(
+                    f"PartitionSpec for {name} uses mesh axis {a!r} twice "
+                    f"({spec}): each mesh axis may shard at most one dim "
+                    f"of one array"
+                )
+            seen.append(a)
+    if shape is None:
+        return
+    if len(entries) > len(shape):
+        raise ValueError(
+            f"PartitionSpec for {name} has {len(entries)} entries ({spec}) "
+            f"but the array has rank {len(shape)} (shape {tuple(shape)}); "
+            f"specs may be shorter than the rank, never longer"
+        )
+    for d, entry in enumerate(entries):
+        if entry is None:
+            continue
+        axes = (entry,) if isinstance(entry, str) else tuple(entry)
+        n = 1
+        for a in axes:
+            n *= int(mesh_axes[a])
+        if n > 0 and shape[d] % n:
+            raise ValueError(
+                f"PartitionSpec for {name} shards dim {d} (size "
+                f"{shape[d]}) over {axes} (total {n} shards), which does "
+                f"not divide evenly - pad the dim or change the spec"
+            )
+
+
+def validate_spec_tree(specs, mesh_axes, *, shapes=None, root="params"):
+    """`validate_partition_spec` over a pytree of specs (leaf-aligned
+    optional ``shapes`` tree of arrays/avals), naming each failing leaf by
+    its tree path."""
+    import jax
+    from jax.sharding import PartitionSpec
+
+    def is_spec(s):
+        return isinstance(s, PartitionSpec)
+
+    leaves, treedef = jax.tree_util.tree_flatten(specs, is_leaf=is_spec)
+    paths = [
+        jax.tree_util.keystr(p)
+        for p, _ in jax.tree_util.tree_flatten_with_path(
+            specs, is_leaf=is_spec
+        )[0]
+    ]
+    shape_leaves = (
+        treedef.flatten_up_to(shapes) if shapes is not None
+        else [None] * len(leaves)
+    )
+
+    def shapes_under(arr):
+        # one spec may broadcast over a whole subtree (shard_map's pytree
+        # prefix rule): validate it against every array leaf underneath
+        if arr is None:
+            return [None]
+        if hasattr(arr, "shape"):
+            return [arr.shape]
+        if isinstance(arr, tuple) and all(isinstance(i, int) for i in arr):
+            return [arr]
+        return [
+            leaf.shape
+            for leaf in jax.tree_util.tree_leaves(arr)
+            if hasattr(leaf, "shape")
+        ] or [None]
+
+    for spec, path, arr in zip(leaves, paths, shape_leaves):
+        for shape in shapes_under(arr):
+            validate_partition_spec(
+                spec, mesh_axes, shape=shape, name=f"{root}{path or ''}"
+            )
+
+
 def shard_size(total: int, n_shards: int) -> int:
     if n_shards <= 0:
         raise ValueError(f"n_shards must be positive, got {n_shards}")

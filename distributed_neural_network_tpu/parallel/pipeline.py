@@ -50,6 +50,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import compat
 from ..models import transformer as tfm
 from ..ops.sgd import sgd_step
 from .collectives import vary_like
@@ -137,7 +138,7 @@ def pipeline_lm_loss(
     backward pipeline). Requires P | M (whole groups) and v*P | L.
     v=1 is exactly the GPipe schedule.
     """
-    n_pipe = jax.lax.axis_size(pipe_axis)
+    n_pipe = compat.axis_size(pipe_axis)
     stage = jax.lax.axis_index(pipe_axis)
     m = n_microbatches
     v = interleave
@@ -298,7 +299,7 @@ def pipeline_lm_loss(
     # global token count is static: every data-shard holds tokens.size tokens
     n_tokens = tokens.size
     for a in sync_axes:
-        n_tokens = n_tokens * jax.lax.axis_size(a)
+        n_tokens = n_tokens * compat.axis_size(a)
     loss = total / jnp.float32(n_tokens)
     if cfg.n_experts:
         # masked per-tick aux sums -> mean over (layers x microbatches),
@@ -308,7 +309,7 @@ def pipeline_lm_loss(
         aux_total = jax.lax.psum(jnp.sum(aux_ticks), axes)
         n_aux = m * cfg.n_layers
         for a in sync_axes:
-            n_aux = n_aux * jax.lax.axis_size(a)
+            n_aux = n_aux * compat.axis_size(a)
         loss = loss + aux_weight * aux_total / jnp.float32(n_aux)
     return loss
 
@@ -323,7 +324,11 @@ def pp_wiring(cfg: tfm.TransformerConfig, mesh: Mesh):
     tp = TP_AXIS if mesh.shape.get(TP_AXIS, 1) > 1 else None
     ep = _ep_axis(cfg, mesh)
     sync = tuple(a for a in (DATA_AXIS,) if a in mesh.axis_names)
-    return tp, ep, sync, pp_param_specs(cfg, tp_axis=tp, ep_axis=ep)
+    specs = pp_param_specs(cfg, tp_axis=tp, ep_axis=ep)
+    from .partition import validate_spec_tree
+
+    validate_spec_tree(specs, dict(mesh.shape), root="params")
+    return tp, ep, sync, specs
 
 
 def pp_optimizer_state_specs(optimizer: str, specs):
@@ -636,13 +641,90 @@ def make_pp_train_step(
     else:
         fn, extra = (lambda p, m, a, b: step(p, m, a, b)), ()
     return jax.jit(
-        jax.shard_map(
+        compat.shard_map(
             fn,
             mesh=mesh,
             in_specs=(specs, mom_spec, data_spec, data_spec) + extra,
             out_specs=(specs, mom_spec, P()),
         ),
         donate_argnums=(0, 1),
+    )
+
+
+def abstract_pp_state(cfg: tfm.TransformerConfig, mesh: Mesh,
+                      optimizer: str = "sgd"):
+    """(params, mom) as ShapeDtypeStruct pytrees for the pipeline step -
+    the analyzer's allocation-free view of the state signature (the ZeRO
+    layouts come from `init_pp_zero_state`'s own math via eval_shape)."""
+    params = jax.eval_shape(
+        lambda k: tfm.init_params(k, cfg),
+        jax.ShapeDtypeStruct((2,), jnp.uint32),
+    )
+    if optimizer == "sgd":
+        return params, params
+    if optimizer == "adam":
+        return params, {
+            "m": params, "v": params,
+            "t": jax.ShapeDtypeStruct((), jnp.int32),
+        }
+    specs = pp_wiring(cfg, mesh)[3]
+    mom = jax.eval_shape(
+        lambda p: init_pp_zero_state(p, specs, mesh, optimizer), params
+    )
+    return params, mom
+
+
+def pp_step_program(
+    cfg: tfm.TransformerConfig,
+    mesh: Mesh,
+    *,
+    batch: int,
+    seq_len: int,
+    name: str = "pp",
+    optimizer: str = "sgd",
+    n_microbatches: int = 2,
+    **step_kwargs,
+):
+    """`make_pp_train_step` packaged as a traceable `StepProgram`
+    (train/program.py) - the pipeline counterpart of train/lm.py
+    `lm_step_program`, consumed by the static analyzer."""
+    from ..train.program import StepProgram
+
+    step = make_pp_train_step(
+        cfg, mesh, optimizer=optimizer, n_microbatches=n_microbatches,
+        **step_kwargs,
+    )
+    tp, ep, sync, specs = pp_wiring(cfg, mesh)
+    mom_spec = pp_optimizer_state_specs(optimizer, specs)
+    params, mom = abstract_pp_state(cfg, mesh, optimizer)
+    tok = jax.ShapeDtypeStruct((batch, seq_len), jnp.int32)
+    has_step = step_kwargs.get("lr_schedule") is not None
+    args = (params, mom, tok, tok) + (
+        (jax.ShapeDtypeStruct((), jnp.int32),) if has_step else ()
+    )
+    return StepProgram(
+        name=name,
+        fn=step,
+        mesh=mesh,
+        abstract_args=args,
+        specs={"params": specs, "opt": mom_spec, "data": P(DATA_AXIS)},
+        donate=(0, 1),
+        donate_labels=("params", "optimizer state"),
+        meta={
+            "family": "pp",
+            "optimizer": optimizer,
+            "grad_sync": step_kwargs.get("grad_sync", "end"),
+            "accum_steps": int(step_kwargs.get("accum_steps", 1)),
+            "mesh": {k: int(v) for k, v in mesh.shape.items()},
+            "dp": int(mesh.shape.get(DATA_AXIS, 1)),
+            "pp": int(mesh.shape.get(PIPE_AXIS, 1)),
+            "tp_axis": tp,
+            "ep_axis": ep,
+            "sync_axes": list(sync),
+            "n_microbatches": n_microbatches,
+            "batch": batch,
+            "seq_len": seq_len,
+        },
     )
 
 
@@ -661,7 +743,7 @@ def make_pp_eval_fn(
     tp, ep, sync, specs = pp_wiring(cfg, mesh)
     data_spec = P(DATA_AXIS)
     return jax.jit(
-        jax.shard_map(
+        compat.shard_map(
             lambda p, tok, tgt: pipeline_lm_loss(
                 p, tok, tgt, cfg,
                 n_microbatches=n_microbatches, tp_axis=tp, ep_axis=ep,

@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import compat
 from .collectives import vary_like
 
 SEQ_AXIS = "seq"
@@ -83,7 +84,7 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS, *, causal: bool = False, 
     communication overlaps the next block's compute under XLA's latency
     hiding scheduler.
     """
-    n = jax.lax.axis_size(axis_name)
+    n = compat.axis_size(axis_name)
     me = jax.lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
     scale_ = (1.0 / jnp.sqrt(d)) if scale is None else scale
@@ -168,7 +169,7 @@ def zigzag_inverse(s: int, n: int):
 
 def zigzag_positions(s_local: int, axis_name: str = SEQ_AXIS):
     """Global positions of the local rows under the zigzag layout."""
-    n = jax.lax.axis_size(axis_name)
+    n = compat.axis_size(axis_name)
     i = jax.lax.axis_index(axis_name)
     h = s_local // 2
     lo = i * h + jnp.arange(h)
@@ -198,7 +199,7 @@ def zigzag_ring_attention(q, k, v, axis_name: str = SEQ_AXIS, *, scale=None):
     `axis_name`. Exact (up to float reassociation) w.r.t. full causal
     attention on the unpermuted sequence - tests/test_ring.py.
     """
-    n = jax.lax.axis_size(axis_name)
+    n = compat.axis_size(axis_name)
     me = jax.lax.axis_index(axis_name)
     b, s_local, h_heads, d = q.shape
     if s_local % 2:
@@ -289,7 +290,7 @@ def ulysses_attention(q, k, v, axis_name: str = SEQ_AXIS, *, causal: bool = Fals
     trades its sequence shard of all heads for the full sequence of H/n
     heads, computes ordinary attention locally, and trades back.
     """
-    n = jax.lax.axis_size(axis_name)
+    n = compat.axis_size(axis_name)
     h = q.shape[2]
     if h % n != 0:
         raise ValueError(f"ulysses needs heads ({h}) divisible by axis size ({n})")

@@ -46,6 +46,8 @@ import jax
 import jax.numpy as jnp
 from jax.flatten_util import ravel_pytree
 
+from .. import compat
+
 
 def _padded(d: int, n: int) -> int:
     return (d + n - 1) // n * n
@@ -92,7 +94,7 @@ def _sharded_leaf_step(
     tuple of per-leaf flat shard trees (one per optimizer buffer).
     Returns (new_params, tuple(new_state_trees)).
     """
-    n = jax.lax.axis_size(axis_name)
+    n = compat.axis_size(axis_name)
     me = jax.lax.axis_index(axis_name)
 
     def leaf(p, g, *states):
@@ -304,7 +306,7 @@ def make_zero_split_step(
     import jax.numpy as _jnp
     from jax.sharding import PartitionSpec as _P
 
-    grad_fn = jax.shard_map(
+    grad_fn = compat.shard_map(
         fwd_bwd,
         mesh=mesh,
         in_specs=(specs, data_spec, data_spec),
@@ -330,7 +332,7 @@ def make_zero_split_step(
         new_p = apply_decoupled_weight_decay(new_p, lr_t, weight_decay)
         return new_p, new_m
 
-    opt_fn = jax.shard_map(
+    opt_fn = compat.shard_map(
         opt_body,
         mesh=mesh,
         in_specs=(specs, mom_spec, specs, _P()),
@@ -400,7 +402,7 @@ def zero_sgd_step(
     the axis is the global gradient, reduced with the canonical
     psum_scatter. Returns (new_params, new_mom_shard).
     """
-    n = jax.lax.axis_size(axis_name)
+    n = compat.axis_size(axis_name)
     me = jax.lax.axis_index(axis_name)
     flat_p, unravel = ravel_pytree(params)
     flat_g, _ = ravel_pytree(grads)

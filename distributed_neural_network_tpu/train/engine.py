@@ -48,6 +48,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import compat
 from ..data.cifar10 import Split
 from ..models.cnn import Network
 from ..ops.sgd import sgd_step
@@ -375,7 +376,7 @@ class Engine:
             )
 
         self._train_fn = jax.jit(
-            jax.shard_map(
+            compat.shard_map(
                 train_shard,
                 mesh=mesh,
                 in_specs=(P(), P(DATA_AXIS), data_spec, data_spec, P()),
@@ -408,7 +409,7 @@ class Engine:
             return stack(params), stack(mom_l), loss_acc + loss[None]
 
         self._stream_fn = jax.jit(
-            jax.shard_map(
+            compat.shard_map(
                 stream_batch_shard,
                 mesh=mesh,
                 in_specs=(P(DATA_AXIS),) * 6,
@@ -422,7 +423,7 @@ class Engine:
             return jax.tree.map(lambda p: p[None], params)
 
         self._spread_fn = jax.jit(
-            jax.shard_map(
+            compat.shard_map(
                 spread_shard, mesh=mesh, in_specs=(P(),), out_specs=P(DATA_AXIS)
             )
         )
@@ -442,7 +443,7 @@ class Engine:
             return avg, train_loss
 
         self._sync_fn = jax.jit(
-            jax.shard_map(
+            compat.shard_map(
                 sync_shard,
                 mesh=mesh,
                 in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
@@ -473,7 +474,7 @@ class Engine:
                 return val_loss, val_acc
 
             self._eval_fn = jax.jit(
-                jax.shard_map(
+                compat.shard_map(
                     eval_shard,
                     mesh=mesh,
                     in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
@@ -483,6 +484,106 @@ class Engine:
         else:
             self._eval_fn = None
             self._local_eval = None
+
+        # spec metadata for the static analyzer (analysis/; docs/
+        # STATIC_ANALYSIS.md): which PartitionSpecs and donations each
+        # compiled phase was wired with, keyed like the phase names above
+        self.step_specs = {
+            "train": {
+                "in": (P(), P(DATA_AXIS), data_spec, data_spec, P()),
+                "out": (P(DATA_AXIS),) * 4,
+                "donate": (1,),
+            },
+            "stream": {
+                "in": (P(DATA_AXIS),) * 6,
+                "out": (P(DATA_AXIS),) * 3,
+                "donate": (0, 1, 2),
+            },
+            "sync": {
+                "in": (P(DATA_AXIS),) * 4,
+                "out": (P(), P()),
+                "donate": (0,),
+            },
+            "eval": {
+                "in": (P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+                "out": (P(), P()),
+                "donate": (),
+            },
+        }
+
+    def step_programs(self):
+        """The engine's compiled phases as traceable `StepProgram`s
+        (train/program.py) - the CNN-side entry point for the static
+        analyzer. Abstract args mirror the live placed arrays, so
+        ``jax.make_jaxpr(prog.fn)(*prog.abstract_args)`` traces exactly
+        the program `run_epoch` dispatches. Stream mode exposes no train
+        program (its per-batch step takes host-assembled feeds)."""
+        from .program import StepProgram
+
+        def sds(tree):
+            return jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), tree
+            )
+
+        programs = []
+        if self.config.input_mode != "stream" and self.train_images is not None:
+            programs.append(
+                StepProgram(
+                    name="cnn_train_epoch",
+                    fn=self._train_fn,
+                    mesh=self.mesh,
+                    abstract_args=(
+                        sds(self.params), sds(self.mom),
+                        sds(self.train_images), sds(self.train_labels),
+                        jax.ShapeDtypeStruct((), jnp.uint32),
+                    ),
+                    specs={
+                        "params": P(),
+                        "opt": P(DATA_AXIS),
+                        "data": self._train_data_spec,
+                    },
+                    donate=(1,),
+                    donate_labels=("momentum",),
+                    meta={
+                        "family": "cnn",
+                        "regime": self.config.regime,
+                        "sync_mode": self.config.sync_mode,
+                        "grad_sync": self.config.grad_sync,
+                        "mesh": {
+                            k: int(v) for k, v in self.mesh.shape.items()
+                        },
+                        "dp": self.n_workers,
+                    },
+                )
+            )
+        n = self.n_workers
+        stacked = jax.tree.map(
+            lambda p: jax.ShapeDtypeStruct((n, *p.shape), p.dtype),
+            sds(self.params),
+        )
+        vec = jax.ShapeDtypeStruct((n,), jnp.float32)
+        programs.append(
+            StepProgram(
+                name="cnn_sync",
+                fn=self._sync_fn,
+                mesh=self.mesh,
+                abstract_args=(stacked, vec, vec, vec),
+                specs={"params": P(DATA_AXIS), "data": P(DATA_AXIS)},
+                donate=(0,),
+                donate_labels=("stacked params",),
+                meta={
+                    "family": "cnn",
+                    "phase": "sync",
+                    "mesh": {k: int(v) for k, v in self.mesh.shape.items()},
+                    "dp": n,
+                    # the donated stack frees n local copies; its outputs
+                    # are the REPLICATED average, so no in-place alias
+                    # exists by design - don't error on it
+                    "expect_alias": False,
+                },
+            )
+        )
+        return programs
 
     # ---------------------------------------------------------- fused spans
 
@@ -563,7 +664,7 @@ class Engine:
         if eval_inside:
             in_specs = in_specs + (P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS))
         fn = jax.jit(
-            jax.shard_map(
+            compat.shard_map(
                 span_shard,
                 mesh=mesh,
                 in_specs=in_specs,

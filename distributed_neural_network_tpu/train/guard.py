@@ -47,7 +47,7 @@ from __future__ import annotations
 import math
 import signal
 import threading
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 POLICIES = ("off", "warn", "skip", "rollback", "abort")
 

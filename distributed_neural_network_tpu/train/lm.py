@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import compat
 from ..models import transformer as tfm
 from ..ops.sgd import init_momentum, sgd_step
 from ..parallel import zero
@@ -226,6 +227,40 @@ def init_lm_momentum(params, mesh: Mesh, optimizer: str = "sgd"):
     raise ValueError(f"unknown optimizer {optimizer!r} (use one of {OPTIMIZERS})")
 
 
+def lm_wiring(cfg: tfm.TransformerConfig, mesh: Mesh, optimizer: str = "sgd"):
+    """(sp, tp, ep, sync_axes, specs, mom_spec, data_spec) for a dp x sp x
+    tp mesh - the single source of the axis/spec derivation shared by
+    `make_lm_train_step`, `lm_step_program`, and the static analyzer
+    (analysis/). Validates every spec against the mesh's axes up front
+    (parallel/partition.py), so a bad axis name fails here with the leaf
+    and the available axes instead of deep inside pjit lowering."""
+    sp = SEQ_AXIS if mesh.shape.get(SEQ_AXIS, 1) > 1 else None
+    tp = TP_AXIS if mesh.shape.get(TP_AXIS, 1) > 1 else None
+    ep = _ep_axis(cfg, mesh)
+    sync_axes = tuple(a for a in (DATA_AXIS, SEQ_AXIS) if a in mesh.axis_names)
+    specs = tfm.param_specs(cfg, tp_axis=tp, ep_axis=ep)
+    data_spec = P(DATA_AXIS, SEQ_AXIS)
+    if optimizer not in OPTIMIZERS:
+        raise ValueError(
+            f"unknown optimizer {optimizer!r} (use one of {OPTIMIZERS})"
+        )
+    if optimizer.startswith("zero") and (tp or ep):
+        raise ValueError(
+            f"optimizer={optimizer!r} shards the flat param vector over the "
+            "data axis, which requires params replicated across the mesh - "
+            f"not compatible with tp_axis={tp!r} / ep_axis={ep!r}; use "
+            "'sgd'/'adam' for tensor/expert-sharded configs"
+        )
+    mom_spec = optimizer_state_specs(optimizer, specs)
+    from ..parallel.partition import validate_spec_tree
+
+    mesh_axes = dict(mesh.shape)
+    validate_spec_tree(specs, mesh_axes, root="params")
+    validate_spec_tree(mom_spec, mesh_axes, root="optimizer state")
+    validate_spec_tree(data_spec, mesh_axes, root="tokens")
+    return sp, tp, ep, sync_axes, specs, mom_spec, data_spec
+
+
 def make_lm_train_step(
     cfg: tfm.TransformerConfig,
     mesh: Mesh,
@@ -308,24 +343,9 @@ def make_lm_train_step(
       argument: the compiled fn takes (params, mom, tokens, targets,
       step) whenever a fault_plan is given, as with lr_schedule.
     """
-    sp = SEQ_AXIS if mesh.shape.get(SEQ_AXIS, 1) > 1 else None
-    tp = TP_AXIS if mesh.shape.get(TP_AXIS, 1) > 1 else None
-    ep = _ep_axis(cfg, mesh)
-    sync_axes = tuple(a for a in (DATA_AXIS, SEQ_AXIS) if a in mesh.axis_names)
-    specs = tfm.param_specs(cfg, tp_axis=tp, ep_axis=ep)
-    data_spec = P(DATA_AXIS, SEQ_AXIS)
-    if optimizer not in OPTIMIZERS:
-        raise ValueError(
-            f"unknown optimizer {optimizer!r} (use one of {OPTIMIZERS})"
-        )
-    if optimizer.startswith("zero") and (tp or ep):
-        raise ValueError(
-            f"optimizer={optimizer!r} shards the flat param vector over the "
-            "data axis, which requires params replicated across the mesh - "
-            f"not compatible with tp_axis={tp!r} / ep_axis={ep!r}; use "
-            "'sgd'/'adam' for tensor/expert-sharded configs"
-        )
-    mom_spec = optimizer_state_specs(optimizer, specs)
+    sp, tp, ep, sync_axes, specs, mom_spec, data_spec = lm_wiring(
+        cfg, mesh, optimizer
+    )
 
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
@@ -535,7 +555,7 @@ def make_lm_train_step(
     out_specs = (specs, mom_spec, P()) + ((P(),) if want_health else ())
     if has_step:
         return jax.jit(
-            jax.shard_map(
+            compat.shard_map(
                 step,
                 mesh=mesh,
                 in_specs=(specs, mom_spec, data_spec, data_spec, P()),
@@ -545,7 +565,7 @@ def make_lm_train_step(
             donate_argnums=(0, 1),
         )
     return jax.jit(
-        jax.shard_map(
+        compat.shard_map(
             lambda p, m, a, b: step(p, m, a, b),
             mesh=mesh,
             in_specs=(specs, mom_spec, data_spec, data_spec),
@@ -553,6 +573,94 @@ def make_lm_train_step(
             check_vma=check_vma,
         ),
         donate_argnums=(0, 1),
+    )
+
+
+def abstract_lm_state(cfg: tfm.TransformerConfig, mesh: Mesh,
+                      optimizer: str = "sgd"):
+    """(params, mom) as ShapeDtypeStruct pytrees - the step's state
+    signature without allocating anything (jax.eval_shape over the real
+    init functions, so analysis can never drift from training)."""
+    params = jax.eval_shape(
+        lambda k: tfm.init_params(k, cfg),
+        jax.ShapeDtypeStruct((2,), jnp.uint32),
+    )
+    dp = mesh.shape.get(DATA_AXIS, 1)
+    if optimizer == "sgd":
+        mom = params
+    elif optimizer == "adam":
+        mom = {
+            "m": params, "v": params,
+            "t": jax.ShapeDtypeStruct((), jnp.int32),
+        }
+    elif optimizer == "zero":
+        mom = jax.eval_shape(
+            lambda p: zero.init_zero_momentum_tree(p, dp), params
+        )
+    elif optimizer == "zero-adam":
+        mom = jax.eval_shape(
+            lambda p: zero.init_zero_adam_tree(p, dp), params
+        )
+    else:
+        raise ValueError(
+            f"unknown optimizer {optimizer!r} (use one of {OPTIMIZERS})"
+        )
+    return params, mom
+
+
+def lm_step_program(
+    cfg: tfm.TransformerConfig,
+    mesh: Mesh,
+    *,
+    batch: int,
+    seq_len: int,
+    name: str = "lm",
+    optimizer: str = "sgd",
+    **step_kwargs,
+):
+    """`make_lm_train_step` packaged as a traceable `StepProgram`
+    (train/program.py) for the static analyzer: the compiled step, its
+    abstract (ShapeDtypeStruct) arguments, the spec trees, and the
+    donation contract. Build inside ``compat.trace_compat()`` on jax
+    builds without `jax.shard_map` (tools/shardlint.py does)."""
+    from .program import StepProgram
+
+    step = make_lm_train_step(
+        cfg, mesh, optimizer=optimizer, **step_kwargs
+    )
+    _, tp, ep, sync_axes, specs, mom_spec, data_spec = lm_wiring(
+        cfg, mesh, optimizer
+    )
+    params, mom = abstract_lm_state(cfg, mesh, optimizer)
+    tok = jax.ShapeDtypeStruct((batch, seq_len), jnp.int32)
+    has_step = (
+        step_kwargs.get("lr_schedule") is not None
+        or step_kwargs.get("fault_plan") is not None
+    )
+    args = (params, mom, tok, tok) + (
+        (jax.ShapeDtypeStruct((), jnp.int32),) if has_step else ()
+    )
+    return StepProgram(
+        name=name,
+        fn=step,
+        mesh=mesh,
+        abstract_args=args,
+        specs={"params": specs, "opt": mom_spec, "data": data_spec},
+        donate=(0, 1),
+        donate_labels=("params", "optimizer state"),
+        meta={
+            "family": "lm",
+            "optimizer": optimizer,
+            "grad_sync": step_kwargs.get("grad_sync", "end"),
+            "accum_steps": int(step_kwargs.get("accum_steps", 1)),
+            "mesh": {k: int(v) for k, v in mesh.shape.items()},
+            "dp": int(mesh.shape.get(DATA_AXIS, 1)),
+            "tp_axis": tp,
+            "ep_axis": ep,
+            "sync_axes": list(sync_axes),
+            "batch": batch,
+            "seq_len": seq_len,
+        },
     )
 
 

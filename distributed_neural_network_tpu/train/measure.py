@@ -726,6 +726,24 @@ def measure_lm_training(
         tracer = tracing_mod.NULL_TRACER
     hw_flops = tracing_mod.compiled_flops(step, params, mom, tokens, targets)
 
+    # static cross-check (shardlint, analysis/): abstractly trace THE
+    # compiled step being benched and total its collective payload, so
+    # the bench row carries both the runtime ring estimate and the
+    # analyzer's logical-payload count side by side (they use different
+    # conventions; the point is that a schedule regression moves one
+    # without the other). Trace-only - never affects the timed loop.
+    static_comm = None
+    try:
+        from ..analysis.trace import collect_trace
+
+        static_comm = collect_trace(
+            jax.make_jaxpr(step)(params, mom, tokens, targets)
+        ).total_collective_bytes()
+    except Exception:
+        pass
+    if step_stats is not None and static_comm is not None:
+        step_stats.static_comm_bytes_per_step = static_comm
+
     with tracer.span("warmup", track="train", steps=max(warmup, 1)):
         for _ in range(max(warmup, 1)):
             params, mom, loss = step(params, mom, tokens, targets)
@@ -783,6 +801,10 @@ def measure_lm_training(
         "remat_policy": remat_policy,
         "accum_steps": accum_steps, "grad_sync": grad_sync,
         "mem_peak_bytes": mem_peak,
+        # shardlint static logical payload per step (None when the trace
+        # failed); the bench row's cross-check against StepStats'
+        # comm_bytes_per_step runtime ring estimate
+        "static_collective_bytes": static_comm,
         # provenance: WHICH flash kernel measured this row (r3's numbers
         # were the library kernel; r4+ defaults to the own kernels)
         "attn_kernel": (

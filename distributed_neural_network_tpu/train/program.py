@@ -1,0 +1,68 @@
+"""StepProgram: a compiled train step plus the metadata to audit it.
+
+The step builders (train/lm.py `make_lm_train_step`, parallel/pipeline.py
+`make_pp_train_step`, train/engine.py) return bare jitted callables - right
+for training, opaque for analysis. A `StepProgram` bundles the callable
+with everything the static analyzer (distributed_neural_network_tpu.
+analysis, tools/shardlint.py) needs to reason about it WITHOUT running it:
+
+- ``abstract_args``: pytrees of `jax.ShapeDtypeStruct` matching the step's
+  signature, so ``jax.make_jaxpr(program.fn)(*program.abstract_args)``
+  traces the whole program (shard_map included) on any host - no params
+  allocated, no device math;
+- ``specs``: the PartitionSpec trees the program was wired with
+  ({"params", "opt", "data"}), for the spec lint;
+- ``donate``: which argument positions the builder donates (and what they
+  are), for the donation audit;
+- ``meta``: free-form facts the lint rules key on (optimizer, grad_sync,
+  accum_steps, mesh axis sizes, param_bytes, ...).
+
+Builders: `train/lm.py lm_step_program`, `parallel/pipeline.py
+pp_step_program`, `train/engine.py Engine.step_programs`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+
+@dataclass(frozen=True)
+class StepProgram:
+    """One traceable compiled step with its audit metadata."""
+
+    name: str
+    fn: Callable
+    mesh: Any
+    abstract_args: tuple
+    specs: dict = field(default_factory=dict)
+    donate: tuple = ()  # argnums the builder donates, e.g. (0, 1)
+    donate_labels: tuple = ()  # human names for those args
+    meta: dict = field(default_factory=dict)
+
+    def make_jaxpr(self):
+        """Closed jaxpr of the full program (jit boundary included)."""
+        import jax
+
+        return jax.make_jaxpr(self.fn)(*self.abstract_args)
+
+    def arg_leaf_counts(self) -> tuple:
+        """Flat-leaf count of each top-level argument, in order - the map
+        from the jit equation's flat ``donated_invars`` back to args."""
+        import jax
+
+        return tuple(
+            len(jax.tree_util.tree_leaves(a)) for a in self.abstract_args
+        )
+
+    def param_bytes(self) -> int:
+        """Total bytes of the parameter argument (argnum 0)."""
+        import jax
+        import numpy as np
+
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(self.abstract_args[0]):
+            total += int(np.prod(leaf.shape, dtype=np.int64)) * np.dtype(
+                leaf.dtype
+            ).itemsize
+        return total

@@ -313,6 +313,7 @@ class StepStats:
         series_prefix: str = "step",
         n_devices: int = 1,
         comm_bytes_per_step: int | None = None,
+        static_comm_bytes_per_step: int | None = None,
         flops_per_step: float | None = None,
         flops_source: str | None = None,
         peak_flops_per_device: float | None = None,
@@ -325,6 +326,10 @@ class StepStats:
         self.series_prefix = series_prefix
         self.n_devices = int(n_devices)
         self.comm_bytes_per_step = comm_bytes_per_step
+        # the shardlint static trace's logical payload bytes per step
+        # (analysis/trace.py), when the caller ran the analyzer - the
+        # cross-check against the runtime ring estimate above
+        self.static_comm_bytes_per_step = static_comm_bytes_per_step
         self.flops_per_step = flops_per_step
         self.flops_source = flops_source
         self.peak_flops_per_device = peak_flops_per_device
@@ -434,6 +439,7 @@ class StepStats:
             "steady_steps": len(steady),
             "steady_includes_compile": steady_includes_compile,
             "comm_bytes_per_step": self.comm_bytes_per_step,
+            "static_comm_bytes_per_step": self.static_comm_bytes_per_step,
             "grad_sync": self.grad_sync,
             "comm_buckets": (
                 {
@@ -517,6 +523,12 @@ class StepStats:
             lines.append(
                 f"  collective payload: {s['comm_bytes_per_step']:,} "
                 f"bytes/step (ring all-reduce estimate{sched})"
+            )
+        if s["static_comm_bytes_per_step"] is not None:
+            lines.append(
+                f"  static analysis payload: "
+                f"{s['static_comm_bytes_per_step']:,} bytes/step "
+                "(shardlint logical payload; tools/trace_summary.py --lint)"
             )
         if s["comm_buckets"]:
             bb = s["comm_buckets"]["bytes_per_bucket"]

@@ -605,12 +605,14 @@ def main() -> int:
     elif args.eval_every:
         from jax.sharding import PartitionSpec as _P
 
+        from distributed_neural_network_tpu import compat as _compat
+
         tp_ax = lmtrain.TP_AXIS if args.tp > 1 else None
         sp_ax = lmtrain.SEQ_AXIS if args.sp > 1 else None
         sync = tuple(a for a in (lmtrain.DATA_AXIS, lmtrain.SEQ_AXIS)
                      if a in mesh.axis_names)
         eval_fn = jax.jit(
-            jax.shard_map(
+            _compat.shard_map(
                 lambda p, tok, tgt: lmtrain.lm_loss(
                     p, tok, tgt, cfg, seq_axis=sp_ax, tp_axis=tp_ax,
                     ep_axis=lmtrain._ep_axis(cfg, mesh),
@@ -662,12 +664,30 @@ def main() -> int:
             peak_flops as _peakf,
         )
 
-        hw_flops = TRC.compiled_flops(
-            step, params, mom, tokens, targets,
-            *((jnp.int32(step0),)
-              if args.lr_schedule != "constant" or fault_plan is not None
-              else ()),
+        step_extra = (
+            (jnp.int32(step0),)
+            if args.lr_schedule != "constant" or fault_plan is not None
+            else ()
         )
+        hw_flops = TRC.compiled_flops(
+            step, params, mom, tokens, targets, *step_extra
+        )
+        # shardlint static cross-check: the analyzer's logical collective
+        # payload for THIS compiled step, reported next to the runtime
+        # ring estimate below (tools/trace_summary.py --lint compares a
+        # recorded trace against the checked-in manifests the same way)
+        static_comm = None
+        try:
+            from distributed_neural_network_tpu.analysis.trace import (
+                collect_trace,
+            )
+
+            static_comm = collect_trace(
+                jax.make_jaxpr(step)(params, mom, tokens, targets,
+                                     *step_extra)
+            ).total_collective_bytes()
+        except Exception:
+            pass
         # gradient sync rides the data (and seq) axes; tensor-sharded
         # leaves keep local grads - this over-counts those, an estimate
         n_sync = mesh.shape.get("data", 1) * mesh.shape.get("seq", 1)
@@ -700,6 +720,7 @@ def main() -> int:
             sink=run if args.step_stats else None,
             n_devices=mesh.devices.size,
             comm_bytes_per_step=comm_bytes,
+            static_comm_bytes_per_step=static_comm,
             grad_sync=args.grad_sync,
             comm_bucket_bytes=bucket_bytes_list,
             compilation_cache_dir=args.compilation_cache_dir,

@@ -184,8 +184,6 @@ def test_all_checkpoints_corrupt_raises(tmp_path):
 def test_stale_tmp_dirs_swept_on_init(tmp_path):
     """A crash between the tmp write and the atomic rename leaks a
     step_*.tmp dir forever; backend init sweeps it."""
-    import os
-
     from distributed_neural_network_tpu.utils.checkpoint import (
         TreeCheckpointer,
     )

@@ -156,15 +156,12 @@ def test_bucket_layout_group_keys_and_dtype_split():
     out = collectives.unpack_buckets(lay, collectives.pack_buckets(lay, mixed))
     assert out["b"].dtype == jnp.bfloat16
     # planning is shape-only: abstract leaves work (in-jit planning)
-    import jax.tree_util as jtu
-
     abstract = jax.tree.map(
         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), tree
     )
     assert collectives.plan_buckets(abstract, bucket_bytes=40).buckets == (
         (0, 2), (2, 3),
     )
-    del jtu
 
 
 def test_bucketed_collectives_match_tree_psum(n_devices):

@@ -3,7 +3,6 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from distributed_neural_network_tpu.data import cifar10, pipeline
 from distributed_neural_network_tpu.parallel import partition

@@ -7,7 +7,6 @@ distribution producing correctly sharded global arrays.
 """
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P

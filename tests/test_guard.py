@@ -689,7 +689,7 @@ def test_cli_kill_and_resume_bit_identical(tmp_path):
     """SIGTERM mid-run -> emergency checkpoint -> resume: the continued
     loss trajectory is BIT-IDENTICAL to the uninterrupted run (same data
     order, same PRNG stream, params/momentum restored exactly)."""
-    base = _run_lm(tmp_path, steps=24, name="a.jsonl")
+    _run_lm(tmp_path, steps=24, name="a.jsonl")
     a = _loss_series(tmp_path / "a.jsonl")
     assert len(a) == 24
 

@@ -7,7 +7,6 @@ layout-preserving normalize, fused gather+normalize, thread-count
 robustness, and the pickle-directory integration path.
 """
 
-import os
 import pickle
 
 import numpy as np

@@ -13,7 +13,6 @@ import os
 import subprocess
 import sys
 
-import pytest
 
 from distributed_neural_network_tpu.utils import metrics as M
 from distributed_neural_network_tpu.utils import timers as T
@@ -99,6 +98,72 @@ def test_summary_rejects_bare_nan_token(tmp_path):
     proc = _run_tool(str(path))
     assert proc.returncode == 1
     assert "non-strict JSON" in proc.stderr
+
+
+def _make_linted_trace(tmp_path, *, comm=1000, buckets=(300, 200)):
+    tracer = tr.Tracer()
+    with tracer.span("train_step", track="train", step=0):
+        pass
+    tr.record_bucket_plan(
+        tracer, list(buckets), schedule="overlap", op="psum", axis_size=4,
+        accum_steps=2,
+    )
+    stats = tr.StepStats(comm_bytes_per_step=comm, n_devices=4)
+    stats.record(0, 0.1, items=10)
+    path = str(tmp_path / "trace.json")
+    tracer.export(path, step_stats=stats)
+    return path
+
+
+def _write_manifest(tmp_path, config, total):
+    mdir = tmp_path / "manifests"
+    mdir.mkdir(exist_ok=True)
+    (mdir / f"{config}.json").write_text(json.dumps({
+        "config": config, "total_collective_bytes": total,
+        "jax_version": "0.0.0", "trace_mode": "compat",
+        "mesh": {"data": 4},
+    }))
+    return str(mdir)
+
+
+def test_lint_mode_prints_measured_vs_manifest_delta(tmp_path):
+    trace = _make_linted_trace(tmp_path, comm=1200)
+    mdir = _write_manifest(tmp_path, "toy_cfg", 1000)
+    proc = _run_tool(trace, "--lint", "toy_cfg", "--manifest-dir", mdir)
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+    assert "manifest static payload: 1,000 B/step" in proc.stdout
+    assert "trace comm_bytes_per_step: 1,200 B/step" in proc.stdout
+    # grad_bucket events: 2 buckets x 500 B/microbatch x accum 2
+    assert "2 bucket(s), 500 B/microbatch -> 1,000 B/step" in proc.stdout
+    assert "delta (trace - manifest): +200 B/step" in proc.stdout
+    assert "ratio 1.200" in proc.stdout
+
+
+def test_lint_tolerance_gates_exit_code(tmp_path):
+    trace = _make_linted_trace(tmp_path, comm=1200)
+    mdir = _write_manifest(tmp_path, "toy_cfg", 1000)
+    ok = _run_tool(
+        trace, "--lint", "toy_cfg", "--manifest-dir", mdir,
+        "--lint-tolerance", "25",
+    )
+    assert ok.returncode == 0, ok.stdout
+    assert "-> OK" in ok.stdout
+    bad = _run_tool(
+        trace, "--lint", "toy_cfg", "--manifest-dir", mdir,
+        "--lint-tolerance", "5",
+    )
+    assert bad.returncode == 1
+    assert "-> FAIL" in bad.stdout
+
+
+def test_lint_missing_manifest_names_the_fix(tmp_path):
+    trace = _make_linted_trace(tmp_path)
+    proc = _run_tool(
+        trace, "--lint", "no_such_cfg",
+        "--manifest-dir", str(tmp_path / "manifests"),
+    )
+    assert proc.returncode == 1
+    assert "--write-manifest" in proc.stdout
 
 
 def _load_plot_metrics():

@@ -431,3 +431,22 @@ def test_step_stats_records_compilation_cache_provenance():
     s = tr.StepStats(compilation_cache_dir="/tmp/jaxcache")
     assert s.summary()["compilation_cache_dir"] == "/tmp/jaxcache"
     assert tr.StepStats().summary()["compilation_cache_dir"] is None
+
+
+def test_step_stats_static_comm_cross_check():
+    """The shardlint static payload rides the summary/report next to the
+    runtime ring estimate (the bench.py cross-check surface)."""
+    s = tr.StepStats(
+        comm_bytes_per_step=4500, static_comm_bytes_per_step=3000
+    )
+    s.record(0, 1.0)
+    s.record(1, 0.5)
+    summ = s.summary()
+    assert summ["static_comm_bytes_per_step"] == 3000
+    rep = s.report()
+    assert "static analysis payload: 3,000 bytes/step" in rep
+    # absent when the analyzer never ran - no line, no crash
+    s2 = tr.StepStats(comm_bytes_per_step=100)
+    s2.record(0, 0.5)
+    assert s2.summary()["static_comm_bytes_per_step"] is None
+    assert "static analysis payload" not in s2.report()

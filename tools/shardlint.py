@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""shardlint CLI: static sharding/collective/donation analysis on CPU.
+
+Abstractly traces the canonical train-step configs (no execution, no TPU;
+distributed_neural_network_tpu/analysis/) and
+
+- lints PartitionSpecs, donation, ZeRO replication leaks, and precision,
+- writes or checks the expected-collectives manifests
+  (distributed_neural_network_tpu/analysis/manifests/*.json).
+
+Usage:
+  python tools/shardlint.py --list
+  python tools/shardlint.py --all --check          # the CI gate
+  python tools/shardlint.py --config lm_zero_overlap --write-manifest
+  python tools/shardlint.py --all --write-manifest # after an intentional
+                                                   # collective change
+
+Exit codes: 0 conforming; 1 lint errors or manifest mismatch; 2 a config
+could not be built/traced. See docs/STATIC_ANALYSIS.md.
+"""
+
+import argparse
+import os
+import sys
+
+
+def _force_cpu_mesh():
+    """8 virtual CPU devices, set BEFORE jax import (the repo-standard
+    test mesh - tests/conftest.py does the same for pytest)."""
+    flag = "--xla_force_host_platform_device_count=8"
+    if flag not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " " + flag
+        ).strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if "jax" in sys.modules:
+        import jax
+
+        try:  # re-assert against site hooks that pre-import jax
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument(
+        "--config", action="append", default=[],
+        help="config name (repeatable); see --list",
+    )
+    ap.add_argument("--all", action="store_true", help="every canonical config")
+    ap.add_argument("--list", action="store_true", help="list configs and exit")
+    ap.add_argument(
+        "--write-manifest", action="store_true",
+        help="regenerate the expected-collectives manifest(s)",
+    )
+    ap.add_argument(
+        "--check", action="store_true",
+        help="diff fresh traces against the checked-in manifest(s)",
+    )
+    ap.add_argument(
+        "--manifest-dir", default=None,
+        help="manifest directory (default: the in-package analysis/manifests)",
+    )
+    ap.add_argument(
+        "-q", "--quiet", action="store_true",
+        help="findings and verdicts only (no per-collective breakdown)",
+    )
+    args = ap.parse_args(argv)
+
+    _force_cpu_mesh()
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from distributed_neural_network_tpu import analysis
+
+    if args.list:
+        for name in analysis.config_names():
+            print(name)
+        return 0
+    if args.write_manifest and args.check:
+        ap.error("--write-manifest and --check are mutually exclusive")
+    names = analysis.config_names() if args.all or not args.config else args.config
+    mode = (
+        "write" if args.write_manifest else "check" if args.check else "lint"
+    )
+    rc, report = analysis.run_shardlint(
+        names, mode=mode, manifest_dir=args.manifest_dir,
+        verbose=not args.quiet,
+    )
+    print(report)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

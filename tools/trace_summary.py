@@ -15,6 +15,18 @@ silently accepted. Stdlib-only: no jax, no repo imports - runs anywhere.
 
 Usage:
   python tools/trace_summary.py trace.json [metrics.jsonl]
+  python tools/trace_summary.py trace.json --lint lm_zero_overlap
+
+--lint CONFIG additionally compares the trace's measured per-step
+collective bytes (the stepStats embed's ``comm_bytes_per_step`` ring
+estimate, and the ``grad_bucket`` plan events when present) against the
+shardlint manifest's static payload for that config
+(distributed_neural_network_tpu/analysis/manifests/CONFIG.json) and
+prints the delta. The two use different conventions - the manifest counts
+logical payload bytes per collective, the runtime estimate counts ring
+all-reduce wire bytes (~2(n-1)/n of the tree) - so the printed ratio is
+the cross-check, not an equality; ``--lint-tolerance PCT`` turns a
+larger-than-PCT ratio drift into a non-zero exit for CI use.
 """
 
 from __future__ import annotations
@@ -22,6 +34,7 @@ from __future__ import annotations
 import argparse
 import json
 import math
+import os
 import sys
 from collections import defaultdict
 
@@ -251,12 +264,136 @@ def jsonl_step_series(path: str) -> str:
     return "\n".join(lines)
 
 
+def default_manifest_dir() -> str:
+    """The in-repo shardlint manifest directory, resolved relative to this
+    script (stdlib-only - no repo import)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.join(
+        os.path.dirname(here),
+        "distributed_neural_network_tpu", "analysis", "manifests",
+    )
+
+
+def measured_collective_bytes(doc: dict):
+    """(comm_bytes_per_step, grad_bucket summary dict | None) from a trace.
+
+    comm_bytes_per_step is the stepStats embed's runtime ring estimate;
+    the grad_bucket instant events (one per bucket of the overlap plan)
+    give per-bucket payloads and the per-step total they imply.
+    """
+    stats = doc.get("stepStats") or {}
+    comm = stats.get("comm_bytes_per_step")
+    buckets = []
+    accum = 1
+    for ev in doc.get("traceEvents", []):
+        if ev.get("name") == "grad_bucket" and ev.get("ph") == "i":
+            a = ev.get("args") or {}
+            if isinstance(a.get("bytes"), (int, float)):
+                buckets.append(int(a["bytes"]))
+                accum = max(accum, int(a.get("per_microbatch", 1) or 1))
+    bucket_summary = None
+    if buckets:
+        bucket_summary = {
+            "count": len(buckets),
+            "bytes_per_microbatch": sum(buckets),
+            "bytes_per_step": sum(buckets) * accum,
+            "accum_steps": accum,
+        }
+    return comm, bucket_summary
+
+
+def lint_against_manifest(
+    doc: dict, config: str, manifest_dir: str | None = None,
+    tolerance_pct: float | None = None,
+):
+    """(report lines, ok) - measured trace bytes vs the shardlint manifest."""
+    path = os.path.join(
+        manifest_dir or default_manifest_dir(), f"{config}.json"
+    )
+    if not os.path.exists(path):
+        return [
+            f"lint: no shardlint manifest for config {config!r} at {path} "
+            "- generate with: python tools/shardlint.py --config "
+            f"{config} --write-manifest"
+        ], False
+    with open(path) as f:
+        man = strict_loads(f.read())
+    static = man.get("total_collective_bytes")
+    comm, buckets = measured_collective_bytes(doc)
+    lines = [f"Shardlint manifest lint (config {config!r}):"]
+    lines.append(
+        f"  manifest static payload: "
+        + (f"{static:,} B/step" if isinstance(static, int) else "n/a")
+        + f" (jax {man.get('jax_version')}, {man.get('trace_mode')} trace, "
+        f"mesh {man.get('mesh')})"
+    )
+    if comm is not None:
+        lines.append(
+            f"  trace comm_bytes_per_step: {comm:,} B/step "
+            "(runtime ring all-reduce estimate)"
+        )
+    if buckets:
+        lines.append(
+            f"  grad_bucket events: {buckets['count']} bucket(s), "
+            f"{buckets['bytes_per_microbatch']:,} B/microbatch -> "
+            f"{buckets['bytes_per_step']:,} B/step at "
+            f"accum={buckets['accum_steps']}"
+        )
+    measured = comm if comm is not None else (
+        buckets["bytes_per_step"] if buckets else None
+    )
+    if measured is None:
+        lines.append(
+            "  lint: trace carries no stepStats comm_bytes_per_step and no "
+            "grad_bucket events - nothing to compare"
+        )
+        return lines, tolerance_pct is None
+    if not isinstance(static, int) or static <= 0:
+        if measured == 0 and (static in (0, None)):
+            lines.append("  delta: both zero (single-device step)")
+            return lines, True
+        lines.append(
+            f"  lint: manifest static payload is {static!r} but the trace "
+            f"measured {measured:,} B/step"
+        )
+        return lines, False
+    delta = measured - static
+    ratio = measured / static
+    lines.append(
+        f"  delta (trace - manifest): {delta:+,} B/step "
+        f"(ratio {ratio:.3f}; conventions differ - see --help)"
+    )
+    ok = True
+    if tolerance_pct is not None:
+        drift = abs(ratio - 1.0) * 100.0
+        ok = drift <= tolerance_pct
+        lines.append(
+            f"  tolerance: {drift:.1f}% drift vs allowed "
+            f"{tolerance_pct:.1f}% -> {'OK' if ok else 'FAIL'}"
+        )
+    return lines, ok
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("trace", help="Chrome trace-event JSON (--trace-out)")
     ap.add_argument(
         "jsonl", nargs="?", default=None,
         help="optional metrics JSONL pair (--metrics-jsonl)",
+    )
+    ap.add_argument(
+        "--lint", metavar="CONFIG", default=None,
+        help="compare measured collective bytes against the shardlint "
+        "manifest for CONFIG and print the delta",
+    )
+    ap.add_argument(
+        "--manifest-dir", default=None,
+        help="shardlint manifest directory (default: the in-repo one)",
+    )
+    ap.add_argument(
+        "--lint-tolerance", type=float, default=None, metavar="PCT",
+        help="with --lint: exit non-zero when the measured/static ratio "
+        "drifts more than PCT percent from 1.0",
     )
     args = ap.parse_args(argv)
 
@@ -300,6 +437,14 @@ def main(argv=None) -> int:
     if args.jsonl:
         print()
         print(jsonl_step_series(args.jsonl))
+    if args.lint:
+        print()
+        lines, ok = lint_against_manifest(
+            doc, args.lint, args.manifest_dir, args.lint_tolerance
+        )
+        print("\n".join(lines))
+        if not ok:
+            return 1
     return 0
 
 
