@@ -248,6 +248,18 @@ def _rows(epochs: int) -> list[dict]:
             "args": {"attn": "flash", "dtype": "bfloat16", "steps": 20},
         },
         {
+            # live-observability overhead A/B at the flagship shape: no
+            # monitoring vs the full --metrics-port stack (registry +
+            # /metrics server + watchdog threads + per-step publishes,
+            # utils/obs.py + train/monitor.py). Asserts within_budget
+            # (<1% steady-step overhead) and final_loss_bitwise_equal
+            # (monitoring is observation-only), like the guard row above
+            "id": "lm_watchdog_overhead_d512_L8_seq2048_bf16",
+            "kind": "watchdog_overhead",
+            "est_s": 600,
+            "args": {"attn": "flash", "dtype": "bfloat16", "steps": 20},
+        },
+        {
             # remat: the XLA path materializes (B, H, S, S) scores, which
             # OOMs a 16 GB v5e at these shapes without recompute (measured
             # r3); flash needs no remat - that contrast is the point
@@ -564,6 +576,12 @@ def _run_worker(spec: dict) -> dict:
         )
 
         return measure_guard_overhead(**spec["args"])
+    if spec["kind"] == "watchdog_overhead":
+        from distributed_neural_network_tpu.train.measure import (
+            measure_watchdog_overhead,
+        )
+
+        return measure_watchdog_overhead(**spec["args"])
     if spec["kind"] == "lm_decode":
         from distributed_neural_network_tpu.train.measure import (
             measure_lm_decode,

@@ -168,11 +168,21 @@ class ChaosMonkey:
     sigterm_after: after this step completes, deliver a real SIGTERM to
       this process (`after_step`), driving the PreemptionGuard ->
       emergency-checkpoint -> exact-resume path end to end.
+    stall_at: after these steps complete, sleep `stall_s` seconds inside
+      the host step callback - the heartbeat stops while the loop is
+      wedged, which is exactly the signature the stall watchdog
+      (`train/monitor.py`) must flag as ``watchdog/stall`` within one
+      detection window. Emitted as a ``straggler`` span on the ``fault``
+      track when a tracer is attached (same in-band convention as the
+      epoch-level straggler sleep above).
     """
 
     spike_at: tuple = ()
     spike_scale: float = 100.0
     sigterm_after: int | None = None
+    stall_at: tuple = ()
+    stall_s: float = 2.0
+    tracer: object = None
     log: object = print
     _fired: set = field(default_factory=set)
 
@@ -185,6 +195,22 @@ class ChaosMonkey:
         return loss, grad_norm, all_finite
 
     def after_step(self, step) -> None:
+        if step in self.stall_at and ("stall", step) not in self._fired:
+            self._fired.add(("stall", step))
+            self.log(
+                f"(chaos: stalling the step loop for {self.stall_s:g}s "
+                f"after step {step})"
+            )
+            tracer = self.tracer
+            if tracer is None:
+                from ..utils import tracing as _tracing
+
+                tracer = _tracing.NULL_TRACER
+            with tracer.span(
+                "straggler", track="fault", step=int(step),
+                duration_s=float(self.stall_s), kind="stall",
+            ):
+                time.sleep(self.stall_s)
         if (
             self.sigterm_after is not None
             and step == self.sigterm_after

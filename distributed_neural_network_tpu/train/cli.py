@@ -193,6 +193,44 @@ def add_common_flags(p: argparse.ArgumentParser, *, epochs: int, batch_size: int
         "time, images/s, device memory, collective bytes, MFU), print the "
         "summary, and emit step/* series to --metrics-jsonl",
     )
+    # live runtime observability (utils/obs.py + train/monitor.py,
+    # docs/OBSERVABILITY.md "Live monitoring")
+    p.add_argument(
+        "--metrics-port",
+        type=int,
+        default=None,
+        metavar="PORT",
+        help="serve live Prometheus metrics on http://127.0.0.1:PORT"
+        "/metrics plus a /healthz JSON liveness/readiness endpoint "
+        "(0 = ephemeral port, printed at startup); also starts the "
+        "stall/recompile/checkpoint watchdog unless --watchdog off",
+    )
+    p.add_argument(
+        "--metrics-linger",
+        type=float,
+        default=0.0,
+        metavar="SEC",
+        help="keep the metrics server up this many seconds after the run "
+        "finishes (final scrape window for CI / external scrapers)",
+    )
+    p.add_argument(
+        "--watchdog",
+        choices=("on", "off"),
+        default="on",
+        help="with --metrics-port: background watchdog flagging stalled "
+        "steps (no heartbeat for N x steady p95 step time), recompile "
+        "storms, and checkpoint staleness as watchdog/* trace events + "
+        "watchdog_*_total counters (train/monitor.py)",
+    )
+    p.add_argument(
+        "--watchdog-escalate",
+        choices=("none", "preempt"),
+        default="none",
+        help="preempt = a persistent stall requests the cooperative "
+        "SIGTERM-style preemption path (emergency checkpoint at the next "
+        "step boundary, then clean exit) instead of burning the "
+        "reservation wedged; requires --on-sigterm checkpoint",
+    )
     return p
 
 
@@ -291,7 +329,15 @@ def honor_platform_env() -> None:
 
 
 def run_training(args, regime: str, *, log=print) -> Engine:
-    """Load data, train, write phase logs - the shared main() body."""
+    """Load data, train, write phase logs - the shared main() body.
+
+    Owns the live-observability lifecycle (`train/monitor.py`): the
+    preemption guard and `--metrics-port` monitor (registry + /metrics +
+    /healthz server + watchdog) are created up front, threaded through
+    the engine/guard/checkpointer, and closed on every exit path - after
+    an optional `--metrics-linger` window so external scrapers can read
+    the final counters.
+    """
     honor_platform_env()
     from ..parallel.distributed import initialize as distributed_initialize
 
@@ -319,6 +365,48 @@ def run_training(args, regime: str, *, log=print) -> Engine:
     want_stats = getattr(args, "step_stats", False)
     tracer = TR.Tracer(enabled=bool(trace_out))
 
+    from .guard import PreemptionGuard
+    from .monitor import WatchdogConfig, attach_monitor
+
+    preemption = None
+    if getattr(args, "on_sigterm", "ignore") == "checkpoint":
+        preemption = PreemptionGuard(log=log).install()
+    monitor = attach_monitor(
+        metrics_port=getattr(args, "metrics_port", None),
+        tracer=tracer,
+        preemption=preemption,
+        watchdog=getattr(args, "watchdog", "on") == "on",
+        config=WatchdogConfig(
+            escalate_after_polls=(
+                5
+                if getattr(args, "watchdog_escalate", "none") == "preempt"
+                and preemption is not None
+                else 0
+            ),
+        ),
+        log=log,
+    )
+    try:
+        return _run_training_body(
+            args, regime, log=log, cfg=cfg, timers=timers, tracer=tracer,
+            preemption=preemption, monitor=monitor, cache_dir=cache_dir,
+            trace_out=trace_out, want_stats=want_stats,
+        )
+    finally:
+        linger = getattr(args, "metrics_linger", 0.0) or 0.0
+        if monitor.server is not None and linger > 0:
+            log(f"(metrics server lingering {linger:g}s for final scrapes)")
+            time.sleep(linger)
+        if preemption is not None:
+            preemption.uninstall()
+        monitor.close()
+
+
+def _run_training_body(
+    args, regime: str, *, log, cfg, timers, tracer, preemption, monitor,
+    cache_dir, trace_out, want_stats,
+) -> Engine:
+    registry = monitor.registry
     syn = getattr(args, "synthetic_size", None)
     with tracer.span(TR.DATA_LOADING, track="host"), timers.phase(T.DATA_LOADING):
         train_split = load_split(
@@ -357,7 +445,9 @@ def run_training(args, regime: str, *, log=print) -> Engine:
     }
 
     t0 = time.perf_counter()
-    engine = Engine(cfg, train_split, test_split, tracer=tracer)
+    engine = Engine(
+        cfg, train_split, test_split, tracer=tracer, registry=registry
+    )
 
     stats = None
     if want_stats or trace_out:
@@ -382,6 +472,7 @@ def run_training(args, regime: str, *, log=print) -> Engine:
             ),
             grad_sync=cfg.grad_sync if cfg.sync_mode == "step" else None,
             compilation_cache_dir=cache_dir,
+            registry=registry,
         )
         engine.step_stats = stats
         if cfg.sync_mode == "step" and cfg.grad_sync == "overlap":
@@ -410,6 +501,7 @@ def run_training(args, regime: str, *, log=print) -> Engine:
             every=args.checkpoint_every,
             keep=args.checkpoint_keep,
             backend=args.checkpoint_backend,
+            registry=registry,
         )
         if args.resume:
             start_epoch = checkpointer.restore_latest(engine)
@@ -423,9 +515,10 @@ def run_training(args, regime: str, *, log=print) -> Engine:
                     "--checkpoint-backend match the original run)"
                 )
 
-    # self-healing layer (train/guard.py): per-epoch policy guard +
-    # cooperative preemption -> emergency checkpoint at the epoch boundary
-    from .guard import GuardConfig, PreemptionGuard, TrainingGuard
+    # self-healing layer (train/guard.py): per-epoch policy guard; the
+    # cooperative preemption guard was installed by run_training before
+    # the monitor (its escalation path needs it)
+    from .guard import GuardConfig, TrainingGuard
 
     guard = None
     if getattr(args, "guard", "off") != "off":
@@ -439,17 +532,20 @@ def run_training(args, regime: str, *, log=print) -> Engine:
                 # a few epochs rather than the step-scale default
                 warmup_steps=3,
             ),
-            tracer=tracer, step_stats=stats, log=log,
+            tracer=tracer, step_stats=stats, registry=registry, log=log,
         )
-    preemption = None
-    if getattr(args, "on_sigterm", "ignore") == "checkpoint":
-        preemption = PreemptionGuard(log=log).install()
 
     profile_dir = getattr(args, "profile_dir", None)
     if profile_dir:
         import jax
 
         jax.profiler.start_trace(profile_dir)
+    if monitor.recompiles is not None:
+        # cache-miss counting on the engine's compiled epoch step: the
+        # watchdog turns a burst of misses into the recompile-storm flag
+        monitor.recompiles.swap(engine._train_fn)
+        engine.recompiles = monitor.recompiles
+
     try:
         engine.run(
             timers=timers,
@@ -479,8 +575,6 @@ def run_training(args, regime: str, *, log=print) -> Engine:
             log(f"(Profiler trace written to {profile_dir})")
         if checkpointer is not None:
             checkpointer.close()
-        if preemption is not None:
-            preemption.uninstall()
     wall = time.perf_counter() - t0
 
     if guard is not None:
@@ -496,6 +590,12 @@ def run_training(args, regime: str, *, log=print) -> Engine:
             "chrome://tracing, or summarize with tools/trace_summary.py)"
         )
     run.stop()
+
+    # the reference's five epoch-phase accumulators, live on /metrics as
+    # phase_seconds_total{phase=...} (utils/obs.py) - not just log/*.txt
+    from ..utils.obs import publish_phase_timers
+
+    publish_phase_timers(registry, timers)
 
     # the canonical phase-summary block (utils/timers.py report(); the
     # reference's stdout phrasing, shared with every other entry point)
@@ -554,6 +654,15 @@ def main(argv=None) -> int:
     )
     add_common_flags(parser, epochs=2, batch_size=16)
     add_distributed_flags(parser, nb_proc=None)
+    parser.add_argument(
+        "cmd",
+        nargs="?",
+        choices=("smoke",),
+        default=None,
+        help="optional subcommand alias: 'smoke' names the default tiny "
+        "synthetic run explicitly (CI: python -m ...train.cli smoke "
+        "--metrics-port 0)",
+    )
     parser.add_argument(
         "--regime",
         choices=("single", "data_parallel", "replication"),

@@ -145,6 +145,7 @@ class Engine:
         mesh: Mesh | None = None,
         tracer: TR.Tracer | None = None,
         step_stats: TR.StepStats | None = None,
+        registry=None,
     ):
         # step-level telemetry (utils/tracing.py): NULL_TRACER costs one
         # attribute check per span when disabled; step_stats is opt-in.
@@ -152,6 +153,27 @@ class Engine:
         # construction (the CLI builds StepStats from the live engine).
         self.tracer = tracer if tracer is not None else TR.NULL_TRACER
         self.step_stats = step_stats
+        # live-metrics registry (utils/obs.py, --metrics-port): children
+        # resolved once here so per-epoch publishing is lock-free adds
+        from ..utils.obs import NULL_REGISTRY
+
+        self.registry = registry if registry is not None else NULL_REGISTRY
+        self._m_steps = self.registry.counter(
+            "train_steps_total", "Completed training steps (epoch "
+            "dispatches for the CNN engine)",
+        )
+        self._m_step_time = self.registry.histogram(
+            "train_step_seconds", "Fenced wall time per training step"
+        )
+        self._m_loss = self.registry.gauge(
+            "train_loss", "Global average training loss of the last step"
+        )
+        self._m_epoch = self.registry.gauge(
+            "train_epoch", "Last completed epoch"
+        )
+        # optional recompile detector (train/monitor.py); observed once
+        # per epoch dispatch, re-bound after deliberate rebuilds
+        self.recompiles = None
         self.config = c = config
         if c.regime == "single":
             n_workers = 1
@@ -773,6 +795,13 @@ class Engine:
                 and not self.step_stats.records,
             )
             self.step_stats.capture_memory(self.tracer)
+        # one fused dispatch = one heartbeat (the watchdog's stall
+        # threshold adapts to whatever cadence the run actually has)
+        self.registry.beat(epoch0 + span - 1)
+        self._m_steps.inc(span)
+        self.registry.mark_ready()
+        self._m_step_time.observe(time.perf_counter() - t_step)
+        self._m_epoch.set(epoch0 + span - 1)
         if eval_inside:
             tl, vl, va, nl = (np.asarray(x) for x in out[2:])
         else:
@@ -789,6 +818,7 @@ class Engine:
             for i in range(span)
         ]
         self.history.extend(metrics)
+        self._m_loss.set(metrics[-1].train_loss)
         return metrics
 
     # ----------------------------------------------------------------- run
@@ -902,9 +932,10 @@ class Engine:
                         jnp.uint32(epoch),
                     )
                 t.value = params_stacked
+        train_wall = time.perf_counter() - t_step
         if self.step_stats is not None:
             self.step_stats.record(
-                epoch, time.perf_counter() - t_step, items=self.images_per_epoch
+                epoch, train_wall, items=self.images_per_epoch
             )
 
         with tracer.span(TR.SYNC, track="sync", step=epoch):
@@ -937,6 +968,16 @@ class Engine:
             n_live=int(mask_host.sum()),
         )
         self.history.append(m)
+        # live metrics + liveness heartbeat (utils/obs.py; no-op without
+        # --metrics-port): one epoch dispatch IS one step here
+        self.registry.beat(epoch)
+        self._m_steps.inc()
+        self.registry.mark_ready()
+        self._m_step_time.observe(train_wall)
+        self._m_loss.set(m.train_loss)
+        self._m_epoch.set(epoch)
+        if self.recompiles is not None:
+            self.recompiles.observe(epoch)
         return m
 
     def run(
@@ -1038,6 +1079,10 @@ class Engine:
                         # per retry, bounded by max_retries)
                         self.config.lr = base_lr * guard.lr_scale
                         self._build_steps()
+                        if self.recompiles is not None:
+                            # deliberate rebuild: re-baseline so the LR
+                            # backoff recompile never counts as a miss
+                            self.recompiles.swap(self._train_fn)
                         self.history = [
                             h for h in self.history if h.epoch < snap_epoch
                         ]

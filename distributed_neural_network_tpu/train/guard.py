@@ -173,11 +173,30 @@ class TrainingGuard:
         *,
         tracer=None,
         step_stats=None,
+        registry=None,
         log=print,
     ):
         self.cfg = config if config is not None else GuardConfig()
         self.tracer = tracer
         self.step_stats = step_stats
+        # live-metrics registry (utils/obs.py; None/NULL_REGISTRY = off):
+        # anomaly/rollback counters surface on /metrics while the run is
+        # alive, not only in the post-hoc trace/StepStats
+        if registry is None:
+            from ..utils.obs import NULL_REGISTRY
+
+            registry = NULL_REGISTRY
+        self._anomaly_counter = registry.counter(
+            "guard_anomalies_total",
+            "Guard anomalies observed, by kind (train/guard.py)",
+        )
+        self._rollback_counter = registry.counter(
+            "guard_rollbacks_total", "Guard rollback restores"
+        )
+        self._lr_scale_gauge = registry.gauge(
+            "guard_lr_scale", "Cumulative guard LR-backoff factor"
+        )
+        self._lr_scale_gauge.set(1.0)
         self.log = log
         self.detector = SpikeDetector(
             decay=self.cfg.ema_decay, warmup=self.cfg.warmup_steps
@@ -273,6 +292,7 @@ class TrainingGuard:
 
     def _anomaly(self, step, kind, reason, zscore) -> Verdict:
         self.counters[kind] += 1
+        self._anomaly_counter.labels(kind=kind).inc()
         self._healthy_streak = 0
         policy = self.cfg.policy
         action = {
@@ -325,7 +345,9 @@ class TrainingGuard:
                 "clipping (--clip-norm)."
             )
         self.counters["rollbacks"] += 1
+        self._rollback_counter.inc()
         self.lr_scale *= self.cfg.lr_backoff
+        self._lr_scale_gauge.set(self.lr_scale)
         self.detector.reset()  # re-warm against the restored trajectory
         if self.step_stats is not None:
             self.step_stats.count_anomaly("rollbacks")
@@ -440,6 +462,21 @@ class PreemptionGuard:
             f"({self.signame} received: finishing the current step, then "
             "writing an emergency checkpoint and exiting; send again to "
             "force)"
+        )
+
+    def request(self, reason: str = "REQUEST") -> None:
+        """Programmatic preemption (no signal involved): the watchdog's
+        stall escalation (`train/monitor.py`) raises the same cooperative
+        flag a SIGTERM would, so the training loop writes its emergency
+        checkpoint at the next step boundary and exits cleanly. Idempotent;
+        works from any thread (unlike signal delivery)."""
+        if self.requested:
+            return
+        self.requested = True
+        self.signame = reason
+        self.log(
+            f"({reason} preemption requested: finishing the current step, "
+            "then writing an emergency checkpoint and exiting)"
         )
 
     def install(self) -> "PreemptionGuard":

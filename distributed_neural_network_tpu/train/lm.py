@@ -673,6 +673,8 @@ def make_traced_step(
     fence: bool = True,
     first_step: int = 0,
     compile_first: bool = True,
+    registry=None,
+    recompiles=None,
 ):
     """Wrap a compiled LM train step with span tracing + StepStats.
 
@@ -692,13 +694,32 @@ def make_traced_step(
     this module / parallel/pipeline.py).
     ``compile_first=False`` marks every record steady-state - for callers
     that already absorbed compilation in their own warm-up.
+
+    ``registry`` (utils/obs.py MetricsRegistry; None = off) adds the live
+    publishing layer: a liveness heartbeat + ``train_steps_total`` +
+    ``train_step_seconds`` histogram + throughput gauge per step, with
+    readiness flipped after the first completed (compiled) call.
+    ``recompiles`` (train/monitor.py RecompileDetector) is observed once
+    per call - one ``_cache_size()`` read - to count silent recompiles.
     """
     import itertools
 
     from ..utils import tracing as _tracing
+    from ..utils.obs import NULL_REGISTRY
     from ..utils.timers import hard_block
 
     counter = itertools.count(first_step)
+    reg = registry if registry is not None else NULL_REGISTRY
+    m_steps = reg.counter(
+        "train_steps_total", "Completed training steps"
+    )
+    m_wall = reg.histogram(
+        "train_step_seconds", "Fenced wall time per training step"
+    )
+    m_thr = reg.gauge(
+        "train_throughput_items_per_s",
+        "Per-step training throughput (tokens/s for the LM paths)",
+    )
 
     def traced_step(*args, **kwargs):
         i = next(counter)
@@ -709,11 +730,20 @@ def make_traced_step(
             out = step_fn(*args, **kwargs)
             if fence:
                 hard_block(out[-1] if isinstance(out, tuple) else out)
+        dt = time.perf_counter() - t0
         if step_stats is not None:
             step_stats.record(
-                i, time.perf_counter() - t0, items=items_per_step,
+                i, dt, items=items_per_step,
                 is_compile=None if compile_first else False,
             )
+        reg.beat(i)
+        m_steps.inc()
+        m_wall.observe(dt)
+        reg.mark_ready()
+        if items_per_step and dt > 0 and reg.ready and i != first_step:
+            m_thr.set(items_per_step / dt)
+        if recompiles is not None:
+            recompiles.observe(i)
         return out
 
     return traced_step

@@ -1,0 +1,420 @@
+"""Runtime watchdog: stall, recompile-storm, and checkpoint-staleness
+detection over the live metrics registry (`utils/obs.py`).
+
+The guard layer (`train/guard.py`) judges what a step REPORTS (loss,
+grad-norm, finite flags); this module judges whether steps are HAPPENING
+at all - the failure class the pjit-at-scale infrastructure paper (arxiv
+2204.06514) localizes with fleet heartbeat monitoring: a wedged collective,
+a dead host thread, a silent recompile storm re-tracing every step, or a
+checkpointer that quietly stopped writing. None of those raise; they just
+stop the world (or burn it at 100x cost), invisibly, until someone reads a
+trace after the fact.
+
+Three detectors on one polling thread (default 1 s cadence, off the
+training loop's critical path):
+
+- **stall**: the training loop heartbeats the registry at each step
+  boundary (`registry.beat(step)`); the watchdog sizes its threshold from
+  the observed steady beat intervals - no heartbeat for
+  ``stall_factor x p95`` (floored by ``min_stall_s``) flags a stall. One
+  flag per episode (latched until the next beat), emitted as a
+  ``watchdog/stall`` tracer instant event + ``watchdog_stall_total``
+  counter; an optional escalation path requests a cooperative
+  SIGTERM-style preemption (`train/guard.py PreemptionGuard.request`) so
+  the run writes its emergency checkpoint and exits instead of burning
+  its reservation wedged.
+- **recompile storm**: `RecompileDetector.observe()` (one
+  ``fn._cache_size()`` read per step at the call site) counts compile
+  cache growth after the first compile into ``recompiles_total``; the
+  watchdog flags when more than ``recompile_storm`` recompiles land
+  within ``recompile_window_s`` - the classic unstable-static-argument
+  bug that silently turns every step into a compile.
+- **checkpoint staleness**: the checkpointer publishes
+  ``checkpoint_last_save_timestamp_seconds`` (`utils/checkpoint.py`);
+  an age beyond ``checkpoint_stale_s`` flags once per stale episode.
+
+`attach_monitor()` is the shared CLI wiring (`--metrics-port`, both
+`lm_train.py` and `train/cli.py`): registry + `/metrics`+`/healthz`
+server + watchdog, one handle to close on exit.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+
+from ..utils import obs as O
+from ..utils import tracing as TR
+
+WATCHDOG_STALL = "watchdog/stall"
+WATCHDOG_RECOMPILE = "watchdog/recompile_storm"
+WATCHDOG_CKPT_STALE = "watchdog/checkpoint_stale"
+
+
+@dataclass
+class WatchdogConfig:
+    """Detection knobs. The stall threshold is ADAPTIVE - N x the steady
+    p95 beat interval - so the same config works for 5 ms CPU smoke steps
+    and multi-minute fused spans; ``min_stall_s`` floors it against noise
+    on sub-millisecond steps, ``max_stall_s`` caps it so a run whose p95
+    was poisoned by one giant outlier still gets flagged eventually."""
+
+    poll_interval_s: float = 1.0
+    stall_factor: float = 10.0
+    min_stall_s: float = 5.0
+    max_stall_s: float = 600.0
+    # beats observed before the stall detector arms (compile-step and
+    # first-steps intervals are legitimately wild)
+    warmup_beats: int = 3
+    # recompile storm: more than this many recompiles inside the window
+    recompile_storm: int = 3
+    recompile_window_s: float = 60.0
+    # checkpoint considered stale after this many seconds without a save
+    # (0 disables; only meaningful when a checkpointer publishes saves)
+    checkpoint_stale_s: float = 0.0
+    # escalate a persistent stall (this many consecutive flagged polls
+    # AFTER the first flag) into PreemptionGuard.request(); 0 disables
+    escalate_after_polls: int = 0
+
+    def __post_init__(self):
+        if self.poll_interval_s <= 0:
+            raise ValueError(
+                f"poll_interval_s must be > 0, got {self.poll_interval_s}"
+            )
+        if self.stall_factor <= 1.0:
+            raise ValueError(
+                f"stall_factor must be > 1, got {self.stall_factor}"
+            )
+        if self.min_stall_s < 0 or self.max_stall_s < self.min_stall_s:
+            raise ValueError(
+                f"need 0 <= min_stall_s <= max_stall_s, got "
+                f"{self.min_stall_s}/{self.max_stall_s}"
+            )
+
+
+class RecompileDetector:
+    """Cache-miss counting on a jitted step function.
+
+    ``observe()`` after each call reads the function's compile-cache size
+    (``fn._cache_size()``, present on modern jax jit wrappers; detection
+    degrades to a no-op where absent) and counts growth beyond the first
+    compile into ``recompiles_total`` + a ``watchdog/recompile`` tracer
+    instant. The watchdog thread turns a burst of these into the storm
+    flag. ``swap(fn)`` rebinds after a deliberate rebuild (the guard's LR
+    backoff recompile is intentional and must not count).
+    """
+
+    def __init__(self, fn=None, *, registry=O.NULL_REGISTRY,
+                 tracer=TR.NULL_TRACER):
+        self.registry = registry
+        self.tracer = tracer
+        self.counter = registry.counter(
+            "recompiles_total",
+            "Compile-cache misses of the jitted train step after the "
+            "first compile",
+        )
+        self.events: list[float] = []  # unix times, read by the watchdog
+        self._lock = threading.Lock()
+        self._fn = None
+        self._baseline = None
+        if fn is not None:
+            self.swap(fn)
+
+    @staticmethod
+    def cache_size(fn) -> int | None:
+        get = getattr(fn, "_cache_size", None)
+        if get is None:
+            return None
+        try:
+            return int(get())
+        except Exception:
+            return None
+
+    def swap(self, fn) -> None:
+        """Track a (new) jitted fn; its current cache size becomes the
+        baseline so deliberate rebuilds don't count as misses."""
+        self._fn = fn
+        self._baseline = self.cache_size(fn)
+
+    def observe(self, step: int | None = None) -> int:
+        """Call after a step completes; returns recompiles counted so
+        far this run. First growth from 0 is THE compile, not a miss."""
+        size = self.cache_size(self._fn)
+        if size is None:
+            return len(self.events)
+        if self._baseline is None or size <= self._baseline:
+            self._baseline = size if self._baseline is None else self._baseline
+            return len(self.events)
+        grew = size - self._baseline
+        if self._baseline == 0:
+            grew -= 1  # the first compile is expected
+        self._baseline = size
+        if grew <= 0:
+            return len(self.events)
+        now = time.time()
+        with self._lock:
+            self.events.extend([now] * grew)
+        self.counter.inc(grew)
+        self.tracer.instant(
+            "watchdog/recompile", track="watchdog",
+            step=step, new_entries=grew, cache_size=size,
+        )
+        return len(self.events)
+
+    def recent(self, window_s: float) -> int:
+        cut = time.time() - window_s
+        with self._lock:
+            return sum(1 for t in self.events if t >= cut)
+
+
+class Watchdog:
+    """The polling thread. start()/stop(), or use as a context manager."""
+
+    def __init__(
+        self,
+        registry,
+        *,
+        config: WatchdogConfig | None = None,
+        tracer=TR.NULL_TRACER,
+        recompiles: RecompileDetector | None = None,
+        preemption=None,
+        log=print,
+    ):
+        self.registry = registry
+        self.cfg = config if config is not None else WatchdogConfig()
+        self.tracer = tracer
+        self.recompiles = recompiles
+        self.preemption = preemption
+        self.log = log
+        self.stall_counter = registry.counter(
+            "watchdog_stall_total",
+            "Stalled-step episodes flagged by the watchdog",
+        )
+        self.storm_counter = registry.counter(
+            "watchdog_recompile_storm_total",
+            "Recompile-storm episodes flagged by the watchdog",
+        )
+        self.ckpt_stale_counter = registry.counter(
+            "watchdog_checkpoint_stale_total",
+            "Checkpoint-staleness episodes flagged by the watchdog",
+        )
+        self.threshold_gauge = registry.gauge(
+            "watchdog_stall_threshold_seconds",
+            "Current adaptive stall threshold (stall_factor x steady p95)",
+        )
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        # episode latches
+        self._stall_flagged_at_step: int | None = None
+        self._stall_polls = 0
+        self._escalated = False
+        self._storm_flagged = False
+        self._ckpt_flagged_for: float | None = None
+
+    # ------------------------------------------------------------ control
+
+    def start(self) -> "Watchdog":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="watchdog", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # ------------------------------------------------------------- detect
+
+    def stall_threshold_s(self) -> float | None:
+        """stall_factor x p95 of the recent beat intervals, clamped to
+        [min_stall_s, max_stall_s]; None while under warmup_beats."""
+        intervals = self.registry.beat_intervals()
+        if len(intervals) < self.cfg.warmup_beats:
+            return None
+        p95 = TR.percentile(intervals, 95)
+        return min(
+            max(self.cfg.stall_factor * p95, self.cfg.min_stall_s),
+            self.cfg.max_stall_s,
+        )
+
+    def check_once(self) -> dict:
+        """One poll of all three detectors (the thread body; callable
+        directly from tests). Returns {stall, storm, ckpt_stale} bools of
+        NEW flags raised by this poll."""
+        raised = {"stall": False, "storm": False, "ckpt_stale": False}
+        # ---- stall
+        thr = self.stall_threshold_s()
+        if thr is not None:
+            self.threshold_gauge.set(thr)
+            age = self.registry.heartbeat_age()
+            step = self.registry.last_step()
+            if age is not None and age > thr:
+                if self._stall_flagged_at_step != step:
+                    self._stall_flagged_at_step = step
+                    self._stall_polls = 0
+                    self._escalated = False
+                    self.stall_counter.inc()
+                    self.tracer.instant(
+                        WATCHDOG_STALL, track="watchdog", step=step,
+                        heartbeat_age_s=round(age, 3),
+                        threshold_s=round(thr, 3),
+                    )
+                    self.log(
+                        f"(watchdog: STALL - no step heartbeat for "
+                        f"{age:.1f}s, threshold {thr:.1f}s "
+                        f"[{self.cfg.stall_factor}x steady p95], last "
+                        f"step {step})"
+                    )
+                    raised["stall"] = True
+                else:
+                    self._stall_polls += 1
+                    if (
+                        self.cfg.escalate_after_polls > 0
+                        and self.preemption is not None
+                        and not self._escalated
+                        and self._stall_polls >= self.cfg.escalate_after_polls
+                    ):
+                        self._escalated = True
+                        self.tracer.instant(
+                            WATCHDOG_STALL, track="watchdog", step=step,
+                            action="escalate",
+                        )
+                        self.log(
+                            "(watchdog: stall persists - requesting "
+                            "cooperative preemption [emergency checkpoint "
+                            "at the next step boundary])"
+                        )
+                        self.preemption.request("WATCHDOG")
+            elif self._stall_flagged_at_step is not None and (
+                age is None or age <= thr
+            ):
+                # heartbeat came back: close the episode
+                self._stall_flagged_at_step = None
+                self._stall_polls = 0
+                self._escalated = False
+        # ---- recompile storm
+        if self.recompiles is not None:
+            n = self.recompiles.recent(self.cfg.recompile_window_s)
+            if n > self.cfg.recompile_storm and not self._storm_flagged:
+                self._storm_flagged = True
+                self.storm_counter.inc()
+                self.tracer.instant(
+                    WATCHDOG_RECOMPILE, track="watchdog",
+                    recompiles_in_window=n,
+                    window_s=self.cfg.recompile_window_s,
+                )
+                self.log(
+                    f"(watchdog: RECOMPILE STORM - {n} recompiles within "
+                    f"{self.cfg.recompile_window_s:.0f}s; a step input's "
+                    "shape/dtype/static arg is changing per call)"
+                )
+                raised["storm"] = True
+            elif n <= self.cfg.recompile_storm:
+                self._storm_flagged = False
+        # ---- checkpoint staleness
+        if self.cfg.checkpoint_stale_s > 0:
+            g = self.registry.get("checkpoint_last_save_timestamp_seconds")
+            last = g.value if g is not None else 0.0
+            if last > 0:
+                age = time.time() - last
+                if (
+                    age > self.cfg.checkpoint_stale_s
+                    and self._ckpt_flagged_for != last
+                ):
+                    self._ckpt_flagged_for = last
+                    self.ckpt_stale_counter.inc()
+                    self.tracer.instant(
+                        WATCHDOG_CKPT_STALE, track="watchdog",
+                        checkpoint_age_s=round(age, 1),
+                        threshold_s=self.cfg.checkpoint_stale_s,
+                    )
+                    self.log(
+                        f"(watchdog: checkpoint is {age:.0f}s old "
+                        f"[threshold {self.cfg.checkpoint_stale_s:.0f}s] "
+                        "- the checkpointer may have stopped writing)"
+                    )
+                    raised["ckpt_stale"] = True
+        return raised
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.cfg.poll_interval_s):
+            try:
+                self.check_once()
+            except Exception as e:  # a detector bug must never kill a run
+                self.log(f"(watchdog: internal error {type(e).__name__}: "
+                         f"{e}; continuing)")
+
+
+# ----------------------------------------------------------- CLI wiring
+
+
+class Monitor:
+    """registry + server + watchdog, one close()."""
+
+    def __init__(self, registry, server=None, watchdog=None,
+                 recompiles: RecompileDetector | None = None):
+        self.registry = registry
+        self.server = server
+        self.watchdog = watchdog
+        self.recompiles = recompiles
+        self._closed = False
+
+    @property
+    def url(self) -> str | None:
+        return self.server.url if self.server is not None else None
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        if self.server is not None:
+            self.server.close()
+
+
+def attach_monitor(
+    *,
+    metrics_port: int | None,
+    tracer=TR.NULL_TRACER,
+    preemption=None,
+    watchdog: bool = True,
+    config: WatchdogConfig | None = None,
+    log=print,
+) -> Monitor:
+    """The shared `--metrics-port` wiring for both CLIs.
+
+    ``metrics_port=None`` returns a fully inert monitor around
+    ``NULL_REGISTRY`` (every publish site stays a no-op); a port (0 =
+    ephemeral) builds the live registry, starts the HTTP server, and
+    (unless ``watchdog=False``) the watchdog thread. The caller logs
+    ``monitor.url`` and closes the monitor on exit.
+    """
+    if metrics_port is None:
+        return Monitor(O.NULL_REGISTRY)
+    registry = O.MetricsRegistry()
+    server = O.ObsServer(registry, port=metrics_port)
+    rec = RecompileDetector(registry=registry, tracer=tracer)
+    dog = None
+    if watchdog:
+        dog = Watchdog(
+            registry, config=config, tracer=tracer, recompiles=rec,
+            preemption=preemption, log=log,
+        ).start()
+    log(
+        f"(metrics server: {server.url}/metrics , {server.url}/healthz"
+        + (" ; watchdog on)" if dog is not None else " ; watchdog off)")
+    )
+    return Monitor(registry, server, dog, rec)

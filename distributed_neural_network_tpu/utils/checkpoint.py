@@ -205,6 +205,37 @@ def _make_backend(backend: str, directory: str, keep: int):
     return backend, cls(directory, keep)
 
 
+class _CkptMetrics:
+    """Live-metrics publishing shared by both checkpointers (utils/obs.py;
+    registry=None stays a no-op). The last-save timestamp gauge is what
+    the watchdog's checkpoint-staleness detector (train/monitor.py) ages
+    against; the step gauge tells a dashboard how far back a restore
+    would rewind."""
+
+    def __init__(self, registry=None):
+        if registry is None:
+            from .obs import NULL_REGISTRY
+
+            registry = NULL_REGISTRY
+        self.saves = registry.counter(
+            "checkpoint_saves_total", "Checkpoints written this run"
+        )
+        self.last_save = registry.gauge(
+            "checkpoint_last_save_timestamp_seconds",
+            "Unix time of the newest checkpoint save",
+        )
+        self.last_step = registry.gauge(
+            "checkpoint_last_step", "Step/epoch of the newest checkpoint"
+        )
+
+    def saved(self, step: int) -> None:
+        import time
+
+        self.saves.inc()
+        self.last_save.set(time.time())
+        self.last_step.set(int(step))
+
+
 class TreeCheckpointer:
     """Save/restore an arbitrary pytree + metadata (same backends).
 
@@ -215,11 +246,14 @@ class TreeCheckpointer:
     to re-place leaves onto the run's mesh.
     """
 
-    def __init__(self, directory: str, *, keep: int = 3, backend: str = "auto"):
+    def __init__(self, directory: str, *, keep: int = 3, backend: str = "auto",
+                 registry=None):
         self.backend_name, self._b = _make_backend(backend, directory, keep)
+        self._metrics = _CkptMetrics(registry)
 
     def save(self, step: int, state, meta: dict | None = None) -> None:
         self._b.save(step, _host_tree(state), meta or {})
+        self._metrics.saved(step)
 
     def latest_step(self):
         return self._b.latest_step()
@@ -271,8 +305,10 @@ class Checkpointer:
         every: int = 1,
         keep: int = 3,
         backend: str = "auto",
+        registry=None,
     ):
         self.backend_name, self._b = _make_backend(backend, directory, keep)
+        self._metrics = _CkptMetrics(registry)
         self.every = every
 
     # ------------------------------------------------------------------ save
@@ -298,6 +334,7 @@ class Checkpointer:
             **resume_cursor(step=epoch, seed=engine.config.seed),
         }
         self._b.save(epoch, state, meta)
+        self._metrics.saved(epoch)
 
     # --------------------------------------------------------------- restore
 

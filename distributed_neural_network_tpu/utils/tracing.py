@@ -246,14 +246,31 @@ class Tracer:
 
     def export(self, path: str, *, step_stats: "StepStats | None" = None) -> str:
         """Write strict Chrome trace-event JSON (never a bare NaN/Inf
-        token - `allow_nan=False` with non-finite floats nulled first)."""
+        token - `allow_nan=False` with non-finite floats nulled first).
+
+        Crash-safe: the document is written to ``<path>.tmp`` and
+        atomically renamed over ``path``, so a SIGTERM (reachable
+        mid-export via the watchdog's preemption escalation,
+        train/monitor.py) or a serializer error can never leave a
+        truncated half-JSON trace where a previous good one stood - the
+        reader sees the old complete file or the new complete file,
+        never a partial write."""
         doc = self.to_chrome(step_stats=step_stats)
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
-        with open(path, "w") as f:
-            json.dump(doc, f, allow_nan=False)
-            f.write("\n")
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(doc, f, allow_nan=False)
+                f.write("\n")
+            os.replace(tmp, path)  # atomic publish
+        finally:
+            if os.path.exists(tmp):
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
         return path
 
 
@@ -320,9 +337,28 @@ class StepStats:
         grad_sync: str | None = None,
         comm_bucket_bytes: list | tuple | None = None,
         compilation_cache_dir: str | None = None,
+        registry=None,
     ):
         self.item_label = item_label
         self.sink = sink
+        # live-metrics registry (utils/obs.py; None = off): anomaly
+        # counters and device-memory gauges surface on /metrics as they
+        # are recorded. Step counting/heartbeat stays with the training
+        # loops (engine / make_traced_step) - StepStats is opt-in, the
+        # liveness signal is not.
+        if registry is None:
+            from .obs import NULL_REGISTRY
+
+            registry = NULL_REGISTRY
+        self._reg_mem = registry.gauge(
+            "device_memory_bytes_in_use",
+            "Peak bytes_in_use per device (device.memory_stats)",
+        )
+        if comm_bytes_per_step is not None:
+            registry.gauge(
+                "collective_bytes_per_step",
+                "Estimated per-device collective payload bytes per step",
+            ).set(comm_bytes_per_step)
         self.series_prefix = series_prefix
         self.n_devices = int(n_devices)
         self.comm_bytes_per_step = comm_bytes_per_step
@@ -379,7 +415,10 @@ class StepStats:
         return rec
 
     def count_anomaly(self, kind: str, n: int = 1) -> None:
-        """Bump a guard anomaly counter (and stream it when sinking)."""
+        """Bump a guard anomaly counter (and stream it when sinking).
+        The /metrics counterpart (guard_anomalies_total) is published by
+        the guard itself (train/guard.py) - the sole anomaly producer -
+        so counts never double when both are wired to one registry."""
         with self._lock:
             self.anomalies[kind] = self.anomalies.get(kind, 0) + int(n)
         if self.sink is not None:
@@ -403,6 +442,7 @@ class StepStats:
             if b is None:
                 continue
             self.memory_peak[label] = max(self.memory_peak.get(label, 0), int(b))
+            self._reg_mem.labels(device=label).set_max(int(b))
         if tracer is not None and self.memory_peak:
             tracer.counter(
                 "device_memory_bytes_in_use",

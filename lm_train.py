@@ -167,6 +167,29 @@ def main() -> int:
                    "step time, tokens/s, device memory, collective bytes, "
                    "MFU from cost_analysis with analytic fallback), print "
                    "the summary, and emit step/* series to --metrics-jsonl")
+    p.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
+                   help="serve live Prometheus metrics on http://127.0.0.1"
+                   ":PORT/metrics plus a /healthz JSON liveness/readiness "
+                   "endpoint (0 = ephemeral port, printed at startup); "
+                   "also starts the stall/recompile/checkpoint watchdog "
+                   "unless --watchdog off (utils/obs.py, train/monitor.py, "
+                   "docs/OBSERVABILITY.md; watch live with "
+                   "tools/live_top.py http://127.0.0.1:PORT)")
+    p.add_argument("--metrics-linger", type=float, default=0.0,
+                   metavar="SEC",
+                   help="keep the metrics server up this many seconds "
+                   "after the run finishes (final scrape window)")
+    p.add_argument("--watchdog", choices=("on", "off"), default="on",
+                   help="with --metrics-port: background watchdog flagging "
+                   "stalled steps (no heartbeat for N x steady p95 step "
+                   "time), recompile storms, and checkpoint staleness as "
+                   "watchdog/* trace events + watchdog_*_total counters")
+    p.add_argument("--watchdog-escalate", choices=("none", "preempt"),
+                   default="none",
+                   help="preempt = a persistent stall requests the "
+                   "cooperative preemption path (emergency checkpoint at "
+                   "the next step boundary, clean exit); requires "
+                   "--on-sigterm checkpoint")
     p.add_argument("--checkpoint-dir", default=None,
                    help="save params+momentum every --checkpoint-every steps")
     p.add_argument("--checkpoint-every", type=int, default=50)
@@ -217,6 +240,17 @@ def main() -> int:
                    help="fault injection: deliver a real SIGTERM to this "
                    "process after step N completes (drives the emergency-"
                    "checkpoint -> exact-resume path end to end)")
+    p.add_argument("--chaos-stall-step", type=int, action="append",
+                   default=None, metavar="N",
+                   help="fault injection: sleep --chaos-stall-seconds "
+                   "inside the host step callback after step N completes "
+                   "(repeatable; host-side, works under --pp too) - the "
+                   "heartbeat stops, which the --metrics-port watchdog "
+                   "must flag as a watchdog/stall event within one "
+                   "detection window")
+    p.add_argument("--chaos-stall-seconds", type=float, default=2.0,
+                   metavar="SEC",
+                   help="stall duration for --chaos-stall-step")
     p.add_argument("--gen-temperature", type=float, default=0.0,
                    help="sampling temperature for --generate (0 = greedy)")
     p.add_argument("--gen-top-k", type=int, default=0,
@@ -294,6 +328,8 @@ def main() -> int:
         )
     if args.bucket_mb <= 0:
         p.error(f"--bucket-mb must be > 0, got {args.bucket_mb}")
+    # --chaos-stall-step is deliberately NOT in this set: it is a pure
+    # host-side sleep (no health bundle involved), so it works under --pp
     chaos_injected = bool(
         args.chaos_nan_step or args.chaos_spike_step
         or args.chaos_sigterm_after is not None
@@ -305,6 +341,12 @@ def main() -> int:
             "pipeline path has no health output yet - drop --pp or the "
             "guard flags"
         )
+    if args.chaos_stall_seconds <= 0:
+        p.error(f"--chaos-stall-seconds must be > 0, got "
+                f"{args.chaos_stall_seconds}")
+    if args.watchdog_escalate == "preempt" and args.on_sigterm != "checkpoint":
+        p.error("--watchdog-escalate preempt rides the cooperative "
+                "preemption path; it requires --on-sigterm checkpoint")
     if args.snapshot_every < 1:
         p.error(f"--snapshot-every must be >= 1, got {args.snapshot_every}")
     if args.max_retries < 0:
@@ -474,6 +516,38 @@ def main() -> int:
         f"{k}{v}" for k, v in mesh.shape.items() if v > 1
     ) or "single"
 
+    # live observability (utils/obs.py + train/monitor.py): the tracer,
+    # preemption guard, and --metrics-port monitor exist BEFORE the
+    # checkpointer/guard/step wiring so every layer can publish into the
+    # same registry (docs/OBSERVABILITY.md "Live monitoring")
+    from distributed_neural_network_tpu.train import guard as G
+    from distributed_neural_network_tpu.train.monitor import (
+        WatchdogConfig,
+        attach_monitor,
+    )
+    from distributed_neural_network_tpu.utils import tracing as TRC
+
+    tracer = TRC.Tracer(enabled=bool(args.trace_out))
+    preempt = None
+    if args.on_sigterm == "checkpoint":
+        preempt = G.PreemptionGuard().install()
+    monitor = attach_monitor(
+        metrics_port=args.metrics_port,
+        tracer=tracer,
+        preemption=preempt,
+        watchdog=args.watchdog == "on",
+        config=WatchdogConfig(
+            escalate_after_polls=(
+                5 if args.watchdog_escalate == "preempt"
+                and preempt is not None else 0
+            ),
+        ),
+    )
+    registry = monitor.registry
+    m_loss_gauge = registry.gauge(
+        "train_loss", "Training loss at the last logged step"
+    )
+
     from distributed_neural_network_tpu.train.guard import (
         check_cursor,
         resume_cursor,
@@ -495,7 +569,7 @@ def main() -> int:
             TreeCheckpointer,
         )
 
-        ck = TreeCheckpointer(args.checkpoint_dir)
+        ck = TreeCheckpointer(args.checkpoint_dir, registry=registry)
         if not args.resume and ck.latest_step() is not None:
             raise SystemExit(
                 f"--checkpoint-dir {args.checkpoint_dir} already contains "
@@ -654,9 +728,7 @@ def main() -> int:
     # The traced wrapper fences each step (hard_block on the loss), so the
     # tokens/s this run reports includes one device->host fetch per step -
     # opt-in observability, not the measurement path (train/measure.py).
-    from distributed_neural_network_tpu.utils import tracing as TRC
-
-    tracer = TRC.Tracer(enabled=bool(args.trace_out))
+    # The tracer itself was created up front with the monitor.
     stats = None
     if args.trace_out or args.step_stats:
         from distributed_neural_network_tpu.train.measure import (
@@ -718,6 +790,7 @@ def main() -> int:
         stats = TRC.StepStats(
             item_label="tokens",
             sink=run if args.step_stats else None,
+            registry=registry,
             n_devices=mesh.devices.size,
             comm_bytes_per_step=comm_bytes,
             static_comm_bytes_per_step=static_comm,
@@ -742,28 +815,36 @@ def main() -> int:
             )
 
     def wrap_step(fn, first_step):
-        """Span tracing + StepStats around a compiled step (identity when
-        telemetry is off); re-applied after a guard LR-backoff rebuild."""
-        if stats is None:
+        """Span tracing + StepStats + live registry publishing around a
+        compiled step (identity when all telemetry is off); re-applied
+        after a guard LR-backoff rebuild. The recompile detector is
+        re-baselined on the (new) fn so deliberate rebuilds never count
+        as cache misses."""
+        if monitor.recompiles is not None:
+            monitor.recompiles.swap(fn)
+        if stats is None and monitor.server is None:
             return fn
         return lmtrain.make_traced_step(
             fn, tracer=tracer, step_stats=stats,
             items_per_step=args.batch_size * args.seq_len,
             fence=True, first_step=first_step,
+            registry=registry, recompiles=monitor.recompiles,
         )
 
     step = wrap_step(step, step0)
 
     # self-healing layer (train/guard.py; docs/ROBUSTNESS.md)
-    from distributed_neural_network_tpu.train import guard as G
-
     monkey = None
-    if args.chaos_spike_step or args.chaos_sigterm_after is not None:
+    if (args.chaos_spike_step or args.chaos_stall_step
+            or args.chaos_sigterm_after is not None):
         from distributed_neural_network_tpu.parallel.fault import ChaosMonkey
 
         monkey = ChaosMonkey(
             spike_at=tuple(args.chaos_spike_step or ()),
             sigterm_after=args.chaos_sigterm_after,
+            stall_at=tuple(args.chaos_stall_step or ()),
+            stall_s=args.chaos_stall_seconds,
+            tracer=tracer,
         )
     guard = hpipe = None
     if guard_on:
@@ -774,14 +855,11 @@ def main() -> int:
                 snapshot_every=args.snapshot_every,
                 max_retries=args.max_retries,
             ),
-            tracer=tracer, step_stats=stats,
+            tracer=tracer, step_stats=stats, registry=registry,
         )
         hpipe = G.HealthPipe(
             guard, perturb=monkey.perturb if monkey is not None else None
         )
-    preempt = None
-    if args.on_sigterm == "checkpoint":
-        preempt = G.PreemptionGuard().install()
 
     ema = ema_fn = None
     if args.ema_decay:
@@ -889,6 +967,7 @@ def main() -> int:
         if (i - step0) % args.log_every == 0 or i == end_step - 1:
             print(f"step {i:>5}  loss {float(loss):.4f}")
             run.append(M.TRAIN_LOSS, float(loss))
+            m_loss_gauge.set(float(loss))
         if ck is not None and (i + 1) % args.checkpoint_every == 0:
             ck.save(i, {"params": params, "mom": mom},
                     ckpt_meta(i, float(loss)))
@@ -1016,6 +1095,11 @@ def main() -> int:
         "model_tflops_per_s": round(model_flops_s / 1e12, 2),
         "mfu_pct": round(mfu, 2) if mfu is not None else None,
     }))
+    if monitor.server is not None and args.metrics_linger > 0:
+        print(f"(metrics server lingering {args.metrics_linger:g}s for "
+              "final scrapes)")
+        time.sleep(args.metrics_linger)
+    monitor.close()
     return 0
 
 

@@ -523,3 +523,133 @@ def test_lm_train_overlap_grad_sync_and_compilation_cache(tmp_path):
     doc2 = _strict_loads((tmp_path / "t2.json").read_text())
     assert doc2["stepStats"]["compilation_cache_dir"] == str(cache)
     assert doc2["stepStats"]["compile_s"] is not None
+
+
+# ---------------------------------------------------- live observability
+
+
+def _popen_env():
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _await_metrics_url(proc, deadline_s=240):
+    """Read the child's stdout until attach_monitor prints the server URL."""
+    import re
+    import time as _time
+
+    t0 = _time.time()
+    lines = []
+    while _time.time() - t0 < deadline_s:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        lines.append(line)
+        m = re.search(r"metrics server: (http://[0-9.:]+)/metrics", line)
+        if m:
+            return m.group(1), lines
+    raise AssertionError(
+        "metrics server URL never printed:\n" + "".join(lines)
+    )
+
+
+def _scrape(url, path="/metrics"):
+    import urllib.request
+
+    with urllib.request.urlopen(url + path, timeout=5) as r:
+        return r.read().decode()
+
+
+def _metric(body, name):
+    for line in body.splitlines():
+        if line.startswith(name + " "):
+            return float(line.split()[1])
+    return None
+
+
+@pytest.mark.skipif(
+    not hasattr(__import__("jax"), "shard_map"),
+    reason="engine execution needs jax.shard_map with vma typing",
+)
+def test_cli_smoke_serves_live_metrics_and_healthz(tmp_path):
+    """The CI acceptance path: `python -m ...train.cli smoke
+    --metrics-port 0` serves valid Prometheus text with an advancing
+    `train_steps_total`, and /healthz flips ready after compile."""
+    import json as _json
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "distributed_neural_network_tpu.train.cli",
+         "smoke", "--metrics-port", "0", "--metrics-linger", "20",
+         "--data", "synthetic", "--synthetic-size", "128",
+         "--epochs", "3", "--batch-size", "16",
+         "--log-dir", str(tmp_path / "log")],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=REPO, env=_popen_env(),
+    )
+    try:
+        url, _ = _await_metrics_url(proc)
+        h = _json.loads(_scrape(url, "/healthz"))
+        assert h["alive"] is True  # liveness from process start
+        # poll until the first epoch compiled + completed
+        import time as _time
+
+        t0 = _time.time()
+        steps = 0.0
+        while _time.time() - t0 < 240:
+            body = _scrape(url)
+            steps = _metric(body, "train_steps_total") or 0.0
+            if steps >= 3:
+                break
+            _time.sleep(0.5)
+        assert steps >= 3, body
+        h = _json.loads(_scrape(url, "/healthz"))
+        assert h["ready"] is True and h["step"] is not None
+        assert _metric(body, "train_ready") == 1
+        assert _metric(body, "train_loss") is not None
+        # the reference's phase accumulators are published on exit; the
+        # linger window keeps the server up for this final scrape
+        deadline = _time.time() + 60
+        while _time.time() < deadline:
+            if "phase_seconds_total" in _scrape(url):
+                break
+            _time.sleep(0.5)
+        assert "phase_seconds_total" in _scrape(url)
+    finally:
+        proc.stdout.close()
+        proc.stderr.close()
+        proc.terminate()
+        proc.wait(timeout=30)
+
+
+@pytest.mark.skipif(
+    not hasattr(__import__("jax"), "shard_map"),
+    reason="LM step execution needs jax.shard_map with vma typing",
+)
+def test_lm_train_chaos_stall_is_flagged_by_watchdog(tmp_path):
+    """`--chaos-stall-step` wedges the host loop; with --metrics-port the
+    watchdog must count a watchdog_stall_total episode and the trace must
+    carry the watchdog/stall instant."""
+    trace = str(tmp_path / "t.json")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "lm_train.py"),
+         "--steps", "30", "--batch-size", "8", "--seq-len", "16",
+         "--d-model", "32", "--n-heads", "4", "--d-ff", "64",
+         "--vocab", "32", "--dp", "1",
+         "--metrics-port", "0",
+         "--chaos-stall-step", "20", "--chaos-stall-seconds", "8",
+         "--trace-out", trace],
+        capture_output=True, text=True, cwd=REPO, env=_popen_env(),
+        timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "(chaos: stalling the step loop" in proc.stdout
+    doc = json.load(open(trace))
+    names = [e.get("name") for e in doc["traceEvents"]]
+    assert "straggler" in names  # the injected stall span (fault track)
+    # the watchdog's detection window is adaptive (10 x steady p95,
+    # floored at 5 s); an 8 s stall over ~ms steps must be flagged
+    assert "watchdog/stall" in names, sorted(set(names))

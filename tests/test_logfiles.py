@@ -1,0 +1,70 @@
+"""utils/logfiles.py: the reference's phase-log naming/line parity.
+
+Previously untested - the byte-compatible line formats are the whole
+point of the module (drop-in comparison against the reference's own
+`log/*.txt`), so each line is pinned exactly, not by substring.
+"""
+
+import os
+
+from distributed_neural_network_tpu.utils import logfiles as LF
+from distributed_neural_network_tpu.utils import timers as T
+
+
+def _timers():
+    t = T.PhaseTimers()
+    t.add(T.DATA_LOADING, 1.25)
+    t.add(T.TRAINING, 10.5)
+    t.add(T.EVALUATION, 2.0)
+    t.add(T.COMMUNICATION, 0.75)
+    return t
+
+
+def test_log_basename_matches_reference_scheme():
+    assert (
+        LF.log_basename(16, 5, 4, "parent")
+        == "bs16_log_epochs5_proc4_parent.txt"
+    )
+    assert (
+        LF.log_basename(128, 2, 8, "children")
+        == "bs128_log_epochs2_proc8_children.txt"
+    )
+
+
+def test_write_phase_logs_writes_both_roles_with_exact_lines(tmp_path):
+    d = str(tmp_path / "log")  # does not exist yet: must be created
+    parent, children = LF.write_phase_logs(
+        d, bs=16, epochs=2, nb_proc=4, timers=_timers()
+    )
+    assert parent == os.path.join(d, "bs16_log_epochs2_proc4_parent.txt")
+    assert children == os.path.join(d, "bs16_log_epochs2_proc4_children.txt")
+    assert open(parent).readlines() == [
+        "Eval data loading time: 1.25\n",
+        "Time spent on evaluation: 2.0\n",
+        "Time spent on parent communication and param sync: 0.75\n",
+    ]
+    assert open(children).readlines() == [
+        "Train data loading time: 1.25\n",
+        "Time spent on training: 10.5\n",
+        "Time spent on children communication: 0.75\n",
+    ]
+
+
+def test_write_phase_logs_eval_loading_override(tmp_path):
+    """The parent file's eval-side loading time can differ from the
+    train-side total (the reference measures them separately)."""
+    parent, children = LF.write_phase_logs(
+        str(tmp_path), bs=8, epochs=1, nb_proc=2, timers=_timers(),
+        eval_data_loading=0.5,
+    )
+    assert "Eval data loading time: 0.5\n" in open(parent).readlines()
+    # the children file keeps the train-side number
+    assert "Train data loading time: 1.25\n" in open(children).readlines()
+
+
+def test_write_phase_logs_zero_phases_render_as_zero(tmp_path):
+    parent, _ = LF.write_phase_logs(
+        str(tmp_path), bs=1, epochs=1, nb_proc=1, timers=T.PhaseTimers()
+    )
+    lines = open(parent).read()
+    assert "Eval data loading time: 0.0\n" in lines

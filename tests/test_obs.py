@@ -1,0 +1,302 @@
+"""utils/obs.py: metrics registry, Prometheus rendering, /metrics +
+/healthz HTTP server, heartbeat state, and the NULL_REGISTRY no-op.
+
+Tier-1 (fast, jax-free): the registry and server are stdlib-only, so
+every assertion here runs on any host. The exposition format is checked
+by PARSING it back (with the same stdlib parser `tools/live_top.py`
+ships), not by eyeballing substrings - the acceptance criterion for the
+live-observability layer.
+"""
+
+import importlib.util
+import json
+import math
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from distributed_neural_network_tpu.utils import obs as O
+from distributed_neural_network_tpu.utils import timers as T
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_live_top():
+    spec = importlib.util.spec_from_file_location(
+        "live_top", os.path.join(REPO, "tools", "live_top.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def parse_prom(text):
+    return _load_live_top().parse_prometheus(text)
+
+
+# ------------------------------------------------------------- registry
+
+
+def test_counter_gauge_histogram_render_and_parse_back():
+    reg = O.MetricsRegistry()
+    reg.counter("steps_total", "steps").inc()
+    reg.counter("steps_total").inc(4)
+    reg.gauge("loss", "loss").set(2.5)
+    h = reg.histogram("step_seconds", "t", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(3.0)
+    parsed = parse_prom(reg.render())
+    assert parsed["steps_total"][()] == 5
+    assert parsed["loss"][()] == 2.5
+    # cumulative bucket counts + sum/count series
+    assert parsed["step_seconds_bucket"][(("le", "0.1"),)] == 1
+    assert parsed["step_seconds_bucket"][(("le", "1"),)] == 2
+    assert parsed["step_seconds_bucket"][(("le", "+Inf"),)] == 3
+    assert parsed["step_seconds_count"][()] == 3
+    assert parsed["step_seconds_sum"][()] == pytest.approx(3.55)
+
+
+def test_labelled_children_are_distinct_and_cached():
+    reg = O.MetricsRegistry()
+    c = reg.counter("anomalies_total", "by kind")
+    c.labels(kind="nan").inc()
+    c.labels(kind="spike").inc(2)
+    # same label set -> the SAME child object (the lock-free fast path:
+    # resolve once, publish forever)
+    assert c.labels(kind="nan") is c.labels(kind="nan")
+    parsed = parse_prom(reg.render())
+    assert parsed["anomalies_total"][(("kind", "nan"),)] == 1
+    assert parsed["anomalies_total"][(("kind", "spike"),)] == 2
+
+
+def test_registry_is_idempotent_by_name_and_rejects_kind_mismatch():
+    reg = O.MetricsRegistry()
+    a = reg.counter("x_total")
+    assert reg.counter("x_total") is a
+    with pytest.raises(ValueError, match="already registered as counter"):
+        reg.gauge("x_total")
+
+
+def test_invalid_metric_and_label_names_raise():
+    reg = O.MetricsRegistry()
+    with pytest.raises(ValueError, match="invalid Prometheus"):
+        reg.counter("bad-name")
+    with pytest.raises(ValueError, match="invalid Prometheus"):
+        reg.counter("9starts_with_digit")
+    with pytest.raises(ValueError, match="invalid Prometheus"):
+        reg.gauge("ok").labels(**{"bad-label": "v"})
+
+
+def test_label_values_are_escaped():
+    reg = O.MetricsRegistry()
+    reg.gauge("g").labels(path='a"b\\c\nd').set(1)
+    text = reg.render()
+    assert '\\"' in text and "\\\\" in text and "\\n" in text
+    parsed = parse_prom(text)
+    assert (("path", 'a"b\\c\nd'),) in parsed["g"]
+
+
+def test_nonfinite_sample_values_render_legally():
+    reg = O.MetricsRegistry()
+    reg.gauge("a").set(float("nan"))
+    reg.gauge("b").set(float("inf"))
+    reg.gauge("c").set(float("-inf"))
+    parsed = parse_prom(reg.render())
+    assert math.isnan(parsed["a"][()])
+    assert parsed["b"][()] == math.inf
+    assert parsed["c"][()] == -math.inf
+
+
+def test_set_max_is_monotonic():
+    g = O.MetricsRegistry().gauge("peak_bytes")
+    g.set_max(100)
+    g.set_max(50)
+    assert g.value == 100
+    g.set_max(200)
+    assert g.value == 200
+
+
+def test_histogram_quantile_upper_bound_approximation():
+    h = O.MetricsRegistry().histogram("t", buckets=(0.01, 0.1, 1.0))
+    assert h.quantile(0.95) is None  # empty
+    for _ in range(19):
+        h.observe(0.05)
+    h.observe(5.0)  # one overflow outlier
+    assert h.quantile(0.5) == 0.1
+    # the outlier lands past the last bound; p99 reports the last bound
+    assert h.quantile(0.99) == 1.0
+
+
+def test_concurrent_publishing_keeps_render_well_formed():
+    reg = O.MetricsRegistry()
+    c = reg.counter("hits_total")
+
+    def worker():
+        child = c.labels(w="x")
+        for _ in range(1000):
+            child.inc()
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    # render concurrently with publishing: must parse, never crash
+    for _ in range(20):
+        parse_prom(reg.render())
+    for t in threads:
+        t.join()
+    final = parse_prom(reg.render())["hits_total"][(("w", "x"),)]
+    # attribute adds may race (documented sub-sampling), but the count
+    # can never exceed the true total and lands near it
+    assert 3000 <= final <= 4000
+
+
+# ------------------------------------------------- heartbeat + readiness
+
+
+def test_heartbeat_state_and_health_json():
+    reg = O.MetricsRegistry()
+    h = reg.health()
+    assert h["alive"] and not h["ready"] and h["step"] is None
+    reg.beat(0)
+    reg.beat(1)
+    reg.mark_ready()
+    assert reg.last_step() == 1
+    assert len(reg.beat_intervals()) == 1
+    assert 0 <= reg.heartbeat_age() < 5
+    h = reg.health(stall_after_s=100.0)
+    assert h["alive"] and h["ready"] and h["step"] == 1
+    # a heartbeat older than the threshold flips liveness
+    assert reg.health(stall_after_s=1e-9)["alive"] is False
+
+
+def test_render_includes_readiness_and_heartbeat_series():
+    reg = O.MetricsRegistry()
+    parsed = parse_prom(reg.render())
+    assert parsed["train_ready"][()] == 0
+    assert "train_heartbeat_step" not in parsed
+    reg.beat(7)
+    reg.mark_ready()
+    parsed = parse_prom(reg.render())
+    assert parsed["train_ready"][()] == 1
+    assert parsed["train_heartbeat_step"][()] == 7
+    assert parsed["train_heartbeat_timestamp_seconds"][()] > 0
+
+
+# ------------------------------------------------------- NULL_REGISTRY
+
+
+def test_null_registry_is_inert_and_api_complete():
+    """Every MetricsRegistry method an instrumented path calls must
+    exist on NULL_REGISTRY and be a cheap no-op (the no---metrics-port
+    default)."""
+    n = O.NULL_REGISTRY
+    c = n.counter("x", "help")
+    c.inc()
+    c.labels(kind="y").inc(5)
+    c.set(3)
+    c.set_max(9)
+    c.observe(1.0)
+    assert c.value == 0.0
+    assert c.quantile(0.95) is None
+    assert n.histogram("h") is n.counter("c") is n.gauge("g")
+    n.beat(3)
+    n.mark_ready()
+    assert n.heartbeat_age() is None
+    assert n.last_step() is None
+    assert n.beat_intervals() == []
+    assert n.health()["alive"] is True
+    assert n.render() == ""
+    assert n.get("x") is None
+    assert n.ready is False
+
+
+# -------------------------------------------------- phase-timer export
+
+
+def test_publish_phase_timers_exports_reference_accumulators():
+    reg = O.MetricsRegistry()
+    timers = T.PhaseTimers()
+    with timers.phase(T.TRAINING):
+        pass
+    timers.add(T.DATA_LOADING, 1.5)
+    O.publish_phase_timers(reg, timers)
+    parsed = parse_prom(reg.render())
+    by_phase = parsed["phase_seconds_total"]
+    assert by_phase[(("phase", T.DATA_LOADING),)] == 1.5
+    assert by_phase[(("phase", T.TRAINING),)] >= 0
+    # republishing is monotonic: a second export never regresses
+    timers.add(T.DATA_LOADING, 0.5)
+    O.publish_phase_timers(reg, timers)
+    parsed = parse_prom(reg.render())
+    assert parsed["phase_seconds_total"][(("phase", T.DATA_LOADING),)] == 2.0
+
+
+# ------------------------------------------------------------- server
+
+
+@pytest.fixture
+def server():
+    reg = O.MetricsRegistry()
+    srv = O.ObsServer(reg, port=0)
+    yield reg, srv
+    srv.close()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return r.status, r.headers.get("Content-Type", ""), r.read().decode()
+
+
+def test_server_serves_parseable_metrics_on_ephemeral_port(server):
+    reg, srv = server
+    assert srv.port > 0  # the OS picked a real port for port=0
+    reg.counter("train_steps_total").inc(3)
+    status, ctype, body = _get(srv.url + "/metrics")
+    assert status == 200
+    assert ctype.startswith("text/plain")
+    assert "version=0.0.4" in ctype
+    assert parse_prom(body)["train_steps_total"][()] == 3
+
+
+def test_server_healthz_flips_ready_and_maps_liveness_to_status(server):
+    reg, srv = server
+    _, ctype, body = _get(srv.url + "/healthz")
+    h = json.loads(body)
+    assert ctype.startswith("application/json")
+    assert h["alive"] and not h["ready"]
+    reg.beat(0)
+    reg.mark_ready()
+    h = json.loads(_get(srv.url + "/healthz")[2])
+    assert h["ready"] and h["step"] == 0 and h["heartbeat_age_s"] >= 0
+
+
+def test_server_healthz_503_when_stalled():
+    reg = O.MetricsRegistry()
+    srv = O.ObsServer(reg, port=0, stall_after_s=1e-9)
+    try:
+        reg.beat(0)  # any heartbeat is now older than the threshold
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(srv.url + "/healthz", timeout=5)
+        assert exc.value.code == 503
+        assert json.loads(exc.value.read())["alive"] is False
+    finally:
+        srv.close()
+
+
+def test_server_root_index_and_404(server):
+    _, srv = server
+    assert "/metrics" in _get(srv.url + "/")[2]
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        urllib.request.urlopen(srv.url + "/nope", timeout=5)
+    assert exc.value.code == 404
+
+
+def test_server_close_is_deterministic_and_frees_the_port(server):
+    reg, srv = server
+    srv.close()  # double close via fixture must also be safe
+    with pytest.raises((urllib.error.URLError, ConnectionError, OSError)):
+        urllib.request.urlopen(srv.url + "/metrics", timeout=1)

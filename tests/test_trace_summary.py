@@ -224,3 +224,69 @@ def test_step_stats_trace_embed_is_strict_json(tmp_path):
 
     doc = json.loads(open(path).read(), parse_constant=reject)
     assert doc["stepStats"]["flops_per_step"] is None
+
+
+# ------------------------------------------------------------ --diff
+
+
+def _make_trace_pair(tmp_path):
+    """Two tiny traces with deliberately different step times (the
+    end-vs-overlap comparison shape)."""
+    paths = []
+    for name, steady in (("end", 0.4), ("overlap", 0.2)):
+        tracer = tr.Tracer()
+        with tracer.span(T.TRAINING, track="host"):
+            pass
+        stats = tr.StepStats(n_devices=4, comm_bytes_per_step=1000)
+        stats.record(0, 1.0, items=400)
+        for i in range(1, 5):
+            with tracer.span("train_step", track="train", step=i):
+                pass
+            stats.record(i, steady, items=400)
+        p = str(tmp_path / f"{name}.json")
+        tracer.export(p, step_stats=stats)
+        paths.append(p)
+    return paths
+
+
+def test_diff_reports_phase_table_and_stepstats_delta(tmp_path):
+    a, b = _make_trace_pair(tmp_path)
+    proc = _run_tool("--diff", a, b)
+    assert proc.returncode == 0, proc.stderr
+    out = proc.stdout
+    assert f"A = {a}" in out and f"B = {b}" in out
+    # phase rows: both files' train_step counts side by side
+    assert "train_step" in out and T.TRAINING in out
+    # StepStats delta rows with the halved steady time as a -50% delta
+    assert "steady p50" in out
+    assert "-50.0%" in out
+    assert "comm bytes/step" in out
+
+
+def test_diff_missing_file_is_a_clean_error(tmp_path):
+    a, _ = _make_trace_pair(tmp_path)
+    proc = _run_tool("--diff", a, str(tmp_path / "nope.json"))
+    assert proc.returncode == 1
+    assert "error:" in proc.stderr
+
+
+def test_diff_without_stepstats_embeds_falls_back_to_spans(tmp_path):
+    """Traces without the stepStats embed still diff: stats come from the
+    train_step spans themselves."""
+    paths = []
+    for name in ("a", "b"):
+        tracer = tr.Tracer()
+        for i in range(3):
+            with tracer.span("train_step", track="train", step=i):
+                pass
+        p = str(tmp_path / f"{name}.json")
+        tracer.export(p)
+        paths.append(p)
+    proc = _run_tool("--diff", *paths)
+    assert proc.returncode == 0, proc.stderr
+    assert "steps" in proc.stdout
+
+
+def test_plain_usage_without_trace_arg_errors(tmp_path):
+    proc = _run_tool()
+    assert proc.returncode != 0

@@ -450,3 +450,58 @@ def test_step_stats_static_comm_cross_check():
     s2.record(0, 0.5)
     assert s2.summary()["static_comm_bytes_per_step"] is None
     assert "static analysis payload" not in s2.report()
+
+
+# --------------------------------------------------- crash-safe export
+
+
+def test_export_is_atomic_over_a_previous_good_trace(tmp_path, monkeypatch):
+    """A crash mid-export (SIGTERM via the watchdog escalation path, or a
+    serializer error) must never leave a truncated half-JSON trace: the
+    document goes to <path>.tmp first and is atomically renamed, so the
+    reader sees the OLD complete file or the NEW complete file, never a
+    partial write."""
+    import os
+
+    path = str(tmp_path / "trace.json")
+    t1 = tr.Tracer()
+    with t1.span("train_step", track="train", step=0):
+        pass
+    t1.export(path)
+    good = open(path).read()
+    _strict_loads(good)  # the baseline is a complete document
+    assert not os.path.exists(path + ".tmp")  # no droppings on success
+
+    t2 = tr.Tracer()
+    with t2.span("train_step", track="train", step=1):
+        pass
+    real_dump = json.dump
+    def dying_dump(doc, f, **kw):
+        # serialize half the document, then die - the torn-write shape a
+        # SIGTERM mid-export produces
+        f.write(json.dumps(doc, **kw)[: 40])
+        raise KeyboardInterrupt("killed mid-export")
+
+    monkeypatch.setattr(tr.json, "dump", dying_dump)
+    with pytest.raises(KeyboardInterrupt):
+        t2.export(path)
+    monkeypatch.setattr(tr.json, "dump", real_dump)
+    # the published file is still the old COMPLETE document...
+    assert open(path).read() == good
+    # ...and the torn temp file was cleaned up
+    assert not os.path.exists(path + ".tmp")
+
+
+def test_export_atomic_rename_publishes_the_new_document(tmp_path):
+    path = str(tmp_path / "trace.json")
+    t = tr.Tracer()
+    with t.span("a", track="host"):
+        pass
+    t.export(path)
+    t2 = tr.Tracer()
+    with t2.span("b", track="host"):
+        pass
+    t2.export(path)
+    doc = _strict_loads(open(path).read())
+    spans = [e["name"] for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert spans == ["b"]

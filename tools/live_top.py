@@ -1,0 +1,464 @@
+#!/usr/bin/env python
+"""live_top: a terminal dashboard for a LIVE training run.
+
+Renders either a live `/metrics` endpoint (a run started with
+`--metrics-port`, docs/OBSERVABILITY.md "Live monitoring") or a tailing
+metrics JSONL file (`--metrics-jsonl` - works on runs without the HTTP
+server, and on dead runs' files). One compact ANSI frame per refresh:
+
+  - header: step, readiness (compiling vs training), heartbeat age,
+    uptime - the same facts `/healthz` reports;
+  - loss sparkline over the recent window + last value;
+  - throughput, step-time p50/p95 (from the train_step_seconds histogram
+    buckets), device memory, collective bytes;
+  - guard anomaly / rollback counters and watchdog flags (stall,
+    recompile storm, stale checkpoint) - red when non-zero.
+
+Stdlib-only (no jax, no repo imports) so it runs anywhere - including a
+laptop pointed at a forwarded TPU host port.
+
+Usage:
+  python tools/live_top.py http://127.0.0.1:9090        # live endpoint
+  python tools/live_top.py runs/lm.jsonl                # tail a JSONL
+  python tools/live_top.py http://host:9090 --once      # one frame (CI)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+import time
+import urllib.error
+import urllib.request
+
+SPARK = "▁▂▃▄▅▆▇█"  # ▁▂▃▄▅▆▇█
+RED, GREEN, YELLOW, DIM, BOLD, RESET = (
+    "\x1b[31m", "\x1b[32m", "\x1b[33m", "\x1b[2m", "\x1b[1m", "\x1b[0m"
+)
+
+
+# ------------------------------------------------------ Prometheus parsing
+
+
+def parse_prometheus(text: str) -> dict:
+    """{metric_name: {labels_frozenset_as_sorted_tuple: float}} from
+    Prometheus text exposition. Histogram series keep their _bucket/_sum/
+    _count suffixes as distinct metric names."""
+    out: dict[str, dict[tuple, float]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            if "{" in line:
+                name, rest = line.split("{", 1)
+                labels_s, value_s = rest.rsplit("}", 1)
+                labels = []
+                for part in _split_labels(labels_s):
+                    k, v = part.split("=", 1)
+                    labels.append((k, _unescape(v.strip('"'))))
+                key = tuple(sorted(labels))
+            else:
+                name, value_s = line.rsplit(None, 1)
+                key = ()
+            v = value_s.strip()
+            value = float("inf") if v == "+Inf" else (
+                float("-inf") if v == "-Inf" else float(v)
+            )
+        except ValueError:
+            continue  # malformed line: skip, never crash a dashboard
+        out.setdefault(name.strip(), {})[key] = value
+    return out
+
+
+def _unescape(s: str) -> str:
+    """Reverse the exposition-format label escaping (\\\\, \\", \\n)."""
+    return (
+        s.replace("\\\\", "\0")
+        .replace('\\"', '"')
+        .replace("\\n", "\n")
+        .replace("\0", "\\")
+    )
+
+
+def _split_labels(s: str):
+    """Split 'a="x",b="y,z"' on commas outside quotes."""
+    parts, buf, in_q, esc = [], [], False, False
+    for ch in s:
+        if esc:
+            buf.append(ch)
+            esc = False
+            continue
+        if ch == "\\":
+            buf.append(ch)
+            esc = True
+            continue
+        if ch == '"':
+            in_q = not in_q
+            buf.append(ch)
+            continue
+        if ch == "," and not in_q:
+            parts.append("".join(buf))
+            buf = []
+            continue
+        buf.append(ch)
+    if buf:
+        parts.append("".join(buf))
+    return [p for p in (p.strip() for p in parts) if p]
+
+
+def metric_value(metrics: dict, name: str, default=None):
+    fam = metrics.get(name)
+    if not fam:
+        return default
+    if () in fam:
+        return fam[()]
+    return next(iter(fam.values()))
+
+
+def metric_sum(metrics: dict, name: str) -> float:
+    return sum((metrics.get(name) or {}).values())
+
+
+def hist_quantile(metrics: dict, name: str, q: float):
+    """Approximate quantile from <name>_bucket cumulative counts (upper
+    bucket bound containing the q-th observation)."""
+    fam = metrics.get(name + "_bucket") or {}
+    buckets = []
+    for key, cum in fam.items():
+        le = dict(key).get("le")
+        if le is None:
+            continue
+        bound = math.inf if le == "+Inf" else float(le)
+        buckets.append((bound, cum))
+    if not buckets:
+        return None
+    buckets.sort()
+    total = buckets[-1][1]
+    if total <= 0:
+        return None
+    target = q * total
+    prev_bound = None
+    for bound, cum in buckets:
+        if cum >= target:
+            return bound if not math.isinf(bound) else prev_bound
+        prev_bound = bound
+    return prev_bound
+
+
+# ----------------------------------------------------------- data sources
+
+
+class EndpointSource:
+    """Polls /metrics (+ /healthz) of a live run."""
+
+    def __init__(self, base_url: str, timeout: float = 3.0):
+        self.base = base_url.rstrip("/")
+        if self.base.endswith("/metrics"):
+            self.base = self.base[: -len("/metrics")]
+        self.timeout = timeout
+        self.loss_history: list[float] = []
+        self.error: str | None = None
+
+    def _get(self, path: str) -> str | None:
+        try:
+            with urllib.request.urlopen(
+                self.base + path, timeout=self.timeout
+            ) as r:
+                body = r.read().decode()
+            self.error = None
+            return body
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            # /healthz answers 503 when stalled - that still carries a body
+            if isinstance(e, urllib.error.HTTPError):
+                try:
+                    return e.read().decode()
+                except Exception:
+                    pass
+            self.error = f"{type(e).__name__}: {e}"
+            return None
+
+    def sample(self) -> dict | None:
+        body = self._get("/metrics")
+        if body is None:
+            return None
+        metrics = parse_prometheus(body)
+        health = None
+        hz = self._get("/healthz")
+        if hz:
+            try:
+                health = json.loads(hz)
+            except ValueError:
+                pass
+        loss = metric_value(metrics, "train_loss")
+        if loss is not None and math.isfinite(loss):
+            if not self.loss_history or self.loss_history[-1] != loss:
+                self.loss_history.append(loss)
+                del self.loss_history[:-512]
+        return {"metrics": metrics, "health": health,
+                "loss_history": list(self.loss_history),
+                "source": self.base}
+
+
+class JsonlSource:
+    """Tails a metrics JSONL file (utils/metrics.py JsonlSink schema:
+    {"t":..., "series":..., "value":...} per line); malformed lines are
+    skipped. Builds the same snapshot shape the endpoint source yields,
+    from the series the sinks actually stream (train/loss, step/*)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._pos = 0
+        self.series: dict[str, list[float]] = {}
+        self.last_t: float | None = None
+
+    def sample(self) -> dict | None:
+        try:
+            with open(self.path) as f:
+                f.seek(self._pos)
+                chunk = f.read()
+                self._pos = f.tell()
+        except OSError:
+            return None
+        for line in chunk.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(ev, dict):
+                continue
+            s, v = ev.get("series"), ev.get("value")
+            if isinstance(s, str) and isinstance(v, (int, float)) \
+                    and not isinstance(v, bool):
+                self.series.setdefault(s, []).append(float(v))
+                del self.series[s][:-512]
+                if isinstance(ev.get("t"), (int, float)):
+                    self.last_t = float(ev["t"])
+        loss_hist = (
+            self.series.get("train/loss")
+            or self.series.get("step/loss") or []
+        )
+        metrics: dict = {}
+        walls = self.series.get("step/wall_s") or []
+        if walls:
+            metrics["train_step_last_s"] = {(): walls[-1]}
+            metrics["train_steps_total"] = {(): float(len(walls))}
+        for thr_key in ("step/tokens_per_s", "step/images_per_s"):
+            if self.series.get(thr_key):
+                metrics["train_throughput_items_per_s"] = {
+                    (): self.series[thr_key][-1]
+                }
+        if self.series.get("step/mem_bytes_in_use_max"):
+            metrics["device_memory_bytes_in_use"] = {
+                (("device", "max"),):
+                    self.series["step/mem_bytes_in_use_max"][-1]
+            }
+        for s, vals in self.series.items():
+            if s.startswith("step/anomaly_"):
+                metrics.setdefault("guard_anomalies_total", {})[
+                    (("kind", s[len("step/anomaly_"):]),)
+                ] = vals[-1]
+        if loss_hist:
+            metrics["train_loss"] = {(): loss_hist[-1]}
+        health = None
+        if self.last_t is not None:
+            age = max(0.0, time.time() - self.last_t)
+            health = {"alive": True, "ready": bool(walls or loss_hist),
+                      "heartbeat_age_s": round(age, 3), "step": None,
+                      "uptime_s": None}
+        return {"metrics": metrics, "health": health,
+                "loss_history": list(loss_hist), "source": self.path,
+                "file_mode": True}
+
+
+# -------------------------------------------------------------- rendering
+
+
+def sparkline(xs, width: int = 48) -> str:
+    if not xs:
+        return ""
+    xs = xs[-width:]
+    lo, hi = min(xs), max(xs)
+    if not (math.isfinite(lo) and math.isfinite(hi)):
+        return "(non-finite)"
+    span = hi - lo
+    if span <= 0:
+        return SPARK[0] * len(xs)
+    return "".join(
+        SPARK[min(len(SPARK) - 1, int((x - lo) / span * len(SPARK)))]
+        for x in xs
+    )
+
+
+def fmt_bytes(b) -> str:
+    if b is None:
+        return "n/a"
+    b = float(b)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if b < 1024 or unit == "TiB":
+            return f"{b:,.1f} {unit}" if unit != "B" else f"{int(b)} B"
+        b /= 1024
+    return f"{b:.1f} TiB"
+
+
+def fmt_rate(v) -> str:
+    if v is None:
+        return "n/a"
+    return f"{v:,.0f}/s"
+
+
+def render(snap: dict, *, color: bool = True, width: int = 72) -> str:
+    """One dashboard frame as a string (ANSI colors optional)."""
+    c = (lambda code, s: f"{code}{s}{RESET}") if color else (lambda _c, s: s)
+    m = snap["metrics"]
+    health = snap.get("health") or {}
+    lines = []
+    steps = metric_value(m, "train_steps_total")
+    ready = health.get("ready")
+    if ready is None:
+        ready = bool(metric_value(m, "train_ready", 0))
+    age = health.get("heartbeat_age_s")
+    state = (
+        c(GREEN, "training") if ready
+        else c(YELLOW, "compiling/starting")
+    )
+    alive = health.get("alive", True)
+    if not alive:
+        state = c(RED, "STALLED")
+    head = (
+        f"{c(BOLD, 'live_top')}  {snap['source']}  [{state}]  "
+        f"step {int(steps) if steps is not None else '?'}"
+    )
+    if age is not None:
+        head += f"  heartbeat {age:.1f}s ago"
+    lines.append(head)
+    lines.append(c(DIM, "-" * width))
+    # loss
+    hist = snap.get("loss_history") or []
+    loss = metric_value(m, "train_loss")
+    lines.append(
+        "loss        "
+        + (f"{loss:.5g}  " if loss is not None else "n/a      ")
+        + sparkline(hist, width - 24)
+    )
+    # throughput + step time
+    thr = metric_value(m, "train_throughput_items_per_s")
+    p50 = hist_quantile(m, "train_step_seconds", 0.50)
+    p95 = hist_quantile(m, "train_step_seconds", 0.95)
+    if p50 is None and metric_value(m, "train_step_last_s") is not None:
+        step_s = f"last<= {metric_value(m, 'train_step_last_s'):.4g}s"
+    elif p50 is not None:
+        step_s = f"p50<={p50:.4g}s p95<={p95:.4g}s"
+    else:
+        step_s = "n/a"
+    lines.append(f"throughput  {fmt_rate(thr)}   step time {step_s}")
+    # memory + collectives
+    mem = m.get("device_memory_bytes_in_use") or {}
+    mem_s = (
+        fmt_bytes(max(mem.values())) + f" peak x{len(mem)} dev"
+        if mem else "n/a"
+    )
+    comm = metric_value(m, "collective_bytes_per_step")
+    lines.append(
+        f"memory      {mem_s}   collective "
+        + (fmt_bytes(comm) + "/step" if comm is not None else "n/a")
+    )
+    # checkpoint
+    last_save = metric_value(m, "checkpoint_last_save_timestamp_seconds")
+    if last_save:
+        ck_age = max(0.0, time.time() - last_save)
+        saves = metric_value(m, "checkpoint_saves_total", 0)
+        lines.append(
+            f"checkpoint  {int(saves)} saved, newest {ck_age:,.0f}s ago "
+            f"(step {int(metric_value(m, 'checkpoint_last_step', -1))})"
+        )
+    # guard + watchdog
+    anomalies = m.get("guard_anomalies_total") or {}
+    anom_s = ", ".join(
+        f"{dict(k).get('kind', '?')}={int(v)}"
+        for k, v in sorted(anomalies.items())
+    ) or "none"
+    rb = metric_value(m, "guard_rollbacks_total", 0)
+    guard_line = f"guard       anomalies: {anom_s}  rollbacks: {int(rb)}"
+    if anomalies or rb:
+        guard_line = c(YELLOW, guard_line)
+    lines.append(guard_line)
+    stall = metric_value(m, "watchdog_stall_total", 0)
+    storm = metric_value(m, "watchdog_recompile_storm_total", 0)
+    stale = metric_value(m, "watchdog_checkpoint_stale_total", 0)
+    rec = metric_value(m, "recompiles_total", 0)
+    dog = (
+        f"watchdog    stalls: {int(stall)}  recompiles: {int(rec)}"
+        f"  storms: {int(storm)}  stale-ckpt: {int(stale)}"
+    )
+    if stall or storm or stale:
+        dog = c(RED, dog)
+    lines.append(dog)
+    phases = m.get("phase_seconds_total") or {}
+    if phases:
+        lines.append(
+            "phases      " + "  ".join(
+                f"{dict(k).get('phase', '?')}={v:.1f}s"
+                for k, v in sorted(phases.items())
+            )
+        )
+    return "\n".join(lines)
+
+
+# --------------------------------------------------------------- main loop
+
+
+def make_source(target: str):
+    if target.startswith(("http://", "https://")):
+        return EndpointSource(target)
+    return JsonlSource(target)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "target",
+        help="a live metrics endpoint (http://host:port[/metrics]) or a "
+        "metrics JSONL path (--metrics-jsonl file) to tail",
+    )
+    ap.add_argument("--interval", type=float, default=1.0,
+                    help="refresh seconds (default 1)")
+    ap.add_argument("--once", action="store_true",
+                    help="render a single frame and exit (CI/scripting)")
+    ap.add_argument("--no-color", action="store_true")
+    ap.add_argument("--width", type=int, default=72)
+    args = ap.parse_args(argv)
+
+    src = make_source(args.target)
+    color = not args.no_color and sys.stdout.isatty()
+    if args.once:
+        color = not args.no_color and False
+    try:
+        while True:
+            snap = src.sample()
+            if snap is None:
+                err = getattr(src, "error", None)
+                frame = (
+                    f"live_top: no data from {args.target}"
+                    + (f" ({err})" if err else "")
+                )
+            else:
+                frame = render(snap, color=color, width=args.width)
+            if args.once:
+                print(frame)
+                return 0 if snap is not None else 1
+            # full-frame repaint: clear + home, no curses dependency
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,6 +16,14 @@ silently accepted. Stdlib-only: no jax, no repo imports - runs anywhere.
 Usage:
   python tools/trace_summary.py trace.json [metrics.jsonl]
   python tools/trace_summary.py trace.json --lint lm_zero_overlap
+  python tools/trace_summary.py --diff end.json overlap.json
+
+--diff A B prints the side-by-side phase breakdown and StepStats delta
+between two traces - the manual compare-two-runs-by-eye workflow (e.g.
+``--grad-sync end`` vs ``overlap``) as one table: per-phase count/total/
+p50 for both files with the total delta, then the steady-state step
+time, throughput, compile time, and collective-bytes deltas from the
+two stepStats embeds.
 
 --lint CONFIG additionally compares the trace's measured per-step
 collective bytes (the stepStats embed's ``comm_bytes_per_step`` ring
@@ -106,6 +114,105 @@ def phase_table(events) -> str:
             f"{percentile(xs, 50) * 1e3:>9.2f}  "
             f"{percentile(xs, 95) * 1e3:>9.2f}  {max(xs) * 1e3:>9.2f}"
         )
+    return "\n".join(lines)
+
+
+def _phase_spans(events) -> dict:
+    """{span name: [durations_s]} of the complete (X) events."""
+    spans = defaultdict(list)
+    for ev in events:
+        if ev.get("ph") == "X" and "dur" in ev:
+            spans[ev.get("name", "?")].append(float(ev["dur"]) / 1e6)
+    return spans
+
+
+def _ordered_names(*span_dicts):
+    present = set()
+    for d in span_dicts:
+        present.update(d)
+    names = [n for n in PREFERRED_ORDER if n in present]
+    names += sorted(
+        (n for n in present if n not in PREFERRED_ORDER),
+        key=lambda n: -max(sum(d.get(n, [])) for d in span_dicts),
+    )
+    return names
+
+
+def _pct_delta(a, b) -> str:
+    if not (
+        isinstance(a, (int, float)) and isinstance(b, (int, float)) and a
+    ):
+        return ""
+    return f"{(b - a) / a * 100.0:+.1f}%"
+
+
+def diff_report(doc_a: dict, doc_b: dict, name_a: str, name_b: str) -> str:
+    """Side-by-side phase + StepStats comparison of two traces."""
+    spans_a = _phase_spans(doc_a.get("traceEvents", []))
+    spans_b = _phase_spans(doc_b.get("traceEvents", []))
+    lines = [f"Trace diff: A = {name_a}   B = {name_b}", ""]
+    names = _ordered_names(spans_a, spans_b)
+    if names:
+        w = max(12, max(len(n) for n in names))
+        head = (
+            f"{'phase':<{w}}  {'cnt A':>6} {'cnt B':>6}  "
+            f"{'total_s A':>10} {'total_s B':>10} {'d_total':>8}  "
+            f"{'p50_ms A':>9} {'p50_ms B':>9}"
+        )
+        lines += [head, "-" * len(head)]
+        for n in names:
+            xa, xb = spans_a.get(n, []), spans_b.get(n, [])
+            ta, tb = sum(xa), sum(xb)
+            lines.append(
+                f"{n:<{w}}  {len(xa):>6} {len(xb):>6}  "
+                f"{ta:>10.3f} {tb:>10.3f} {_pct_delta(ta, tb):>8}  "
+                + (f"{percentile(xa, 50) * 1e3:>9.2f}" if xa
+                   else f"{'-':>9}")
+                + " "
+                + (f"{percentile(xb, 50) * 1e3:>9.2f}" if xb
+                   else f"{'-':>9}")
+            )
+    else:
+        lines.append("(no complete spans in either trace)")
+    sa = doc_a.get("stepStats") or step_stats_from_spans(
+        doc_a.get("traceEvents", [])
+    ) or {}
+    sb = doc_b.get("stepStats") or step_stats_from_spans(
+        doc_b.get("traceEvents", [])
+    ) or {}
+    rows = [
+        ("steps", "steps", "{:d}"),
+        ("compile_s", "compile", "{:.4f} s"),
+        ("steady_mean_s", "steady mean", "{:.4f} s"),
+        ("steady_p50_s", "steady p50", "{:.4f} s"),
+        ("steady_p95_s", "steady p95", "{:.4f} s"),
+        ("throughput_items_per_s", "throughput", "{:,.1f}/s"),
+        ("comm_bytes_per_step", "comm bytes/step", "{:,d} B"),
+        ("mfu_pct", "MFU", "{:.2f} %"),
+    ]
+    stat_lines = []
+    for key, label, fmt in rows:
+        va, vb = sa.get(key), sb.get(key)
+        if va is None and vb is None:
+            continue
+
+        def f(v):
+            if v is None:
+                return "n/a"
+            try:
+                return fmt.format(int(v) if "d}" in fmt else v)
+            except (ValueError, TypeError):
+                return str(v)
+
+        stat_lines.append(
+            f"  {label:<16} A: {f(va):>14}   B: {f(vb):>14}   "
+            f"{_pct_delta(va, vb)}"
+        )
+    if stat_lines:
+        lines += ["", "Step stats delta (B vs A):", *stat_lines]
+    else:
+        lines += ["", "Step stats delta: unavailable (no stepStats embed "
+                  "or train_step spans in either trace)"]
     return "\n".join(lines)
 
 
@@ -376,10 +483,18 @@ def lint_against_manifest(
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("trace", help="Chrome trace-event JSON (--trace-out)")
+    ap.add_argument(
+        "trace", nargs="?", default=None,
+        help="Chrome trace-event JSON (--trace-out)",
+    )
     ap.add_argument(
         "jsonl", nargs="?", default=None,
         help="optional metrics JSONL pair (--metrics-jsonl)",
+    )
+    ap.add_argument(
+        "--diff", nargs=2, metavar=("A.json", "B.json"), default=None,
+        help="compare two traces: side-by-side phase breakdown and "
+        "StepStats delta (B vs A)",
     )
     ap.add_argument(
         "--lint", metavar="CONFIG", default=None,
@@ -396,6 +511,18 @@ def main(argv=None) -> int:
         "drifts more than PCT percent from 1.0",
     )
     args = ap.parse_args(argv)
+
+    if args.diff is not None:
+        path_a, path_b = args.diff
+        try:
+            doc_a, doc_b = load_trace(path_a), load_trace(path_b)
+        except (ValueError, OSError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        print(diff_report(doc_a, doc_b, path_a, path_b))
+        return 0
+    if args.trace is None:
+        ap.error("a trace file is required (or use --diff A.json B.json)")
 
     try:
         doc = load_trace(args.trace)
