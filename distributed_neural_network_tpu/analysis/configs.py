@@ -89,6 +89,20 @@ def _pp(name, *, dp=2, pp=2, optimizer="sgd", **kw):
     return build
 
 
+def _reshard(name, *, dp=4):
+    from ..parallel import reshard
+    from ..train import lm as lmtrain
+
+    def build():
+        _require_devices(dp)
+        cfg = _trace_cfg()
+        mesh = lmtrain.create_lm_mesh(dp, 1, 1)
+        with compat.trace_compat():
+            return reshard.reshard_step_program(cfg, mesh, name=name)
+
+    return build
+
+
 def _cnn(name, phase):
     def build():
         _require_devices(4)
@@ -136,6 +150,11 @@ CANONICAL_CONFIGS = {
     "pp_gpipe": _pp("pp_gpipe"),
     "pp_overlap": _pp("pp_overlap", **OVERLAP),
     "pp_zero": _pp("pp_zero", optimizer="zero"),
+    # elastic resharder (parallel/reshard.py): the same-mesh collective
+    # form of the ZeRO reassembly - one tiled all_gather per state leaf
+    # over 'data' - so the reshard transfer's collective bytes are pinned
+    # like every training step's
+    "lm_reshard_zero_gather": _reshard("lm_reshard_zero_gather"),
     # the CNN engine: the sharded local-SGD epoch (no collectives by
     # design - local training) and the fault-masked parameter-average
     # sync phase (where the epoch-edge psums live)

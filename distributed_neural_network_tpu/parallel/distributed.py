@@ -32,18 +32,36 @@ DCN requires actual multi-host hardware).
 
 from __future__ import annotations
 
+import inspect
 import os
+import time
 from collections.abc import Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# coordinator-handshake bounding (overridable per call or via env): without
+# these, an unreachable coordinator hangs `jax.distributed.initialize`
+# forever and a preempted/rescheduled pod never surfaces an error
+DEFAULT_COORDINATOR_RETRIES = 5
+DEFAULT_COORDINATOR_DEADLINE_S = 300.0
+DEFAULT_COORDINATOR_BACKOFF_S = 1.0
+_BACKOFF_CAP_S = 30.0
+
 
 def initialize(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
     process_id: int | None = None,
+    *,
+    max_retries: int | None = None,
+    deadline_s: float | None = None,
+    backoff_s: float | None = None,
+    log=print,
+    _connect=None,
+    _sleep=time.sleep,
+    _clock=time.monotonic,
 ) -> bool:
     """Join the multi-host JAX runtime; returns True if it initialized.
 
@@ -56,6 +74,15 @@ def initialize(
     multi-host once the single-process backend exists - which is also why
     this function decides the no-op case from the env alone instead of
     asking JAX.
+
+    The coordinator handshake is BOUNDED: up to `max_retries` + 1
+    connection attempts under exponential backoff (`backoff_s` doubling,
+    capped at 30s) and a wall-clock `deadline_s` - an unreachable
+    coordinator no longer hangs the process forever. Defaults come from
+    DNN_TPU_COORDINATOR_RETRIES / DNN_TPU_COORDINATOR_DEADLINE_S /
+    DNN_TPU_COORDINATOR_BACKOFF_S (falling back to 5 / 300s / 1s). On
+    exhaustion a RuntimeError names the address, the attempts made, and
+    the env vars to check. `_connect`/`_sleep`/`_clock` are test seams.
     """
     already = _already_initialized()
     if already is not None:
@@ -89,10 +116,93 @@ def initialize(
             "[0, num_processes) (auto-detection only works on cloud "
             "TPU/Slurm/OpenMPI environments)"
         )
-    jax.distributed.initialize(
-        coordinator_address=addr, num_processes=num, process_id=pid
+    _connect_with_retry(
+        _connect if _connect is not None else jax.distributed.initialize,
+        dict(coordinator_address=addr, num_processes=num, process_id=pid),
+        addr=addr,
+        max_retries=(
+            max_retries if max_retries is not None
+            else _env_int("DNN_TPU_COORDINATOR_RETRIES")
+            if _env_int("DNN_TPU_COORDINATOR_RETRIES") is not None
+            else DEFAULT_COORDINATOR_RETRIES
+        ),
+        deadline_s=(
+            deadline_s if deadline_s is not None
+            else _env_float(
+                "DNN_TPU_COORDINATOR_DEADLINE_S",
+                DEFAULT_COORDINATOR_DEADLINE_S,
+            )
+        ),
+        backoff_s=(
+            backoff_s if backoff_s is not None
+            else _env_float(
+                "DNN_TPU_COORDINATOR_BACKOFF_S",
+                DEFAULT_COORDINATOR_BACKOFF_S,
+            )
+        ),
+        log=log, sleep=_sleep, clock=_clock,
     )
     return True
+
+
+def _connect_with_retry(
+    connect, kwargs, *, addr, max_retries, deadline_s, backoff_s, log,
+    sleep, clock,
+):
+    """Bounded-retry wrapper around the coordinator handshake.
+
+    Each attempt gets the REMAINING deadline as its per-attempt
+    `initialization_timeout` when the jax build supports the parameter
+    (so one wedged TCP connect cannot eat the whole budget); failures
+    back off exponentially. Raises an actionable RuntimeError on
+    exhaustion - address, attempt count, elapsed time, and the env vars
+    to check are all in the message.
+    """
+    try:
+        takes_timeout = (
+            "initialization_timeout" in inspect.signature(connect).parameters
+        )
+    except (TypeError, ValueError):
+        takes_timeout = False
+    start = clock()
+    attempt = 0
+    last = None
+    while True:
+        attempt += 1
+        remaining = deadline_s - (clock() - start)
+        if remaining <= 0:
+            break
+        call = dict(kwargs)
+        if takes_timeout:
+            call["initialization_timeout"] = max(int(remaining), 1)
+        try:
+            connect(**call)
+            return attempt
+        except Exception as e:  # noqa: BLE001 - retrying IS the handling
+            last = e
+            if attempt > max_retries:
+                break
+            remaining = deadline_s - (clock() - start)
+            if remaining <= 0:
+                break
+            pause = min(
+                backoff_s * (2 ** (attempt - 1)), _BACKOFF_CAP_S, remaining
+            )
+            log(
+                f"(coordinator handshake attempt {attempt}/"
+                f"{max_retries + 1} failed: {type(e).__name__}: {e}; "
+                f"retrying in {pause:.1f}s)"
+            )
+            sleep(pause)
+    raise RuntimeError(
+        f"could not reach the JAX coordinator at {addr} after {attempt} "
+        f"attempt(s) over {clock() - start:.1f}s (deadline {deadline_s:g}s, "
+        f"retry budget {max_retries}). Check that the coordinator process "
+        "is up and that JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / "
+        "JAX_PROCESS_ID match on every host; raise "
+        "DNN_TPU_COORDINATOR_DEADLINE_S or DNN_TPU_COORDINATOR_RETRIES for "
+        f"slow cluster starts. Last error: {type(last).__name__ if last is not None else None}: {last}"
+    ) from last
 
 
 def _already_initialized() -> bool | None:
@@ -108,6 +218,11 @@ def _already_initialized() -> bool | None:
 def _env_int(name: str) -> int | None:
     v = os.environ.get(name)
     return int(v) if v else None
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    return float(v) if v else default
 
 
 def create_hybrid_mesh(

@@ -175,6 +175,14 @@ class ChaosMonkey:
       detection window. Emitted as a ``straggler`` span on the ``fault``
       track when a tracer is attached (same in-band convention as the
       epoch-level straggler sleep above).
+    shrink_at: after this step completes, raise a cooperative SHRINK
+      preemption on the attached `preempt` guard (train/guard.py
+      PreemptionGuard.request) - the elastic driver (`lm_train.py
+      --chaos-shrink-at-step`) answers it by writing an emergency
+      checkpoint, rebuilding the mesh from the surviving device subset,
+      resharding params + optimizer state (parallel/reshard.py), and
+      CONTINUING training - the full preempt -> checkpoint -> reshard ->
+      resume path in one process.
     """
 
     spike_at: tuple = ()
@@ -182,6 +190,8 @@ class ChaosMonkey:
     sigterm_after: int | None = None
     stall_at: tuple = ()
     stall_s: float = 2.0
+    shrink_at: int | None = None
+    preempt: object = None
     tracer: object = None
     log: object = print
     _fired: set = field(default_factory=set)
@@ -211,6 +221,17 @@ class ChaosMonkey:
                 duration_s=float(self.stall_s), kind="stall",
             ):
                 time.sleep(self.stall_s)
+        if (
+            self.shrink_at is not None
+            and step == self.shrink_at
+            and "shrink" not in self._fired
+        ):
+            self._fired.add("shrink")
+            self.log(
+                f"(chaos: requesting SHRINK preemption after step {step})"
+            )
+            if self.preempt is not None:
+                self.preempt.request("SHRINK")
         if (
             self.sigterm_after is not None
             and step == self.sigterm_after

@@ -1,0 +1,469 @@
+"""Mesh-portable checkpoint resharding: load any saved layout onto any mesh.
+
+The reference's only fault story is a simulated dead worker
+(`data_parallelism_train.py:41-46`); this repo already survives bad steps
+(train/guard.py) and sees trouble live (train/monitor.py), but a checkpoint
+saved under one mesh shape could previously only be restored into the
+identical shape - a preempted or shrunk device pool was fatal. This module
+is the portable redistribution layer in the spirit of "Memory-efficient
+array redistribution through portable collective communication"
+(arXiv 2112.01075): combined with the guard's exact-resume cursor it turns
+preemptions into reshard-and-continue events (the elastic-training property
+the pjit/TPUv4 stack of arXiv 2204.06514 treats as table stakes).
+
+Three layers:
+
+- **Topology metadata** (`mesh_topology`, `topology_mismatch`,
+  `spec_tree_to_json`): every checkpoint records the save-time mesh - axis
+  names/sizes, device/process counts, the PartitionSpec tree, optimizer
+  layout - so restore DETECTS a shape mismatch up front with a named diff
+  instead of crashing deep inside pjit with an opaque sharding error.
+- **Leaf-wise resharder** (`reshard_state`, `place_tree`,
+  `convert_optimizer_state`): maps any saved layout onto any target mesh.
+  Placement is memory-bounded - one leaf at a time via `device_put` /
+  `make_array_from_callback` (each process uploads only its addressable
+  slices), never a fully replicated device copy of the whole tree. The
+  ZeRO-1 flat buffers are re-padded for the new data-axis size
+  (`reshard_zero_tree`), and optimizer state converts between the
+  replicated and ZeRO layouts of the same family (sgd <-> zero,
+  adam <-> zero-adam) bitwise.
+- **Device-level transfer program** (`make_zero_gather_fn`,
+  `reshard_step_program`): the same-mesh collective form of the ZeRO
+  reassembly (one tiled all_gather per leaf over the data axis) as a
+  traceable StepProgram, so shardlint pins the resharder's collective
+  bytes like every other program (analysis/configs.py
+  `lm_reshard_zero_gather`).
+
+Everything host-side here runs on any jax (no shard_map needed), which is
+what makes the reshard path itself testable on the 8-device CPU mesh of
+the pinned CI container.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+RESHARD_META_VERSION = 1
+
+# optimizer families: state converts bitwise within a family (same logical
+# values, different layout); across families there is nothing to map
+_OPTIMIZER_FAMILY = {
+    "sgd": "sgd", "zero": "sgd", "adam": "adam", "zero-adam": "adam",
+}
+
+
+# ------------------------------------------------- PartitionSpec (de)serde
+
+
+def spec_to_json(spec) -> list:
+    """One PartitionSpec as a JSON list (tuple entries become lists)."""
+    return [list(e) if isinstance(e, tuple) else e for e in tuple(spec)]
+
+
+def spec_from_json(entries) -> P:
+    return P(*[tuple(e) if isinstance(e, list) else e for e in entries])
+
+
+def _is_spec(s) -> bool:
+    return isinstance(s, P)
+
+
+def spec_tree_to_json(tree):
+    """A pytree of PartitionSpecs as nested JSON; each spec leaf becomes
+    ``{"__spec__": [...]}`` so subtrees and specs stay unambiguous."""
+    return jax.tree.map(
+        lambda s: {"__spec__": spec_to_json(s)}, tree, is_leaf=_is_spec
+    )
+
+
+def spec_tree_from_json(doc):
+    def is_enc(d):
+        return isinstance(d, dict) and "__spec__" in d
+
+    return jax.tree.map(
+        lambda d: spec_from_json(d["__spec__"]), doc, is_leaf=is_enc
+    )
+
+
+# ----------------------------------------------------- topology metadata
+
+
+def mesh_topology(
+    mesh: Mesh, *, specs=None, optimizer: str | None = None, **extra
+) -> dict:
+    """The JSON-serializable save-time topology block for checkpoint meta.
+
+    Records what a restore needs to (a) detect that the saved layout does
+    not match the target mesh and (b) rebuild the saved state's abstract
+    template (train/elastic.py `saved_state_template`): ordered axis
+    names/sizes, device and process counts, the optimizer layout name,
+    and the PartitionSpec tree the params were placed with. ``extra``
+    lands verbatim (global batch, accum_steps, pp_interleave, ...).
+    """
+    topo = {
+        "version": RESHARD_META_VERSION,
+        "axes": {str(k): int(v) for k, v in mesh.shape.items()},
+        "devices": int(mesh.devices.size),
+        "process_count": int(jax.process_count()),
+        "platform": str(mesh.devices.ravel()[0].platform),
+    }
+    if optimizer is not None:
+        topo["optimizer"] = str(optimizer)
+    if specs is not None:
+        topo["specs"] = spec_tree_to_json(specs)
+    topo.update(extra)
+    return topo
+
+
+def topology_mismatch(saved: dict, current: dict) -> list:
+    """Human-readable differences between two `mesh_topology` blocks.
+
+    Empty list == the saved layout drops onto the current mesh unchanged
+    (plain sharded restore). Anything listed requires the resharder. The
+    comparison is deliberately by *layout-bearing* fields only - platform
+    changes (TPU save -> CPU restore) are already portable and not listed.
+    """
+    diffs = []
+    if saved.get("version", 0) > RESHARD_META_VERSION:
+        diffs.append(
+            f"checkpoint mesh meta version {saved.get('version')} is newer "
+            f"than this build's {RESHARD_META_VERSION}"
+        )
+    a, b = saved.get("axes") or {}, current.get("axes") or {}
+    for name in sorted(set(a) | set(b)):
+        sa, sb = int(a.get(name, 1)), int(b.get(name, 1))
+        if sa != sb:
+            diffs.append(f"mesh axis {name!r}: saved {sa}, target {sb}")
+    if saved.get("devices") != current.get("devices"):
+        diffs.append(
+            f"device count: saved {saved.get('devices')}, "
+            f"target {current.get('devices')}"
+        )
+    so, co = saved.get("optimizer"), current.get("optimizer")
+    if so is not None and co is not None and so != co:
+        diffs.append(f"optimizer layout: saved {so!r}, target {co!r}")
+    si, ci = saved.get("pp_interleave", 1), current.get("pp_interleave", 1)
+    if int(si) != int(ci):
+        diffs.append(f"pp_interleave: saved {si}, target {ci}")
+    return diffs
+
+
+# ------------------------------------------------- memory-bounded placement
+
+
+def put_leaf(x, sharding):
+    """Place ONE leaf onto a sharding without a full replicated device copy.
+
+    jax.Array input: a direct cross-sharding transfer (`device_put` moves
+    shards over ICI/DCN without a host round trip). Host arrays on a
+    multi-process mesh: `make_array_from_callback` so each process uploads
+    only the slices addressable to it. Either way the peak footprint is
+    one leaf, never the whole tree.
+    """
+    if isinstance(x, jax.Array) or jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    x = np.asarray(x)
+    return jax.make_array_from_callback(x.shape, sharding, lambda idx: x[idx])
+
+
+def place_tree(tree, shardings):
+    """Leaf-wise `put_leaf` over a (host or device) pytree."""
+    return jax.tree.map(put_leaf, tree, shardings)
+
+
+# --------------------------------------------------- ZeRO layout transforms
+
+
+def reshard_zero_leaf(buf, size: int, new_n: int):
+    """Re-pad one flat ZeRO buffer for a new shard count.
+
+    The buffer holds the leaf's `size` logical elements plus zero padding
+    to a multiple of the OLD shard count (`parallel/zero.py
+    leaf_shard_size`); the padding length changes with the shard count, so
+    a dp change must unpad to the logical elements and re-pad - values are
+    untouched (bitwise round trip).
+    """
+    from .zero import leaf_shard_size
+
+    buf = np.asarray(buf)
+    if buf.ndim != 1 or buf.shape[0] < size:
+        raise ValueError(
+            f"ZeRO buffer of shape {buf.shape} cannot hold {size} logical "
+            "elements - not a flat per-leaf ZeRO buffer"
+        )
+    flat = buf[:size]
+    total = leaf_shard_size(size, new_n) * new_n
+    out = np.zeros((total,), buf.dtype)
+    out[:size] = flat
+    return out
+
+
+def reshard_zero_tree(flat_tree, params_template, new_n: int):
+    """`reshard_zero_leaf` over a per-leaf ZeRO buffer tree; logical sizes
+    come from the aligned `params_template` leaves."""
+    return jax.tree.map(
+        lambda buf, ref: reshard_zero_leaf(buf, int(np.prod(ref.shape, dtype=np.int64)), new_n),
+        flat_tree,
+        params_template,
+    )
+
+
+def zero_tree_to_momentum(flat_tree, params_template):
+    """ZeRO per-leaf flat buffers -> the replicated momentum tree (each
+    leaf unpadded and reshaped to its parameter's shape). Values bitwise."""
+    def leaf(buf, ref):
+        size = int(np.prod(ref.shape, dtype=np.int64))
+        buf = np.asarray(buf)
+        if buf.shape[0] < size:
+            raise ValueError(
+                f"ZeRO buffer ({buf.shape[0]} elements) smaller than its "
+                f"parameter ({size}) - layout mismatch"
+            )
+        return buf[:size].reshape(ref.shape)
+
+    return jax.tree.map(leaf, flat_tree, params_template)
+
+
+def momentum_to_zero_tree(mom_tree, n_shards: int):
+    """Replicated momentum tree -> ZeRO per-leaf flat buffers padded for
+    `n_shards` (inverse of `zero_tree_to_momentum`; f32, the ZeRO state
+    dtype). Values bitwise."""
+    from .zero import leaf_shard_size
+
+    def leaf(m):
+        m = np.asarray(m, np.float32).reshape(-1)
+        total = leaf_shard_size(m.size, n_shards) * n_shards
+        out = np.zeros((total,), np.float32)
+        out[: m.size] = m
+        return out
+
+    return jax.tree.map(leaf, mom_tree)
+
+
+# ------------------------------------------- optimizer layout conversion
+
+
+def convert_optimizer_state(
+    mom, *, src: str, dst: str, params_template, src_dp: int, dst_dp: int
+):
+    """Map optimizer state between layouts (host-level, values bitwise).
+
+    Within a family the state is the same logical values under a different
+    partition: sgd <-> zero re-flattens/pads the momentum tree,
+    adam <-> zero-adam does the same for both moment trees (the step
+    counter passes through). Across families (sgd <-> adam) there is no
+    meaningful mapping and a ValueError names the supported conversions.
+    """
+    for name, o in (("saved", src), ("target", dst)):
+        if o not in _OPTIMIZER_FAMILY:
+            raise ValueError(f"unknown {name} optimizer {o!r}")
+    if _OPTIMIZER_FAMILY[src] != _OPTIMIZER_FAMILY[dst]:
+        raise ValueError(
+            f"cannot convert optimizer state {src!r} -> {dst!r}: the "
+            "layouts carry different quantities. Supported conversions: "
+            "sgd<->zero, adam<->zero-adam, and any optimizer to itself "
+            "across mesh shapes."
+        )
+    if src == dst:
+        if src in ("zero", "zero-adam") and src_dp != dst_dp:
+            if src == "zero":
+                return reshard_zero_tree(mom, params_template, dst_dp)
+            return {
+                "m": reshard_zero_tree(mom["m"], params_template, dst_dp),
+                "v": reshard_zero_tree(mom["v"], params_template, dst_dp),
+                "t": mom["t"],
+            }
+        return mom
+    if (src, dst) == ("zero", "sgd"):
+        return zero_tree_to_momentum(mom, params_template)
+    if (src, dst) == ("sgd", "zero"):
+        return momentum_to_zero_tree(mom, dst_dp)
+    if (src, dst) == ("zero-adam", "adam"):
+        return {
+            "m": zero_tree_to_momentum(mom["m"], params_template),
+            "v": zero_tree_to_momentum(mom["v"], params_template),
+            "t": mom["t"],
+        }
+    if (src, dst) == ("adam", "zero-adam"):
+        return {
+            "m": momentum_to_zero_tree(mom["m"], dst_dp),
+            "v": momentum_to_zero_tree(mom["v"], dst_dp),
+            "t": mom["t"],
+        }
+    raise AssertionError(f"unhandled conversion {src!r} -> {dst!r}")
+
+
+def reshard_state(
+    state,
+    *,
+    saved_optimizer: str,
+    saved_dp: int,
+    optimizer: str,
+    dp: int,
+    params_template,
+    param_shardings=None,
+    mom_shardings=None,
+):
+    """The leaf-wise resharder: one saved ``{"params", "mom"}`` state tree
+    (host or device arrays, any mesh of origin) onto a new layout.
+
+    Parameters are layout-invariant logical arrays - only their placement
+    changes. Optimizer state goes through `convert_optimizer_state`
+    (ZeRO re-padding for the new data-axis size, replicated<->ZeRO within
+    a family). With shardings given, leaves are placed memory-boundedly
+    (`place_tree`); without, host trees come back for the caller to place.
+    """
+    params = state["params"]
+    mom = convert_optimizer_state(
+        state["mom"], src=saved_optimizer, dst=optimizer,
+        params_template=params_template, src_dp=saved_dp, dst_dp=dp,
+    )
+    if param_shardings is not None:
+        params = place_tree(params, param_shardings)
+    if mom_shardings is not None:
+        mom = place_tree(mom, mom_shardings)
+    return {"params": params, "mom": mom}
+
+
+# ----------------------------------------------- batch / accumulation math
+
+
+def rescale_accum(global_batch: int, old_dp: int, new_dp: int, accum: int) -> int:
+    """Gradient-accumulation steps after a dp change, global batch FIXED.
+
+    The exact-resume cursor pins the data stream as a function of
+    (seed, step, global batch) - so elasticity must never change the
+    global batch. What can change is how it is sliced: prefer keeping the
+    per-device microbatch row count constant (accum scales by
+    old_dp/new_dp - a shrink accumulates more, a growth less, activation
+    memory per device stays put); fall back to the old accum when the new
+    dp still divides; last resort accum=1. Raises when `global_batch` is
+    not divisible by `new_dp` at all (no slicing can preserve it).
+    """
+    for name, v in (
+        ("global_batch", global_batch), ("old_dp", old_dp),
+        ("new_dp", new_dp), ("accum", accum),
+    ):
+        if int(v) < 1:
+            raise ValueError(f"{name} must be >= 1, got {v}")
+    if global_batch % new_dp:
+        raise ValueError(
+            f"global batch {global_batch} does not divide over the new "
+            f"data-parallel size {new_dp} - the elastic contract keeps the "
+            "global batch (and so the data cursor) exact; choose a target "
+            "dp that divides the batch"
+        )
+    scaled = accum * old_dp
+    if scaled % new_dp == 0:
+        k = scaled // new_dp
+        if global_batch % (new_dp * k) == 0:
+            return k
+    if global_batch % (new_dp * accum) == 0:
+        return accum
+    return 1
+
+
+# --------------------------------------------- engine (CNN) momentum stack
+
+
+def reshard_momentum_stack(mom_stack, n_new: int):
+    """The CNN engine's per-device momentum stack onto a new worker count.
+
+    Shrink: the first `n_new` rows survive (their devices keep training
+    with their own buffers - the buffers of removed workers are dropped
+    with the workers). Grow: new workers start with ZERO momentum (the
+    same fresh-optimizer state the reference's per-epoch SGD re-creation
+    gives every worker every epoch). Host-level, leaf-wise.
+    """
+    if n_new < 1:
+        raise ValueError(f"n_new must be >= 1, got {n_new}")
+
+    def leaf(m):
+        m = np.asarray(m)
+        n_old = m.shape[0]
+        if n_new <= n_old:
+            return m[:n_new]
+        pad = np.zeros((n_new - n_old, *m.shape[1:]), m.dtype)
+        return np.concatenate([m, pad], axis=0)
+
+    return jax.tree.map(leaf, mom_stack)
+
+
+# --------------------------------- device-level transfer program (traced)
+
+
+def make_zero_gather_fn(params_template, mesh: Mesh, axis_name: str = "data"):
+    """Compiled same-mesh ZeRO reassembly: per-leaf flat dp-sharded buffers
+    -> the replicated momentum tree, one tiled `all_gather` per leaf.
+
+    This is the collective form of `zero_tree_to_momentum` (arXiv
+    2112.01075's portable-collective redistribution on one mesh): each
+    device contributes its 1/dp shard and the gather output is sliced to
+    the logical size and reshaped. Runs outside autodiff, so it lives in a
+    ``check_vma=False`` shard_map like the ZeRO optimizer itself
+    (parallel/zero.py). Shardlint traces it via `reshard_step_program` to
+    pin the transfer's collective bytes.
+    """
+    from .. import compat
+
+    refs = jax.tree.map(
+        lambda p: jax.ShapeDtypeStruct(tuple(p.shape), p.dtype),
+        params_template,
+    )
+
+    def body(flat_tree):
+        def leaf(buf, ref):
+            full = jax.lax.all_gather(buf, axis_name, tiled=True)
+            size = int(np.prod(ref.shape, dtype=np.int64))
+            return full[:size].reshape(ref.shape).astype(jnp.float32)
+
+        return jax.tree.map(leaf, flat_tree, refs)
+
+    return jax.jit(
+        compat.shard_map(
+            body, mesh=mesh, in_specs=(P(axis_name),), out_specs=P(),
+            check_vma=False,
+        ),
+        donate_argnums=(0,),
+    )
+
+
+def reshard_step_program(cfg, mesh: Mesh, *, name: str = "reshard_zero_gather"):
+    """`make_zero_gather_fn` packaged as a traceable StepProgram
+    (train/program.py) for the static analyzer: the manifest pins one
+    all_gather over the data axis per state leaf at the padded buffer
+    size, so a transfer-schedule regression (extra collective, de-tiled
+    gather) fails `shardlint --check` like any training step would."""
+    from ..models import transformer as tfm
+    from ..train.program import StepProgram
+    from .zero import init_zero_momentum_tree
+
+    dp = int(mesh.shape.get("data", 1))
+    params = jax.eval_shape(
+        lambda k: tfm.init_params(k, cfg),
+        jax.ShapeDtypeStruct((2,), jnp.uint32),
+    )
+    flat = jax.eval_shape(lambda p: init_zero_momentum_tree(p, dp), params)
+    fn = make_zero_gather_fn(params, mesh, axis_name="data")
+    return StepProgram(
+        name=name,
+        fn=fn,
+        mesh=mesh,
+        abstract_args=(flat,),
+        specs={"params": P("data")},
+        donate=(0,),
+        donate_labels=("zero state shards",),
+        meta={
+            "family": "reshard",
+            "optimizer": "zero",
+            "mesh": {k: int(v) for k, v in mesh.shape.items()},
+            "dp": dp,
+            # the donated flat buffers are freed early; outputs are the
+            # reassembled param-shaped tree, so no in-place alias exists
+            # by design (same opt-out as the engine's sync program)
+            "expect_alias": False,
+        },
+    )

@@ -122,6 +122,16 @@ def add_common_flags(p: argparse.ArgumentParser, *, epochs: int, batch_size: int
         help="resume from the latest checkpoint in --checkpoint-dir",
     )
     p.add_argument(
+        "--elastic",
+        action="store_true",
+        help="elastic resume (parallel/reshard.py, docs/ROBUSTNESS.md): "
+        "accept a checkpoint written under a DIFFERENT --nb-proc and "
+        "reshard the per-device momentum stack onto this mesh (shrink: "
+        "surviving workers keep their buffers; grow: new workers start "
+        "with zero momentum). Without it a worker-count mismatch is a "
+        "hard error",
+    )
+    p.add_argument(
         "--fused",
         action="store_true",
         help="run multi-epoch compiled spans (one dispatch per span) instead "
@@ -504,7 +514,9 @@ def _run_training_body(
             registry=registry,
         )
         if args.resume:
-            start_epoch = checkpointer.restore_latest(engine)
+            start_epoch = checkpointer.restore_latest(
+                engine, elastic=getattr(args, "elastic", False), log=log
+            )
             if start_epoch:
                 log(f"(Resumed from checkpoint: next epoch {start_epoch})")
             else:

@@ -1,0 +1,268 @@
+"""Elastic resume: restore any checkpoint into any mesh and keep training.
+
+The driver layer over `parallel/reshard.py`, threaded through both CLIs:
+
+- `lm_mesh_meta` stamps the LM trainer's checkpoint meta with the
+  save-time topology (mesh axes, specs, optimizer, global batch, accum),
+  so a later restore can detect and plan a reshard instead of crashing in
+  pjit.
+- `elastic_restore` is the resume path: peek the newest checkpoint's
+  meta, rebuild the SAVED state's abstract template from it (so the npz
+  validation still checks every leaf), restore host-side, run the
+  leaf-wise resharder (`reshard_state`), and place onto the target mesh's
+  shardings - emitting a `reshard` trace span plus
+  ``elastic_events_total`` / ``reshard_seconds`` live metrics.
+- `rescaled_accum_steps` keeps the global batch (and with it the
+  exact-resume data cursor) fixed across a dp change by re-slicing it
+  into microbatches.
+
+`lm_train.py` uses all three for `--elastic` startup resume and for the
+in-process `--chaos-shrink-at-step` preempt -> checkpoint -> reshard ->
+resume path; `train/cli.py --elastic` rides `Checkpointer.restore_latest(
+engine, elastic=True)` which reshards the engine's per-device momentum
+stack with `reshard_momentum_stack`. Semantics: docs/ROBUSTNESS.md
+"Elastic resume".
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel.reshard import (
+    mesh_topology,
+    rescale_accum,
+    reshard_state,
+    topology_mismatch,
+)
+
+ELASTIC_KINDS = ("restore", "shrink", "grow")
+
+
+def _metrics(registry):
+    if registry is None:
+        from ..utils.obs import NULL_REGISTRY
+
+        registry = NULL_REGISTRY
+    events = registry.counter(
+        "elastic_events_total",
+        "Elastic reshard events, by kind (train/elastic.py)",
+    )
+    seconds = registry.histogram(
+        "reshard_seconds", "Wall time of one checkpoint reshard"
+    )
+    return events, seconds
+
+
+def lm_mesh_meta(
+    mesh, specs, optimizer: str, *, batch: int, accum_steps: int, **extra
+) -> dict:
+    """The LM trainer's `mesh_meta` checkpoint block (`mesh_topology` plus
+    the batch-slicing facts `rescaled_accum_steps` needs)."""
+    return mesh_topology(
+        mesh, specs=specs, optimizer=optimizer,
+        global_batch=int(batch), accum_steps=int(accum_steps), **extra,
+    )
+
+
+def saved_state_template(cfg, saved: dict):
+    """Abstract ``{"params", "mom"}`` template of a checkpoint's SAVED
+    layout, rebuilt from its recorded topology - so the backend's
+    leaf-count/shape/dtype validation still guards the restore even when
+    the saved layout differs from the run's.
+
+    Params are layout-invariant (always the full logical tree); the
+    optimizer state's shapes depend on the saved optimizer and - for the
+    ZeRO variants, whose flat buffers are padded per shard count - the
+    saved data-axis size. ZeRO state saved under pipeline parallelism
+    carries an additional per-stage split this template cannot describe;
+    that combination is rejected with the supported alternatives named.
+    """
+    from ..models import transformer as tfm
+    from ..parallel.zero import init_zero_adam_tree, init_zero_momentum_tree
+
+    optimizer = saved.get("optimizer", "sgd")
+    axes = saved.get("axes") or {}
+    dp = int(axes.get("data", 1))
+    if optimizer.startswith("zero") and int(axes.get("pipe", 1)) > 1:
+        raise ValueError(
+            "elastic restore of ZeRO state saved under pipeline parallelism "
+            "is not supported (the flat buffers carry a per-stage split the "
+            "portable template cannot rebuild) - resume with the original "
+            "mesh shape, or save pipeline runs with sgd/adam for elasticity"
+        )
+    params = jax.eval_shape(
+        lambda k: tfm.init_params(k, cfg),
+        jax.ShapeDtypeStruct((2,), jnp.uint32),
+    )
+    if optimizer == "sgd":
+        mom = params
+    elif optimizer == "adam":
+        mom = {
+            "m": params, "v": params,
+            "t": jax.ShapeDtypeStruct((), jnp.int32),
+        }
+    elif optimizer == "zero":
+        mom = jax.eval_shape(
+            lambda p: init_zero_momentum_tree(p, dp), params
+        )
+    elif optimizer == "zero-adam":
+        mom = jax.eval_shape(lambda p: init_zero_adam_tree(p, dp), params)
+    else:
+        raise ValueError(f"checkpoint records unknown optimizer {optimizer!r}")
+    return {"params": params, "mom": mom}
+
+
+def rescaled_accum_steps(saved: dict, *, batch: int, new_dp: int,
+                         accum_steps: int) -> int:
+    """This run's accumulation steps given the saved topology: keep the
+    GLOBAL batch exact across the dp change (`rescale_accum`); checkpoints
+    without the batch facts (or with a changed global batch - the
+    operator overrode it deliberately) keep the requested value."""
+    if int(saved.get("global_batch", -1)) != int(batch):
+        return accum_steps
+    old_dp = int((saved.get("axes") or {}).get("data", 1))
+    return rescale_accum(
+        batch, old_dp, new_dp, int(saved.get("accum_steps", accum_steps))
+    )
+
+
+def elastic_restore(
+    ck,
+    *,
+    cfg,
+    mesh,
+    specs,
+    optimizer: str,
+    param_shardings,
+    mom_shardings,
+    current_meta: dict | None = None,
+    template=None,
+    tracer=None,
+    registry=None,
+    log=print,
+):
+    """Restore the newest checkpoint onto THIS run's mesh, resharding when
+    the saved topology differs.
+
+    Returns ``(state, meta, step, resharded)`` or None when the directory
+    holds no checkpoint. Matching topology (or a pre-elastic checkpoint
+    without a `mesh_meta` block) takes the plain per-leaf sharded restore;
+    a mismatch logs the named differences, rebuilds the saved template
+    (`saved_state_template`), restores host-side, and runs the leaf-wise
+    resharder under a `reshard` trace span with live metrics.
+    """
+    from ..parallel.pipeline import interleave_layer_order
+    from ..utils import tracing as TR
+
+    latest = ck.latest_meta()
+    if latest is None:
+        return None
+    _, meta = latest
+    saved = meta.get("mesh_meta")
+    current = current_meta or lm_mesh_meta(
+        mesh, specs, optimizer, batch=-1, accum_steps=1
+    )
+    diffs = topology_mismatch(saved, current) if saved else []
+    if template is None:
+        template = saved_state_template(
+            cfg, {"optimizer": optimizer, "axes": dict(mesh.shape)}
+        )
+    if not diffs:
+        restored = ck.restore_latest(
+            template,
+            {"params": param_shardings, "mom": mom_shardings},
+            log=log,
+        )
+        if restored is None:
+            return None
+        state, meta, step = restored
+        return state, meta, step, False
+
+    events, seconds = _metrics(registry)
+    tracer = tracer if tracer is not None else TR.NULL_TRACER
+    for d in diffs:
+        log(f"(elastic: {d})")
+    saved_optimizer = saved.get("optimizer", "sgd")
+    saved_axes = saved.get("axes") or {}
+    saved_dp = int(saved_axes.get("data", 1))
+    dp = int(mesh.shape.get("data", 1))
+    t0 = time.perf_counter()
+    with tracer.span(
+        TR.RESHARD, track="elastic",
+        saved_axes=dict(saved_axes),
+        target_axes={k: int(v) for k, v in mesh.shape.items()},
+        saved_optimizer=saved_optimizer, optimizer=optimizer,
+    ):
+        saved_template = saved_state_template(cfg, saved)
+        restored = ck.restore_latest(saved_template, log=log)
+        if restored is None:
+            return None
+        state, meta, step = restored
+        v0 = int(saved.get("pp_interleave", meta.get("pp_interleave", 1)))
+        v1 = int(current.get("pp_interleave", 1))
+        if v0 != v1:
+            # the interleaved pipeline schedule permutes the layer axis on
+            # device; route through canonical order so any v -> any v maps.
+            # ZeRO+pipe was already rejected by the template, so the
+            # momentum here mirrors the param tree (sgd) or holds two
+            # mirrors of it (adam) - permute the same leaves.
+            pp0 = int(saved_axes.get("pipe", 1))
+            pp1 = int(current.get("axes", {}).get("pipe", 1))
+            perms = []
+            if v0 > 1:
+                perms.append(
+                    interleave_layer_order(cfg.n_layers, pp0, v0, inverse=True)
+                )
+            if v1 > 1:
+                perms.append(interleave_layer_order(cfg.n_layers, pp1, v1))
+            state = {
+                "params": _reorder_layers(state["params"], perms),
+                "mom": (
+                    {
+                        "m": _reorder_layers(state["mom"]["m"], perms),
+                        "v": _reorder_layers(state["mom"]["v"], perms),
+                        "t": state["mom"]["t"],
+                    }
+                    if saved_optimizer == "adam"
+                    else _reorder_layers(state["mom"], perms)
+                    if saved_optimizer == "sgd"
+                    else state["mom"]
+                ),
+            }
+        state = reshard_state(
+            state,
+            saved_optimizer=saved_optimizer, saved_dp=saved_dp,
+            optimizer=optimizer, dp=dp,
+            params_template=template["params"],
+            param_shardings=param_shardings, mom_shardings=mom_shardings,
+        )
+    dt = time.perf_counter() - t0
+    kind = "shrink" if current.get("devices", 0) < saved.get("devices", 0) \
+        else "grow" if current.get("devices", 0) > saved.get("devices", 0) \
+        else "restore"
+    events.labels(kind=kind).inc()
+    seconds.observe(dt)
+    log(
+        f"(elastic: resharded checkpoint step {step} "
+        f"[{_axes_desc(saved_axes)}, {saved_optimizer}] -> "
+        f"[{_axes_desc(dict(mesh.shape))}, {optimizer}] in {dt:.2f}s)"
+    )
+    return state, meta, step, True
+
+
+def _axes_desc(axes: dict) -> str:
+    return "x".join(f"{k}{v}" for k, v in axes.items() if int(v) > 1) or "single"
+
+
+def _reorder_layers(tree, perms) -> dict:
+    """Apply layer-axis permutations (in order) to every `layers` leaf of a
+    param-shaped tree (host-level; the stacked layer dim is axis 0)."""
+    layers = tree["layers"]
+    for order in perms:
+        idx = np.asarray(order)
+        layers = jax.tree.map(lambda x: np.asarray(x)[idx], layers)
+    return {**tree, "layers": layers}

@@ -310,6 +310,16 @@ class Engine:
         self.params = jax.device_put(tree["params"], self._repl)
         self.mom = jax.device_put(tree["mom"], self._shard)
 
+    def mesh_meta(self) -> dict:
+        """Save-time topology block for checkpoint meta
+        (`parallel/reshard.py mesh_topology`): what an elastic restore
+        (`Checkpointer.restore_latest(engine, elastic=True)`) needs to
+        detect a worker-count change and reshard the per-device momentum
+        stack instead of crashing on a shape mismatch."""
+        from ..parallel.reshard import mesh_topology
+
+        return mesh_topology(self.mesh, n_workers=self.n_workers)
+
     # ----------------------------------------------------------- telemetry
 
     @property

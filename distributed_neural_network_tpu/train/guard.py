@@ -247,6 +247,13 @@ class TrainingGuard:
         a retry)."""
         return self._snapshot
 
+    def drop_snapshot(self) -> None:
+        """Invalidate the rolling snapshot (the elastic shrink path: a
+        snapshot taken under the pre-shrink mesh/optimizer layout must
+        never be rolled back into the resharded run; the next cadence
+        retakes one in the new layout)."""
+        self._snapshot = None
+
     # -------------------------------------------------------- observation
 
     def observe(

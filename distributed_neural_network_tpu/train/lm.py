@@ -261,6 +261,24 @@ def lm_wiring(cfg: tfm.TransformerConfig, mesh: Mesh, optimizer: str = "sgd"):
     return sp, tp, ep, sync_axes, specs, mom_spec, data_spec
 
 
+def make_lm_shardings(cfg: tfm.TransformerConfig, mesh: Mesh,
+                      optimizer: str = "sgd"):
+    """(specs, param_shardings, mom_shardings) for one mesh/optimizer -
+    the placement triple the elastic driver (train/elastic.py) rebuilds
+    whenever the mesh changes under a run (shrink/grow resume), derived
+    from the same `lm_wiring` the compiled step uses so the restored
+    leaves land exactly where the step expects them."""
+    specs = lm_wiring(cfg, mesh, optimizer)[4]
+    param_shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs
+    )
+    mom_shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        optimizer_state_specs(optimizer, specs),
+    )
+    return specs, param_shardings, mom_shardings
+
+
 def make_lm_train_step(
     cfg: tfm.TransformerConfig,
     mesh: Mesh,

@@ -80,7 +80,10 @@ class _OrbaxBackend:
     def latest_step(self):
         return self._mgr.latest_step()
 
-    def restore(self, step: int, template=None):
+    def restore(self, step: int, template=None, shardings=None):
+        # shardings are applied by the caller for this backend (orbax
+        # already streams leaves; the npz backend is the one that would
+        # otherwise materialize the whole host tree first)
         out = self._mgr.restore(
             step,
             args=ocp.args.Composite(
@@ -89,6 +92,22 @@ class _OrbaxBackend:
             ),
         )
         return out["state"], out["meta"]
+
+    def load_meta(self, step: int) -> dict:
+        """Only the JSON meta of one step (no array reads when the orbax
+        layout allows a partial restore)."""
+        try:
+            out = self._mgr.restore(
+                step, args=ocp.args.Composite(meta=ocp.args.JsonRestore())
+            )
+            return out["meta"]
+        except Exception:
+            try:
+                return self.restore(step)[1]
+            except Exception as e:  # pragma: no cover - surface uniformly
+                raise CheckpointCorruptError(
+                    step, f"{type(e).__name__}: {e}"
+                ) from e
 
     def all_steps(self):
         return sorted(self._mgr.all_steps())
@@ -148,47 +167,89 @@ class _NpzBackend:
         steps = self.all_steps()
         return steps[-1] if steps else None
 
-    def restore(self, step: int, template=None):
-        """Load + validate one checkpoint. With a `template`, the leaf
-        count, every shape, and every dtype are checked BEFORE unflatten,
-        so a truncated archive or a layout from a different run raises a
-        clear `CheckpointCorruptError` instead of a cryptic unflatten /
-        device_put failure deep in the restore path."""
-        d = self._step_dir(step)
+    def load_meta(self, step: int) -> dict:
+        """Only the JSON meta sidecar of one step (no array reads)."""
         try:
-            with np.load(os.path.join(d, "state.npz")) as z:
-                leaves = [z[f"leaf_{i}"] for i in range(len(z.files))]
-            with open(os.path.join(d, "meta.json")) as f:
-                meta = json.load(f)
-        except CheckpointCorruptError:
-            raise
-        except Exception as e:  # unreadable zip, missing file, bad json
+            with open(os.path.join(self._step_dir(step), "meta.json")) as f:
+                return json.load(f)
+        except Exception as e:
             raise CheckpointCorruptError(
                 step, f"{type(e).__name__}: {e}"
             ) from e
-        if template is None:
-            return leaves, meta
-        want = jax.tree.leaves(template)
-        if len(leaves) != len(want):
+
+    def restore(self, step: int, template=None, shardings=None):
+        """Load + validate one checkpoint, leaf by leaf. With a `template`,
+        the leaf count, every shape, and every dtype are checked as each
+        leaf streams out of the archive, so a truncated archive or a
+        layout from a different run raises a `CheckpointCorruptError`
+        naming the offending LEAF PATH instead of a cryptic unflatten /
+        device_put failure deep in the restore path.
+
+        `shardings` (a pytree of jax.sharding.Sharding aligned with
+        `template`) places each leaf on device THE MOMENT it is read -
+        the host copy is dropped before the next leaf loads, so peak host
+        memory is one leaf, not the whole tree (the npz archive is a zip;
+        members decompress individually on access)."""
+        d = self._step_dir(step)
+        try:
+            z = np.load(os.path.join(d, "state.npz"))
+        except Exception as e:  # unreadable zip, missing file
             raise CheckpointCorruptError(
-                step,
-                f"{len(leaves)} stored leaves, template has {len(want)} - "
-                "truncated archive or a different model/optimizer layout",
+                step, f"{type(e).__name__}: {e}"
+            ) from e
+        with z:
+            meta = self.load_meta(step)
+            n_stored = len(z.files)
+            if template is None:
+                try:
+                    leaves = [z[f"leaf_{i}"] for i in range(n_stored)]
+                except Exception as e:
+                    raise CheckpointCorruptError(
+                        step, f"{type(e).__name__}: {e}"
+                    ) from e
+                return leaves, meta
+            flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+            if n_stored != len(flat):
+                raise CheckpointCorruptError(
+                    step,
+                    f"{n_stored} stored leaves, template has {len(flat)} - "
+                    "truncated archive or a different model/optimizer "
+                    "layout",
+                )
+            shard_leaves = (
+                treedef.flatten_up_to(shardings)
+                if shardings is not None else [None] * len(flat)
             )
-        for i, (got, ref) in enumerate(zip(leaves, want)):
-            if tuple(got.shape) != tuple(np.shape(ref)):
-                raise CheckpointCorruptError(
-                    step,
-                    f"leaf_{i} shape {tuple(got.shape)} != template "
-                    f"{tuple(np.shape(ref))}",
+            if shardings is not None:
+                from ..parallel.reshard import put_leaf
+            leaves = []
+            for i, ((path, ref), shard) in enumerate(zip(flat, shard_leaves)):
+                name = jax.tree_util.keystr(path) or f"leaf_{i}"
+                try:
+                    got = z[f"leaf_{i}"]
+                except Exception as e:
+                    raise CheckpointCorruptError(
+                        step, f"{name}: {type(e).__name__}: {e}"
+                    ) from e
+                ref_shape = tuple(getattr(ref, "shape", np.shape(ref)))
+                if tuple(got.shape) != ref_shape:
+                    raise CheckpointCorruptError(
+                        step,
+                        f"{name} shape {tuple(got.shape)} != template "
+                        f"{ref_shape}",
+                    )
+                ref_dt = np.dtype(
+                    getattr(ref, "dtype", None) or np.asarray(ref).dtype
                 )
-            ref_dt = np.dtype(getattr(ref, "dtype", np.asarray(ref).dtype))
-            if np.dtype(got.dtype) != ref_dt:
-                raise CheckpointCorruptError(
-                    step,
-                    f"leaf_{i} dtype {got.dtype} != template {ref_dt}",
-                )
-        state = jax.tree.unflatten(jax.tree.structure(template), leaves)
+                if np.dtype(got.dtype) != ref_dt:
+                    raise CheckpointCorruptError(
+                        step,
+                        f"{name} dtype {got.dtype} != template {ref_dt}",
+                    )
+                if shard is not None:
+                    got = put_leaf(got, shard)
+                leaves.append(got)
+        state = jax.tree_util.tree_unflatten(treedef, leaves)
         return state, meta
 
     def close(self) -> None:
@@ -227,6 +288,10 @@ class _CkptMetrics:
         self.last_step = registry.gauge(
             "checkpoint_last_step", "Step/epoch of the newest checkpoint"
         )
+        self.elastic_events = registry.counter(
+            "elastic_events_total",
+            "Elastic reshard events, by kind (train/elastic.py)",
+        )
 
     def saved(self, step: int) -> None:
         import time
@@ -234,6 +299,9 @@ class _CkptMetrics:
         self.saves.inc()
         self.last_save.set(time.time())
         self.last_step.set(int(step))
+
+    def elastic(self, kind: str) -> None:
+        self.elastic_events.labels(kind=kind).inc()
 
 
 class TreeCheckpointer:
@@ -258,16 +326,34 @@ class TreeCheckpointer:
     def latest_step(self):
         return self._b.latest_step()
 
+    def latest_meta(self, *, log=print):
+        """(step, meta) of the newest checkpoint with READABLE meta, or
+        None - the cheap peek the elastic resume path (train/elastic.py)
+        uses to learn the SAVED mesh topology before deciding which
+        template (and which resharding plan) the real restore needs."""
+        steps = self._b.all_steps()
+        for step in reversed(steps):
+            try:
+                return step, self._b.load_meta(step)
+            except CheckpointCorruptError as e:
+                log(f"(WARNING: {e}; falling back to the previous "
+                    "checkpoint)")
+        return None
+
     def restore_latest(self, template, shardings=None, *, log=print):
         """(state, meta, step) from the newest VALID checkpoint, or None.
 
-        `template` supplies the tree structure (its leaf values are unused);
-        `shardings` re-places each restored leaf via device_put. A newest
-        checkpoint that fails validation (CheckpointCorruptError - e.g. the
-        writer was killed mid-save on a filesystem without atomic rename)
-        is skipped with a warning and the previous step is tried, oldest
-        last; only if every retained checkpoint is corrupt does the error
-        propagate.
+        `template` supplies the tree structure (its leaf values are unused;
+        `jax.ShapeDtypeStruct` leaves work); `shardings` places each
+        restored leaf onto its target sharding. The npz backend applies
+        the sharding PER LEAF at read time (one leaf of host memory at a
+        peak, never the whole unsharded tree - the host-OOM hazard of
+        restoring a large model); orbax restores its own way and leaves
+        are placed afterwards. A newest checkpoint that fails validation
+        (CheckpointCorruptError - e.g. the writer was killed mid-save on a
+        filesystem without atomic rename) is skipped with a warning and
+        the previous step is tried, oldest last; only if every retained
+        checkpoint is corrupt does the error propagate.
         """
         steps = self._b.all_steps()
         if not steps:
@@ -275,14 +361,16 @@ class TreeCheckpointer:
         last_err = None
         for step in reversed(steps):
             try:
-                state, meta = self._b.restore(step, template)
+                state, meta = self._b.restore(step, template, shardings)
             except CheckpointCorruptError as e:
                 log(f"(WARNING: {e}; falling back to the previous "
                     "checkpoint)")
                 last_err = e
                 continue
-            if shardings is not None:
-                state = jax.tree.map(jax.device_put, state, shardings)
+            if shardings is not None and self.backend_name != "npz":
+                from ..parallel.reshard import place_tree
+
+                state = place_tree(state, shardings)
             return state, meta, step
         raise last_err
 
@@ -328,6 +416,10 @@ class Checkpointer:
             "n_workers": engine.n_workers,
             "regime": engine.config.regime,
             "history": [dataclasses.asdict(m) for m in engine.history],
+            # save-time mesh topology so a restore into a different worker
+            # count is DETECTED and (with elastic=True) resharded instead
+            # of crashing on a momentum-stack shape mismatch
+            "mesh_meta": engine.mesh_meta(),
             # versioned exact-resume cursor: every shuffle/fault stream is
             # a pure function of (seed, epoch), so these two pin the
             # continuation's data order bit-exactly (train/guard.py)
@@ -341,19 +433,48 @@ class Checkpointer:
     def latest_epoch(self):
         return self._b.latest_step()
 
-    def restore_latest(self, engine, *, log=print) -> int:
+    def restore_latest(self, engine, *, elastic: bool = False,
+                       log=print) -> int:
         """Load the newest VALID checkpoint into `engine`; returns the next
         epoch to run (0 if no checkpoint exists). A corrupt newest
         checkpoint is skipped with a warning (same fallback semantics as
-        `TreeCheckpointer.restore_latest`)."""
+        `TreeCheckpointer.restore_latest`).
+
+        ``elastic=True`` accepts a checkpoint written under a DIFFERENT
+        worker count: the restore template is rebuilt for the saved stack
+        shape (so leaf validation still applies) and the per-device
+        momentum stack is resharded onto this engine's mesh
+        (`parallel/reshard.py reshard_momentum_stack`: surviving workers
+        keep their buffers on shrink, new workers start with zero momentum
+        on grow). The replicated params re-place unchanged. Without it, a
+        worker-count mismatch stays a hard error naming the fix."""
         steps = self._b.all_steps()
         if not steps:
             return 0
         state = meta = None
         last_err = None
+        want = engine.state_tree()
         for step in reversed(steps):
             try:
-                state, meta = self._b.restore(step, engine.state_tree())
+                n_saved = int(
+                    self._b.load_meta(step).get(
+                        "n_workers", engine.n_workers
+                    )
+                )
+                template = want
+                if n_saved != engine.n_workers:
+                    # validate against the SAVED stack shape; the elastic
+                    # decision happens after the meta checks below
+                    template = {
+                        "params": want["params"],
+                        "mom": jax.tree.map(
+                            lambda m: jax.ShapeDtypeStruct(
+                                (n_saved, *m.shape[1:]), m.dtype
+                            ),
+                            want["mom"],
+                        ),
+                    }
+                state, meta = self._b.restore(step, template)
                 break
             except CheckpointCorruptError as e:
                 log(f"(WARNING: {e}; falling back to the previous "
@@ -362,9 +483,32 @@ class Checkpointer:
         if meta is None:
             raise last_err
         if meta["n_workers"] != engine.n_workers:
-            raise ValueError(
-                f"checkpoint was written with n_workers={meta['n_workers']}, "
-                f"engine has {engine.n_workers} - momentum buffers don't map"
+            if not elastic:
+                raise ValueError(
+                    f"checkpoint was written with "
+                    f"n_workers={meta['n_workers']}, engine has "
+                    f"{engine.n_workers} - momentum buffers don't map; "
+                    "pass elastic=True (CLI: --elastic) to reshard the "
+                    "momentum stack onto this worker count"
+                )
+            from ..parallel.reshard import reshard_momentum_stack
+
+            n_saved = int(meta["n_workers"])
+            state = {
+                "params": state["params"],
+                "mom": reshard_momentum_stack(
+                    state["mom"], engine.n_workers
+                ),
+            }
+            self._metrics.elastic(
+                "shrink" if engine.n_workers < n_saved else "grow"
+            )
+            log(
+                f"(elastic: momentum stack resharded {n_saved} -> "
+                f"{engine.n_workers} workers; "
+                + ("surviving workers keep their buffers)"
+                   if engine.n_workers < n_saved
+                   else "new workers start with zero momentum)")
             )
         if meta["regime"] != engine.config.regime:
             raise ValueError(

@@ -56,6 +56,9 @@ DATA_LOADING = "data_loading"
 # restore) and the fault simulator's straggler stall span (parallel/fault.py)
 GUARD = "guard"
 STRAGGLER = "straggler"
+# elastic resume/shrink events (train/elastic.py): the reshard span wraps
+# one whole checkpoint->new-mesh redistribution on the "elastic" track
+RESHARD = "reshard"
 
 
 class _NullSpan:

@@ -195,6 +195,15 @@ def main() -> int:
     p.add_argument("--checkpoint-every", type=int, default=50)
     p.add_argument("--resume", action="store_true",
                    help="resume from the latest checkpoint in --checkpoint-dir")
+    p.add_argument("--elastic", action="store_true",
+                   help="elastic resume (parallel/reshard.py, docs/"
+                   "ROBUSTNESS.md): accept a checkpoint saved under a "
+                   "DIFFERENT mesh shape or optimizer layout and reshard "
+                   "it onto this run's mesh - dp/sp/tp may all change, "
+                   "ZeRO shards re-pad for the new dp, and sgd<->zero / "
+                   "adam<->zero-adam convert bitwise; the global batch "
+                   "stays fixed (grad accumulation is re-sliced) so the "
+                   "exact-resume data cursor still holds")
     p.add_argument("--guard", choices=("off", "warn", "skip", "rollback",
                                        "abort"),
                    default="off",
@@ -251,6 +260,22 @@ def main() -> int:
     p.add_argument("--chaos-stall-seconds", type=float, default=2.0,
                    metavar="SEC",
                    help="stall duration for --chaos-stall-step")
+    p.add_argument("--chaos-shrink-at-step", type=int, default=None,
+                   metavar="N",
+                   help="fault injection (parallel/fault.py): after step N "
+                   "raise a cooperative SHRINK preemption - the elastic "
+                   "driver writes an emergency checkpoint, rebuilds the "
+                   "mesh from the first --chaos-shrink-to devices, "
+                   "reshards params+optimizer state onto it "
+                   "(parallel/reshard.py) and CONTINUES training in this "
+                   "process: the full preempt -> checkpoint -> reshard -> "
+                   "resume path. Requires --checkpoint-dir and "
+                   "--on-sigterm checkpoint; mesh path only (not --pp)")
+    p.add_argument("--chaos-shrink-to", type=int, default=None,
+                   metavar="DP",
+                   help="data-parallel size the SHRINK preemption drops to "
+                   "(default dp//2); sp/tp are kept, the global batch is "
+                   "preserved by re-slicing gradient accumulation")
     p.add_argument("--gen-temperature", type=float, default=0.0,
                    help="sampling temperature for --generate (0 = greedy)")
     p.add_argument("--gen-top-k", type=int, default=0,
@@ -344,6 +369,38 @@ def main() -> int:
     if args.chaos_stall_seconds <= 0:
         p.error(f"--chaos-stall-seconds must be > 0, got "
                 f"{args.chaos_stall_seconds}")
+    if args.elastic and not args.resume and args.chaos_shrink_at_step is None:
+        p.error("--elastic configures how --resume (or a SHRINK "
+                "preemption) maps a checkpoint onto this mesh; add "
+                "--resume with --checkpoint-dir, or --chaos-shrink-at-step")
+    if args.elastic and args.pp > 1 and args.optimizer.startswith("zero"):
+        p.error("--elastic with --pp composes with sgd/adam only: the "
+                "pipeline ZeRO buffers carry a per-stage split the "
+                "portable reshard template cannot rebuild "
+                "(docs/ROBUSTNESS.md 'Elastic resume')")
+    if args.chaos_shrink_at_step is not None:
+        if args.pp > 1:
+            p.error("--chaos-shrink-at-step shrinks the dp x sp x tp mesh "
+                    "in process; drop --pp")
+        if not args.checkpoint_dir:
+            p.error("--chaos-shrink-at-step drives the preempt -> "
+                    "checkpoint -> reshard -> resume path; it requires "
+                    "--checkpoint-dir")
+        if args.on_sigterm != "checkpoint":
+            p.error("--chaos-shrink-at-step rides the cooperative "
+                    "preemption guard; it requires --on-sigterm checkpoint")
+        if args.eval_every:
+            p.error("--chaos-shrink-at-step cannot rebuild the --eval-every "
+                    "evaluator mid-run; drop one of the two")
+        if args.chaos_shrink_to is None:
+            args.chaos_shrink_to = max(args.dp // 2, 1)
+        if not 1 <= args.chaos_shrink_to < args.dp:
+            p.error(f"--chaos-shrink-to must be in [1, dp) = "
+                    f"[1, {args.dp}), got {args.chaos_shrink_to}")
+        if args.batch_size % args.chaos_shrink_to:
+            p.error(f"--batch-size {args.batch_size} must divide over "
+                    f"--chaos-shrink-to {args.chaos_shrink_to} (the global "
+                    "batch is preserved across the shrink)")
     if args.watchdog_escalate == "preempt" and args.on_sigterm != "checkpoint":
         p.error("--watchdog-escalate preempt rides the cooperative "
                 "preemption path; it requires --on-sigterm checkpoint")
@@ -553,13 +610,28 @@ def main() -> int:
         resume_cursor,
     )
 
+    from distributed_neural_network_tpu.train import elastic as EL
+
+    def current_mesh_meta():
+        """Save-time topology of the CURRENT mesh (re-read after an
+        in-process shrink: mesh/specs/accum are rebound locals)."""
+        return EL.lm_mesh_meta(
+            mesh, specs, args.optimizer,
+            batch=args.batch_size, accum_steps=args.accum_steps,
+            pp_interleave=args.pp_interleave,
+        )
+
     def ckpt_meta(i: int, loss_val):
         """Checkpoint meta incl. the versioned exact-resume cursor: every
         batch/PRNG stream here is a pure function of (seed, step), so the
-        cursor pins the continuation's data order bit-exactly."""
+        cursor pins the continuation's data order bit-exactly. mesh_meta
+        records the save-time topology so a restore into a different
+        mesh/optimizer is detected and - with --elastic - resharded
+        (parallel/reshard.py) instead of crashing inside pjit."""
         return {"mesh": mesh_desc, "optimizer": args.optimizer,
                 "mom_format": MOM_FORMAT, "loss": loss_val,
                 "pp_interleave": args.pp_interleave,
+                "mesh_meta": current_mesh_meta(),
                 **resume_cursor(step=i, seed=args.seed)}
 
     ck = None
@@ -577,7 +649,44 @@ def main() -> int:
                 "--resume to continue that run or use a fresh directory "
                 "(saves at existing step numbers would be silently skipped)"
             )
-        if args.resume:
+        if args.resume and args.elastic:
+            restored = EL.elastic_restore(
+                ck, cfg=cfg, mesh=mesh, specs=specs,
+                optimizer=args.optimizer,
+                param_shardings=param_shardings,
+                mom_shardings=mom_shardings,
+                current_meta=current_mesh_meta(),
+                tracer=tracer, registry=registry,
+            )
+            if restored is None:
+                print(
+                    f"(WARNING: --resume found no checkpoint in "
+                    f"{args.checkpoint_dir}; starting from scratch)"
+                )
+            else:
+                state, meta, last, resharded = restored
+                try:
+                    check_cursor(meta, seed=args.seed)
+                except ValueError as e:
+                    raise SystemExit(str(e))
+                params, mom = state["params"], state["mom"]
+                step0 = last + 1
+                if resharded and not pipe:
+                    new_accum = EL.rescaled_accum_steps(
+                        meta.get("mesh_meta") or {}, batch=args.batch_size,
+                        new_dp=args.dp, accum_steps=args.accum_steps,
+                    )
+                    if new_accum != args.accum_steps:
+                        print(
+                            f"(elastic: accum-steps {args.accum_steps} -> "
+                            f"{new_accum} keeps the global batch "
+                            f"{args.batch_size} - and with it the data "
+                            "cursor - exact across the dp change)"
+                        )
+                        args.accum_steps = new_accum
+                        step = build_step()
+                print(f"(Resumed from step {last}; continuing at {step0})")
+        elif args.resume:
             restored = ck.restore_latest(
                 {"params": params, "mom": mom},
                 {"params": param_shardings, "mom": mom_shardings},
@@ -613,7 +722,9 @@ def main() -> int:
                             f"checkpoint was written with {key_}="
                             f"{meta.get(key_)!r}, this run has {want!r} - "
                             "momentum/param shards don't map across layouts; "
-                            "resume with the original flags"
+                            "resume with the original flags, or pass "
+                            "--elastic to reshard the checkpoint onto this "
+                            "run's layout (parallel/reshard.py)"
                             + (
                                 " (or restart training: this checkpoint "
                                 "predates the current momentum layout)"
@@ -836,7 +947,8 @@ def main() -> int:
     # self-healing layer (train/guard.py; docs/ROBUSTNESS.md)
     monkey = None
     if (args.chaos_spike_step or args.chaos_stall_step
-            or args.chaos_sigterm_after is not None):
+            or args.chaos_sigterm_after is not None
+            or args.chaos_shrink_at_step is not None):
         from distributed_neural_network_tpu.parallel.fault import ChaosMonkey
 
         monkey = ChaosMonkey(
@@ -844,6 +956,8 @@ def main() -> int:
             sigterm_after=args.chaos_sigterm_after,
             stall_at=tuple(args.chaos_stall_step or ()),
             stall_s=args.chaos_stall_seconds,
+            shrink_at=args.chaos_shrink_at_step,
+            preempt=preempt,
             tracer=tracer,
         )
     guard = hpipe = None
@@ -913,6 +1027,57 @@ def main() -> int:
         i = snap_step
         return True
 
+    def do_elastic_shrink(new_dp: int, at_step: int) -> None:
+        """Answer a SHRINK preemption in process: the emergency checkpoint
+        is already on disk; rebuild the mesh from the surviving device
+        prefix, reshard the checkpoint onto it (the same elastic_restore
+        path a fresh process would take - ZeRO shards re-pad for the new
+        dp), re-slice gradient accumulation so the global batch and data
+        cursor stay exact, and rebuild+rewrap the compiled step."""
+        nonlocal mesh, specs, param_shardings, mom_shardings, mesh_desc
+        nonlocal params, mom, step, ema
+        from distributed_neural_network_tpu.parallel.reshard import (
+            place_tree,
+            rescale_accum,
+        )
+
+        old_dp = mesh.shape.get("data", 1)
+        mesh = lmtrain.create_lm_mesh(new_dp, args.sp, args.tp)
+        specs, param_shardings, mom_shardings = lmtrain.make_lm_shardings(
+            cfg, mesh, args.optimizer
+        )
+        args.accum_steps = rescale_accum(
+            args.batch_size, old_dp, new_dp, args.accum_steps
+        )
+        args.dp = new_dp
+        mesh_desc = "x".join(
+            f"{k}{v}" for k, v in mesh.shape.items() if v > 1
+        ) or "single"
+        restored = EL.elastic_restore(
+            ck, cfg=cfg, mesh=mesh, specs=specs, optimizer=args.optimizer,
+            param_shardings=param_shardings, mom_shardings=mom_shardings,
+            current_meta=current_mesh_meta(), tracer=tracer,
+            registry=registry,
+        )
+        state, _meta, _last, _resharded = restored
+        params, mom = state["params"], state["mom"]
+        step = wrap_step(
+            build_step(guard.lr_scale if guard is not None else 1.0),
+            at_step + 1,
+        )
+        if ema is not None:
+            ema = place_tree(ema, param_shardings)
+        if guard is not None:
+            # rolling snapshots hold the pre-shrink layout; a later
+            # rollback must not restore them - the next cadence retakes
+            guard.drop_snapshot()
+        if hpipe is not None:
+            hpipe.clear()
+        print(
+            f"(elastic: continuing at step {at_step + 1} on mesh "
+            f"{mesh_desc}, accum_steps={args.accum_steps})"
+        )
+
     while i < end_step:
         if guard is not None and (i - step0) % args.snapshot_every == 0:
             # settle the in-flight observation BEFORE snapshotting, so the
@@ -975,10 +1140,24 @@ def main() -> int:
         if monkey is not None:
             monkey.after_step(i)
         if preempt is not None and preempt.requested:
-            preempted = True
             if ck is not None:
                 ck.save(i, {"params": params, "mom": mom},
                         ckpt_meta(i, float(loss)))
+            if (preempt.signame == "SHRINK" and ck is not None
+                    and args.chaos_shrink_to is not None):
+                # elastic path: the emergency checkpoint above is the
+                # hand-off; reshard it onto the shrunken mesh and keep
+                # training instead of dying with the lost devices
+                print(f"(emergency checkpoint at step {i}; SHRINK "
+                      "preemption -> resharding onto the surviving "
+                      "devices)")
+                do_elastic_shrink(args.chaos_shrink_to, i)
+                preempt.requested = False
+                preempt.signame = None
+                i += 1
+                continue
+            preempted = True
+            if ck is not None:
                 print(f"(emergency checkpoint at step {i}; resume with "
                       "--resume to continue bit-exactly)")
             else:

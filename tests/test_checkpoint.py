@@ -88,8 +88,44 @@ def test_worker_count_mismatch_raises(tmp_path, n_devices):
     cfg8 = _cfg(1)
     cfg8.nb_proc = 8
     other = Engine(cfg8, TRAIN, None)
-    with pytest.raises(ValueError, match="n_workers"):
+    with pytest.raises(ValueError, match="--elastic"):
         ck.restore_latest(other)
+
+
+@pytest.mark.slow
+def test_elastic_restore_across_worker_counts(tmp_path, n_devices):
+    """elastic=True accepts a checkpoint from a different worker count:
+    shrink keeps the surviving workers' momentum rows, grow zero-pads new
+    workers, the replicated params re-place unchanged, and meta records
+    the save-time topology (parallel/reshard.py mesh_topology)."""
+    import jax
+
+    ck = Checkpointer(str(tmp_path / "e"), every=1, backend="npz")
+    eng = Engine(_cfg(2), TRAIN, TEST)
+    eng.run(log=lambda *_: None, checkpointer=ck)
+    saved_params = _leaves(eng.state_tree()["params"])
+    saved_mom = [np.asarray(m) for m in jax.tree.leaves(eng.state_tree()["mom"])]
+    meta = ck._b.load_meta(ck.latest_epoch())
+    assert meta["mesh_meta"]["axes"] == {"data": 4}
+    assert meta["mesh_meta"]["n_workers"] == 4
+
+    cfg8 = _cfg(2)
+    cfg8.nb_proc = 8
+    grown = Engine(cfg8, TRAIN, None)
+    logs = []
+    assert ck.restore_latest(grown, elastic=True, log=logs.append) == 2
+    assert any("momentum stack resharded 4 -> 8" in s for s in logs)
+    for a, b in zip(saved_params, _leaves(grown.state_tree()["params"])):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(saved_mom, jax.tree.leaves(grown.state_tree()["mom"])):
+        b = np.asarray(b)
+        np.testing.assert_array_equal(a, b[:4])
+        np.testing.assert_array_equal(b[4:], 0.0)
+    # the grown engine keeps training from the restored state
+    grown.config.epochs = 3
+    hist = grown.run(log=lambda *_: None, start_epoch=2)
+    assert [m.epoch for m in hist] == [0, 1, 2]
+    ck.close()
 
 
 def test_restore_on_empty_dir_is_fresh_start(tmp_path, n_devices):

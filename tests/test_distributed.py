@@ -104,6 +104,139 @@ def test_initialize_missing_num_processes(monkeypatch):
         dist.initialize()
 
 
+def _retry_env(monkeypatch, num="4"):
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:9999")
+    monkeypatch.setenv("JAX_NUM_PROCESSES", num)
+    monkeypatch.setenv("JAX_PROCESS_ID", "1")
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, s):
+        self.t += s
+
+
+def test_initialize_retries_until_coordinator_appears(monkeypatch):
+    """Transient coordinator unavailability (rescheduled pod, slow DNS)
+    is retried with exponential backoff instead of failing - or worse,
+    hanging - on the first connect."""
+    _retry_env(monkeypatch)
+    clock = _FakeClock()
+    calls, sleeps = [], []
+
+    def connect(**kw):
+        calls.append(kw)
+        if len(calls) < 3:
+            raise ConnectionError("connection refused")
+
+    def sleep(s):
+        sleeps.append(s)
+        clock.sleep(s)
+
+    assert dist.initialize(
+        backoff_s=1.0, max_retries=5, deadline_s=300.0,
+        log=lambda *_: None, _connect=connect, _sleep=sleep, _clock=clock,
+    ) is True
+    assert len(calls) == 3
+    assert sleeps == [1.0, 2.0]  # exponential backoff
+    assert calls[0]["coordinator_address"] == "10.0.0.1:9999"
+    assert calls[0]["num_processes"] == 4 and calls[0]["process_id"] == 1
+
+
+def test_initialize_exhaustion_is_actionable(monkeypatch):
+    """An unreachable coordinator exhausts the bounded retry budget and
+    raises a RuntimeError naming the address and the env vars to check -
+    never a silent forever-hang."""
+    _retry_env(monkeypatch)
+    clock = _FakeClock()
+
+    def connect(**kw):
+        raise TimeoutError("deadline exceeded")
+
+    with pytest.raises(RuntimeError) as e:
+        dist.initialize(
+            backoff_s=1.0, max_retries=2, deadline_s=300.0,
+            log=lambda *_: None, _connect=connect, _sleep=clock.sleep,
+            _clock=clock,
+        )
+    msg = str(e.value)
+    assert "10.0.0.1:9999" in msg
+    assert "3 attempt(s)" in msg
+    assert "JAX_COORDINATOR_ADDRESS" in msg and "JAX_PROCESS_ID" in msg
+    assert "DNN_TPU_COORDINATOR_DEADLINE_S" in msg
+    assert "TimeoutError" in msg
+
+
+def test_initialize_deadline_cuts_retries(monkeypatch):
+    """The wall-clock deadline bounds the whole handshake even when the
+    retry budget is not yet exhausted."""
+    _retry_env(monkeypatch)
+    clock = _FakeClock()
+    calls = []
+
+    def connect(**kw):
+        calls.append(kw)
+        clock.sleep(40.0)  # each attempt burns 40s of fake wall clock
+        raise ConnectionError("refused")
+
+    with pytest.raises(RuntimeError, match="deadline 100"):
+        dist.initialize(
+            backoff_s=1.0, max_retries=50, deadline_s=100.0,
+            log=lambda *_: None, _connect=connect, _sleep=clock.sleep,
+            _clock=clock,
+        )
+    assert len(calls) <= 3  # 100s deadline / 40s attempts, not 50 retries
+
+
+def test_initialize_passes_remaining_deadline_as_timeout(monkeypatch):
+    """jax builds whose initialize takes `initialization_timeout` get the
+    REMAINING deadline per attempt, so one wedged TCP connect cannot eat
+    the whole budget."""
+    _retry_env(monkeypatch)
+    clock = _FakeClock()
+    seen = []
+
+    def connect(coordinator_address, num_processes, process_id,
+                initialization_timeout=None):
+        seen.append(initialization_timeout)
+        clock.sleep(30.0)
+        if len(seen) < 2:
+            raise ConnectionError("refused")
+
+    assert dist.initialize(
+        backoff_s=2.0, max_retries=3, deadline_s=120.0,
+        log=lambda *_: None, _connect=connect, _sleep=clock.sleep,
+        _clock=clock,
+    ) is True
+    assert seen[0] == 120
+    assert seen[1] < seen[0]  # shrinks with the elapsed clock
+
+
+def test_initialize_retry_env_defaults(monkeypatch):
+    """DNN_TPU_COORDINATOR_* env vars set the retry/deadline defaults."""
+    _retry_env(monkeypatch)
+    monkeypatch.setenv("DNN_TPU_COORDINATOR_RETRIES", "0")
+    monkeypatch.setenv("DNN_TPU_COORDINATOR_DEADLINE_S", "50")
+    clock = _FakeClock()
+    calls = []
+
+    def connect(**kw):
+        calls.append(kw)
+        raise ConnectionError("refused")
+
+    with pytest.raises(RuntimeError, match="retry budget 0"):
+        dist.initialize(
+            log=lambda *_: None, _connect=connect, _sleep=clock.sleep,
+            _clock=clock,
+        )
+    assert len(calls) == 1  # zero retries = exactly one attempt
+
+
 def test_distribute_host_data_shards_rows(n_devices):
     mesh = dist.create_hybrid_mesh({"data": 8})
     x = np.arange(32, dtype=np.float32).reshape(16, 2)

@@ -352,6 +352,40 @@ def test_chaos_monkey_fires_once():
     assert len(logs) == 1
 
 
+@pytest.mark.chaos
+def test_chaos_monkey_shrink_requests_preemption_once():
+    """--chaos-shrink-at-step: the monkey raises a cooperative SHRINK
+    preemption on the attached guard exactly once; the elastic driver
+    (lm_train.py) answers it with checkpoint -> reshard -> resume."""
+    logs = []
+    p = G.PreemptionGuard(log=logs.append)  # not installed: flag only
+    m = F.ChaosMonkey(shrink_at=5, preempt=p, log=logs.append)
+    m.after_step(4)
+    assert not p.requested
+    m.after_step(5)
+    assert p.requested and p.signame == "SHRINK"
+    # the driver clears the flag after resharding; the fault never re-fires
+    p.requested, p.signame = False, None
+    m.after_step(5)
+    assert not p.requested
+    assert sum("SHRINK" in s for s in logs) >= 1
+
+
+def test_guard_drop_snapshot():
+    """The elastic shrink invalidates the rolling snapshot (it holds the
+    pre-shrink layout); the next cadence retakes one."""
+    g = G.TrainingGuard(
+        G.GuardConfig(policy="rollback", snapshot_every=4),
+        log=lambda *_: None,
+    )
+    g.snapshot(4, {"w": jnp.ones((2,))})
+    assert g.has_snapshot
+    g.drop_snapshot()
+    assert not g.has_snapshot and g.rollback() is None
+    # cadence restarts: the next maybe_snapshot always takes
+    assert g.maybe_snapshot(6, {"w": jnp.ones((2,))}, first_step=0)
+
+
 def test_straggler_sleep_emits_trace_span():
     from distributed_neural_network_tpu.utils import tracing as TR
 
@@ -688,7 +722,11 @@ def _loss_series(path):
 def test_cli_kill_and_resume_bit_identical(tmp_path):
     """SIGTERM mid-run -> emergency checkpoint -> resume: the continued
     loss trajectory is BIT-IDENTICAL to the uninterrupted run (same data
-    order, same PRNG stream, params/momentum restored exactly)."""
+    order, same PRNG stream, params/momentum restored exactly). The
+    elastic extension of this scenario - resume on a SMALLER mesh via
+    --elastic, tolerance-gated because the loss psum reassociates across
+    dp - lives in tests/test_reshard.py
+    (test_cli_kill_and_resume_on_smaller_mesh)."""
     _run_lm(tmp_path, steps=24, name="a.jsonl")
     a = _loss_series(tmp_path / "a.jsonl")
     assert len(a) == 24
