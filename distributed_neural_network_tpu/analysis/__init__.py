@@ -22,12 +22,38 @@ CPU, before a TPU ever sees the change (docs/STATIC_ANALYSIS.md):
   diffs fresh traces against, so an extra all-gather or a de-bucketed
   reduce fails statically with the op, axes, and byte count named.
 - ``configs``  - the canonical train-step configs (dp/tp/zero/zero-adam/
-  pp x grad_sync end/overlap, plus the CNN engine's epoch program).
+  pp x grad_sync end/overlap, plus the CNN engine's epoch program), each
+  with a structured BLUEPRINT the sharding search re-factors.
 - ``runner``   - the library API behind tools/shardlint.py
   (``run_shardlint``).
+- ``cost``     - the static cost model: score a traced plan's collective
+  wire bytes, per-device state memory, donation coverage, and
+  replication leaks - all from `TraceFacts`, nothing executed.
+- ``autoshard`` - the ``--sharding auto`` search: enumerate mesh
+  factorizations x rule-derived spec assignments x optimizer layouts,
+  trace each candidate with ``trace``, score with ``cost``, pin the
+  winner as a checked-in plan manifest (analysis/plans/*.json) that
+  ``tools/autoshard.py --check`` gates in CI.
 """
 
-from .configs import CANONICAL_CONFIGS, build_program, config_names
+from .autoshard import (
+    build_plan_doc,
+    diff_plans,
+    load_plan,
+    plan_path,
+    run_autoshard,
+    save_plan,
+    search_config,
+    search_plans,
+)
+from .configs import (
+    BLUEPRINTS,
+    CANONICAL_CONFIGS,
+    build_program,
+    config_names,
+    searchable_config_names,
+)
+from .cost import CostBreakdown, CostWeights, score_program
 from .lint import Finding, lint_program
 from .manifest import (
     MANIFEST_SCHEMA,
@@ -42,21 +68,33 @@ from .runner import analyze_program, run_shardlint
 from .trace import CollectiveSite, TraceFacts, collect_trace
 
 __all__ = [
+    "BLUEPRINTS",
     "CANONICAL_CONFIGS",
     "CollectiveSite",
+    "CostBreakdown",
+    "CostWeights",
     "Finding",
     "MANIFEST_SCHEMA",
     "TraceFacts",
     "analyze_program",
     "build_manifest",
+    "build_plan_doc",
     "build_program",
     "collect_trace",
     "config_names",
     "default_manifest_dir",
     "diff_manifests",
+    "diff_plans",
     "lint_program",
     "load_manifest",
+    "load_plan",
     "manifest_path",
+    "plan_path",
+    "run_autoshard",
     "run_shardlint",
     "save_manifest",
+    "save_plan",
+    "score_program",
+    "search_config",
+    "search_plans",
 ]

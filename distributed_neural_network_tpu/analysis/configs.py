@@ -56,8 +56,21 @@ def _require_devices(n: int):
         )
 
 
+# config name -> the structured recipe behind its builder: family, mesh
+# factors, optimizer, extra step kwargs. The autoshard search
+# (analysis/autoshard.py) re-builds the same program at OTHER mesh
+# factorizations from these, so search candidates can never drift from
+# what shardlint traces.
+BLUEPRINTS: dict = {}
+
+
 def _lm(name, *, dp=4, sp=1, tp=1, optimizer="sgd", **kw):
     from ..train import lm as lmtrain
+
+    BLUEPRINTS[name] = {
+        "family": "lm", "dp": dp, "sp": sp, "tp": tp,
+        "optimizer": optimizer, "kwargs": dict(kw),
+    }
 
     def build():
         _require_devices(dp * sp * tp)
@@ -74,6 +87,12 @@ def _lm(name, *, dp=4, sp=1, tp=1, optimizer="sgd", **kw):
 
 def _pp(name, *, dp=2, pp=2, optimizer="sgd", **kw):
     from ..parallel import pipeline as ppl
+
+    BLUEPRINTS[name] = {
+        "family": "pp", "dp": dp, "pp": pp, "tp": 1,
+        "optimizer": optimizer,
+        "kwargs": dict(kw, n_microbatches=2),
+    }
 
     def build():
         _require_devices(dp * pp)
@@ -165,6 +184,14 @@ CANONICAL_CONFIGS = {
 
 def config_names() -> list:
     return list(CANONICAL_CONFIGS)
+
+
+def searchable_config_names() -> list:
+    """Configs the autoshard search covers: the lm/pp TRAINING steps,
+    whose mesh factorization is a free choice. The CNN engine's programs
+    (batch-axis only) and the reshard transfer program (mesh fixed by the
+    checkpoint) have nothing to search over."""
+    return [n for n, bp in BLUEPRINTS.items() if bp["family"] in ("lm", "pp")]
 
 
 def build_program(name: str):

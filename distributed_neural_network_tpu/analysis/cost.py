@@ -1,0 +1,343 @@
+"""Static cost model: score a sharding plan from its abstract trace.
+
+Input: a `StepProgram` (train/program.py) and the `TraceFacts` the
+shardlint tracer (trace.py) computed for it - collective op/axes/bytes
+with static multiplicity, scan-carry footprints, donation coverage. All
+of it exists WITHOUT executing anything, which is what makes the
+autoshard search (autoshard.py) cheap: scoring a candidate costs one
+``jax.make_jaxpr`` trace, never a compile or a device.
+
+The score (lower is better) combines four terms:
+
+1. **Collective wire bytes.** Each static site's logical payload bytes
+   (trace.py byte convention: input avals, except all_gather which counts
+   its output) are converted to per-device wire bytes with the standard
+   ring factors over the site's axis group size n = prod(mesh[axis]):
+   psum (ring all-reduce) 2(n-1)/n, all_gather / reduce_scatter /
+   all_to_all (n-1)/n, ppermute 1. Dynamic (while-loop) sites have no
+   static trip count; they are surfaced in the breakdown but excluded
+   from the score, matching the manifest convention.
+2. **Per-device peak state bytes** vs an HBM budget: params + optimizer
+   state sharded per the plan's PartitionSpecs (each leaf's bytes divided
+   by the product of its spec's axis sizes) + the largest scan carry.
+   Over budget = infeasible (the search prunes it); under budget a small
+   pressure term still prefers leaner layouts.
+3. **Donation coverage.** Un-donated state doubles its peak bytes during
+   the step; the undonated fraction of state bytes is charged at
+   ``donation_weight``.
+4. **Replication-leak penalty.** A ZeRO overlap plan whose in-scan
+   gradient carry is not O(D/dp) (lint.py's leak threshold: carry >= D/2)
+   is charged the full leaked bytes - such a plan must never outrank a
+   correctly sharded one.
+
+On jax builds that trace through the pre-vma compat path
+(``compat.trace_mode() == "compat"``), the typed-autodiff gradient psums
+of `grad_sync="end"` steps are INVISIBLE in the trace. The model adds
+them analytically (replicated param-leaf bytes, psum ring factor over
+the sync axes) so end-sync data parallelism is never scored as free; on
+native traces the same psums appear in `TraceFacts` and the analytic
+term stays zero - never both.
+
+`predicted_collective_bytes` (the logical per-step total) is by
+construction EQUAL to the shardlint manifest's ``total_collective_bytes``
+for the same config - one `TraceFacts` source, pinned by test.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class CostWeights:
+    """Weights/budget for `score_program`. Defaults favour wire bytes as
+    the primary signal (the quantity manifests already pin) with memory
+    as a feasibility gate plus a mild pressure term."""
+
+    wire_weight: float = 1.0  # per wire byte moved per step
+    mem_weight: float = 0.01  # per peak state byte per device
+    donation_weight: float = 0.5  # per un-donated state byte
+    leak_weight: float = 4.0  # per leaked (unsharded ZeRO carry) byte
+    hbm_bytes: int = 16 * 2**30  # per-device budget (v5e-class default)
+
+
+# ring wire factor per logical payload byte, by op, for axis group size n
+def wire_factor(op: str, n: int) -> float:
+    if n <= 1:
+        return 0.0
+    if op == "psum":
+        return 2.0 * (n - 1) / n
+    if op in ("all_gather", "reduce_scatter", "all_to_all"):
+        return (n - 1) / n
+    if op == "ppermute":
+        return 1.0
+    return 1.0
+
+
+@dataclass
+class CostBreakdown:
+    """One plan's score with every term exposed (the --explain payload)."""
+
+    plan: str
+    mesh: dict
+    feasible: bool = True
+    infeasible_reason: str = ""
+    # term 1: collectives
+    collective_bytes: int = 0  # logical, static sites == manifest total
+    dynamic_collective_bytes: int = 0  # per while-iteration, unscored
+    wire_bytes: float = 0.0  # ring-weighted, traced sites
+    wire_bytes_by_axes: dict = field(default_factory=dict)
+    untraced_grad_sync_bytes: float = 0.0  # analytic compat-trace term
+    # term 2: memory
+    param_bytes_per_device: int = 0
+    opt_bytes_per_device: int = 0
+    scan_carry_bytes: int = 0
+    peak_state_bytes: int = 0
+    hbm_bytes: int = 0
+    # term 3: donation
+    state_bytes_total: int = 0
+    undonated_state_bytes: int = 0
+    # term 4: leak
+    leaked_carry_bytes: int = 0
+    score: float = float("inf")
+
+    def why(self) -> str:
+        """Human-readable breakdown, one line per term."""
+        if not self.feasible:
+            return (
+                f"{self.plan}: INFEASIBLE - {self.infeasible_reason}"
+            )
+        lines = [
+            f"{self.plan}: score {self.score:,.1f}",
+            f"  wire bytes/step      {self.wire_bytes:>14,.1f}  "
+            f"(logical {self.collective_bytes:,} B over "
+            + (
+                ", ".join(
+                    f"{'+'.join(a) or 'local'}: {b:,.1f}"
+                    for a, b in sorted(self.wire_bytes_by_axes.items())
+                )
+                or "no collectives"
+            )
+            + ")",
+        ]
+        if self.untraced_grad_sync_bytes:
+            lines.append(
+                f"  + grad-sync (analytic) {self.untraced_grad_sync_bytes:>12,.1f}  "
+                "(end-sync psums invisible to the compat trace)"
+            )
+        if self.dynamic_collective_bytes:
+            lines.append(
+                f"  dynamic bytes/iter   {self.dynamic_collective_bytes:>14,}  "
+                "(while-loop sites, excluded from the score)"
+            )
+        lines.append(
+            f"  peak state B/device  {self.peak_state_bytes:>14,}  "
+            f"(params {self.param_bytes_per_device:,} + opt "
+            f"{self.opt_bytes_per_device:,} + carry "
+            f"{self.scan_carry_bytes:,}; budget {self.hbm_bytes:,})"
+        )
+        if self.undonated_state_bytes:
+            lines.append(
+                f"  un-donated state B   {self.undonated_state_bytes:>14,}  "
+                "(double-buffered during the step)"
+            )
+        if self.leaked_carry_bytes:
+            lines.append(
+                f"  ZeRO leak penalty B  {self.leaked_carry_bytes:>14,}  "
+                "(in-scan carry not O(D/dp))"
+            )
+        return "\n".join(lines)
+
+
+def sharded_leaf_bytes(avals, specs, mesh_axes) -> int:
+    """Per-device bytes of an abstract state tree under a spec tree: each
+    leaf's bytes divided by the product of its spec's axis sizes (the
+    spec may be a pytree prefix, shard_map's broadcast rule)."""
+    import jax
+    from jax.sharding import PartitionSpec
+
+    def is_spec(s):
+        return isinstance(s, PartitionSpec)
+
+    spec_leaves, treedef = jax.tree_util.tree_flatten(specs, is_leaf=is_spec)
+    aval_groups = treedef.flatten_up_to(avals)
+    total = 0
+    for spec, group in zip(spec_leaves, aval_groups):
+        shards = 1
+        for entry in tuple(spec):
+            if entry is None:
+                continue
+            for a in (entry,) if isinstance(entry, str) else tuple(entry):
+                shards *= int(mesh_axes.get(a, 1))
+        for leaf in jax.tree_util.tree_leaves(group):
+            if not hasattr(leaf, "shape"):
+                continue
+            nbytes = int(
+                np.prod(leaf.shape, dtype=np.int64)
+            ) * np.dtype(leaf.dtype).itemsize
+            total += -(-nbytes // shards)  # ceil: padding is real memory
+    return total
+
+
+def replicated_param_bytes(program) -> int:
+    """Bytes of param leaves whose spec is fully replicated (no mesh axis
+    named) - the leaves whose end-sync gradients psum over the sync axes."""
+    import jax
+    from jax.sharding import PartitionSpec
+
+    specs = (program.specs or {}).get("params")
+    if specs is None or not program.abstract_args:
+        return 0
+
+    def is_spec(s):
+        return isinstance(s, PartitionSpec)
+
+    spec_leaves, treedef = jax.tree_util.tree_flatten(specs, is_leaf=is_spec)
+    aval_groups = treedef.flatten_up_to(program.abstract_args[0])
+    total = 0
+    for spec, group in zip(spec_leaves, aval_groups):
+        if any(e is not None for e in tuple(spec)):
+            continue
+        for leaf in jax.tree_util.tree_leaves(group):
+            if hasattr(leaf, "shape"):
+                total += int(
+                    np.prod(leaf.shape, dtype=np.int64)
+                ) * np.dtype(leaf.dtype).itemsize
+    return total
+
+
+def untraced_grad_sync_wire_bytes(program, facts) -> float:
+    """Analytic wire bytes of the end-sync gradient psums the COMPAT trace
+    cannot see (pre-vma jax traces no typed-autodiff psums). Zero on
+    native traces (the psums are in `facts`), zero under overlap sync
+    (its collectives are explicit and traced), zero when no sync axis has
+    size > 1."""
+    from .. import compat
+
+    if compat.trace_mode() != "compat":
+        return 0.0
+    meta = program.meta or {}
+    if meta.get("family") not in ("lm", "pp"):
+        return 0.0
+    if meta.get("grad_sync") == "overlap" and int(meta.get("accum_steps", 1)) > 1:
+        return 0.0
+    mesh_axes = dict(program.mesh.shape)
+    sync_axes = [
+        a for a in (meta.get("sync_axes") or []) if mesh_axes.get(a, 1) > 1
+    ]
+    if not sync_axes:
+        return 0.0
+    n = 1
+    for a in sync_axes:
+        n *= int(mesh_axes[a])
+    rep = replicated_param_bytes(program)
+    if str(meta.get("optimizer", "")).startswith("zero"):
+        # ZeRO end-sync reduces with reduce_scatter + all_gather instead
+        # of a full all-reduce; same (n-1)/n each way = same 2(n-1)/n
+        # total, so the psum factor is the right analytic stand-in
+        pass
+    return rep * wire_factor("psum", n)
+
+
+def score_program(program, facts, weights: CostWeights | None = None,
+                  plan: str | None = None) -> CostBreakdown:
+    """Score one traced plan. Never raises on a scoreable program; memory
+    over budget marks the breakdown infeasible (score stays +inf)."""
+    w = weights or CostWeights()
+    mesh_axes = {str(k): int(v) for k, v in program.mesh.shape.items()}
+    bd = CostBreakdown(
+        plan=plan or program.name, mesh=mesh_axes, hbm_bytes=int(w.hbm_bytes)
+    )
+
+    # --- term 1: collectives -------------------------------------------
+    bd.collective_bytes = facts.total_collective_bytes()
+    bd.dynamic_collective_bytes = facts.dynamic_collective_bytes_per_iter()
+    by_axes = {}
+    for c in facts.collectives:
+        if c.dynamic:
+            continue
+        n = 1
+        for a in c.axes:
+            n *= int(mesh_axes.get(a, 1))
+        wb = c.total_bytes * wire_factor(c.op, n)
+        bd.wire_bytes += wb
+        by_axes[c.axes] = by_axes.get(c.axes, 0.0) + wb
+    bd.wire_bytes_by_axes = by_axes
+    bd.untraced_grad_sync_bytes = untraced_grad_sync_wire_bytes(
+        program, facts
+    )
+
+    # --- term 2: memory -------------------------------------------------
+    args = program.abstract_args
+    specs = program.specs or {}
+    if args and "params" in specs:
+        bd.param_bytes_per_device = sharded_leaf_bytes(
+            args[0], specs["params"], mesh_axes
+        )
+    if len(args) > 1 and "opt" in specs:
+        bd.opt_bytes_per_device = sharded_leaf_bytes(
+            args[1], specs["opt"], mesh_axes
+        )
+    bd.scan_carry_bytes = int(facts.scan_carry_max_bytes)
+    bd.peak_state_bytes = (
+        bd.param_bytes_per_device + bd.opt_bytes_per_device
+        + bd.scan_carry_bytes
+    )
+    if bd.peak_state_bytes > w.hbm_bytes:
+        bd.feasible = False
+        bd.infeasible_reason = (
+            f"peak state {bd.peak_state_bytes:,} B/device exceeds the HBM "
+            f"budget {int(w.hbm_bytes):,} B (params "
+            f"{bd.param_bytes_per_device:,} + optimizer "
+            f"{bd.opt_bytes_per_device:,} + scan carry "
+            f"{bd.scan_carry_bytes:,})"
+        )
+        return bd
+
+    # --- term 3: donation ----------------------------------------------
+    bd.state_bytes_total = bd.param_bytes_per_device + bd.opt_bytes_per_device
+    donated = facts.donated_invars
+    if donated is not None and program.donate:
+        counts = program.arg_leaf_counts()
+        if sum(counts) == len(donated):
+            offsets = [0]
+            for cnt in counts:
+                offsets.append(offsets[-1] + cnt)
+            state_bytes = [bd.param_bytes_per_device, bd.opt_bytes_per_device]
+            for argnum in program.donate:
+                if argnum >= len(counts) or argnum >= len(state_bytes):
+                    continue
+                flags = donated[offsets[argnum]:offsets[argnum + 1]]
+                if flags and not all(flags):
+                    frac = 1.0 - sum(flags) / len(flags)
+                    bd.undonated_state_bytes += int(
+                        state_bytes[argnum] * frac
+                    )
+    elif donated is None and program.donate:
+        # no jit boundary found: charge the full state conservatively
+        bd.undonated_state_bytes = bd.state_bytes_total
+
+    # --- term 4: ZeRO replication leak ----------------------------------
+    meta = program.meta or {}
+    if (
+        str(meta.get("optimizer", "")).startswith("zero")
+        and meta.get("grad_sync") == "overlap"
+        and int(meta.get("accum_steps", 1)) > 1
+    ):
+        dp = int(meta.get("dp", 1))
+        d_bytes = program.param_bytes()
+        carry = facts.reduce_scatter_carry_bytes
+        if carry is None:
+            bd.leaked_carry_bytes = d_bytes  # schedule not running at all
+        elif dp > 1 and carry >= d_bytes // 2:
+            bd.leaked_carry_bytes = carry - d_bytes // dp
+
+    bd.score = (
+        w.wire_weight * (bd.wire_bytes + bd.untraced_grad_sync_bytes)
+        + w.mem_weight * bd.peak_state_bytes
+        + w.donation_weight * bd.undonated_state_bytes
+        + w.leak_weight * bd.leaked_carry_bytes
+    )
+    return bd

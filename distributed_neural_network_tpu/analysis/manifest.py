@@ -57,6 +57,9 @@ def build_manifest(program, facts) -> dict:
         ],
         "collective_totals": facts.op_totals(),
         "total_collective_bytes": facts.total_collective_bytes(),
+        "dynamic_collective_bytes_per_iter": (
+            facts.dynamic_collective_bytes_per_iter()
+        ),
         "upcasts": {
             k: dict(v) for k, v in sorted(facts.upcasts.items())
         },
@@ -152,6 +155,18 @@ def diff_manifests(expected: dict, actual: dict) -> list:
                             for m in msgs):
         msgs.append(
             f"total collective bytes changed: {eb:,} -> {ab:,} per step"
+        )
+    # dynamic (while-loop) sites are excluded from the per-step total and
+    # compared on their own per-iteration figure, so a decode-style loop
+    # can never zero a manifest silently (older manifests lack the key)
+    edyn = expected.get("dynamic_collective_bytes_per_iter", 0) or 0
+    adyn = actual.get("dynamic_collective_bytes_per_iter", 0) or 0
+    if edyn != adyn and not any(
+        m.startswith(("EXTRA", "MISSING", "collective")) for m in msgs
+    ):
+        msgs.append(
+            f"dynamic (while-loop) collective bytes changed: {edyn:,} -> "
+            f"{adyn:,} per loop iteration"
         )
     ed, ad = expected.get("donation") or {}, actual.get("donation") or {}
     if ed != ad:

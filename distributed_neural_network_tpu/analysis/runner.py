@@ -49,16 +49,44 @@ def analyze_program(program) -> AnalysisResult:
     )
 
 
+def explain_sites(facts) -> list:
+    """Per-collective-site provenance table lines: op, axes, bytes/call,
+    static multiplicity, dynamic flag, and WHERE the site lives (the
+    jaxpr nesting recorded by the tracer) - ``shardlint --explain``."""
+    if not facts.sites:
+        return ["    (no collective sites)"]
+    lines = [
+        f"    {'op':<16} {'axes':<12} {'B/call':>10} {'count':>6} "
+        f"{'dyn':>4}  where"
+    ]
+    for c in facts.sites:
+        lines.append(
+            f"    {c.op:<16} {','.join(c.axes) or '-':<12} "
+            f"{c.bytes_per_call:>10,} {c.count:>6} "
+            f"{'yes' if c.dynamic else '-':>4}  {c.path or '(top level)'}"
+        )
+    dyn = facts.dynamic_collective_bytes_per_iter()
+    if dyn:
+        lines.append(
+            f"    dynamic sites move {dyn:,} B per while-loop iteration "
+            "(excluded from the per-step total)"
+        )
+    return lines
+
+
 def run_shardlint(
     names=None,
     *,
     mode: str = "lint",
     manifest_dir: str | None = None,
     verbose: bool = True,
+    explain: bool = False,
 ):
     """Analyze configs; mode: 'lint' (no manifest I/O), 'write' (regenerate
     manifests), 'check' (diff against checked-in manifests). Returns
-    (exit_code, report_str)."""
+    (exit_code, report_str). ``explain=True`` prints the per-site
+    provenance table (op, axes, bytes, multiplicity, enclosing jaxprs)
+    instead of the merged per-collective summary."""
     if mode not in ("lint", "write", "check"):
         raise ValueError(f"mode must be lint/write/check, got {mode!r}")
     names = list(names) if names else config_names()
@@ -85,7 +113,10 @@ def run_shardlint(
             f"call(s), {facts.total_collective_bytes():,} B/step, "
             f"{len(result.findings)} finding(s) [{dt:.1f}s]"
         )
-        if verbose:
+        if explain:
+            lines.append(summary)
+            lines.extend(explain_sites(facts))
+        elif verbose:
             lines.append(summary)
             for c in facts.collectives:
                 dyn = " DYNAMIC" if c.dynamic else ""

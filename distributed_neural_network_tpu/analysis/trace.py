@@ -7,7 +7,14 @@ descend - and returns `TraceFacts`:
 - every collective primitive (psum / all_gather / reduce_scatter /
   ppermute / all_to_all) with its mesh axes, per-call payload bytes, and
   STATIC multiplicity (scan bodies multiply by trip count; while bodies
-  have no static count and are flagged ``dynamic``); ``pbroadcast`` /
+  have no static count and are flagged ``dynamic`` - their bytes are
+  reported PER ITERATION via ``dynamic_collective_bytes_per_iter`` and
+  excluded from ``total_collective_bytes``, so a while-based decode loop
+  can neither inflate nor silently zero out a per-step manifest total);
+  each site additionally carries its provenance ``path`` (the jaxpr
+  nesting it lives under, e.g. ``pjit/shard_map/scan[x4]`` - what
+  ``tools/shardlint.py --explain`` prints), with the manifest-pinned
+  ``collectives`` view merged across paths; ``pbroadcast`` /
   ``pcast`` are type casts that move no data and are not counted;
 - every float-widening ``convert_element_type`` (bf16->f32, f32->f64, ...)
   with the same multiplicity accounting, plus any f64 result anywhere;
@@ -55,6 +62,7 @@ class CollectiveSite:
     bytes_per_call: int
     count: int  # static multiplicity (scan trip counts folded in)
     dynamic: bool = False  # under a while loop: count is per-iteration
+    path: str = ""  # provenance: jaxpr nesting, e.g. "pjit/shard_map/scan[x4]"
 
     @property
     def total_bytes(self) -> int:
@@ -64,6 +72,7 @@ class CollectiveSite:
 @dataclass
 class TraceFacts:
     collectives: list = field(default_factory=list)  # CollectiveSite, merged
+    sites: list = field(default_factory=list)  # CollectiveSite, per call path
     upcasts: dict = field(default_factory=dict)  # "bf16->f32" -> {count, bytes}
     f64_sites: int = 0
     scan_carry_max_bytes: int = 0
@@ -74,7 +83,18 @@ class TraceFacts:
     has_dynamic_loop: bool = False
 
     def total_collective_bytes(self) -> int:
-        return sum(c.total_bytes for c in self.collectives)
+        """Per-step bytes over STATIC sites only. Sites under a while loop
+        (``dynamic=True``) have no static trip count - their per-iteration
+        bytes are a separate figure (`dynamic_collective_bytes_per_iter`),
+        never silently folded into (or zeroed out of) the per-step
+        total a manifest pins."""
+        return sum(c.total_bytes for c in self.collectives if not c.dynamic)
+
+    def dynamic_collective_bytes_per_iter(self) -> int:
+        """Bytes PER LOOP ITERATION of collectives under a while loop
+        (e.g. a token-by-token decode loop); the trip count is runtime
+        data, so there is no static per-step total for these."""
+        return sum(c.total_bytes for c in self.collectives if c.dynamic)
 
     def op_totals(self) -> dict:
         out = {}
@@ -149,9 +169,10 @@ def collect_trace(closed_jaxpr) -> TraceFacts:
         facts.donated_invars = tuple(best.params["donated_invars"])
         facts.out_avals = [getattr(v, "aval", None) for v in best.outvars]
 
-    raw = defaultdict(int)  # (op, axes, bytes, dynamic) -> count
+    # (op, axes, bytes, dynamic, provenance path) -> count
+    raw = defaultdict(int)
 
-    def walk(jaxpr, mult: int, dynamic: bool):
+    def walk(jaxpr, mult: int, dynamic: bool, path: str):
         for eqn in jaxpr.eqns:
             name = eqn.primitive.name
             op = COLLECTIVE_PRIMS.get(name)
@@ -160,7 +181,7 @@ def collect_trace(closed_jaxpr) -> TraceFacts:
                     nbytes = sum(_aval_bytes(v) for v in eqn.outvars)
                 else:
                     nbytes = sum(_aval_bytes(v) for v in eqn.invars)
-                raw[(op, _axes_of(eqn.params), nbytes, dynamic)] += mult
+                raw[(op, _axes_of(eqn.params), nbytes, dynamic, path)] += mult
             elif name == "convert_element_type":
                 src_aval = getattr(eqn.invars[0], "aval", None)
                 src = _np_dtype(getattr(src_aval, "dtype", None))
@@ -196,27 +217,50 @@ def collect_trace(closed_jaxpr) -> TraceFacts:
                 if _contains_op(body, "reduce_scatter"):
                     prev = facts.reduce_scatter_carry_bytes or 0
                     facts.reduce_scatter_carry_bytes = max(prev, carry)
-                walk(body, mult * int(eqn.params["length"]), dynamic)
+                length = int(eqn.params["length"])
+                walk(
+                    body, mult * length, dynamic,
+                    _join(path, f"scan[x{length}]"),
+                )
             elif name == "while":
                 facts.has_dynamic_loop = True
                 for sub, _ in _sub_jaxprs(eqn):
-                    walk(sub, mult, True)
+                    walk(sub, mult, True, _join(path, "while"))
             else:
                 for sub, _ in _sub_jaxprs(eqn):
-                    walk(sub, mult, dynamic)
+                    walk(sub, mult, dynamic, _join(path, name))
 
-    walk(top, 1, False)
+    walk(top, 1, False, "")
+    facts.sites = sorted(
+        (
+            CollectiveSite(
+                op=op, axes=axes, bytes_per_call=nbytes, count=count,
+                dynamic=dyn, path=path,
+            )
+            for (op, axes, nbytes, dyn, path), count in raw.items()
+        ),
+        key=lambda c: (c.op, c.axes, -c.bytes_per_call, c.dynamic, c.path),
+    )
+    # merged view (stable across refactors that only move a site between
+    # enclosing jaxprs) - what manifests pin; `sites` keeps provenance
+    merged = defaultdict(int)
+    for c in facts.sites:
+        merged[(c.op, c.axes, c.bytes_per_call, c.dynamic)] += c.count
     facts.collectives = sorted(
         (
             CollectiveSite(
                 op=op, axes=axes, bytes_per_call=nbytes, count=count,
                 dynamic=dyn,
             )
-            for (op, axes, nbytes, dyn), count in raw.items()
+            for (op, axes, nbytes, dyn), count in merged.items()
         ),
         key=lambda c: (c.op, c.axes, -c.bytes_per_call, c.dynamic),
     )
     return facts
+
+
+def _join(path: str, label: str) -> str:
+    return f"{path}/{label}" if path else label
 
 
 def _contains_op(jaxpr, op: str) -> bool:

@@ -167,57 +167,55 @@ def _stack_layers(layers):
     return jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
 
 
+def param_skeleton(cfg: TransformerConfig):
+    """The param tree's STRUCTURE (same keys as `init_params`, placeholder
+    leaves) - what the partition-rule matcher walks when no real params
+    exist yet. Kept next to `init_params` so the two can never drift."""
+    layer_keys = [
+        "ln1_scale", "ln1_bias", "wq", "wk", "wv", "wo",
+        "ln2_scale", "ln2_bias",
+    ]
+    if cfg.n_experts:
+        layer_keys += ["wr", "w1", "b1", "w2", "b2"]
+    else:
+        layer_keys += ["w1", "b1", "w2", "b2"]
+    return {
+        "embed": 0,
+        "lnf_scale": 0,
+        "lnf_bias": 0,
+        "head": 0,
+        "layers": {k: 0 for k in layer_keys},
+    }
+
+
 def param_specs(
     cfg: TransformerConfig,
     tp_axis: str | None = None,
     ep_axis: str | None = None,
+    rules=None,
 ):
-    """PartitionSpec pytree for the param tree.
+    """PartitionSpec pytree for the param tree, derived from the
+    declarative rule table (`parallel/rules.py lm_partition_rules`).
 
     With `tp_axis`: wq/wk/wv and w1 column-sharded (heads / ff-hidden split),
     wo and w2 row-sharded (psum after), b1 sharded with its columns;
     everything else replicated. Without: fully replicated. With
     `cfg.n_experts > 0` and `ep_axis`: expert tensors additionally sharded
     over the expert dimension (router replicated).
+
+    ``rules`` overrides the built-in table with a custom ordered
+    ``(regex, PartitionSpec)`` list (the ``--sharding rules:<file>``
+    path); every leaf must match or derivation fails with the path named.
     """
-    t = tp_axis
-    layer = {
-        "ln1_scale": P(),
-        "ln1_bias": P(),
-        "wq": P(None, None, t),
-        "wk": P(None, None, t),
-        "wv": P(None, None, t),
-        "wo": P(None, t, None),
-        "ln2_scale": P(),
-        "ln2_bias": P(),
-    }
-    if cfg.n_experts:
-        ep = ep_axis
-        layer.update(
-            {
-                "wr": P(),
-                "w1": P(None, ep, None, t),
-                "b1": P(None, ep, t),
-                "w2": P(None, ep, t, None),
-                "b2": P(None, ep, None),
-            }
+    from ..parallel.rules import lm_partition_rules, match_partition_rules
+
+    if rules is None:
+        rules = lm_partition_rules(
+            tp_axis=tp_axis, ep_axis=ep_axis, n_experts=cfg.n_experts
         )
-    else:
-        layer.update(
-            {
-                "w1": P(None, None, t),
-                "b1": P(None, t),
-                "w2": P(None, t, None),
-                "b2": P(),
-            }
-        )
-    return {
-        "embed": P(),
-        "lnf_scale": P(),
-        "lnf_bias": P(),
-        "head": P(),
-        "layers": layer,
-    }
+    return match_partition_rules(
+        rules, param_skeleton(cfg), skip_scalars=False
+    )
 
 
 def _layer_norm(x, scale, bias, eps=1e-5):

@@ -1,0 +1,258 @@
+"""Declarative partition rules: regex -> PartitionSpec over named trees.
+
+The sharding layout of every model family used to be a hand-written
+pytree-of-specs per scenario (`models/transformer.py param_specs`,
+`parallel/pipeline.py pp_param_specs`); each new scenario (serving, fp8,
+DrJAX sims) re-wired the same knowledge by hand. This module makes the
+layout DECLARATIVE: an ordered list of ``(regex, PartitionSpec)`` rules is
+matched against each leaf's ``/``-joined tree path, first match wins, and
+an unmatched leaf is a hard error naming the path and the rules tried -
+silence is never a layout.
+
+- `match_partition_rules(rules, tree)` - the matcher (exemplar idiom:
+  fmengine's ``match_partition_rules``), structure-preserving: returns a
+  spec pytree congruent to ``tree``.
+- `rules_to_spec_tree(rules, tree, mesh_axes)` - match + round-trip the
+  result through `partition.validate_spec_tree`, so a rule naming a
+  nonexistent mesh axis (or a non-divisible dim, when ``tree`` carries
+  shapes) fails at derivation time with the leaf path named.
+- `lm_partition_rules(...)` - THE rule set for the transformer family;
+  `transformer.param_specs` is now a thin matcher call over these rules,
+  so dp/tp/ep (and via `pipeline.pp_param_specs`, pp) all derive from one
+  declarative table.
+- `load_rules(path)` / `save_rules` / `rules_to_json` / `rules_from_json`
+  - the ``--sharding rules:<file>`` file format (a JSON list of
+  ``[pattern, spec-entries]`` pairs; spec entries use the same encoding
+  as checkpoint mesh meta, `parallel/reshard.py spec_to_json`).
+
+The static sharding search (`analysis/autoshard.py`) generates its spec
+candidates from these rules: a candidate mesh factorization activates or
+deactivates the tp/ep axes and the SAME table yields the layout, so the
+search can never propose a layout training cannot build.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+
+from jax.sharding import PartitionSpec as P
+
+SEP = "/"
+
+
+def named_leaves(tree, *, sep: str = SEP, is_leaf=None):
+    """[(path, leaf)] with dict keys / sequence indices ``sep``-joined
+    ("layers/wq", "m/layers/wq", ...) - the names the rules match."""
+    import jax
+
+    def name_of(entry) -> str:
+        key = getattr(entry, "key", None)
+        if key is not None:
+            return str(key)
+        idx = getattr(entry, "idx", None)
+        if idx is not None:
+            return str(idx)
+        name = getattr(entry, "name", None)
+        if name is not None:
+            return str(name)
+        return str(entry)
+
+    flat = jax.tree_util.tree_flatten_with_path(tree, is_leaf=is_leaf)[0]
+    return [(sep.join(name_of(e) for e in path), leaf) for path, leaf in flat]
+
+
+def match_partition_rules(
+    rules, tree, *, sep: str = SEP, skip_scalars: bool = True
+):
+    """Spec pytree for ``tree``: each leaf gets the spec of the FIRST rule
+    whose regex ``re.search``-matches its ``sep``-joined path.
+
+    ``skip_scalars=True`` (the matcher default) maps rank-0 / size-1
+    leaves to ``P()`` without consulting the rules - a scalar cannot be
+    sharded, and optimizer counters ("t") should never need a rule. An
+    unmatched non-scalar leaf raises ``ValueError`` naming the path and
+    every pattern tried; a partial layout is never returned.
+    """
+    import jax
+    import numpy as np
+
+    rules = list(rules)
+    for pattern, spec in rules:
+        if not isinstance(spec, P):
+            raise TypeError(
+                f"rule {pattern!r} maps to {spec!r} "
+                f"({type(spec).__name__}), not a PartitionSpec - build "
+                "rules as (regex, PartitionSpec) pairs (load_rules decodes "
+                "the JSON form)"
+            )
+
+    def spec_for(name, leaf):
+        if skip_scalars and hasattr(leaf, "shape"):
+            if len(leaf.shape) == 0 or int(np.prod(leaf.shape)) == 1:
+                return P()
+        for pattern, spec in rules:
+            if re.search(pattern, name) is not None:
+                return spec
+        raise ValueError(
+            f"no partition rule matches leaf {name!r} - every leaf must "
+            "be matched (first-match-wins over "
+            f"{[p for p, _ in rules]!r}); add a rule, or a catch-all "
+            "('.*', PartitionSpec()) for replicated leftovers"
+        )
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    names = [name for name, _ in named_leaves(tree, sep=sep)]
+    return jax.tree_util.tree_unflatten(
+        treedef, [spec_for(n, x) for n, x in zip(names, leaves)]
+    )
+
+
+def rules_to_spec_tree(
+    rules, tree, mesh_axes, *, root: str = "params", sep: str = SEP,
+    skip_scalars: bool = True,
+):
+    """`match_partition_rules` + `partition.validate_spec_tree`: the spec
+    pytree, already validated against the mesh axes (and against the
+    leaves' shapes when ``tree`` carries arrays/avals), failing with the
+    leaf path named. This is the round-trip every rules file goes through
+    before a step is built."""
+    from .partition import validate_spec_tree
+
+    specs = match_partition_rules(
+        rules, tree, sep=sep, skip_scalars=skip_scalars
+    )
+    has_shapes = any(
+        hasattr(leaf, "shape") for _, leaf in named_leaves(tree, sep=sep)
+    )
+    validate_spec_tree(
+        specs, dict(mesh_axes), shapes=tree if has_shapes else None,
+        root=root,
+    )
+    return specs
+
+
+# ------------------------------------------------------ the LM rule table
+
+
+def lm_partition_rules(
+    *,
+    tp_axis: str | None = None,
+    ep_axis: str | None = None,
+    n_experts: int = 0,
+):
+    """The transformer family's declarative layout, one table for every
+    scenario: dp-only (both axes None -> everything effectively
+    replicated), tensor parallel (``tp_axis``: wq/wk/wv and w1
+    column-sharded, wo/w2 row-sharded, b1 with its columns), expert
+    parallel (``ep_axis`` shards the expert dim of MoE leaves; the router
+    stays replicated). Leaf paths are the stacked param-tree names
+    ("layers/wq" etc. - leading dim is the scanned layer axis).
+
+    `transformer.param_specs` matches these against the param skeleton,
+    so the table IS the layout training, checkpointing, and the static
+    analyzer all share.
+    """
+    t = tp_axis
+    rules = [
+        (r"^embed$", P()),
+        (r"^head$", P()),
+        # every norm leaf: ln1_*/ln2_* in layers, lnf_* at the root
+        (r"(^|/)ln[0-9a-z]*_(scale|bias)$", P()),
+        (r"(^|/)w[qkv]$", P(None, None, t)),
+        (r"(^|/)wo$", P(None, t, None)),
+    ]
+    if n_experts:
+        ep = ep_axis
+        rules += [
+            (r"(^|/)wr$", P()),
+            (r"(^|/)w1$", P(None, ep, None, t)),
+            (r"(^|/)b1$", P(None, ep, t)),
+            (r"(^|/)w2$", P(None, ep, t, None)),
+            (r"(^|/)b2$", P(None, ep, None)),
+        ]
+    else:
+        rules += [
+            (r"(^|/)w1$", P(None, None, t)),
+            (r"(^|/)b1$", P(None, t)),
+            (r"(^|/)w2$", P(None, t, None)),
+            (r"(^|/)b2$", P()),
+        ]
+    return rules
+
+
+# --------------------------------------------------- rules-file (de)serde
+
+
+def rules_to_json(rules) -> list:
+    """[[pattern, spec-entries], ...] - the ``--sharding rules:<file>``
+    document (spec encoding shared with checkpoint mesh meta)."""
+    from .reshard import spec_to_json
+
+    return [[pattern, spec_to_json(spec)] for pattern, spec in rules]
+
+
+def rules_from_json(doc) -> list:
+    from .reshard import spec_from_json
+
+    if not isinstance(doc, list):
+        raise ValueError(
+            f"a rules document is a JSON list of [pattern, spec] pairs, "
+            f"got {type(doc).__name__}"
+        )
+    rules = []
+    for i, entry in enumerate(doc):
+        if (
+            not isinstance(entry, (list, tuple)) or len(entry) != 2
+            or not isinstance(entry[0], str)
+            or not isinstance(entry[1], list)
+        ):
+            raise ValueError(
+                f"rules entry {i} must be [pattern, [spec entries...]], "
+                f"got {entry!r}"
+            )
+        pattern, spec = entry
+        try:
+            re.compile(pattern)
+        except re.error as e:
+            raise ValueError(
+                f"rules entry {i}: pattern {pattern!r} is not a valid "
+                f"regex: {e}"
+            ) from None
+        rules.append((pattern, spec_from_json(spec)))
+    return rules
+
+
+def save_rules(rules, path: str) -> str:
+    with open(path, "w") as f:
+        json.dump(rules_to_json(rules), f, indent=2)
+        f.write("\n")
+    return path
+
+
+def load_rules(path: str) -> list:
+    """Parse a ``--sharding rules:<file>`` JSON document into rule pairs,
+    with file/parse errors naming the path."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except FileNotFoundError:
+        raise FileNotFoundError(
+            f"rules file {path!r} does not exist (--sharding rules:<file> "
+            "expects a JSON list of [pattern, spec] pairs; write one with "
+            "parallel/rules.py save_rules)"
+        ) from None
+    except json.JSONDecodeError as e:
+        raise ValueError(f"rules file {path!r} is not valid JSON: {e}") from None
+    try:
+        return rules_from_json(doc)
+    except ValueError as e:
+        raise ValueError(f"rules file {path!r}: {e}") from None
+
+
+def format_rules(rules) -> str:
+    """One rule per line, for --explain output and error context."""
+    width = max((len(p) for p, _ in rules), default=0)
+    return "\n".join(
+        f"  {pattern:<{width}}  ->  {spec}" for pattern, spec in rules
+    )

@@ -272,6 +272,17 @@ def add_distributed_flags(p: argparse.ArgumentParser, *, nb_proc: int = 4):
         help="N-1 compute workers at --nb-proc N, as the reference's idle-parent "
         "topology (default: all N devices train)",
     )
+    p.add_argument(
+        "--sharding",
+        choices=("manual", "auto"),
+        default="manual",
+        help="auto derives --nb-proc statically instead of taking it as "
+        "given: the largest worker count that fits the visible devices "
+        "AND divides the global batch (the engine's divisibility "
+        "contract; analysis/autoshard.py auto_nb_proc) - the CNN "
+        "engine's one free sharding choice, decided by the same "
+        "declarative layer the LM mesh search uses",
+    )
     return p
 
 
@@ -368,6 +379,18 @@ def run_training(args, regime: str, *, log=print) -> Engine:
                 "cache config; --compilation-cache-dir ignored)"
             )
             cache_dir = None
+    if getattr(args, "sharding", "manual") == "auto":
+        import jax
+
+        from ..analysis.autoshard import auto_nb_proc
+
+        chosen = auto_nb_proc(args.bs, jax.device_count())
+        log(
+            f"(--sharding auto: nb_proc {getattr(args, 'nb_proc', None)} "
+            f"-> {chosen}: largest worker count dividing batch {args.bs} "
+            f"on {jax.device_count()} device(s))"
+        )
+        args.nb_proc = chosen
     cfg = config_from_args(args, regime)
     timers = T.PhaseTimers()
 

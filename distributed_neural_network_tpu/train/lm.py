@@ -55,6 +55,21 @@ def create_lm_mesh(dp: int, sp: int, tp: int = 1) -> Mesh:
     return Mesh(arr, (DATA_AXIS, SEQ_AXIS, TP_AXIS))
 
 
+def _named_spec_leaves(specs):
+    """[(path, spec)] over a spec pytree (rules-file diagnostics)."""
+    from jax.sharding import PartitionSpec
+
+    from ..parallel.rules import named_leaves
+
+    return [
+        (path, s)
+        for path, s in named_leaves(
+            specs, is_leaf=lambda s: isinstance(s, PartitionSpec)
+        )
+        if isinstance(s, PartitionSpec)
+    ]
+
+
 def _ep_axis(cfg, mesh: Mesh) -> str | None:
     """Experts shard over the data axis (GShard convention) when present."""
     dp = mesh.shape.get(DATA_AXIS, 1)
@@ -69,10 +84,14 @@ def _ep_axis(cfg, mesh: Mesh) -> str | None:
     return None
 
 
-def shard_params(params, cfg, mesh: Mesh):
-    """Place a replicated-layout param tree onto the mesh per param_specs."""
+def shard_params(params, cfg, mesh: Mesh, rules=None):
+    """Place a replicated-layout param tree onto the mesh per param_specs
+    (``rules`` overrides the built-in partition-rule table - the
+    ``--sharding rules:<file>`` path, parallel/rules.py)."""
     tp = TP_AXIS if mesh.shape.get(TP_AXIS, 1) > 1 else None
-    specs = tfm.param_specs(cfg, tp_axis=tp, ep_axis=_ep_axis(cfg, mesh))
+    specs = tfm.param_specs(
+        cfg, tp_axis=tp, ep_axis=_ep_axis(cfg, mesh), rules=rules
+    )
     return jax.tree.map(
         lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), params, specs
     ), specs
@@ -227,18 +246,23 @@ def init_lm_momentum(params, mesh: Mesh, optimizer: str = "sgd"):
     raise ValueError(f"unknown optimizer {optimizer!r} (use one of {OPTIMIZERS})")
 
 
-def lm_wiring(cfg: tfm.TransformerConfig, mesh: Mesh, optimizer: str = "sgd"):
+def lm_wiring(cfg: tfm.TransformerConfig, mesh: Mesh, optimizer: str = "sgd",
+              rules=None):
     """(sp, tp, ep, sync_axes, specs, mom_spec, data_spec) for a dp x sp x
     tp mesh - the single source of the axis/spec derivation shared by
     `make_lm_train_step`, `lm_step_program`, and the static analyzer
-    (analysis/). Validates every spec against the mesh's axes up front
-    (parallel/partition.py), so a bad axis name fails here with the leaf
-    and the available axes instead of deep inside pjit lowering."""
+    (analysis/). Param specs derive from the declarative partition-rule
+    table (parallel/rules.py `lm_partition_rules` via
+    `transformer.param_specs`; ``rules`` substitutes a custom ordered
+    rule list - the ``--sharding rules:<file>`` path). Validates every
+    spec against the mesh's axes up front (parallel/partition.py), so a
+    bad axis name fails here with the leaf and the available axes instead
+    of deep inside pjit lowering."""
     sp = SEQ_AXIS if mesh.shape.get(SEQ_AXIS, 1) > 1 else None
     tp = TP_AXIS if mesh.shape.get(TP_AXIS, 1) > 1 else None
     ep = _ep_axis(cfg, mesh)
     sync_axes = tuple(a for a in (DATA_AXIS, SEQ_AXIS) if a in mesh.axis_names)
-    specs = tfm.param_specs(cfg, tp_axis=tp, ep_axis=ep)
+    specs = tfm.param_specs(cfg, tp_axis=tp, ep_axis=ep, rules=rules)
     data_spec = P(DATA_AXIS, SEQ_AXIS)
     if optimizer not in OPTIMIZERS:
         raise ValueError(
@@ -251,6 +275,19 @@ def lm_wiring(cfg: tfm.TransformerConfig, mesh: Mesh, optimizer: str = "sgd"):
             f"not compatible with tp_axis={tp!r} / ep_axis={ep!r}; use "
             "'sgd'/'adam' for tensor/expert-sharded configs"
         )
+    if rules is not None and optimizer.startswith("zero"):
+        sharded = [
+            (path, s) for path, s in _named_spec_leaves(specs)
+            if any(e is not None for e in tuple(s))
+        ]
+        if sharded:
+            raise ValueError(
+                f"optimizer={optimizer!r} requires fully replicated param "
+                "specs (the flat ZeRO buffers shard over the data axis), "
+                f"but the rules file shards {sharded[0][0]!r} as "
+                f"{sharded[0][1]} ({len(sharded)} sharded leaf/leaves "
+                "total) - use 'sgd'/'adam' with sharded rules"
+            )
     mom_spec = optimizer_state_specs(optimizer, specs)
     from ..parallel.partition import validate_spec_tree
 
@@ -262,13 +299,13 @@ def lm_wiring(cfg: tfm.TransformerConfig, mesh: Mesh, optimizer: str = "sgd"):
 
 
 def make_lm_shardings(cfg: tfm.TransformerConfig, mesh: Mesh,
-                      optimizer: str = "sgd"):
+                      optimizer: str = "sgd", rules=None):
     """(specs, param_shardings, mom_shardings) for one mesh/optimizer -
     the placement triple the elastic driver (train/elastic.py) rebuilds
     whenever the mesh changes under a run (shrink/grow resume), derived
     from the same `lm_wiring` the compiled step uses so the restored
     leaves land exactly where the step expects them."""
-    specs = lm_wiring(cfg, mesh, optimizer)[4]
+    specs = lm_wiring(cfg, mesh, optimizer, rules=rules)[4]
     param_shardings = jax.tree.map(
         lambda s: NamedSharding(mesh, s), specs
     )
@@ -297,6 +334,7 @@ def make_lm_train_step(
     with_health: bool = False,
     skip_nonfinite: bool = False,
     fault_plan=None,
+    rules=None,
 ):
     """Compiled (params, mom, tokens, targets) -> (params, mom, loss).
 
@@ -360,9 +398,14 @@ def make_lm_train_step(
       for tests and the bench chaos row. Requires the step-index
       argument: the compiled fn takes (params, mom, tokens, targets,
       step) whenever a fault_plan is given, as with lr_schedule.
+    - rules: a custom ordered (regex, PartitionSpec) partition-rule list
+      replacing the built-in table (parallel/rules.py; the
+      ``--sharding rules:<file>`` path). Every param leaf must match;
+      zero optimizers additionally require the matched specs to be
+      fully replicated.
     """
     sp, tp, ep, sync_axes, specs, mom_spec, data_spec = lm_wiring(
-        cfg, mesh, optimizer
+        cfg, mesh, optimizer, rules=rules
     )
 
     if accum_steps < 1:
@@ -647,7 +690,7 @@ def lm_step_program(
         cfg, mesh, optimizer=optimizer, **step_kwargs
     )
     _, tp, ep, sync_axes, specs, mom_spec, data_spec = lm_wiring(
-        cfg, mesh, optimizer
+        cfg, mesh, optimizer, rules=step_kwargs.get("rules")
     )
     params, mom = abstract_lm_state(cfg, mesh, optimizer)
     tok = jax.ShapeDtypeStruct((batch, seq_len), jnp.int32)

@@ -42,6 +42,18 @@ def main() -> int:
                    "exclusive with --sp; composes with --experts (experts "
                    "shard over dp); zero optimizers compose with --dp, "
                    "not --tp/--experts)")
+    p.add_argument("--sharding", default="manual", metavar="MODE",
+                   help="how the partition layout is chosen (dp x sp x tp "
+                   "mesh path): 'manual' (default) shards per "
+                   "--dp/--sp/--tp with the built-in partition-rule table "
+                   "(parallel/rules.py); 'auto' runs the static cost-model "
+                   "search (analysis/autoshard.py) over every mesh "
+                   "factorization of --dp*--sp*--tp devices (or all "
+                   "visible devices when those are 1) and adopts the "
+                   "winning plan - pure abstract tracing, nothing "
+                   "executes; 'rules:<file>' loads a custom ordered "
+                   "[regex, spec] JSON rule list for the param layout "
+                   "(every leaf must match)")
     p.add_argument("--microbatches", type=int, default=2)
     p.add_argument(
         "--pp-interleave", type=int, default=1,
@@ -321,6 +333,22 @@ def main() -> int:
         p.error("--gen-temperature/--gen-top-k/--gen-top-p configure "
                 "--generate N, which was not requested - add "
                 "--generate N or drop the sampling flags")
+    if args.sharding not in ("manual", "auto") and not args.sharding.startswith(
+        "rules:"
+    ):
+        p.error(
+            f"--sharding must be 'manual', 'auto', or 'rules:<file>', got "
+            f"{args.sharding!r}"
+        )
+    if args.sharding == "rules:":
+        p.error("--sharding rules: needs a file path (rules:<file>)")
+    if args.sharding != "manual" and args.pp > 1:
+        p.error(
+            "--sharding auto/rules:<file> drive the dp x sp x tp mesh "
+            "path's partition layer (parallel/rules.py); the pipeline "
+            "path's stage sharding is fixed by --pp - drop --pp or use "
+            "--sharding manual"
+        )
     if args.ema_decay and args.pp > 1:
         p.error("--ema-decay is unused under --pp (the pipeline path has "
                 "no --eval-every/--generate consumer for the averaged "
@@ -458,6 +486,55 @@ def main() -> int:
 
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    # --sharding: the declarative partition layer (parallel/rules.py +
+    # analysis/autoshard.py). 'auto' searches every mesh factorization of
+    # the device budget with the static cost model (abstract traces only
+    # - scoring happens before anything is placed or compiled) and
+    # rewrites --dp/--sp/--tp to the winning plan; 'rules:<file>' swaps
+    # the built-in rule table for a custom one, threaded through every
+    # spec-derivation site (shard_params / make_lm_train_step / the
+    # elastic reshard path).
+    shard_rules = None
+    if args.sharding.startswith("rules:"):
+        from distributed_neural_network_tpu.parallel.rules import load_rules
+
+        rules_path = args.sharding[len("rules:"):]
+        shard_rules = load_rules(rules_path)
+        print(f"(sharding rules: {rules_path}, {len(shard_rules)} rule(s))")
+    elif args.sharding == "auto":
+        from distributed_neural_network_tpu.analysis.autoshard import (
+            search_plans,
+        )
+
+        budget = args.dp * args.sp * args.tp
+        if budget == 1:
+            budget = jax.device_count()
+        result = search_plans(
+            "lm", cfg=cfg, devices=budget, batch=args.batch_size,
+            seq_len=args.seq_len, optimizer=args.optimizer,
+            kwargs=dict(
+                accum_steps=args.accum_steps, grad_sync=args.grad_sync,
+                bucket_mb=args.bucket_mb, loss_chunks=args.loss_chunks,
+                attn_impl=args.attn,
+            ),
+            config=f"auto@{budget}dev",
+        )
+        if result.chosen is None:
+            raise SystemExit(
+                "--sharding auto found no feasible plan over "
+                f"{budget} device(s):\n" + "\n".join(
+                    f"  {pl.label}: {pl.infeasible_reason}"
+                    for pl in result.infeasible
+                )
+            )
+        print(result.explain(top_k=3))
+        dims = result.chosen.dims
+        args.dp, args.sp, args.tp = dims["dp"], dims["sp"], dims["tp"]
+        print(
+            f"(sharding auto: adopted mesh dp{args.dp} x sp{args.sp} x "
+            f"tp{args.tp}, optimizer {result.chosen.optimizer})"
+        )
+
     params = tfm.init_params(jax.random.key(args.seed), cfg)
     pipe = args.pp > 1
     # guard defaults for the pipeline branch (pp + guard/chaos is rejected
@@ -522,7 +599,9 @@ def main() -> int:
         )
     else:
         mesh = lmtrain.create_lm_mesh(args.dp, args.sp, args.tp)
-        params, specs = lmtrain.shard_params(params, cfg, mesh)
+        params, specs = lmtrain.shard_params(
+            params, cfg, mesh, rules=shard_rules
+        )
         mom = lmtrain.init_lm_momentum(params, mesh, args.optimizer)
         mom_shardings = jax.tree.map(
             lambda s: NamedSharding(mesh, s),
@@ -563,6 +642,7 @@ def main() -> int:
                 with_health=guard_on,
                 skip_nonfinite=args.guard == "skip",
                 fault_plan=fault_plan,
+                rules=shard_rules,
             )
 
         step = build_step()
@@ -1044,7 +1124,7 @@ def main() -> int:
         old_dp = mesh.shape.get("data", 1)
         mesh = lmtrain.create_lm_mesh(new_dp, args.sp, args.tp)
         specs, param_shardings, mom_shardings = lmtrain.make_lm_shardings(
-            cfg, mesh, args.optimizer
+            cfg, mesh, args.optimizer, rules=shard_rules
         )
         args.accum_steps = rescale_accum(
             args.batch_size, old_dp, new_dp, args.accum_steps

@@ -1,0 +1,316 @@
+"""Autoshard: static cost model (analysis/cost.py) + sharding search
+(analysis/autoshard.py) + tools/autoshard.py CLI.
+
+Everything traces abstractly on the 8-virtual-CPU-device mesh - no step
+executes. The cost model's collective-byte prediction is pinned EQUAL to
+the shardlint manifest total (one TraceFacts source), per the acceptance
+contract.
+"""
+
+import importlib.util
+import json
+import os
+
+import jax
+import pytest
+
+from distributed_neural_network_tpu import analysis, compat
+from distributed_neural_network_tpu.analysis import autoshard as AS
+from distributed_neural_network_tpu.analysis import cost as C
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------ cost model
+
+
+def test_wire_factor_ring_conventions():
+    assert C.wire_factor("psum", 4) == pytest.approx(2 * 3 / 4)
+    assert C.wire_factor("all_gather", 4) == pytest.approx(3 / 4)
+    assert C.wire_factor("reduce_scatter", 2) == pytest.approx(1 / 2)
+    assert C.wire_factor("ppermute", 4) == 1.0
+    # a size-1 group moves nothing
+    assert C.wire_factor("psum", 1) == 0.0
+
+
+def test_sharded_leaf_bytes_divides_by_spec_axes(n_devices):
+    from jax.sharding import PartitionSpec as P
+
+    avals = {
+        "a": jax.ShapeDtypeStruct((8, 4), "float32"),  # 128 B
+        "b": jax.ShapeDtypeStruct((16,), "float32"),  # 64 B
+    }
+    specs = {"a": P("data"), "b": P()}
+    got = C.sharded_leaf_bytes(avals, specs, {"data": 4})
+    assert got == 128 // 4 + 64
+    # a spec prefix broadcasting over a subtree divides every leaf
+    got = C.sharded_leaf_bytes(avals, P("data"), {"data": 4})
+    assert got == 128 // 4 + 64 // 4
+
+
+@pytest.mark.parametrize("name", ["lm_zero_overlap", "lm_tp", "pp_gpipe"])
+def test_cost_model_collective_bytes_match_manifest(name, n_devices):
+    """ACCEPTANCE PIN: the cost model's predicted per-step collective
+    bytes equal the shardlint manifest total exactly - both read the same
+    TraceFacts."""
+    man = analysis.load_manifest(name)
+    if man.get("jax_version") != jax.__version__:
+        pytest.skip("manifests pinned to another jax version")
+    prog = analysis.build_program(name)
+    facts = analysis.collect_trace(prog.make_jaxpr())
+    bd = C.score_program(prog, facts)
+    assert bd.collective_bytes == man["total_collective_bytes"]
+    assert bd.feasible and bd.score < float("inf")
+
+
+def test_cost_memory_budget_prunes(n_devices):
+    prog = analysis.build_program("lm_dp")
+    facts = analysis.collect_trace(prog.make_jaxpr())
+    bd = C.score_program(prog, facts, C.CostWeights(hbm_bytes=1024))
+    assert not bd.feasible
+    assert "HBM budget" in bd.infeasible_reason
+    assert bd.score == float("inf")
+    assert "INFEASIBLE" in bd.why()
+
+
+def test_cost_why_breaks_down_terms(n_devices):
+    prog = analysis.build_program("lm_zero_overlap")
+    facts = analysis.collect_trace(prog.make_jaxpr())
+    bd = C.score_program(prog, facts)
+    why = bd.why()
+    assert "wire bytes/step" in why
+    assert "peak state B/device" in why
+    assert f"{bd.peak_state_bytes:,}" in why
+
+
+def test_cost_zero_leak_penalty(n_devices):
+    """A fabricated full-size ZeRO carry must be charged, pushing the
+    leaked plan's score above the honest one."""
+    prog = analysis.build_program("lm_zero_overlap")
+    facts = analysis.collect_trace(prog.make_jaxpr())
+    honest = C.score_program(prog, facts)
+    assert honest.leaked_carry_bytes == 0
+    facts.reduce_scatter_carry_bytes = prog.param_bytes()
+    leaked = C.score_program(prog, facts)
+    assert leaked.leaked_carry_bytes > 0
+    assert leaked.score > honest.score
+    assert "leak" in leaked.why()
+
+
+def test_untraced_grad_sync_term_counts_replicated_params(n_devices):
+    """On compat traces, end-sync dp gradients are invisible - the
+    analytic term must charge them; overlap configs (explicit traced
+    collectives) must NOT be double-charged."""
+    end = analysis.build_program("lm_dp")
+    facts_end = analysis.collect_trace(end.make_jaxpr())
+    bd_end = C.score_program(end, facts_end)
+    ov = analysis.build_program("lm_dp_overlap")
+    facts_ov = analysis.collect_trace(ov.make_jaxpr())
+    bd_ov = C.score_program(ov, facts_ov)
+    if compat.trace_mode() == "compat":
+        # fully replicated params, dp=4: the whole tree rides the psum
+        assert bd_end.untraced_grad_sync_bytes == pytest.approx(
+            end.param_bytes() * C.wire_factor("psum", 4)
+        )
+    else:
+        assert bd_end.untraced_grad_sync_bytes == 0.0
+    assert bd_ov.untraced_grad_sync_bytes == 0.0
+    assert bd_ov.collective_bytes > 0  # the explicit bucketed psums
+
+
+# ------------------------------------------------------------- the search
+
+
+def test_lm_mesh_candidates_enumerate_factorizations():
+    dims = AS.lm_mesh_candidates(8)
+    assert {"dp": 8, "sp": 1, "tp": 1} in dims
+    assert {"dp": 2, "sp": 2, "tp": 2} in dims
+    assert len(dims) == 10  # ordered triples over 8 = 2^3
+    assert all(d["dp"] * d["sp"] * d["tp"] == 8 for d in dims)
+    assert AS.pp_mesh_candidates(4) == [
+        {"dp": 2, "pp": 2}, {"dp": 1, "pp": 4},
+    ]
+
+
+def test_search_config_ranks_deterministically(n_devices):
+    r1 = AS.search_config("lm_zero")
+    r2 = AS.search_config("lm_zero")
+    assert [p.label for p in r1.ranked] == [p.label for p in r2.ranked]
+    assert r1.chosen.score == r2.chosen.score
+    # zero x tp candidates are pruned with the builder's own error
+    assert any(
+        "tp_axis" in p.infeasible_reason for p in r1.infeasible
+    )
+    assert r1.chosen.dims == {"dp": 4, "sp": 1, "tp": 1}
+    assert r1.matches_hand_config() is True
+
+
+def test_search_explain_names_winner_and_pruned(n_devices):
+    r = AS.search_config("lm_zero")
+    text = r.explain()
+    assert "<- chosen" in text
+    assert "INFEASIBLE" in text
+    assert "why the winner" in text
+
+
+def test_search_unknown_config_lists_known():
+    with pytest.raises(KeyError) as e:
+        AS.search_config("nonsense")
+    assert "lm_zero_overlap" in str(e.value)
+    # the CNN / reshard programs have no factorization to search
+    with pytest.raises(KeyError):
+        AS.search_config("cnn_dp")
+
+
+def test_search_optimizer_dimension_widens(n_devices):
+    """optimizers=(...) scores weight-update layouts against each other
+    (arXiv 2004.13336): zero shards optimizer state, cutting peak bytes,
+    at the price of gather collectives - both appear in the ranking."""
+    r = AS.search_config("lm_dp", optimizers=("sgd", "zero"))
+    opts = {p.optimizer for p in r.ranked}
+    assert opts == {"sgd", "zero"}
+    by_opt = {}
+    for p in r.ranked:
+        if p.dims == {"dp": 4, "sp": 1, "tp": 1}:
+            by_opt[p.optimizer] = p.breakdown
+    assert by_opt["zero"].opt_bytes_per_device < (
+        by_opt["sgd"].opt_bytes_per_device
+    )
+
+
+# --------------------------------------------------------- plan manifests
+
+
+def test_plan_doc_roundtrip_and_check(tmp_path, n_devices):
+    r = AS.search_config("lm_zero")
+    doc = AS.build_plan_doc(r)
+    AS.save_plan(doc, "lm_zero", str(tmp_path))
+    loaded = AS.load_plan("lm_zero", str(tmp_path))
+    assert AS.diff_plans(loaded, r) == []
+    # a drifted winner fails with both plans named
+    loaded["chosen"]["dims"] = {"dp": 1, "sp": 1, "tp": 4}
+    loaded["chosen"]["optimizer"] = "sgd"
+    diffs = AS.diff_plans(loaded, r)
+    assert diffs and "top-ranked plan changed" in diffs[0]
+    # byte drift on the same winner is its own message
+    loaded2 = AS.load_plan("lm_zero", str(tmp_path))
+    loaded2["chosen"]["collective_bytes"] += 64
+    diffs2 = AS.diff_plans(loaded2, r)
+    assert diffs2 and "collective bytes changed" in diffs2[0]
+
+
+def test_plan_env_mismatch_short_circuits(tmp_path, n_devices):
+    r = AS.search_config("lm_zero")
+    doc = AS.build_plan_doc(r)
+    doc["jax_version"] = "0.0.1"
+    diffs = AS.diff_plans(doc, r)
+    assert len(diffs) == 1 and "regenerate" in diffs[0]
+
+
+def test_missing_plan_is_actionable(tmp_path):
+    with pytest.raises(FileNotFoundError, match="--write-manifest"):
+        AS.load_plan("lm_zero", str(tmp_path))
+
+
+@pytest.mark.skipif(
+    not os.path.exists(AS.plan_path("lm_dp")),
+    reason="no checked-in plan manifests",
+)
+def test_checked_in_plans_conform(n_devices):
+    """python tools/autoshard.py --all --check, as the CI gate runs it."""
+    pinned = AS.load_plan("lm_dp").get("jax_version")
+    if pinned != jax.__version__:
+        pytest.skip(
+            f"plans pinned to jax {pinned}, running {jax.__version__} - "
+            "regenerate with --write-manifest to re-enable"
+        )
+    rc, report = AS.run_autoshard(mode="check", verbose=False)
+    assert rc == 0, report
+
+
+def test_checked_in_plans_cover_every_searchable_config():
+    for name in analysis.searchable_config_names():
+        assert os.path.exists(AS.plan_path(name)), (
+            f"missing plan manifest for {name}; run tools/autoshard.py "
+            "--all --write-manifest"
+        )
+        doc = json.load(open(AS.plan_path(name)))
+        assert doc["config"] == name
+        assert "matches_hand_config" in doc
+        assert doc["chosen"]["plan"]
+
+
+def test_run_autoshard_write_then_check_roundtrip(tmp_path, n_devices):
+    rc, report = AS.run_autoshard(
+        ["lm_zero"], mode="write", plan_dir=str(tmp_path), verbose=False
+    )
+    assert rc == 0, report
+    rc, report = AS.run_autoshard(
+        ["lm_zero"], mode="check", plan_dir=str(tmp_path), verbose=False
+    )
+    assert rc == 0, report
+    # a missing plan manifest fails check with the fix named
+    rc, report = AS.run_autoshard(
+        ["lm_dp"], mode="check", plan_dir=str(tmp_path), verbose=False
+    )
+    assert rc == 1
+    assert "--write-manifest" in report
+
+
+# ----------------------------------------------------- the trivial plans
+
+
+def test_auto_nb_proc_largest_divisor():
+    assert AS.auto_nb_proc(32, 8) == 8
+    assert AS.auto_nb_proc(12, 8) == 6
+    assert AS.auto_nb_proc(7, 8) == 7
+    assert AS.auto_nb_proc(5, 4) == 1
+    with pytest.raises(ValueError):
+        AS.auto_nb_proc(0, 8)
+
+
+# ------------------------------------------------------------------ CLI
+
+
+def _load_cli():
+    spec = importlib.util.spec_from_file_location(
+        "autoshard_cli", os.path.join(ROOT, "tools", "autoshard.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_cli_list_and_write_check_roundtrip(tmp_path, capsys, n_devices):
+    cli = _load_cli()
+    assert cli.main(["--list"]) == 0
+    out = capsys.readouterr().out
+    assert "lm_zero_overlap" in out and "pp_gpipe" in out
+    assert "cnn_dp" not in out  # nothing to search there
+
+    rc = cli.main([
+        "--model", "lm_zero", "--write-manifest",
+        "--plan-dir", str(tmp_path), "-q",
+    ])
+    assert rc == 0
+    rc = cli.main([
+        "--model", "lm_zero", "--check", "--plan-dir", str(tmp_path), "-q",
+    ])
+    assert rc == 0
+
+
+def test_cli_comma_separated_models_and_typo_exit_2(capsys, n_devices):
+    cli = _load_cli()
+    rc = cli.main(["--model", "lm_zero,nonsense", "-q"])
+    out = capsys.readouterr().out
+    assert rc == 2
+    assert "unknown autoshard config" in out
+    assert "lm_zero_overlap" in out  # the known list is printed
+
+
+def test_cli_explain_prints_ranking(capsys, n_devices):
+    cli = _load_cli()
+    rc = cli.main(["--model", "lm_zero", "--explain"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "<- chosen" in out and "why the winner" in out

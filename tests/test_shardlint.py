@@ -383,3 +383,161 @@ def test_engine_exposes_step_specs(n_devices):
     assert prog.donate == (1,)  # the epoch path donates momentum only
     result = analysis.analyze_program(prog)
     assert result.errors == []
+
+
+# ------------------------------------- dynamic (while-loop) collectives
+
+
+def _while_psum_program(extra_scan_psums: int = 0):
+    """A toy step with a psum inside a while loop (a decode-style dynamic
+    loop) and optionally a static scan psum next to it."""
+    mesh = _toy_mesh()
+
+    def body(x):
+        def cond(state):
+            i, _ = state
+            return i < x.shape[0]
+
+        def step(state):
+            i, acc = state
+            return i + 1, acc + jax.lax.psum(x.sum(), "data")
+
+        _, acc = jax.lax.while_loop(cond, step, (0, 0.0))
+        if extra_scan_psums:
+            def s(c, _):
+                return c + jax.lax.psum(x.sum(), "data"), None
+
+            acc2, _ = jax.lax.scan(s, 0.0, None, length=extra_scan_psums)
+            acc = acc + acc2
+        return acc
+
+    with compat.trace_compat():
+        fn = jax.jit(
+            compat.shard_map(
+                body, mesh=mesh, in_specs=(P("data"),), out_specs=P(None),
+                check_vma=False,
+            )
+        )
+    return _toy_program(fn, jax.ShapeDtypeStruct((8, 4), jnp.float32))
+
+
+def test_dynamic_sites_excluded_from_total_surfaced_separately(n_devices):
+    """A while-based loop must not zero out (or inflate) the per-step
+    manifest total: dynamic sites carry per-iteration bytes on their own
+    field."""
+    facts = analysis.collect_trace(_while_psum_program().make_jaxpr())
+    assert facts.has_dynamic_loop
+    dyn = [c for c in facts.collectives if c.dynamic]
+    assert dyn and all(c.op == "psum" for c in dyn)
+    # the scalar psum: 4 B per call, once per loop iteration
+    assert facts.total_collective_bytes() == 0
+    assert facts.dynamic_collective_bytes_per_iter() == sum(
+        c.total_bytes for c in dyn
+    ) > 0
+
+
+def test_dynamic_and_static_sites_coexist(n_devices):
+    facts = analysis.collect_trace(
+        _while_psum_program(extra_scan_psums=3).make_jaxpr()
+    )
+    # static total counts ONLY the x3 scan psums
+    static = [c for c in facts.collectives if not c.dynamic]
+    assert sum(c.count for c in static) == 3
+    assert facts.total_collective_bytes() == sum(
+        c.total_bytes for c in static
+    )
+    assert facts.dynamic_collective_bytes_per_iter() > 0
+
+
+def test_manifest_pins_dynamic_bytes_separately(n_devices):
+    prog = _while_psum_program()
+    facts = analysis.collect_trace(prog.make_jaxpr())
+    man = analysis.build_manifest(prog, facts)
+    assert man["total_collective_bytes"] == 0
+    assert man["dynamic_collective_bytes_per_iter"] > 0
+    assert man["has_dynamic_loop"] is True
+    # drift in the per-iteration bytes fails the diff with its own message
+    other = dict(man, dynamic_collective_bytes_per_iter=0)
+    diffs = analysis.diff_manifests(other, man)
+    assert diffs and "per loop iteration" in diffs[0]
+    # manifests written before the field existed compare as zero
+    legacy = {k: v for k, v in man.items()
+              if k != "dynamic_collective_bytes_per_iter"}
+    diffs = analysis.diff_manifests(legacy, man)
+    assert any("per loop iteration" in d for d in diffs)
+
+
+# --------------------------------------------- per-site provenance paths
+
+
+def test_sites_carry_provenance_paths(n_devices):
+    facts = analysis.collect_trace(
+        _while_psum_program(extra_scan_psums=3).make_jaxpr()
+    )
+    paths = {c.path for c in facts.sites}
+    assert any("while" in p for p in paths)
+    assert any("scan[x3]" in p for p in paths)
+    # merged view still aggregates across paths with identical keys
+    assert sum(c.count for c in facts.collectives) == sum(
+        c.count for c in facts.sites
+    )
+
+
+def test_canonical_config_sites_locate_the_scan(n_devices):
+    """Provenance attributes the ZeRO overlap schedule's reduce-scatters
+    to where they actually run: microbatch 0's buckets before the
+    accumulation scan, the remaining accum_steps-1 microbatches' inside
+    it (accumulate_fwd_bwd_overlap peels the first iteration)."""
+    prog = analysis.build_program("lm_zero_overlap")
+    facts = analysis.collect_trace(prog.make_jaxpr())
+    rs = [c for c in facts.sites if c.op == "reduce_scatter"]
+    assert rs
+    in_scan = [c for c in rs if "scan[x1]" in c.path]
+    peeled = [c for c in rs if c.path.endswith("shard_map")]
+    assert in_scan and peeled
+    assert sum(c.count for c in in_scan) == sum(c.count for c in peeled)
+
+
+def test_explain_sites_table(n_devices):
+    from distributed_neural_network_tpu.analysis.runner import explain_sites
+
+    facts = analysis.collect_trace(
+        _while_psum_program(extra_scan_psums=3).make_jaxpr()
+    )
+    lines = explain_sites(facts)
+    assert "where" in lines[0]
+    assert any("yes" in ln and "while" in ln for ln in lines[1:])
+    assert any("per while-loop iteration" in ln for ln in lines)
+
+
+def test_cli_explain_flag(capsys, n_devices):
+    cli = _load_cli()
+    rc = cli.main(["--config", "lm_zero_overlap", "--explain"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "where" in out and "shard_map" in out
+
+
+# ------------------------------------------- CLI config-list ergonomics
+
+
+def test_cli_comma_separated_configs(tmp_path, capsys, n_devices):
+    cli = _load_cli()
+    rc = cli.main([
+        "--config", "lm_dp,lm_zero", "--write-manifest",
+        "--manifest-dir", str(tmp_path), "-q",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "lm_dp" in out and "lm_zero" in out
+    assert os.path.exists(os.path.join(str(tmp_path), "lm_dp.json"))
+    assert os.path.exists(os.path.join(str(tmp_path), "lm_zero.json"))
+
+
+def test_cli_typo_exits_2_with_known_list(capsys, n_devices):
+    cli = _load_cli()
+    rc = cli.main(["--config", "lm_dp,lm_zzz", "-q"])
+    out = capsys.readouterr().out
+    assert rc == 2
+    assert "lm_zzz" in out  # the typo is named
+    assert "lm_zero_overlap" in out  # and the known list printed

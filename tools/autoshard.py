@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""autoshard CLI: static cost-model sharding search on CPU.
+
+For each model scenario (the searchable shardlint configs,
+analysis/configs.py), enumerates every mesh factorization of the device
+count, derives candidate PartitionSpecs from the declarative rule table
+(parallel/rules.py), abstract-traces each candidate step with the
+shardlint tracer, scores it with the static cost model (analysis/cost.py:
+ring-weighted collective wire bytes + per-device state memory vs the HBM
+budget + donation coverage + replication-leak penalties), and ranks the
+feasible plans. Nothing executes - the search is jaxpr tracing only.
+
+Usage:
+  python tools/autoshard.py --list
+  python tools/autoshard.py --all --check            # the CI gate
+  python tools/autoshard.py --model lm_dp --explain  # ranked plans + why
+  python tools/autoshard.py --model lm_dp,lm_tp --devices 8
+  python tools/autoshard.py --model lm_zero --optimizers sgd,zero
+  python tools/autoshard.py --all --write-manifest   # pin the winners
+
+Exit codes: 0 conforming; 1 plan drift or missing plan manifest; 2 a
+search failed or an unknown --model name (the known list is printed).
+See docs/STATIC_ANALYSIS.md ("Autoshard").
+"""
+
+import argparse
+import os
+import sys
+
+
+def _force_cpu_mesh():
+    """8 virtual CPU devices, set BEFORE jax import (the repo-standard
+    test mesh - same bootstrap as tools/shardlint.py)."""
+    flag = "--xla_force_host_platform_device_count=8"
+    if flag not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " " + flag
+        ).strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if "jax" in sys.modules:
+        import jax
+
+        try:  # re-assert against site hooks that pre-import jax
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument(
+        "--model", "--config", dest="model", action="append", default=[],
+        help="model scenario name(s): repeatable and/or comma-separated; "
+        "see --list",
+    )
+    ap.add_argument(
+        "--all", action="store_true", help="every searchable config"
+    )
+    ap.add_argument(
+        "--list", action="store_true",
+        help="list searchable configs and exit",
+    )
+    ap.add_argument(
+        "--devices", type=int, default=None, metavar="N",
+        help="device count to factorize (default: the config's canonical "
+        "mesh size)",
+    )
+    ap.add_argument(
+        "--explain", action="store_true",
+        help="print the full ranked plan table and the winner's per-term "
+        "cost breakdown",
+    )
+    ap.add_argument(
+        "--optimizers", default=None, metavar="A,B",
+        help="widen the optimizer-layout dimension of the search (e.g. "
+        "sgd,zero scores the cross-replica weight-update sharding "
+        "against the replicated update; default: the scenario's own "
+        "optimizer only)",
+    )
+    ap.add_argument(
+        "--hbm-gb", type=float, default=None, metavar="GB",
+        help="per-device HBM budget for the memory feasibility gate "
+        "(default 16)",
+    )
+    ap.add_argument(
+        "--write-manifest", action="store_true",
+        help="pin each search's winning plan as analysis/plans/<name>.json",
+    )
+    ap.add_argument(
+        "--check", action="store_true",
+        help="re-search and fail if any top-ranked plan drifted from its "
+        "checked-in plan manifest",
+    )
+    ap.add_argument(
+        "--plan-dir", default=None,
+        help="plan-manifest directory (default: the in-package "
+        "analysis/plans)",
+    )
+    ap.add_argument(
+        "-q", "--quiet", action="store_true",
+        help="verdict lines only (no ranking tables)",
+    )
+    args = ap.parse_args(argv)
+
+    _force_cpu_mesh()
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    from distributed_neural_network_tpu.analysis import autoshard
+    from distributed_neural_network_tpu.analysis.configs import (
+        searchable_config_names,
+    )
+    from distributed_neural_network_tpu.analysis.cost import CostWeights
+
+    known = searchable_config_names()
+    if args.list:
+        for name in known:
+            print(name)
+        return 0
+    if args.write_manifest and args.check:
+        ap.error("--write-manifest and --check are mutually exclusive")
+    requested = [n for entry in args.model for n in entry.split(",") if n]
+    unknown = [n for n in requested if n not in known]
+    if unknown:
+        print(
+            f"unknown autoshard config(s): {', '.join(unknown)}\n"
+            f"searchable configs: {', '.join(known)}"
+        )
+        return 2
+    names = known if args.all or not requested else requested
+    mode = (
+        "write" if args.write_manifest else "check" if args.check else "rank"
+    )
+    weights = None
+    if args.hbm_gb is not None:
+        weights = CostWeights(hbm_bytes=int(args.hbm_gb * 2**30))
+    optimizers = (
+        tuple(o for o in args.optimizers.split(",") if o)
+        if args.optimizers else None
+    )
+    rc, report = autoshard.run_autoshard(
+        names, mode=mode, plan_dir=args.plan_dir, devices=args.devices,
+        explain=args.explain, optimizers=optimizers, weights=weights,
+        verbose=not args.quiet,
+    )
+    print(report)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,11 +12,15 @@ Usage:
   python tools/shardlint.py --list
   python tools/shardlint.py --all --check          # the CI gate
   python tools/shardlint.py --config lm_zero_overlap --write-manifest
+  python tools/shardlint.py --config lm_dp,lm_tp   # comma lists work
+  python tools/shardlint.py --explain --config lm_zero_overlap
+                                                   # per-site provenance
   python tools/shardlint.py --all --write-manifest # after an intentional
                                                    # collective change
 
 Exit codes: 0 conforming; 1 lint errors or manifest mismatch; 2 a config
-could not be built/traced. See docs/STATIC_ANALYSIS.md.
+could not be built/traced or an unknown --config name (the known list is
+printed). See docs/STATIC_ANALYSIS.md.
 """
 
 import argparse
@@ -49,7 +53,8 @@ def main(argv=None) -> int:
     )
     ap.add_argument(
         "--config", action="append", default=[],
-        help="config name (repeatable); see --list",
+        help="config name(s): repeatable and/or comma-separated "
+        "(--config a,b); see --list",
     )
     ap.add_argument("--all", action="store_true", help="every canonical config")
     ap.add_argument("--list", action="store_true", help="list configs and exit")
@@ -60,6 +65,12 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--check", action="store_true",
         help="diff fresh traces against the checked-in manifest(s)",
+    )
+    ap.add_argument(
+        "--explain", action="store_true",
+        help="per-collective-site provenance table (op, axes, bytes/call, "
+        "static multiplicity, dynamic flag, enclosing jaxpr path) instead "
+        "of the merged per-op summary",
     )
     ap.add_argument(
         "--manifest-dir", default=None,
@@ -81,13 +92,22 @@ def main(argv=None) -> int:
         return 0
     if args.write_manifest and args.check:
         ap.error("--write-manifest and --check are mutually exclusive")
-    names = analysis.config_names() if args.all or not args.config else args.config
+    requested = [n for entry in args.config for n in entry.split(",") if n]
+    known = analysis.config_names()
+    unknown = [n for n in requested if n not in known]
+    if unknown:
+        print(
+            f"unknown shardlint config(s): {', '.join(unknown)}\n"
+            f"known configs: {', '.join(known)}"
+        )
+        return 2
+    names = known if args.all or not requested else requested
     mode = (
         "write" if args.write_manifest else "check" if args.check else "lint"
     )
     rc, report = analysis.run_shardlint(
         names, mode=mode, manifest_dir=args.manifest_dir,
-        verbose=not args.quiet,
+        verbose=not args.quiet, explain=args.explain,
     )
     print(report)
     return rc
