@@ -122,6 +122,19 @@ def _reshard(name, *, dp=4):
     return build
 
 
+def _reshard_pp(name, *, dp=2, pp=2):
+    from ..parallel import pipeline as ppl, reshard
+
+    def build():
+        _require_devices(dp * pp)
+        cfg = _trace_cfg()
+        mesh = ppl.create_pp_mesh(dp, pp, 1)
+        with compat.trace_compat():
+            return reshard.reshard_pp_step_program(cfg, mesh, name=name)
+
+    return build
+
+
 def _cnn(name, phase):
     def build():
         _require_devices(4)
@@ -174,6 +187,11 @@ CANONICAL_CONFIGS = {
     # over 'data' - so the reshard transfer's collective bytes are pinned
     # like every training step's
     "lm_reshard_zero_gather": _reshard("lm_reshard_zero_gather"),
+    # the ZeRO-under-pp resharder: per pipe-sharded leaf one data-axis
+    # segment gather + one pipe-axis stage concat (stage order explicit),
+    # per replicated leaf the mesh path's single data gather - pinned so
+    # the elastic path's transfer schedule cannot regress silently
+    "pp_reshard_zero_gather": _reshard_pp("pp_reshard_zero_gather"),
     # the CNN engine: the sharded local-SGD epoch (no collectives by
     # design - local training) and the fault-masked parameter-average
     # sync phase (where the epoch-edge psums live)

@@ -116,6 +116,7 @@ def initialize(
             "[0, num_processes) (auto-detection only works on cloud "
             "TPU/Slurm/OpenMPI environments)"
         )
+    _enable_cpu_collectives()
     _connect_with_retry(
         _connect if _connect is not None else jax.distributed.initialize,
         dict(coordinator_address=addr, num_processes=num, process_id=pid),
@@ -203,6 +204,26 @@ def _connect_with_retry(
         "DNN_TPU_COORDINATOR_DEADLINE_S or DNN_TPU_COORDINATOR_RETRIES for "
         f"slow cluster starts. Last error: {type(last).__name__ if last is not None else None}: {last}"
     ) from last
+
+
+def _enable_cpu_collectives() -> None:
+    """Select a cross-process collectives backend for CPU meshes.
+
+    On the jax generations this repo pins, the CPU backend ships with NO
+    multi-process collective implementation selected - a 2-process CPU
+    mesh then fails at the first psum with "Multiprocess computations
+    aren't implemented on the CPU backend". 'gloo' is the bundled
+    implementation; newer jax selects it automatically (and eventually
+    drops the config knob), so failures to set it are ignored. Only
+    applied when the operator pinned JAX_PLATFORMS=cpu - real TPU/GPU
+    runs keep their native ICI/NCCL collectives.
+    """
+    if os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip() != "cpu":
+        return
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
 
 
 def _already_initialized() -> bool | None:

@@ -240,3 +240,75 @@ class ChaosMonkey:
             self._fired.add("sigterm")
             self.log(f"(chaos: delivering SIGTERM after step {step})")
             os.kill(os.getpid(), _signal.SIGTERM)
+
+
+# ------------------------------------------------ process-level injectors
+
+
+@dataclass(frozen=True)
+class KillEvent:
+    """One process-level fault: deliver `sig` ('KILL' or 'TERM') to worker
+    `rank` once its heartbeat reports step >= `at_step`. rank 0 is the
+    process hosting the JAX coordinator service, so killing it is the
+    coordinator-death scenario. Fires exactly once (the ChaosMonkey
+    convention: the induced death is then handled - or not - by the
+    supervisor's ordinary failure path)."""
+
+    rank: int
+    at_step: int = 0
+    sig: str = "KILL"
+
+    def __post_init__(self):
+        if self.sig not in ("KILL", "TERM"):
+            raise ValueError(
+                f"KillEvent signal must be 'KILL' or 'TERM', got {self.sig!r}"
+            )
+        if self.rank < 0:
+            raise ValueError(f"rank must be >= 0, got {self.rank}")
+
+    @property
+    def signum(self) -> int:
+        return _signal.SIGKILL if self.sig == "KILL" else _signal.SIGTERM
+
+
+@dataclass
+class ProcessChaos:
+    """Process-level fault plan driven by the SUPERVISOR
+    (train/supervisor.py / tools/launch.py --chaos-kill-*), the real-OS
+    sibling of the in-process ChaosMonkey: instead of perturbing an
+    observation stream it actually kills group members - SIGKILL for a
+    crash (no emergency checkpoint, the group restarts from the last
+    periodic save), SIGTERM for a preemption notice (the worker's
+    cooperative path writes its checkpoint first), rank 0 for coordinator
+    death. The supervisor polls worker heartbeats and calls `due(steps)`
+    each tick; every event fires once.
+    """
+
+    events: tuple = ()
+    _fired: set = field(default_factory=set)
+
+    def __post_init__(self):
+        self.events = tuple(self.events)
+        for e in self.events:
+            if not isinstance(e, KillEvent):
+                raise TypeError(f"ProcessChaos events must be KillEvent, got {e!r}")
+
+    def __bool__(self):
+        return bool(self.events)
+
+    def due(self, steps: dict) -> list:
+        """[(rank, signum)] for events whose rank has reached its step.
+
+        `steps` maps rank -> last heartbeat step (None before the first
+        beat). at_step=0 fires as soon as the rank heartbeats at all, so
+        rendezvous itself can be chaos-tested.
+        """
+        out = []
+        for i, e in enumerate(self.events):
+            if i in self._fired or e.rank not in steps:
+                continue
+            step = steps[e.rank]
+            if e.at_step <= 0 or (step is not None and step >= e.at_step):
+                self._fired.add(i)
+                out.append((e.rank, e.signum))
+        return out

@@ -88,6 +88,20 @@ def spec_tree_from_json(doc):
     )
 
 
+def spec_axes(spec) -> tuple:
+    """Flattened mesh-axis names a PartitionSpec shards over (tuple
+    entries - e.g. ``P(('pipe','data'))`` - are expanded)."""
+    out = []
+    for e in tuple(spec):
+        if e is None:
+            continue
+        if isinstance(e, (tuple, list)):
+            out.extend(e)
+        else:
+            out.append(e)
+    return tuple(out)
+
+
 # ----------------------------------------------------- topology metadata
 
 
@@ -243,11 +257,87 @@ def momentum_to_zero_tree(mom_tree, n_shards: int):
     return jax.tree.map(leaf, mom_tree)
 
 
+# ------------------------------------------ ZeRO-under-pp layout transforms
+
+
+def pp_zero_tree_to_momentum(flat_tree, params_template, pp_specs, pp: int):
+    """ZeRO-under-pp per-leaf flat buffers -> the replicated momentum tree.
+
+    The pipeline ZeRO layout (`parallel/pipeline.py init_pp_zero_state`,
+    the DeepSpeed ZeRO-1 + PP convention) flattens each pipe-sharded leaf
+    STAGE-MAJOR: pp segments of ``dp * ceil((size/pp)/dp)`` elements, each
+    holding one stage's contiguous layer chunk plus per-stage dp padding.
+    Unpadding each segment and concatenating in stage order recovers the
+    row-major flattened logical leaf (the leading layer axis is the
+    pipe-sharded one, so stage q's chunk IS elements
+    ``[q*size/pp, (q+1)*size/pp)``). Pipe-replicated leaves (embed / head /
+    final norm) carry the plain dp-padded layout and unpad like the mesh
+    path. Values bitwise; `pp_specs` (pp_param_specs(cfg)) says which
+    leaves carry the per-stage split.
+    """
+    def leaf(buf, ref, spec):
+        buf = np.asarray(buf)
+        size = int(np.prod(ref.shape, dtype=np.int64))
+        if pp > 1 and "pipe" in spec_axes(spec):
+            if size % pp or buf.shape[0] % pp:
+                raise ValueError(
+                    f"pipe-sharded leaf of {size} elements / buffer "
+                    f"{buf.shape} does not split over {pp} stages"
+                )
+            local = size // pp
+            seg = buf.shape[0] // pp
+            if seg < local:
+                raise ValueError(
+                    f"ZeRO-under-pp segment ({seg} elements) smaller than "
+                    f"its stage chunk ({local}) - layout mismatch"
+                )
+            flat = buf.reshape(pp, seg)[:, :local].reshape(-1)
+        else:
+            if buf.shape[0] < size:
+                raise ValueError(
+                    f"ZeRO buffer ({buf.shape[0]} elements) smaller than "
+                    f"its parameter ({size}) - layout mismatch"
+                )
+            flat = buf[:size]
+        return flat.reshape(ref.shape)
+
+    return jax.tree.map(leaf, flat_tree, params_template, pp_specs)
+
+
+def momentum_to_pp_zero_tree(mom_tree, pp_specs, pp: int, dp: int):
+    """Replicated momentum tree -> ZeRO-under-pp per-leaf flat buffers
+    (inverse of `pp_zero_tree_to_momentum`; f32, the ZeRO state dtype).
+    Pipe-sharded leaves re-split stage-major with per-stage dp padding;
+    pipe-replicated leaves pad like the mesh path. Values bitwise."""
+    from .zero import leaf_shard_size
+
+    def leaf(m, spec):
+        m = np.asarray(m, np.float32).reshape(-1)
+        if pp > 1 and "pipe" in spec_axes(spec):
+            if m.size % pp:
+                raise ValueError(
+                    f"leaf of {m.size} elements does not split over {pp} "
+                    "stages"
+                )
+            local = m.size // pp
+            seg = dp * leaf_shard_size(local, dp)
+            out = np.zeros((pp, seg), np.float32)
+            out[:, :local] = m.reshape(pp, local)
+            return out.reshape(-1)
+        total = dp * leaf_shard_size(m.size, dp)
+        out = np.zeros((total,), np.float32)
+        out[: m.size] = m
+        return out
+
+    return jax.tree.map(leaf, mom_tree, pp_specs)
+
+
 # ------------------------------------------- optimizer layout conversion
 
 
 def convert_optimizer_state(
-    mom, *, src: str, dst: str, params_template, src_dp: int, dst_dp: int
+    mom, *, src: str, dst: str, params_template, src_dp: int, dst_dp: int,
+    src_pp: int = 1, dst_pp: int = 1, pp_specs=None,
 ):
     """Map optimizer state between layouts (host-level, values bitwise).
 
@@ -256,6 +346,13 @@ def convert_optimizer_state(
     adam <-> zero-adam does the same for both moment trees (the step
     counter passes through). Across families (sgd <-> adam) there is no
     meaningful mapping and a ValueError names the supported conversions.
+
+    ``src_pp``/``dst_pp`` > 1 mark ZeRO state laid out under pipeline
+    parallelism (the per-stage split of `init_pp_zero_state`); those
+    conversions route through the canonical replicated momentum tree
+    (`pp_zero_tree_to_momentum` / `momentum_to_pp_zero_tree` - still
+    bitwise) and need ``pp_specs`` (the pipeline param-spec tree that says
+    which leaves carry the split).
     """
     for name, o in (("saved", src), ("target", dst)):
         if o not in _OPTIMIZER_FAMILY:
@@ -267,6 +364,41 @@ def convert_optimizer_state(
             "sgd<->zero, adam<->zero-adam, and any optimizer to itself "
             "across mesh shapes."
         )
+    src_zero = src in ("zero", "zero-adam")
+    dst_zero = dst in ("zero", "zero-adam")
+    if (src_zero and src_pp > 1) or (dst_zero and dst_pp > 1):
+        if pp_specs is None:
+            raise ValueError(
+                "ZeRO state under pipeline parallelism carries a per-stage "
+                "split; pass pp_specs (parallel/pipeline.py "
+                "pp_param_specs) so the converter knows which leaves "
+                "split over 'pipe'"
+            )
+        if (src, src_dp, src_pp) == (dst, dst_dp, dst_pp):
+            return mom
+
+        def to_mom(flat):
+            if src_pp > 1:
+                return pp_zero_tree_to_momentum(
+                    flat, params_template, pp_specs, src_pp
+                )
+            return zero_tree_to_momentum(flat, params_template)
+
+        def to_zero(tree):
+            if dst_pp > 1:
+                return momentum_to_pp_zero_tree(
+                    tree, pp_specs, dst_pp, dst_dp
+                )
+            return momentum_to_zero_tree(tree, dst_dp)
+
+        if _OPTIMIZER_FAMILY[src] == "sgd":
+            mid = to_mom(mom) if src_zero else mom
+            return to_zero(mid) if dst_zero else mid
+        mid_m = to_mom(mom["m"]) if src_zero else mom["m"]
+        mid_v = to_mom(mom["v"]) if src_zero else mom["v"]
+        if dst_zero:
+            mid_m, mid_v = to_zero(mid_m), to_zero(mid_v)
+        return {"m": mid_m, "v": mid_v, "t": mom["t"]}
     if src == dst:
         if src in ("zero", "zero-adam") and src_dp != dst_dp:
             if src == "zero":
@@ -306,6 +438,9 @@ def reshard_state(
     params_template,
     param_shardings=None,
     mom_shardings=None,
+    saved_pp: int = 1,
+    pp: int = 1,
+    pp_specs=None,
 ):
     """The leaf-wise resharder: one saved ``{"params", "mom"}`` state tree
     (host or device arrays, any mesh of origin) onto a new layout.
@@ -313,13 +448,16 @@ def reshard_state(
     Parameters are layout-invariant logical arrays - only their placement
     changes. Optimizer state goes through `convert_optimizer_state`
     (ZeRO re-padding for the new data-axis size, replicated<->ZeRO within
-    a family). With shardings given, leaves are placed memory-boundedly
-    (`place_tree`); without, host trees come back for the caller to place.
+    a family, the ZeRO-under-pp per-stage split rebuilt from
+    ``saved_pp``/``pp`` + ``pp_specs``). With shardings given, leaves are
+    placed memory-boundedly (`place_tree`); without, host trees come back
+    for the caller to place.
     """
     params = state["params"]
     mom = convert_optimizer_state(
         state["mom"], src=saved_optimizer, dst=optimizer,
         params_template=params_template, src_dp=saved_dp, dst_dp=dp,
+        src_pp=saved_pp, dst_pp=pp, pp_specs=pp_specs,
     )
     if param_shardings is not None:
         params = place_tree(params, param_shardings)
@@ -428,6 +566,134 @@ def make_zero_gather_fn(params_template, mesh: Mesh, axis_name: str = "data"):
             check_vma=False,
         ),
         donate_argnums=(0,),
+    )
+
+
+def make_pp_zero_gather_fn(
+    params_template,
+    mesh: Mesh,
+    *,
+    data_axis: str = "data",
+    pipe_axis: str = "pipe",
+):
+    """Compiled same-mesh ZeRO-under-pp reassembly: the per-stage flat
+    dp-sharded buffers (`parallel/pipeline.py init_pp_zero_state`) -> the
+    replicated momentum tree.
+
+    The collective form of `pp_zero_tree_to_momentum`: per pipe-sharded
+    leaf, one tiled `all_gather` over the data axis rebuilds each stage's
+    padded segment, the per-stage padding is sliced off, and a second
+    tiled `all_gather` over the pipe axis concatenates the stage chunks in
+    stage order (two collectives, so the stage-major ordering is explicit
+    rather than depending on a fused multi-axis gather's index order).
+    Pipe-replicated leaves take the mesh path's single data-axis gather.
+    Outside autodiff, so it lives in a ``check_vma=False`` shard_map like
+    the ZeRO optimizer; shardlint traces it via `reshard_pp_step_program`.
+    """
+    from .. import compat
+    from .pipeline import pp_optimizer_state_specs
+
+    pp = int(mesh.shape.get(pipe_axis, 1))
+    refs = jax.tree.map(
+        lambda p: jax.ShapeDtypeStruct(tuple(p.shape), p.dtype),
+        params_template,
+    )
+    # which leaves are pipe-sharded follows from the param tree's own
+    # structure (the layer stack), not from a TransformerConfig
+    specs = pp_param_specs_for_tree(params_template)
+    state_specs = pp_optimizer_state_specs("zero", specs)
+
+    def body(flat_tree):
+        def leaf(buf, ref, spec):
+            size = int(np.prod(ref.shape, dtype=np.int64))
+            if pp > 1 and "pipe" in spec_axes(spec):
+                local = size // pp
+                seg = jax.lax.all_gather(buf, data_axis, tiled=True)
+                flat = jax.lax.all_gather(
+                    seg[:local], pipe_axis, tiled=True
+                )
+            else:
+                full = jax.lax.all_gather(buf, data_axis, tiled=True)
+                flat = full[:size]
+            return flat.reshape(ref.shape).astype(jnp.float32)
+
+        return jax.tree.map(leaf, flat_tree, refs, specs)
+
+    return jax.jit(
+        compat.shard_map(
+            body, mesh=mesh, in_specs=(state_specs,), out_specs=P(),
+            check_vma=False,
+        ),
+        donate_argnums=(0,),
+    )
+
+
+def pp_param_specs_for_tree(params_template):
+    """The pipeline PartitionSpec tree for any transformer-shaped param
+    tree: every `layers` leaf stage-sharded over 'pipe' on its leading
+    (layer) axis, everything else replicated - the structural fact the
+    ZeRO-under-pp reshard needs, derived from the tree itself so callers
+    without a TransformerConfig (gather fns, templates built from saved
+    arrays) never re-derive it by hand."""
+    def sub(tree, piped: bool):
+        def leaf(p):
+            if piped:
+                return P("pipe", *([None] * (len(np.shape(p)) - 1)))
+            return P(*([None] * len(np.shape(p))))
+
+        return jax.tree.map(leaf, tree)
+
+    return {
+        k: sub(v, k == "layers") for k, v in params_template.items()
+    }
+
+
+def reshard_pp_step_program(
+    cfg, mesh: Mesh, *, name: str = "pp_reshard_zero_gather"
+):
+    """`make_pp_zero_gather_fn` packaged as a traceable StepProgram: the
+    manifest pins the per-leaf gather pair (data-axis segment gather +
+    pipe-axis stage concat for pipe-sharded leaves; single data gather for
+    replicated ones) so a transfer-schedule regression in the
+    ZeRO-under-pp reshard fails `shardlint --check` like
+    `lm_reshard_zero_gather` does for the mesh path."""
+    from ..models import transformer as tfm
+    from ..train.program import StepProgram
+    from .pipeline import (
+        init_pp_zero_state,
+        pp_optimizer_state_specs,
+        pp_param_specs,
+    )
+
+    dp = int(mesh.shape.get("data", 1))
+    pp = int(mesh.shape.get("pipe", 1))
+    params = jax.eval_shape(
+        lambda k: tfm.init_params(k, cfg),
+        jax.ShapeDtypeStruct((2,), jnp.uint32),
+    )
+    specs = pp_param_specs(cfg)
+    flat = jax.eval_shape(
+        lambda p: init_pp_zero_state(p, specs, mesh, "zero"), params
+    )
+    fn = make_pp_zero_gather_fn(params, mesh)
+    return StepProgram(
+        name=name,
+        fn=fn,
+        mesh=mesh,
+        abstract_args=(flat,),
+        specs={"params": pp_optimizer_state_specs("zero", specs)},
+        donate=(0,),
+        donate_labels=("pp zero state shards",),
+        meta={
+            "family": "reshard",
+            "optimizer": "zero",
+            "mesh": {k: int(v) for k, v in mesh.shape.items()},
+            "dp": dp,
+            "pp": pp,
+            # donated flat buffers free early; outputs are the reassembled
+            # param-shaped tree, so no in-place alias exists by design
+            "expect_alias": False,
+        },
     )
 
 

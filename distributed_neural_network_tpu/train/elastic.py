@@ -33,9 +33,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..parallel.reshard import (
+    convert_optimizer_state,
     mesh_topology,
     rescale_accum,
     reshard_state,
+    spec_axes,
     topology_mismatch,
 )
 
@@ -77,9 +79,10 @@ def saved_state_template(cfg, saved: dict):
     Params are layout-invariant (always the full logical tree); the
     optimizer state's shapes depend on the saved optimizer and - for the
     ZeRO variants, whose flat buffers are padded per shard count - the
-    saved data-axis size. ZeRO state saved under pipeline parallelism
-    carries an additional per-stage split this template cannot describe;
-    that combination is rejected with the supported alternatives named.
+    saved data-axis size, plus (under pipeline parallelism) the recorded
+    stage count: ZeRO-under-pp buffers carry the per-stage split of
+    `parallel/pipeline.py init_pp_zero_state`, and the template rebuilds
+    it stage-by-stage from the same math.
     """
     from ..models import transformer as tfm
     from ..parallel.zero import init_zero_adam_tree, init_zero_momentum_tree
@@ -87,13 +90,7 @@ def saved_state_template(cfg, saved: dict):
     optimizer = saved.get("optimizer", "sgd")
     axes = saved.get("axes") or {}
     dp = int(axes.get("data", 1))
-    if optimizer.startswith("zero") and int(axes.get("pipe", 1)) > 1:
-        raise ValueError(
-            "elastic restore of ZeRO state saved under pipeline parallelism "
-            "is not supported (the flat buffers carry a per-stage split the "
-            "portable template cannot rebuild) - resume with the original "
-            "mesh shape, or save pipeline runs with sgd/adam for elasticity"
-        )
+    pp = int(axes.get("pipe", 1))
     params = jax.eval_shape(
         lambda k: tfm.init_params(k, cfg),
         jax.ShapeDtypeStruct((2,), jnp.uint32),
@@ -103,6 +100,26 @@ def saved_state_template(cfg, saved: dict):
     elif optimizer == "adam":
         mom = {
             "m": params, "v": params,
+            "t": jax.ShapeDtypeStruct((), jnp.int32),
+        }
+    elif optimizer in ("zero", "zero-adam") and pp > 1:
+        from ..parallel.pipeline import pp_param_specs
+        from ..parallel.zero import leaf_shard_size
+
+        specs = pp_param_specs(cfg)
+
+        def buf(p, spec):
+            size = int(np.prod(p.shape, dtype=np.int64))
+            if "pipe" in spec_axes(spec):
+                n = pp * dp * leaf_shard_size(size // pp, dp)
+            else:
+                n = dp * leaf_shard_size(size, dp)
+            return jax.ShapeDtypeStruct((n,), jnp.float32)
+
+        flat = jax.tree.map(buf, params, specs)
+        mom = flat if optimizer == "zero" else {
+            "m": flat,
+            "v": jax.tree.map(lambda x: x, flat),
             "t": jax.ShapeDtypeStruct((), jnp.int32),
         }
     elif optimizer == "zero":
@@ -189,7 +206,16 @@ def elastic_restore(
     saved_optimizer = saved.get("optimizer", "sgd")
     saved_axes = saved.get("axes") or {}
     saved_dp = int(saved_axes.get("data", 1))
+    saved_pp = int(saved_axes.get("pipe", 1))
     dp = int(mesh.shape.get("data", 1))
+    dst_pp = int(mesh.shape.get("pipe", 1))
+    pp_specs = None
+    if (saved_optimizer.startswith("zero") and saved_pp > 1) or (
+        optimizer.startswith("zero") and dst_pp > 1
+    ):
+        from ..parallel.pipeline import pp_param_specs
+
+        pp_specs = pp_param_specs(cfg)
     t0 = time.perf_counter()
     with tracer.span(
         TR.RESHARD, track="elastic",
@@ -207,9 +233,22 @@ def elastic_restore(
         if v0 != v1:
             # the interleaved pipeline schedule permutes the layer axis on
             # device; route through canonical order so any v -> any v maps.
-            # ZeRO+pipe was already rejected by the template, so the
-            # momentum here mirrors the param tree (sgd) or holds two
-            # mirrors of it (adam) - permute the same leaves.
+            # ZeRO-under-pp buffers follow the PLACED layer order, so they
+            # are first reassembled into the replicated family layout (the
+            # same permutation then applies to params and momentum alike);
+            # the target layout is rebuilt by reshard_state below.
+            if saved_optimizer.startswith("zero"):
+                family = "sgd" if saved_optimizer == "zero" else "adam"
+                state = {
+                    **state,
+                    "mom": convert_optimizer_state(
+                        state["mom"], src=saved_optimizer, dst=family,
+                        params_template=state["params"],
+                        src_dp=saved_dp, dst_dp=1,
+                        src_pp=saved_pp, pp_specs=pp_specs,
+                    ),
+                }
+                saved_optimizer, saved_dp, saved_pp = family, 1, 1
             pp0 = int(saved_axes.get("pipe", 1))
             pp1 = int(current.get("axes", {}).get("pipe", 1))
             perms = []
@@ -237,6 +276,7 @@ def elastic_restore(
             state,
             saved_optimizer=saved_optimizer, saved_dp=saved_dp,
             optimizer=optimizer, dp=dp,
+            saved_pp=saved_pp, pp=dst_pp, pp_specs=pp_specs,
             params_template=template["params"],
             param_shardings=param_shardings, mom_shardings=mom_shardings,
         )

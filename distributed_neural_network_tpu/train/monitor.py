@@ -361,14 +361,16 @@ class Watchdog:
 
 
 class Monitor:
-    """registry + server + watchdog, one close()."""
+    """registry + server + watchdog + heartbeat file, one close()."""
 
     def __init__(self, registry, server=None, watchdog=None,
-                 recompiles: RecompileDetector | None = None):
+                 recompiles: RecompileDetector | None = None,
+                 heartbeat=None):
         self.registry = registry
         self.server = server
         self.watchdog = watchdog
         self.recompiles = recompiles
+        self.heartbeat = heartbeat
         self._closed = False
 
     @property
@@ -383,6 +385,8 @@ class Monitor:
             self.watchdog.stop()
         if self.server is not None:
             self.server.close()
+        if self.heartbeat is not None:
+            self.heartbeat.close()
 
 
 def attach_monitor(
@@ -397,14 +401,30 @@ def attach_monitor(
     """The shared `--metrics-port` wiring for both CLIs.
 
     ``metrics_port=None`` returns a fully inert monitor around
-    ``NULL_REGISTRY`` (every publish site stays a no-op); a port (0 =
-    ephemeral) builds the live registry, starts the HTTP server, and
-    (unless ``watchdog=False``) the watchdog thread. The caller logs
+    ``NULL_REGISTRY`` (every publish site stays a no-op) - UNLESS the
+    process runs under the elastic supervisor (`train/supervisor.py`
+    exports DNN_TPU_HEARTBEAT_FILE): then a real registry is built
+    regardless, with a `utils/obs.py HeartbeatFileWriter` mirroring its
+    heartbeat state into the supervisor's per-worker file. A port (0 =
+    ephemeral) additionally starts the HTTP server and (unless
+    ``watchdog=False``) the watchdog thread. The caller logs
     ``monitor.url`` and closes the monitor on exit.
     """
-    if metrics_port is None:
+    import os as _os
+
+    hb_path = _os.environ.get("DNN_TPU_HEARTBEAT_FILE")
+    if metrics_port is None and not hb_path:
         return Monitor(O.NULL_REGISTRY)
+    if metrics_port is None:
+        registry = O.MetricsRegistry()
+        hb = O.HeartbeatFileWriter(registry, hb_path)
+        log(f"(supervisor heartbeat file: {hb_path})")
+        return Monitor(registry, heartbeat=hb)
     registry = O.MetricsRegistry()
+    hb = None
+    if hb_path:
+        hb = O.HeartbeatFileWriter(registry, hb_path)
+        log(f"(supervisor heartbeat file: {hb_path})")
     server = O.ObsServer(registry, port=metrics_port)
     rec = RecompileDetector(registry=registry, tracer=tracer)
     dog = None
@@ -417,4 +437,4 @@ def attach_monitor(
         f"(metrics server: {server.url}/metrics , {server.url}/healthz"
         + (" ; watchdog on)" if dog is not None else " ; watchdog off)")
     )
-    return Monitor(registry, server, dog, rec)
+    return Monitor(registry, server, dog, rec, heartbeat=hb)

@@ -1,0 +1,647 @@
+"""Elastic multi-process supervisor: rendezvous, failure detection, and
+shrink/grow group restarts across real process boundaries.
+
+The reference's multi-process story is ``mpiexec -n N`` plus a fault
+*simulator* (`data_parallelism_train.py:41-46`) - a dead worker is a
+``time.sleep``, and a REAL dead worker kills the whole mpiexec group. The
+elastic machinery this repo grew in PR 6 (`parallel/reshard.py`,
+`train/elastic.py`) removed the mesh-shape restriction from checkpoints,
+but had only ever been exercised *inside one process*
+(``--chaos-shrink-at-step``). This module is the missing process layer -
+the single-node analog of a cluster manager's job controller:
+
+- **Rendezvous.** The supervisor owns coordinator port allocation
+  (`reserve_port`) and spawns N workers, each joining the JAX runtime
+  through the standard env-var handshake (`parallel/distributed.py
+  initialize()` - bounded retry/backoff on the worker side). A group that
+  dies *before* every worker has come up (port stolen between allocation
+  and bind, a straggler host) is a **rendezvous failure**: the whole
+  group is torn down and relaunched at the same size on a FRESH port,
+  under its own bounded retry budget - the bind-race fix that
+  `tests/test_multiprocess.py` used to be exposed to.
+- **Failure detection.** Workers are monitored via exit codes and a
+  heartbeat file each one writes (`utils/obs.py HeartbeatFileWriter`, fed
+  by the PR 5 metrics registry: writer liveness + last step). A non-zero
+  exit, a delivered signal, or (optionally) a stale heartbeat marks the
+  worker dead.
+- **Shrink restart.** On a worker death the survivors get SIGTERM -
+  triggering the PR 3 cooperative-preemption path (finish the step, write
+  an emergency checkpoint, exit 0) when they are not wedged in a
+  collective with the dead peer - then SIGKILL after a grace window. The
+  group relaunches with the surviving worker count; the worker command's
+  ``{nprocs}``/``{devices}`` tokens re-substitute, so an
+  ``lm_train.py --resume --elastic`` workload reshards the newest
+  consistent checkpoint onto the smaller mesh and continues with the
+  global batch and data cursor intact (`train/elastic.py`).
+- **Grow/rejoin.** When the group runs below target and capacity returns
+  (``capacity_fn``; full target on a single node), a *planned* restart -
+  graceful SIGTERM, emergency checkpoints, relaunch at the larger size -
+  rejoins the freed slots. Opt-in via ``grow_after_s`` (the healthy-time
+  hysteresis that stops a flapping host from thrashing the group).
+- **Restart budget.** Failure restarts consume a bounded budget with
+  exponential backoff between attempts; a crash-looping group exhausts it
+  and fails FAST with the last failure named (`SUPERVISOR ABORT`), never
+  flapping forever. Rendezvous retries are budgeted separately (they are
+  startup races, not workload crashes).
+
+Process-level chaos (`parallel/fault.py ProcessChaos`: kill rank R with
+SIGKILL/SIGTERM once its heartbeat reaches step S; rank 0 = coordinator
+death) is driven from this loop, so the whole
+detect -> checkpoint -> reshard -> resume story is exercised end to end
+across genuine process boundaries (`tools/launch.py --chaos-kill-*`,
+tests/test_supervisor.py, the supervisor-chaos-smoke CI job).
+
+Everything here is stdlib-only (no jax import): the supervisor must keep
+running when a worker's runtime is wedged, and the unit tests drive it
+with plain-python dummy workers. Live metrics ride the same registry as
+everything else (`utils/obs.py`): ``supervisor_group_size``,
+``worker_failures_total{signal}``, ``elastic_restarts_total{direction}``,
+``supervisor_restart_seconds`` - rendered by `tools/live_top.py`.
+Semantics: docs/ROBUSTNESS.md "Elastic supervisor".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from dataclasses import dataclass
+
+HEARTBEAT_ENV = "DNN_TPU_HEARTBEAT_FILE"
+
+# exit code a SUPERVISED worker uses for "preempted cleanly" (emergency
+# checkpoint written, exiting on request) - EX_TEMPFAIL. Exit 0 means the
+# workload is DONE; without a distinct code the supervisor could not tell
+# a finished worker from one that was asked to step aside and must be
+# relaunched (lm_train.py returns this when DNN_TPU_SUPERVISOR is set).
+PREEMPT_RC = 75
+
+# restart-latency histogram bounds: sub-second dummy-worker relaunches up
+# to multi-minute real-group teardowns (grace + SIGKILL + rendezvous)
+RESTART_SECONDS_BUCKETS = (
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0,
+)
+
+
+def reserve_port(host: str = "127.0.0.1") -> int:
+    """A currently-free TCP port from the OS.
+
+    The OS hands out a distinct ephemeral port per call, which makes the
+    classic allocate->close->bind race *rare*, not impossible - another
+    process can still take it before the coordinator binds. The fix is
+    not a cleverer allocator but ownership: the supervisor reserves a
+    FRESH port for every group launch and treats a group that dies during
+    rendezvous as retryable (`SupervisorConfig.rendezvous_retries`), so a
+    lost race costs one relaunch instead of a failed run.
+    `tests/test_multiprocess.py` reuses this allocator + the retry idiom
+    instead of rolling its own.
+    """
+    with socket.socket() as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((host, 0))
+        return int(s.getsockname()[1])
+
+
+def read_heartbeat(path: str) -> dict | None:
+    """Parse one heartbeat file (`utils/obs.py HeartbeatFileWriter`
+    schema: {"t", "beat_unix", "step", "pid"}); None when absent or
+    torn (the writer publishes atomically, but the first write may not
+    have landed yet)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
+def signal_label(returncode: int) -> str:
+    """Prometheus-friendly failure label: killed-by-signal exits name the
+    signal (SIGKILL/SIGTERM/...), a clean preemption exit is "preempt",
+    plain failures are exit:<code>."""
+    if returncode == PREEMPT_RC:
+        return "preempt"
+    if returncode < 0:
+        try:
+            return signal.Signals(-returncode).name
+        except ValueError:
+            return f"signal:{-returncode}"
+    return f"exit:{returncode}"
+
+
+@dataclass
+class SupervisorConfig:
+    """Knobs for `Supervisor`; `tools/launch.py` maps them 1:1 to flags."""
+
+    nprocs: int
+    devices_per_proc: int = 1
+    # force_host_devices: append --xla_force_host_platform_device_count to
+    # each worker's XLA_FLAGS (the CPU dev/CI mode); off for real
+    # accelerators where the local device count is the hardware's
+    force_host_devices: bool = True
+    min_procs: int = 1
+    # failure-restart budget for the whole run; exhausted -> fail fast
+    max_restarts: int = 3
+    restart_backoff_s: float = 1.0
+    backoff_cap_s: float = 30.0
+    # startup races (coordinator port lost, worker died before the full
+    # group ever heartbeat) retry on a fresh port under their own budget
+    rendezvous_retries: int = 2
+    rendezvous_timeout_s: float = 120.0
+    # SIGTERM -> SIGKILL grace when stopping survivors (long enough for a
+    # healthy worker to finish its step + emergency checkpoint)
+    grace_s: float = 10.0
+    # 0 = exit codes only; > 0 additionally treats a worker whose TRAINING
+    # heartbeat (beat_unix) is older than this as dead (armed only after
+    # the worker's first beat - compilation produces none)
+    heartbeat_timeout_s: float = 0.0
+    # 0 = never grow; > 0 = after a shrunk group has been healthy this
+    # long AND capacity_fn() reports free slots, do a planned grow restart
+    grow_after_s: float = 0.0
+    poll_s: float = 0.2
+    host: str = "127.0.0.1"
+
+    def __post_init__(self):
+        if self.nprocs < 1:
+            raise ValueError(f"nprocs must be >= 1, got {self.nprocs}")
+        if not 1 <= self.min_procs <= self.nprocs:
+            raise ValueError(
+                f"min_procs must be in [1, nprocs={self.nprocs}], got "
+                f"{self.min_procs}"
+            )
+        if self.devices_per_proc < 1:
+            raise ValueError(
+                f"devices_per_proc must be >= 1, got {self.devices_per_proc}"
+            )
+        for name in ("max_restarts", "rendezvous_retries"):
+            if getattr(self, name) < 0:
+                raise ValueError(f"{name} must be >= 0")
+        for name in ("restart_backoff_s", "grace_s", "poll_s"):
+            if getattr(self, name) <= 0:
+                raise ValueError(f"{name} must be > 0")
+
+
+@dataclass
+class _Worker:
+    rank: int
+    proc: subprocess.Popen
+    hb_path: str
+    log_path: str
+    log_file: object
+    returncode: int | None = None
+    ever_beat: bool = False
+
+    def poll(self) -> int | None:
+        if self.returncode is None:
+            rc = self.proc.poll()
+            if rc is not None:
+                self.returncode = rc
+                try:
+                    self.log_file.close()
+                except Exception:
+                    pass
+        return self.returncode
+
+    def alive(self) -> bool:
+        return self.poll() is None
+
+    def kill(self, sig: int) -> None:
+        if self.alive():
+            try:
+                self.proc.send_signal(sig)
+            except (ProcessLookupError, OSError):
+                pass
+
+
+class Supervisor:
+    """Spawn and babysit one elastic training group (see module docs).
+
+    ``command`` is the worker argv; every element may carry the tokens
+    ``{rank}`` (this worker's process id), ``{nprocs}`` (the CURRENT
+    group size), and ``{devices}`` (nprocs * devices_per_proc - what an
+    ``lm_train.py --dp {devices}`` mesh should span), re-substituted on
+    every (re)launch so a shrink/grow restart reshapes the workload.
+    ``capacity_fn() -> int`` reports how many worker slots are currently
+    available (defaults to the full target - the single-node case);
+    ``chaos`` is a `parallel/fault.py ProcessChaos` plan driven from the
+    monitor loop. `run()` blocks until the group completes (rc 0), the
+    restart budget is exhausted (rc 3), or rendezvous never succeeds
+    (rc 4), and prints one machine-readable ``SUPERVISOR_SUMMARY {json}``
+    line either way.
+    """
+
+    def __init__(
+        self,
+        command: list,
+        config: SupervisorConfig,
+        *,
+        run_dir: str,
+        chaos=None,
+        base_env: dict | None = None,
+        registry=None,
+        capacity_fn=None,
+        log=print,
+    ):
+        self.command = [str(c) for c in command]
+        self.cfg = config
+        self.run_dir = os.path.abspath(run_dir)
+        self.chaos = chaos
+        self.base_env = dict(base_env if base_env is not None else os.environ)
+        self.capacity_fn = capacity_fn or (lambda: config.nprocs)
+        self.log = log
+        if registry is None:
+            from ..utils.obs import MetricsRegistry
+
+            registry = MetricsRegistry()
+        self.registry = registry
+        self._m_size = registry.gauge(
+            "supervisor_group_size", "Live worker count of the elastic group"
+        )
+        self._m_target = registry.gauge(
+            "supervisor_target_size", "Configured target worker count"
+        )
+        self._m_budget = registry.gauge(
+            "supervisor_restart_budget_remaining",
+            "Failure restarts left before the group fails fast",
+        )
+        self._m_failures = registry.counter(
+            "worker_failures_total",
+            "Worker deaths observed, by signal/exit label",
+        )
+        self._m_restarts = registry.counter(
+            "elastic_restarts_total",
+            "Group restarts, by direction (shrink/grow/rendezvous)",
+        )
+        self._m_restart_s = registry.histogram(
+            "supervisor_restart_seconds",
+            "Failure detection -> group respawned latency",
+            buckets=RESTART_SECONDS_BUCKETS,
+        )
+        self.workers: list[_Worker] = []
+        self.generation = -1
+        self.n = config.nprocs
+        self.port: int | None = None
+        self.restarts_used = 0
+        self.rendezvous_used = 0
+        self.failures: list[dict] = []
+        self._group_started = 0.0
+        self._healthy_since: float | None = None
+        os.makedirs(os.path.join(self.run_dir, "hb"), exist_ok=True)
+        os.makedirs(os.path.join(self.run_dir, "logs"), exist_ok=True)
+        self._m_target.set(config.nprocs)
+        self._m_budget.set(config.max_restarts)
+
+    # ------------------------------------------------------------- spawn
+
+    def _worker_argv(self, rank: int, n: int) -> list:
+        devices = n * self.cfg.devices_per_proc
+        sub = {
+            "{rank}": str(rank), "{nprocs}": str(n),
+            "{devices}": str(devices),
+        }
+        out = []
+        for arg in self.command:
+            for k, v in sub.items():
+                arg = arg.replace(k, v)
+            out.append(arg)
+        return out
+
+    def _worker_env(self, rank: int, n: int, port: int, hb_path: str) -> dict:
+        env = dict(self.base_env)
+        if self.cfg.force_host_devices:
+            # replace (not append) any inherited device-count flag: the
+            # supervisor's parent env often carries its own (conftest,
+            # dev shells), and the WORKER's count must win unambiguously
+            kept = [
+                f for f in env.get("XLA_FLAGS", "").split()
+                if not f.startswith("--xla_force_host_platform_device_count")
+            ]
+            kept.append(
+                "--xla_force_host_platform_device_count="
+                f"{self.cfg.devices_per_proc}"
+            )
+            env["XLA_FLAGS"] = " ".join(kept)
+        env["JAX_COORDINATOR_ADDRESS"] = f"{self.cfg.host}:{port}"
+        env["JAX_NUM_PROCESSES"] = str(n)
+        env["JAX_PROCESS_ID"] = str(rank)
+        env[HEARTBEAT_ENV] = hb_path
+        env["DNN_TPU_SUPERVISOR"] = "1"
+        env["DNN_TPU_SUPERVISOR_GEN"] = str(self.generation)
+        return env
+
+    def _spawn_group(self, n: int) -> None:
+        self.generation += 1
+        self.n = n
+        self.port = reserve_port(self.cfg.host)
+        self.workers = []
+        g = self.generation
+        for rank in range(n):
+            hb_path = os.path.join(
+                self.run_dir, "hb", f"gen{g}_rank{rank}.json"
+            )
+            log_path = os.path.join(
+                self.run_dir, "logs", f"gen{g}_rank{rank}.log"
+            )
+            log_file = open(log_path, "w")
+            argv = self._worker_argv(rank, n)
+            proc = subprocess.Popen(
+                argv,
+                env=self._worker_env(rank, n, self.port, hb_path),
+                stdout=log_file,
+                stderr=subprocess.STDOUT,
+            )
+            self.workers.append(
+                _Worker(rank, proc, hb_path, log_path, log_file)
+            )
+        self._group_started = time.monotonic()
+        self._healthy_since = None
+        self._m_size.set(n)
+        self.log(
+            f"(supervisor: gen {g} - {n} worker(s) x "
+            f"{self.cfg.devices_per_proc} device(s), coordinator "
+            f"{self.cfg.host}:{self.port}, logs {self.run_dir}/logs)"
+        )
+
+    # -------------------------------------------------------------- stop
+
+    def _stop_group(self, *, reason: str) -> None:
+        """SIGTERM every living worker (the cooperative emergency-
+        checkpoint path), SIGKILL whatever outlives the grace window."""
+        living = [w for w in self.workers if w.alive()]
+        if living:
+            self.log(
+                f"(supervisor: stopping {len(living)} worker(s) - {reason}; "
+                f"SIGTERM, then SIGKILL after {self.cfg.grace_s:g}s)"
+            )
+        for w in living:
+            w.kill(signal.SIGTERM)
+        deadline = time.monotonic() + self.cfg.grace_s
+        while time.monotonic() < deadline and any(
+            w.alive() for w in self.workers
+        ):
+            time.sleep(min(self.cfg.poll_s, 0.1))
+        for w in self.workers:
+            if w.alive():
+                self.log(
+                    f"(supervisor: rank {w.rank} ignored SIGTERM for "
+                    f"{self.cfg.grace_s:g}s; SIGKILL)"
+                )
+                w.kill(signal.SIGKILL)
+        for w in self.workers:
+            try:
+                w.proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:  # pragma: no cover - defensive
+                pass
+            w.poll()
+
+    def _tail(self, w: _Worker, lines: int = 20) -> str:
+        try:
+            with open(w.log_path, errors="replace") as f:
+                return "".join(f.readlines()[-lines:])
+        except OSError:
+            return "(no log)"
+
+    # ------------------------------------------------------------ monitor
+
+    def _observe(self) -> dict:
+        """One poll: worker liveness + heartbeat steps; fires due chaos."""
+        steps: dict[int, int | None] = {}
+        for w in self.workers:
+            # read even for dead workers: the file's existence proves the
+            # worker got through rendezvous, however briefly it lived
+            hb = read_heartbeat(w.hb_path)
+            if hb is not None:
+                w.ever_beat = True
+                if not w.alive():
+                    continue
+                steps[w.rank] = hb.get("step")
+                if self.cfg.heartbeat_timeout_s > 0:
+                    beat = hb.get("beat_unix")
+                    if (
+                        beat is not None
+                        and time.time() - float(beat)
+                        > self.cfg.heartbeat_timeout_s
+                    ):
+                        self.log(
+                            f"(supervisor: rank {w.rank} heartbeat is "
+                            f"{time.time() - float(beat):.1f}s stale "
+                            f"(budget {self.cfg.heartbeat_timeout_s:g}s); "
+                            "declaring it dead)"
+                        )
+                        w.kill(signal.SIGKILL)
+        if self.chaos is not None:
+            for rank, sig in self.chaos.due(steps):
+                for w in self.workers:
+                    if w.rank == rank and w.alive():
+                        self.log(
+                            f"(supervisor chaos: sending "
+                            f"{signal.Signals(sig).name} to rank {rank}"
+                            + (" [the coordinator process]"
+                               if rank == 0 else "")
+                            + f" at step {steps.get(rank)})"
+                        )
+                        w.kill(sig)
+        return steps
+
+    def _group_ready(self) -> bool:
+        return all(w.ever_beat for w in self.workers)
+
+    # --------------------------------------------------------------- run
+
+    def run(self) -> int:
+        self._spawn_group(self.n)
+        rc = self._loop()
+        self._summary(rc)
+        return rc
+
+    def _loop(self) -> int:
+        cfg = self.cfg
+        while True:
+            time.sleep(cfg.poll_s)
+            self._observe()
+            exited = [w for w in self.workers if not w.alive()]
+            failed = [w for w in exited if w.returncode != 0]
+            if failed:
+                rc = self._handle_failure(failed)
+                if rc is not None:
+                    return rc
+                continue
+            if len(exited) == len(self.workers):
+                self.log(
+                    f"(supervisor: all {self.n} worker(s) exited cleanly)"
+                )
+                return 0
+            ready = self._group_ready()
+            if not ready and (
+                time.monotonic() - self._group_started
+                > cfg.rendezvous_timeout_s
+            ):
+                self.log(
+                    "(supervisor: group did not finish rendezvous within "
+                    f"{cfg.rendezvous_timeout_s:g}s)"
+                )
+                rc = self._handle_failure([], rendezvous_timeout=True)
+                if rc is not None:
+                    return rc
+                continue
+            if ready:
+                if self._healthy_since is None:
+                    self._healthy_since = time.monotonic()
+                grow_rc = self._maybe_grow()
+                if grow_rc is not None:
+                    return grow_rc
+
+    def _maybe_grow(self) -> int | None:
+        cfg = self.cfg
+        if cfg.grow_after_s <= 0 or self.n >= cfg.nprocs:
+            return None
+        if (
+            self._healthy_since is None
+            or time.monotonic() - self._healthy_since < cfg.grow_after_s
+        ):
+            return None
+        capacity = min(int(self.capacity_fn()), cfg.nprocs)
+        if capacity <= self.n:
+            return None
+        self.log(
+            f"(supervisor: capacity is back ({capacity} slot(s)); planned "
+            f"grow restart {self.n} -> {capacity} - graceful SIGTERM so "
+            "every worker writes its emergency checkpoint first)"
+        )
+        t0 = time.monotonic()
+        self._stop_group(reason="planned grow restart")
+        bad = [
+            w for w in self.workers
+            if w.returncode not in (0, None, PREEMPT_RC)
+        ]
+        if bad:
+            # a worker that cannot even stop cleanly is a real failure -
+            # fall through to the failure path (budgeted) instead of
+            # growing on top of a corrupt group
+            return self._handle_failure(bad)
+        self._m_restarts.labels(direction="grow").inc()
+        self._spawn_group(capacity)
+        self._m_restart_s.observe(time.monotonic() - t0)
+        return None
+
+    def _handle_failure(
+        self, failed: list, *, rendezvous_timeout: bool = False
+    ) -> int | None:
+        """Tear the group down and decide: relaunch (None) or abort (rc)."""
+        cfg = self.cfg
+        t0 = time.monotonic()
+        rendezvous = rendezvous_timeout or not self._group_ready()
+        for w in failed:
+            label = signal_label(w.returncode)
+            self._m_failures.labels(signal=label).inc()
+            self.failures.append(
+                {"gen": self.generation, "rank": w.rank, "cause": label}
+            )
+            self.log(
+                f"(supervisor: rank {w.rank} died [{label}]"
+                + (" during rendezvous" if rendezvous else "")
+                + f"; last output:\n{self._tail(w)})"
+            )
+        self._stop_group(
+            reason="worker failure" if failed else "rendezvous timeout"
+        )
+        # deaths during teardown are collateral of the group stop, not new
+        # failures; they are visible in the logs either way
+        if rendezvous:
+            self.rendezvous_used += 1
+            if self.rendezvous_used > cfg.rendezvous_retries:
+                self.log(
+                    "SUPERVISOR ABORT: rendezvous failed "
+                    f"{self.rendezvous_used} time(s) (budget "
+                    f"{cfg.rendezvous_retries}); the group never came up. "
+                    "Check the worker logs for the real error (import "
+                    "failure, bad flags, unreachable coordinator) - "
+                    f"{self.run_dir}/logs"
+                )
+                return 4
+            self._m_restarts.labels(direction="rendezvous").inc()
+            self.log(
+                f"(supervisor: rendezvous retry "
+                f"{self.rendezvous_used}/{cfg.rendezvous_retries} on a "
+                "fresh port)"
+            )
+            self._spawn_group(self.n)
+            self._m_restart_s.observe(time.monotonic() - t0)
+            return None
+        self.restarts_used += 1
+        self._m_budget.set(max(cfg.max_restarts - self.restarts_used, 0))
+        last = self.failures[-1] if self.failures else {"cause": "unknown"}
+        if self.restarts_used > cfg.max_restarts:
+            self.log(
+                f"SUPERVISOR ABORT: restart budget ({cfg.max_restarts}) "
+                f"exhausted after {self.restarts_used} failure(s); last "
+                f"failure: rank {last.get('rank')} [{last.get('cause')}] "
+                f"in gen {last.get('gen')}. The group is crash-looping - "
+                "inspect the worker logs "
+                f"({self.run_dir}/logs), fix the cause, and relaunch; the "
+                "newest consistent checkpoint is intact."
+            )
+            return 3
+        if len(failed) >= len(self.workers):
+            # the WHOLE group died at once (e.g. a coordinator crash took
+            # everyone down): there is no survivor count to shrink onto,
+            # but the newest checkpoint still allows a same-size restart
+            new_n = self.n
+        else:
+            new_n = self.n - len(failed)
+            if new_n < cfg.min_procs:
+                self.log(
+                    f"SUPERVISOR ABORT: only {new_n} worker(s) survive "
+                    f"but --min-procs is {cfg.min_procs}; not enough "
+                    "capacity to continue. Last failure: rank "
+                    f"{last.get('rank')} [{last.get('cause')}]."
+                )
+                return 3
+        pause = min(
+            cfg.restart_backoff_s * (2 ** (self.restarts_used - 1)),
+            cfg.backoff_cap_s,
+        )
+        direction = "shrink" if new_n < self.n else "same"
+        self.log(
+            f"(supervisor: restart {self.restarts_used}/{cfg.max_restarts} "
+            f"[{direction}] {self.n} -> {new_n} worker(s) after "
+            f"{pause:.1f}s backoff; resuming from the newest consistent "
+            "checkpoint)"
+        )
+        time.sleep(pause)
+        self._m_restarts.labels(direction=direction).inc()
+        self._spawn_group(new_n)
+        self._m_restart_s.observe(time.monotonic() - t0)
+        return None
+
+    def _summary(self, rc: int) -> None:
+        self.log("SUPERVISOR_SUMMARY " + json.dumps({
+            "exit": {0: "ok", 3: "budget", 4: "rendezvous"}.get(rc, "error"),
+            "rc": rc,
+            "target_nprocs": self.cfg.nprocs,
+            "final_size": self.n,
+            "generations": self.generation + 1,
+            "restarts": self.restarts_used,
+            "rendezvous_retries": self.rendezvous_used,
+            "worker_failures": self.failures,
+        }))
+
+
+def main(argv=None) -> int:  # pragma: no cover - thin alias
+    """`python -m distributed_neural_network_tpu.train.supervisor` =
+    tools/launch.py (kept import-light; the CLI lives in tools/)."""
+    repo = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    sys.path.insert(0, os.path.join(repo, "tools"))
+    import launch
+
+    return launch.main(argv)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -42,8 +42,42 @@ except Exception:  # pragma: no cover
     _HAVE_ORBAX = False
 
 
+def _host_leaf(x):
+    """One leaf to host numpy, multi-process safe.
+
+    Single-process (and any fully-addressable array): plain device_get.
+    On a multi-process mesh a replicated leaf is read from the first
+    LOCAL shard (every replica holds the full value - no collective, so
+    ranks at slightly different wall-clock positions cannot deadlock),
+    while a cross-process-sharded leaf (ZeRO flat buffers, the engine's
+    per-device momentum stack) is reassembled with
+    ``multihost_utils.process_allgather`` - a collective, which is why
+    `save()` runs the host conversion on EVERY rank before only rank 0
+    writes the files.
+    """
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        if x.sharding.is_fully_replicated:
+            return np.asarray(x.addressable_shards[0].data)
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return np.asarray(jax.device_get(x))
+
+
 def _host_tree(tree):
-    return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
+    return jax.tree.map(_host_leaf, tree)
+
+
+def _is_writer_rank() -> bool:
+    """True on the process that owns the checkpoint files (rank 0). With
+    one process - the common case - always True; in a multi-process group
+    every rank participates in `_host_tree`'s collectives but only this
+    one touches the directory (a shared filesystem would otherwise get N
+    racing writers of the same step)."""
+    try:
+        return jax.process_index() == 0
+    except Exception:  # jax backend not initialized yet
+        return True
 
 
 class CheckpointCorruptError(RuntimeError):
@@ -320,7 +354,11 @@ class TreeCheckpointer:
         self._metrics = _CkptMetrics(registry)
 
     def save(self, step: int, state, meta: dict | None = None) -> None:
-        self._b.save(step, _host_tree(state), meta or {})
+        # host conversion on EVERY rank (it may be collective for
+        # cross-process-sharded leaves); file writes on rank 0 only
+        host = _host_tree(state)
+        if _is_writer_rank():
+            self._b.save(step, host, meta or {})
         self._metrics.saved(step)
 
     def latest_step(self):
@@ -425,7 +463,8 @@ class Checkpointer:
             # continuation's data order bit-exactly (train/guard.py)
             **resume_cursor(step=epoch, seed=engine.config.seed),
         }
-        self._b.save(epoch, state, meta)
+        if _is_writer_rank():
+            self._b.save(epoch, state, meta)
         self._metrics.saved(epoch)
 
     # --------------------------------------------------------------- restore

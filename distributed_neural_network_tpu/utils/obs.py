@@ -35,6 +35,7 @@ from __future__ import annotations
 import http.server
 import json
 import math
+import os
 import threading
 import time
 from collections import deque
@@ -452,6 +453,59 @@ class NullRegistry:
 
 
 NULL_REGISTRY = NullRegistry()
+
+
+class HeartbeatFileWriter:
+    """Daemon thread mirroring a registry's heartbeat state into a small
+    JSON file - the per-worker liveness channel the elastic supervisor
+    (`train/supervisor.py`) monitors across the process boundary.
+
+    Schema (all the supervisor's failure detection and chaos step
+    triggers need): ``{"t": <writer wall time>, "beat_unix": <last
+    training-step heartbeat or null while compiling>, "step": <last
+    heartbeat step or null>, "pid": ...}``. Written atomically
+    (tmp + rename) every ``interval_s`` so a reader never sees a torn
+    file; the file's very existence doubles as the worker's
+    "rendezvous done" signal (the writer is attached after
+    `parallel/distributed.py initialize()` succeeded).
+    """
+
+    def __init__(self, registry, path: str, *, interval_s: float = 0.5):
+        self.registry = registry
+        self.path = os.path.abspath(path)
+        self.interval_s = float(interval_s)
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="heartbeat-file", daemon=True
+        )
+        self._write()  # the rendezvous-done marker, before the first tick
+        self._thread.start()
+
+    def _write(self) -> None:
+        age = self.registry.heartbeat_age()
+        doc = {
+            "t": time.time(),
+            "beat_unix": (time.time() - age) if age is not None else None,
+            "step": self.registry.last_step(),
+            "pid": os.getpid(),
+        }
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, self.path)
+        except OSError:
+            pass  # a full disk must never kill the training loop
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self._write()
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self._write()  # final state (the supervisor sees the last step)
 
 
 def publish_phase_timers(registry, timers) -> None:

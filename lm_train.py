@@ -80,6 +80,12 @@ def main() -> int:
         "it saves the most)",
     )
     p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--stop-at-step", type=int, default=None, metavar="N",
+                   help="ABSOLUTE step to stop before (end_step = N), "
+                   "overriding the relative '--steps more' semantics on "
+                   "resume - the supervisor (tools/launch.py) passes this "
+                   "so every relaunch of an elastic group trains to the "
+                   "same target instead of adding --steps per restart")
     p.add_argument("--batch-size", type=int, default=32, help="global batch")
     p.add_argument("--seq-len", type=int, default=64)
     p.add_argument("--vocab", type=int, default=256)
@@ -401,11 +407,8 @@ def main() -> int:
         p.error("--elastic configures how --resume (or a SHRINK "
                 "preemption) maps a checkpoint onto this mesh; add "
                 "--resume with --checkpoint-dir, or --chaos-shrink-at-step")
-    if args.elastic and args.pp > 1 and args.optimizer.startswith("zero"):
-        p.error("--elastic with --pp composes with sgd/adam only: the "
-                "pipeline ZeRO buffers carry a per-stage split the "
-                "portable reshard template cannot rebuild "
-                "(docs/ROBUSTNESS.md 'Elastic resume')")
+    if args.stop_at_step is not None and args.stop_at_step < 1:
+        p.error(f"--stop-at-step must be >= 1, got {args.stop_at_step}")
     if args.chaos_shrink_at_step is not None:
         if args.pp > 1:
             p.error("--chaos-shrink-at-step shrinks the dp x sp x tp mesh "
@@ -649,6 +652,27 @@ def main() -> int:
 
     param_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
 
+    def place_batch(tok, tgt):
+        """Host batch -> the mesh's data sharding. Single-process: the jit
+        boundary places it (a no-op here keeps that path byte-identical).
+        Multi-process (a supervisor group, real multi-host): each process
+        uploads only its addressable slices via `distribute_host_data` -
+        the compiled step's in_specs span devices this host cannot see,
+        so host arrays must become global jax.Arrays BEFORE dispatch."""
+        if jax.process_count() == 1:
+            return tok, tgt
+        import numpy as _np
+
+        from distributed_neural_network_tpu.parallel.distributed import (
+            distribute_host_data,
+        )
+
+        spec = P("data") if pipe else P("data", "seq")
+        return (
+            distribute_host_data(_np.asarray(tok), mesh, spec),
+            distribute_host_data(_np.asarray(tgt), mesh, spec),
+        )
+
     mesh_desc = "x".join(
         f"{k}{v}" for k, v in mesh.shape.items() if v > 1
     ) or "single"
@@ -848,7 +872,7 @@ def main() -> int:
             tok, tgt = jnp.asarray(tok), jnp.asarray(tgt)
             if zperm is not None:
                 tok, tgt = tok[:, zperm], tgt[:, zperm]
-            return tok, tgt
+            return place_batch(tok, tgt)
 
         tokens, targets = batch_at(0)
     else:
@@ -858,6 +882,7 @@ def main() -> int:
         )
         if zperm is not None:
             tokens, targets = tokens[:, zperm], targets[:, zperm]
+        tokens, targets = place_batch(tokens, targets)
 
     eval_fn = None
     if args.eval_every and pipe:
@@ -1013,7 +1038,8 @@ def main() -> int:
         as cache misses."""
         if monitor.recompiles is not None:
             monitor.recompiles.swap(fn)
-        if stats is None and monitor.server is None:
+        if stats is None and monitor.server is None \
+                and monitor.heartbeat is None:
             return fn
         return lmtrain.make_traced_step(
             fn, tracer=tracer, step_stats=stats,
@@ -1069,7 +1095,23 @@ def main() -> int:
     eval_s = 0.0
     preempted = False
     timed_steps = 0
-    end_step = step0 + args.steps
+    end_step = (
+        args.stop_at_step if args.stop_at_step is not None
+        else step0 + args.steps
+    )
+    if end_step <= step0:
+        # a supervised relaunch after the target step was already reached
+        # (e.g. the group shrank on the very last checkpoint): nothing to
+        # train, exit cleanly so the supervisor records completion
+        print(f"(stop-at-step {end_step} already reached - resumed at "
+              f"step {step0}; nothing to do)")
+        if preempt is not None:
+            preempt.uninstall()
+        if ck is not None:
+            ck.close()
+        run.stop()
+        monitor.close()
+        return 0
     i = last_step = step0
 
     def handle_verdict(v) -> bool:
@@ -1359,6 +1401,21 @@ def main() -> int:
               "final scrapes)")
         time.sleep(args.metrics_linger)
     monitor.close()
+    if preempted and os.environ.get("DNN_TPU_SUPERVISOR"):
+        # tell the supervisor (train/supervisor.py) this is a clean
+        # PREEMPTION, not workload completion: the emergency checkpoint
+        # is on disk and the group should restart from it. os._exit skips
+        # the jax distributed-runtime shutdown barrier - on a preemption
+        # the OTHER ranks are usually still mid-step, and waiting for
+        # them would hold the exit (and the supervisor's restart) for the
+        # barrier's multi-minute timeout.
+        from distributed_neural_network_tpu.train.supervisor import (
+            PREEMPT_RC,
+        )
+
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(PREEMPT_RC)
     return 0
 
 

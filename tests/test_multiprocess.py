@@ -1,36 +1,44 @@
-"""Real 2-process distributed execution (VERDICT r2 missing #3).
+"""Real multi-process distributed execution (VERDICT r2 missing #3) and
+the elastic supervisor driving it across process boundaries.
 
 The reference genuinely runs N OS processes under `mpiexec -n N`
 (`/root/reference/README.md:28`, rank discovery
 `data_parallelism_train.py:60-62`). This is the TPU-native equivalent:
-two actual Python processes join one JAX runtime via the coordinator
-handshake (`parallel/distributed.py initialize()`), each contributing 4
-virtual CPU devices to a global 8-device mesh, and train one data-parallel
-epoch through the engine - executing the multi-host happy path and BOTH
-`distribute_host_data` branches that in-process tests cannot reach.
+actual Python processes join one JAX runtime via the coordinator
+handshake (`parallel/distributed.py initialize()`) - and, in the
+supervisor tests, get KILLED mid-run so the survivors must reshard the
+newest checkpoint onto the smaller mesh and continue with the data
+cursor intact (train/supervisor.py, docs/ROBUSTNESS.md "Elastic
+supervisor"). Coordinator ports come from the supervisor's allocator
+(`reserve_port`) - port ownership lives with the launcher, and a lost
+bind race is retried there instead of failing the test.
 """
 
 import json
 import os
-import socket
 import subprocess
 import sys
 
 import pytest
 
+from distributed_neural_network_tpu.parallel.fault import (
+    KillEvent,
+    ProcessChaos,
+)
+from distributed_neural_network_tpu.train.supervisor import (
+    Supervisor,
+    SupervisorConfig,
+    reserve_port,
+)
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "mp_worker.py")
-
-
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+SV_WORKER = os.path.join(REPO, "tests", "sv_worker.py")
 
 
 @pytest.mark.slow
 def test_two_process_mesh_trains_one_epoch():
-    port = _free_port()
+    port = reserve_port()
     procs = []
     for pid in range(2):
         env = dict(os.environ)
@@ -83,3 +91,105 @@ def test_two_process_mesh_trains_one_epoch():
         r1["zero_adam_loss"], rel=1e-6
     )
     assert 0.0 < r0["zero_adam_loss"] < 10.0, r0["zero_adam_loss"]
+
+
+# ----------------------------------- elastic supervisor, real jax group
+
+
+STOP_AT = 12
+
+
+def _sv_oracle(stop_at: int = STOP_AT) -> float:
+    """sv_worker's final state as a pure function of the step count: any
+    kill/shrink/resume schedule that preserves the cursor must land here."""
+    s = sum(range(stop_at))
+    return 16 * 0.001 * 12 * s + 12 * s
+
+
+def _run_supervised(tmp_path, *, nprocs, chaos, stop_at=STOP_AT,
+                    step_sleep=0.3, **cfg_kw):
+    logs = []
+    base_env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cfg = SupervisorConfig(
+        nprocs=nprocs, devices_per_proc=1, poll_s=0.1, grace_s=5.0,
+        restart_backoff_s=0.2, rendezvous_timeout_s=300.0,
+        **cfg_kw,
+    )
+    sup = Supervisor(
+        [sys.executable, SV_WORKER, str(tmp_path / "ck"), str(stop_at),
+         str(step_sleep)],
+        cfg,
+        run_dir=str(tmp_path / "run"),
+        chaos=chaos,
+        base_env=base_env,
+        log=lambda *a: logs.append(" ".join(str(x) for x in a)),
+    )
+    rc = sup.run()
+    summary = json.loads(next(
+        ln for ln in logs if ln.startswith("SUPERVISOR_SUMMARY ")
+    )[len("SUPERVISOR_SUMMARY "):])
+    return rc, summary, logs, sup
+
+
+def _worker_logs(sup):
+    out = {}
+    log_dir = os.path.join(sup.run_dir, "logs")
+    for name in sorted(os.listdir(log_dir)):
+        with open(os.path.join(log_dir, name), errors="replace") as f:
+            out[name] = f.read()
+    return out
+
+
+def _sv_results(texts):
+    res = []
+    for body in texts.values():
+        for ln in body.splitlines():
+            if ln.startswith("SV_RESULT "):
+                res.append(json.loads(ln[len("SV_RESULT "):]))
+    return res
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_supervisor_sigkill_shrinks_real_group(tmp_path):
+    """3 real jax processes; rank 2 is SIGKILLed mid-run. The supervisor
+    SIGTERMs the (wedged) survivors, SIGKILLs them after grace, and
+    relaunches 2 workers that elastic-restore the newest checkpoint onto
+    the smaller mesh - the final state matches the uninterrupted oracle
+    exactly (cursor intact: every step's contribution is a function of
+    the step index alone)."""
+    chaos = ProcessChaos(events=(KillEvent(rank=2, at_step=3, sig="KILL"),))
+    rc, summary, logs, sup = _run_supervised(
+        tmp_path, nprocs=3, chaos=chaos, max_restarts=2,
+    )
+    assert rc == 0, "\n".join(logs)
+    assert summary["exit"] == "ok" and summary["final_size"] == 2
+    assert {"gen": 0, "rank": 2, "cause": "SIGKILL"} in \
+        summary["worker_failures"]
+    texts = _worker_logs(sup)
+    results = _sv_results(texts)
+    assert results, texts.keys()
+    finals = {round(r["final"], 3) for r in results}
+    assert finals == {round(_sv_oracle(), 3)}, (finals, _sv_oracle())
+    done = [r for r in results if r["nprocs"] == 2]
+    assert done and all(r["start_step"] > 0 for r in done)
+    assert any("resumed from step" in t for t in texts.values())
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_supervisor_sigterm_preemption_is_lossless(tmp_path):
+    """TERM chaos = a preemption notice: the worker finishes its step,
+    writes the emergency checkpoint, and exits PREEMPT_RC; the supervisor
+    restarts the survivors WITHOUT losing a step (same oracle)."""
+    chaos = ProcessChaos(events=(KillEvent(rank=1, at_step=3, sig="TERM"),))
+    rc, summary, logs, sup = _run_supervised(
+        tmp_path, nprocs=2, chaos=chaos, max_restarts=2,
+    )
+    assert rc == 0, "\n".join(logs)
+    assert summary["final_size"] == 1
+    causes = {f["cause"] for f in summary["worker_failures"]}
+    assert "preempt" in causes, summary
+    results = _sv_results(_worker_logs(sup))
+    finals = {round(r["final"], 3) for r in results}
+    assert finals == {round(_sv_oracle(), 3)}

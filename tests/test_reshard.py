@@ -24,6 +24,7 @@ import subprocess
 import sys
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -348,10 +349,115 @@ def test_saved_state_template_matches_all_optimizers(n_devices):
             assert a == b, (optimizer, a, b)
 
 
-def test_saved_state_template_rejects_pp_zero():
-    with pytest.raises(ValueError, match="pipeline"):
-        E.saved_state_template(
-            _cfg(), {"optimizer": "zero", "axes": {"data": 2, "pipe": 2}}
+def test_saved_state_template_pp_zero_matches_init(n_devices):
+    """The ZeRO-under-pp template rebuilds init_pp_zero_state's per-stage
+    split (pp segments of dp-padded stage-local buffers) exactly - shapes,
+    dtypes, and tree structure - for both zero and zero-adam."""
+    from distributed_neural_network_tpu.parallel.pipeline import (
+        create_pp_mesh,
+        init_pp_zero_state,
+        pp_param_specs,
+    )
+
+    cfg = _cfg()
+    mesh = create_pp_mesh(2, 2, 1)
+    params = jax.eval_shape(
+        lambda k: tfm.init_params(k, cfg),
+        jax.ShapeDtypeStruct((2,), jnp.uint32),
+    )
+    specs = pp_param_specs(cfg)
+    for optimizer in ("zero", "zero-adam"):
+        want = jax.eval_shape(
+            lambda p: init_pp_zero_state(p, specs, mesh, optimizer), params
+        )
+        tpl = E.saved_state_template(
+            cfg, {"optimizer": optimizer, "axes": {"data": 2, "pipe": 2}}
+        )
+        assert jax.tree.structure(tpl["mom"]) == jax.tree.structure(want)
+        for a, b in zip(jax.tree.leaves(tpl["mom"]), jax.tree.leaves(want)):
+            assert (tuple(a.shape), a.dtype) == (tuple(b.shape), b.dtype)
+
+
+def test_pp_zero_tree_momentum_roundtrip_bitwise(n_devices):
+    """momentum -> ZeRO-under-pp flat buffers -> momentum is bitwise, and
+    the stage-major segment layout holds each stage's contiguous layer
+    chunk (the DeepSpeed ZeRO-1 + PP convention)."""
+    from distributed_neural_network_tpu.parallel.pipeline import (
+        pp_param_specs,
+    )
+    from distributed_neural_network_tpu.parallel.zero import leaf_shard_size
+
+    cfg = _cfg()
+    params = _host(tfm.init_params(jax.random.key(0), _cfg()))
+    specs = pp_param_specs(cfg)
+    rng = np.random.default_rng(0)
+    mom = jax.tree.map(
+        lambda p: rng.standard_normal(p.shape).astype(np.float32), params
+    )
+    flat = R.momentum_to_pp_zero_tree(mom, specs, 2, 2)
+    # layer leaves carry the per-stage split: pp * dp * S elements
+    wq, wq_m = flat["layers"]["wq"], mom["layers"]["wq"]
+    local = wq_m.size // 2
+    seg = 2 * leaf_shard_size(local, 2)
+    assert wq.shape == (2 * seg,)
+    np.testing.assert_array_equal(
+        wq[:local], wq_m.reshape(-1)[:local]  # stage 0 = first layers
+    )
+    np.testing.assert_array_equal(
+        wq[seg:seg + local], wq_m.reshape(-1)[local:]  # stage 1
+    )
+    # replicated leaves use the plain dp-padded layout
+    assert flat["embed"].shape == (
+        2 * leaf_shard_size(mom["embed"].size, 2),
+    )
+    back = R.pp_zero_tree_to_momentum(flat, params, specs, 2)
+    _assert_trees_equal(back, mom)
+
+
+def test_convert_pp_zero_roundtrips_bitwise(n_devices):
+    """pp2/zero -> sgd -> pp2/zero and pp2/zero-adam -> adam -> back:
+    the per-stage split survives two layout conversions bitwise, and the
+    converter demands pp_specs when a stage split is involved."""
+    from distributed_neural_network_tpu.parallel.pipeline import (
+        pp_param_specs,
+    )
+
+    cfg = _cfg()
+    params = _host(tfm.init_params(jax.random.key(0), cfg))
+    specs = pp_param_specs(cfg)
+    rng = np.random.default_rng(1)
+    mom = jax.tree.map(
+        lambda p: rng.standard_normal(p.shape).astype(np.float32), params
+    )
+    flat = R.momentum_to_pp_zero_tree(mom, specs, 2, 2)
+    sgd = R.convert_optimizer_state(
+        flat, src="zero", dst="sgd", params_template=params,
+        src_dp=2, dst_dp=1, src_pp=2, pp_specs=specs,
+    )
+    _assert_trees_equal(sgd, mom)
+    back = R.convert_optimizer_state(
+        sgd, src="sgd", dst="zero", params_template=params,
+        src_dp=1, dst_dp=2, dst_pp=2, pp_specs=specs,
+    )
+    _assert_trees_equal(back, flat)
+    za = {"m": flat, "v": jax.tree.map(lambda x: x + 1.0, flat),
+          "t": np.int32(5)}
+    adam = R.convert_optimizer_state(
+        za, src="zero-adam", dst="adam", params_template=params,
+        src_dp=2, dst_dp=1, src_pp=2, pp_specs=specs,
+    )
+    _assert_trees_equal(adam["m"], mom)
+    za2 = R.convert_optimizer_state(
+        adam, src="adam", dst="zero-adam", params_template=params,
+        src_dp=1, dst_dp=2, dst_pp=2, pp_specs=specs,
+    )
+    _assert_trees_equal(za2["m"], za["m"])
+    _assert_trees_equal(za2["v"], za["v"])
+    assert int(za2["t"]) == 5
+    with pytest.raises(ValueError, match="pp_specs"):
+        R.convert_optimizer_state(
+            flat, src="zero", dst="sgd", params_template=params,
+            src_dp=2, dst_dp=1, src_pp=2,
         )
 
 
@@ -501,6 +607,130 @@ def test_elastic_restore_interleaved_pipe_to_mesh(tmp_path, n_devices):
     ck.close()
 
 
+def _save_pp_zero_checkpoint(tmp_path, cfg, *, dp=2, pp=2, step=7,
+                             interleave=1, seed=0):
+    """A real checkpoint saved under a dp x pp mesh with ZeRO state whose
+    buffers derive from a known momentum tree; returns (ck, host params
+    in CANONICAL layer order, canonical momentum values, flat buffers as
+    saved)."""
+    from distributed_neural_network_tpu.parallel.pipeline import (
+        create_pp_mesh,
+        init_pp_zero_state,
+        interleave_layer_order,
+        pp_param_specs,
+        shard_pp_params,
+    )
+
+    mesh = create_pp_mesh(dp, pp, 1)
+    params_c = _host(tfm.init_params(jax.random.key(seed), cfg))
+    rng = np.random.default_rng(seed + 1)
+    mom_c = jax.tree.map(
+        lambda p: rng.standard_normal(p.shape).astype(np.float32), params_c
+    )
+    params_p, mom_p = params_c, mom_c
+    if interleave > 1:
+        order = np.asarray(
+            interleave_layer_order(cfg.n_layers, pp, interleave)
+        )
+        perm = lambda t: {
+            **t, "layers": jax.tree.map(lambda x: x[order], t["layers"]),
+        }
+        params_p, mom_p = perm(params_c), perm(mom_c)
+    specs = pp_param_specs(cfg)
+    flat = R.momentum_to_pp_zero_tree(mom_p, specs, pp, dp)
+    placed, pspecs = shard_pp_params(
+        jax.tree.map(jnp.asarray, params_c), cfg, mesh,
+        interleave=interleave,
+    )
+    state_abs = init_pp_zero_state(placed, pspecs, mesh, "zero")
+    mom_dev = jax.tree.map(
+        lambda h, m: jax.device_put(h, m.sharding), flat, state_abs
+    )
+    ck = TreeCheckpointer(str(tmp_path / "ck"), backend="npz")
+    ck.save(step, {"params": placed, "mom": mom_dev}, {
+        "optimizer": "zero",
+        "mesh_meta": E.lm_mesh_meta(
+            mesh, pspecs, "zero", batch=16, accum_steps=1,
+            pp_interleave=interleave,
+        ),
+        **resume_cursor(step=step, seed=seed),
+    })
+    return ck, params_c, mom_c, flat
+
+
+def test_elastic_restore_pp_zero_roundtrip_bitwise(tmp_path, n_devices):
+    """The acceptance shape: pp2 x dp2 / zero -> dp4 / zero -> back to
+    pp2 x dp2 / zero through real checkpoints; optimizer state bitwise at
+    every hop (the combination saved_state_template used to reject)."""
+    from distributed_neural_network_tpu.parallel.pipeline import (
+        create_pp_mesh,
+        pp_optimizer_state_specs,
+        pp_wiring,
+    )
+
+    cfg = _cfg()
+    ck, params_c, mom_c, flat = _save_pp_zero_checkpoint(tmp_path, cfg)
+    mesh4, specs4, ps4, ms4 = _target(cfg, dp=4, optimizer="zero")
+    state, meta, step, resharded = E.elastic_restore(
+        ck, cfg=cfg, mesh=mesh4, specs=specs4, optimizer="zero",
+        param_shardings=ps4, mom_shardings=ms4,
+        current_meta=E.lm_mesh_meta(mesh4, specs4, "zero", batch=16,
+                                    accum_steps=1),
+        log=lambda *_: None,
+    )
+    assert resharded and step == 7
+    _assert_trees_equal(state["params"], params_c)
+    _assert_trees_equal(state["mom"], R.momentum_to_zero_tree(mom_c, 4))
+    # save the dp4 layout and restore BACK into the per-stage split
+    ck.save(9, state, {
+        "optimizer": "zero",
+        "mesh_meta": E.lm_mesh_meta(mesh4, specs4, "zero", batch=16,
+                                    accum_steps=1),
+        **resume_cursor(step=9, seed=0),
+    })
+    mesh_pp = create_pp_mesh(2, 2, 1)
+    pspecs = pp_wiring(cfg, mesh_pp)[3]
+    ps = jax.tree.map(lambda s: NamedSharding(mesh_pp, s), pspecs)
+    ms = jax.tree.map(
+        lambda s: NamedSharding(mesh_pp, s),
+        pp_optimizer_state_specs("zero", pspecs),
+    )
+    state2, _, step2, resharded2 = E.elastic_restore(
+        ck, cfg=cfg, mesh=mesh_pp, specs=pspecs, optimizer="zero",
+        param_shardings=ps, mom_shardings=ms,
+        current_meta=E.lm_mesh_meta(mesh_pp, pspecs, "zero", batch=16,
+                                    accum_steps=1),
+        log=lambda *_: None,
+    )
+    assert resharded2 and step2 == 9
+    _assert_trees_equal(state2["params"], params_c)
+    _assert_trees_equal(state2["mom"], flat)
+    ck.close()
+
+
+def test_elastic_restore_interleaved_pp_zero_to_mesh(tmp_path, n_devices):
+    """ZeRO saved under the INTERLEAVED pipeline layout: the flat buffers
+    follow the placed (permuted) layer order, so the restore first
+    reassembles them into the replicated family layout, applies the same
+    layer-order mapping as the params, and lands in canonical order."""
+    cfg = _cfg(n_layers=4)
+    ck, params_c, mom_c, _ = _save_pp_zero_checkpoint(
+        tmp_path, cfg, interleave=2
+    )
+    mesh, specs, ps, ms = _target(cfg, dp=2, optimizer="sgd")
+    state, _, _, resharded = E.elastic_restore(
+        ck, cfg=cfg, mesh=mesh, specs=specs, optimizer="sgd",
+        param_shardings=ps, mom_shardings=ms,
+        current_meta=E.lm_mesh_meta(mesh, specs, "sgd", batch=16,
+                                    accum_steps=1),
+        log=lambda *_: None,
+    )
+    assert resharded
+    _assert_trees_equal(state["params"], params_c)
+    _assert_trees_equal(state["mom"], mom_c)
+    ck.close()
+
+
 def test_elastic_restore_empty_dir_returns_none(tmp_path, n_devices):
     cfg = _cfg()
     ck = TreeCheckpointer(str(tmp_path / "ck"), backend="npz")
@@ -619,6 +849,64 @@ def test_zero_gather_fn_matches_host_transform(n_devices):
     out = fn(placed)
     want = R.zero_tree_to_momentum(flat, params)
     _assert_trees_equal(out, want)
+
+
+def test_reshard_pp_step_program_traces_with_gather_pair(n_devices):
+    """The pp_reshard_zero_gather shardlint config: every pipe-sharded
+    (layers) leaf gathers twice - data-axis segment gather + pipe-axis
+    stage concat - while replicated leaves take one data gather (the
+    contract the checked-in manifest pins)."""
+    from distributed_neural_network_tpu import compat
+    from distributed_neural_network_tpu.analysis.trace import collect_trace
+    from distributed_neural_network_tpu.parallel.pipeline import (
+        create_pp_mesh,
+    )
+
+    cfg = _cfg()
+    mesh = create_pp_mesh(2, 2, 1)
+    with compat.trace_compat():
+        prog = R.reshard_pp_step_program(cfg, mesh)
+        facts = collect_trace(prog.make_jaxpr())
+    flat = prog.abstract_args[0]
+    n_leaves = len(jax.tree.leaves(flat))
+    n_layer_leaves = len(jax.tree.leaves(flat["layers"]))
+    gathers = [c for c in facts.collectives if c.op == "all_gather"]
+    assert sum(
+        c.count for c in gathers if c.axes == ("data",)
+    ) == n_leaves
+    assert sum(
+        c.count for c in gathers if c.axes == ("pipe",)
+    ) == n_layer_leaves
+    assert sum(c.count for c in gathers) == n_leaves + n_layer_leaves
+
+
+@requires_shard_map
+def test_pp_zero_gather_fn_matches_host_transform(n_devices):
+    """Executed parity (modern jax): the two-gather collective reassembly
+    of the ZeRO-under-pp buffers equals pp_zero_tree_to_momentum bitwise."""
+    from distributed_neural_network_tpu.parallel.pipeline import (
+        create_pp_mesh,
+        pp_optimizer_state_specs,
+        pp_param_specs,
+    )
+
+    cfg = _cfg()
+    mesh = create_pp_mesh(2, 2, 1)
+    params = _host(tfm.init_params(jax.random.key(0), cfg))
+    specs = pp_param_specs(cfg)
+    rng = np.random.default_rng(3)
+    mom = jax.tree.map(
+        lambda p: rng.standard_normal(p.shape).astype(np.float32), params
+    )
+    flat = R.momentum_to_pp_zero_tree(mom, specs, 2, 2)
+    state_specs = pp_optimizer_state_specs("zero", specs)
+    placed = jax.tree.map(
+        lambda b, s: jax.device_put(b, NamedSharding(mesh, s)),
+        flat, state_specs,
+    )
+    fn = R.make_pp_zero_gather_fn(params, mesh)
+    out = fn(placed)
+    _assert_trees_equal(out, mom)
 
 
 # ------------------------------------------------ CLI e2e (slow, gated)

@@ -1,0 +1,497 @@
+"""Elastic multi-process supervisor (train/supervisor.py, tools/launch.py).
+
+Driven with plain-python dummy workers (no jax in the children), so the
+whole detect -> stop -> shrink/grow -> relaunch state machine, the restart
+budget, the rendezvous retry, and the process-level chaos injectors run in
+tier-1 on any build. The real-jax group (actual coordinator handshake,
+checkpoint reshard across process boundaries) is covered by
+tests/test_multiprocess.py (slow) and the supervisor-chaos-smoke CI job.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from distributed_neural_network_tpu.parallel.fault import (
+    KillEvent,
+    ProcessChaos,
+)
+from distributed_neural_network_tpu.train.supervisor import (
+    Supervisor,
+    SupervisorConfig,
+    read_heartbeat,
+    reserve_port,
+    signal_label,
+)
+from distributed_neural_network_tpu.utils.obs import MetricsRegistry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Dummy worker: heartbeats like a real one (utils/obs.py schema), records
+# its env/argv per generation, honors SIGTERM like the cooperative
+# preemption path (exit 0), and follows a per-(gen, rank) behavior spec
+# passed as JSON: {"g0": {"1": {...}, "*": {...}}, ...} with knobs
+#   steps / dt     heartbeat cadence;        rc / fail_at   die mid-run
+#   no_beat        die before any heartbeat (rendezvous failure)
+#   freeze_beat    keep beating the SAME beat_unix (a wedged step loop)
+#   hang           never exit (needs SIGTERM/SIGKILL or staleness kill)
+WORKER = """\
+import json, os, signal, sys, time
+
+hb_path = os.environ["DNN_TPU_HEARTBEAT_FILE"]
+rank = int(os.environ["JAX_PROCESS_ID"])
+gen = int(os.environ["DNN_TPU_SUPERVISOR_GEN"])
+nprocs = int(os.environ["JAX_NUM_PROCESSES"])
+out_dir, spec = sys.argv[1], json.loads(sys.argv[2])
+with open(os.path.join(out_dir, f"seen_g{gen}_r{rank}.json"), "w") as f:
+    json.dump({"rank": rank, "gen": gen, "nprocs": nprocs,
+               "argv_rank": sys.argv[3] if len(sys.argv) > 3 else None,
+               "coord": os.environ.get("JAX_COORDINATOR_ADDRESS"),
+               "xla_flags": os.environ.get("XLA_FLAGS", "")}, f)
+me = spec.get(f"g{gen}", {}).get(str(rank)) or \
+     spec.get(f"g{gen}", {}).get("*") or {}
+signal.signal(signal.SIGTERM,
+              lambda s, f: sys.exit(me.get("term_rc", 0)))
+
+def beat(step, beat_unix):
+    tmp = hb_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"t": time.time(), "beat_unix": beat_unix, "step": step,
+                   "pid": os.getpid()}, f)
+    os.replace(tmp, hb_path)
+
+if me.get("no_beat"):
+    time.sleep(me.get("sleep", 0.05))
+    sys.exit(me.get("rc", 1))
+t0 = time.time()
+for s in range(me.get("steps", 3)):
+    beat(s, t0 if me.get("freeze_beat") else time.time())
+    if me.get("fail_at") is not None and s >= me["fail_at"]:
+        sys.exit(me.get("rc", 1))
+    time.sleep(me.get("dt", 0.05))
+while me.get("hang"):
+    time.sleep(0.05)
+sys.exit(me.get("final_rc", 0))
+"""
+
+
+def _fast_cfg(**kw):
+    base = dict(
+        nprocs=2, devices_per_proc=1, poll_s=0.03, grace_s=2.0,
+        restart_backoff_s=0.05, rendezvous_timeout_s=20.0,
+    )
+    base.update(kw)
+    return SupervisorConfig(**base)
+
+
+def _supervise(tmp_path, spec, cfg, *, chaos=None, registry=None,
+               capacity_fn=None):
+    worker = tmp_path / "worker.py"
+    worker.write_text(WORKER)
+    out_dir = tmp_path / "out"
+    out_dir.mkdir(exist_ok=True)
+    logs = []
+    sup = Supervisor(
+        [sys.executable, str(worker), str(out_dir), json.dumps(spec),
+         "{rank}"],
+        cfg,
+        run_dir=str(tmp_path / "run"),
+        chaos=chaos,
+        registry=registry,
+        capacity_fn=capacity_fn,
+        log=lambda *a: logs.append(" ".join(str(x) for x in a)),
+    )
+    rc = sup.run()
+    summary = json.loads(next(
+        ln for ln in logs if ln.startswith("SUPERVISOR_SUMMARY ")
+    )[len("SUPERVISOR_SUMMARY "):])
+    return rc, summary, logs, sup, out_dir
+
+
+def _seen(out_dir):
+    out = {}
+    for name in os.listdir(out_dir):
+        if name.startswith("seen_"):
+            with open(os.path.join(out_dir, name)) as f:
+                doc = json.load(f)
+            out[(doc["gen"], doc["rank"])] = doc
+    return out
+
+
+# ------------------------------------------------------------- primitives
+
+
+def test_reserve_port_is_bindable():
+    port = reserve_port()
+    with socket.socket() as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", port))  # would raise if taken
+
+
+def test_signal_label():
+    assert signal_label(1) == "exit:1"
+    assert signal_label(0) == "exit:0"
+    assert signal_label(-9) == "SIGKILL"
+    assert signal_label(-15) == "SIGTERM"
+
+
+def test_read_heartbeat_absent_and_torn(tmp_path):
+    assert read_heartbeat(str(tmp_path / "nope.json")) is None
+    p = tmp_path / "torn.json"
+    p.write_text("{not json")
+    assert read_heartbeat(str(p)) is None
+    p.write_text('{"t": 1.0, "step": 3}')
+    assert read_heartbeat(str(p))["step"] == 3
+
+
+def test_process_chaos_fires_once_per_event():
+    chaos = ProcessChaos(events=(
+        KillEvent(rank=1, at_step=5, sig="KILL"),
+        KillEvent(rank=0, at_step=0, sig="TERM"),
+    ))
+    assert bool(chaos)
+    # rank 0 fires as soon as it appears; rank 1 waits for step >= 5
+    assert chaos.due({0: None, 1: 2}) == [(0, 15)]
+    assert chaos.due({0: 3, 1: 4}) == []
+    assert chaos.due({1: 5}) == [(1, 9)]
+    assert chaos.due({0: 9, 1: 9}) == []  # both spent
+
+
+def test_kill_event_validation():
+    with pytest.raises(ValueError, match="KILL"):
+        KillEvent(rank=0, sig="HUP")
+    with pytest.raises(ValueError, match="rank"):
+        KillEvent(rank=-1)
+    with pytest.raises(ValueError, match="min_procs"):
+        SupervisorConfig(nprocs=2, min_procs=3)
+    with pytest.raises(ValueError, match="nprocs"):
+        SupervisorConfig(nprocs=0)
+
+
+# ----------------------------------------------------------- happy path
+
+
+def test_group_completes_cleanly(tmp_path):
+    reg = MetricsRegistry()
+    rc, summary, logs, sup, out = _supervise(
+        tmp_path, {"g0": {"*": {"steps": 3}}}, _fast_cfg(), registry=reg,
+    )
+    assert rc == 0 and summary["exit"] == "ok"
+    assert summary["generations"] == 1 and summary["restarts"] == 0
+    assert summary["worker_failures"] == []
+    seen = _seen(out)
+    assert set(seen) == {(0, 0), (0, 1)}
+    # {rank}/{nprocs} tokens substituted per worker; env handshake + the
+    # forced per-proc device count are wired
+    for (g, r), doc in seen.items():
+        assert doc["argv_rank"] == str(r)
+        assert doc["nprocs"] == 2
+        assert doc["coord"] == f"127.0.0.1:{sup.port}"
+        assert "--xla_force_host_platform_device_count=1" in doc["xla_flags"]
+    assert reg.get("supervisor_group_size").value == 2
+    assert reg.get("worker_failures_total") is not None
+    assert sum(
+        c.value for c in reg.get("worker_failures_total")._children.values()
+    ) == 0
+
+
+# ------------------------------------------------------- failure restarts
+
+
+def test_worker_death_shrinks_group(tmp_path):
+    reg = MetricsRegistry()
+    spec = {
+        "g0": {"2": {"fail_at": 1, "rc": 1, "steps": 50},
+               "*": {"steps": 1000, "dt": 0.02}},
+        "g1": {"*": {"steps": 3}},
+    }
+    rc, summary, logs, sup, out = _supervise(
+        tmp_path, spec, _fast_cfg(nprocs=3), registry=reg,
+    )
+    assert rc == 0 and summary["exit"] == "ok"
+    assert summary["restarts"] == 1 and summary["final_size"] == 2
+    assert summary["worker_failures"] == [
+        {"gen": 0, "rank": 2, "cause": "exit:1"}
+    ]
+    # gen 1 re-substituted the smaller group into the tokens
+    seen = _seen(out)
+    assert seen[(1, 0)]["nprocs"] == 2 and (1, 2) not in seen
+    assert reg.get("elastic_restarts_total").labels(
+        direction="shrink"
+    ).value == 1
+    assert reg.get("worker_failures_total").labels(
+        signal="exit:1"
+    ).value == 1
+    assert reg.get("supervisor_restart_seconds").labels().count == 1
+    assert any("restart 1/" in ln and "3 -> 2" in ln for ln in logs)
+
+
+def test_restart_budget_exhaustion_fails_fast(tmp_path):
+    spec = {f"g{g}": {"*": {"fail_at": 0, "rc": 7, "steps": 5}}
+            for g in range(5)}
+    t0 = time.monotonic()
+    rc, summary, logs, _, _ = _supervise(
+        tmp_path, spec, _fast_cfg(nprocs=1, max_restarts=1),
+    )
+    assert rc == 3 and summary["exit"] == "budget"
+    assert summary["restarts"] == 2  # budget 1 + the exhausting failure
+    assert time.monotonic() - t0 < 30  # fails fast, no crash loop
+    abort = next(ln for ln in logs if ln.startswith("SUPERVISOR ABORT"))
+    assert "restart budget (1) exhausted" in abort
+    assert "exit:7" in abort  # the last failure is named
+
+
+def test_whole_group_crash_restarts_same_size(tmp_path):
+    spec = {
+        "g0": {"*": {"fail_at": 1, "rc": 2, "steps": 5}},
+        "g1": {"*": {"steps": 3}},
+    }
+    rc, summary, logs, _, out = _supervise(
+        tmp_path, spec, _fast_cfg(nprocs=2, min_procs=2),
+    )
+    assert rc == 0
+    assert summary["final_size"] == 2 and summary["restarts"] == 1
+    assert _seen(out)[(1, 0)]["nprocs"] == 2
+
+
+def test_shrink_below_min_procs_aborts(tmp_path):
+    spec = {"g0": {"1": {"fail_at": 1, "rc": 1, "steps": 50},
+                   "*": {"steps": 1000, "dt": 0.02}}}
+    rc, summary, logs, _, _ = _supervise(
+        tmp_path, spec, _fast_cfg(nprocs=2, min_procs=2),
+    )
+    assert rc == 3 and summary["exit"] == "budget"
+    assert any("--min-procs is 2" in ln for ln in logs)
+
+
+# ------------------------------------------------------------- rendezvous
+
+
+def test_rendezvous_failure_retries_on_fresh_port(tmp_path):
+    reg = MetricsRegistry()
+    spec = {
+        # rank 0 dies before ever heartbeating: the group never finishes
+        # rendezvous (the bind-race shape)
+        "g0": {"0": {"no_beat": True, "rc": 1},
+               "*": {"steps": 1000, "dt": 0.02}},
+        "g1": {"*": {"steps": 3}},
+    }
+    rc, summary, logs, sup, out = _supervise(
+        tmp_path, spec, _fast_cfg(), registry=reg,
+    )
+    assert rc == 0 and summary["exit"] == "ok"
+    assert summary["rendezvous_retries"] == 1
+    assert summary["restarts"] == 0  # startup races don't burn the budget
+    seen = _seen(out)
+    # the retry ran at FULL size on a different coordinator port
+    assert seen[(1, 0)]["nprocs"] == 2
+    assert seen[(1, 0)]["coord"] != seen[(0, 1)]["coord"]
+    assert reg.get("elastic_restarts_total").labels(
+        direction="rendezvous"
+    ).value == 1
+
+
+def test_rendezvous_budget_exhaustion(tmp_path):
+    spec = {f"g{g}": {"*": {"no_beat": True, "rc": 1}} for g in range(4)}
+    rc, summary, logs, _, _ = _supervise(
+        tmp_path, spec, _fast_cfg(nprocs=1, rendezvous_retries=1),
+    )
+    assert rc == 4 and summary["exit"] == "rendezvous"
+    assert any(
+        "rendezvous failed" in ln and "never came up" in ln for ln in logs
+    )
+
+
+# ------------------------------------------------------------ chaos kills
+
+
+def test_chaos_sigkill_shrinks_and_labels_signal(tmp_path):
+    reg = MetricsRegistry()
+    spec = {
+        "g0": {"*": {"steps": 1000, "dt": 0.02}},
+        "g1": {"*": {"steps": 3}},
+    }
+    chaos = ProcessChaos(events=(KillEvent(rank=1, at_step=3, sig="KILL"),))
+    rc, summary, logs, _, out = _supervise(
+        tmp_path, spec, _fast_cfg(nprocs=2), chaos=chaos, registry=reg,
+    )
+    assert rc == 0
+    assert summary["worker_failures"] == [
+        {"gen": 0, "rank": 1, "cause": "SIGKILL"}
+    ]
+    assert summary["final_size"] == 1
+    assert reg.get("worker_failures_total").labels(
+        signal="SIGKILL"
+    ).value == 1
+    assert any("supervisor chaos" in ln and "SIGKILL" in ln for ln in logs)
+
+
+def test_chaos_coordinator_death_preempt_exit_restarts(tmp_path):
+    """TERM chaos on rank 0 = coordinator death by preemption notice: the
+    worker's cooperative path exits PREEMPT_RC (checkpoint written), and
+    the supervisor treats that as a group-restart trigger - NOT as the
+    workload finishing - labeled 'preempt'."""
+    spec = {
+        "g0": {"*": {"steps": 1000, "dt": 0.02, "term_rc": 75}},
+        "g1": {"*": {"steps": 3}},
+    }
+    chaos = ProcessChaos(events=(KillEvent(rank=0, at_step=2, sig="TERM"),))
+    rc, summary, logs, _, _ = _supervise(
+        tmp_path, spec, _fast_cfg(nprocs=2), chaos=chaos,
+    )
+    assert rc == 0
+    assert any("[the coordinator process]" in ln for ln in logs)
+    assert {"gen": 0, "rank": 0, "cause": "preempt"} in \
+        summary["worker_failures"]
+    assert summary["final_size"] == 1
+
+
+# ---------------------------------------------------- heartbeat staleness
+
+
+def test_stale_heartbeat_declares_worker_dead(tmp_path):
+    reg = MetricsRegistry()
+    spec = {
+        # rank 1 beats twice with a FROZEN beat_unix then hangs: a wedged
+        # step loop whose writer thread is still alive
+        "g0": {"1": {"steps": 2, "freeze_beat": True, "hang": True,
+                     "dt": 0.02},
+               "*": {"steps": 1000, "dt": 0.02}},
+        "g1": {"*": {"steps": 3}},
+    }
+    rc, summary, logs, _, _ = _supervise(
+        tmp_path, spec, _fast_cfg(nprocs=2, heartbeat_timeout_s=0.4),
+        registry=reg,
+    )
+    assert rc == 0
+    assert summary["worker_failures"] == [
+        {"gen": 0, "rank": 1, "cause": "SIGKILL"}
+    ]
+    assert any("heartbeat is" in ln and "stale" in ln for ln in logs)
+
+
+# ------------------------------------------------------------------ grow
+
+
+def test_grow_restart_when_capacity_returns(tmp_path):
+    reg = MetricsRegistry()
+    spec = {
+        "g0": {"1": {"fail_at": 1, "rc": 1, "steps": 50},
+               "*": {"steps": 1000, "dt": 0.02}},
+        # gen 1 (shrunk to 1): beat long enough for the grow hysteresis
+        "g1": {"*": {"steps": 1000, "dt": 0.02}},
+        "g2": {"*": {"steps": 3}},
+    }
+    rc, summary, logs, _, out = _supervise(
+        tmp_path, spec, _fast_cfg(nprocs=2, grow_after_s=0.3), registry=reg,
+    )
+    assert rc == 0 and summary["exit"] == "ok"
+    assert summary["final_size"] == 2
+    assert summary["restarts"] == 1  # the failure; grow is planned, free
+    seen = _seen(out)
+    assert seen[(2, 0)]["nprocs"] == 2 and (2, 1) in seen
+    assert reg.get("elastic_restarts_total").labels(
+        direction="grow"
+    ).value == 1
+    assert any("planned grow restart 1 -> 2" in ln for ln in logs)
+
+
+def test_grow_respects_capacity_fn(tmp_path):
+    calls = []
+
+    def capacity():
+        calls.append(1)
+        return 1  # capacity never returns
+
+    spec = {
+        "g0": {"1": {"fail_at": 1, "rc": 1, "steps": 50},
+               "*": {"steps": 30, "dt": 0.02}},
+        "g1": {"*": {"steps": 20, "dt": 0.02}},
+    }
+    rc, summary, logs, _, _ = _supervise(
+        tmp_path, spec, _fast_cfg(nprocs=2, grow_after_s=0.1),
+        capacity_fn=capacity,
+    )
+    assert rc == 0
+    assert summary["final_size"] == 1  # never grew
+    assert calls  # but capacity was consulted
+
+
+# ------------------------------------------------------- live_top render
+
+
+def test_live_top_renders_supervisor_metrics(tmp_path):
+    """The dashboard renders the supervisor family: group/target size,
+    failures by signal, restart directions - parsed from the registry's
+    own Prometheus rendering (the same path a live scrape takes)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import live_top
+
+    reg = MetricsRegistry()
+    reg.gauge("supervisor_group_size").set(2)
+    reg.gauge("supervisor_target_size").set(3)
+    reg.counter("worker_failures_total").labels(signal="SIGKILL").inc()
+    reg.counter("elastic_restarts_total").labels(direction="shrink").inc()
+    reg.histogram(
+        "supervisor_restart_seconds", buckets=(0.5, 5.0)
+    ).observe(0.3)
+    snap = {"metrics": live_top.parse_prometheus(reg.render()),
+            "health": None, "loss_history": [], "source": "test"}
+    frame = live_top.render(snap, color=False)
+    assert "supervisor  group 2/3" in frame
+    assert "SIGKILL=1" in frame
+    assert "shrink=1" in frame
+    assert "restart p95<=0.5" in frame
+
+
+# ------------------------------------------------------------ launch CLI
+
+
+def test_launch_cli_happy_path(tmp_path):
+    worker = tmp_path / "w.py"
+    worker.write_text(WORKER)
+    out_dir = tmp_path / "out"
+    out_dir.mkdir()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "--nprocs", "2", "--poll", "0.05", "--run-dir",
+         str(tmp_path / "run"), "--",
+         sys.executable, str(worker), str(out_dir),
+         json.dumps({"g0": {"*": {"steps": 2}}}), "{rank}"],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "SUPERVISOR_SUMMARY" in proc.stdout
+    summary = json.loads(next(
+        ln for ln in proc.stdout.splitlines()
+        if ln.startswith("SUPERVISOR_SUMMARY ")
+    )[len("SUPERVISOR_SUMMARY "):])
+    assert summary["exit"] == "ok" and summary["target_nprocs"] == 2
+
+
+def test_launch_cli_rendezvous_abort_rc4(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "--nprocs", "1", "--poll", "0.05", "--rendezvous-retries", "0",
+         "--run-dir", str(tmp_path / "run"), "--",
+         sys.executable, "-c", "import sys; sys.exit(1)"],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert proc.returncode == 4, proc.stdout + proc.stderr
+    assert "SUPERVISOR ABORT: rendezvous failed" in proc.stdout
+
+
+def test_launch_cli_rejects_dangling_chaos_flags(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "--nprocs", "1", "--chaos-kill-at-step", "3", "--",
+         sys.executable, "-c", "pass"],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert proc.returncode == 2
+    assert "--chaos-kill-rank" in proc.stderr

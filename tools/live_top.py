@@ -12,7 +12,10 @@ server, and on dead runs' files). One compact ANSI frame per refresh:
   - throughput, step-time p50/p95 (from the train_step_seconds histogram
     buckets), device memory, collective bytes;
   - guard anomaly / rollback counters and watchdog flags (stall,
-    recompile storm, stale checkpoint) - red when non-zero.
+    recompile storm, stale checkpoint) - red when non-zero;
+  - when pointed at a tools/launch.py --metrics-port endpoint: the
+    elastic supervisor's group size vs target, worker failures by
+    signal, shrink/grow/rendezvous restarts, and restart latency.
 
 Stdlib-only (no jax, no repo imports) so it runs anywhere - including a
 laptop pointed at a forwarded TPU host port.
@@ -399,6 +402,32 @@ def render(snap: dict, *, color: bool = True, width: int = 72) -> str:
     if stall or storm or stale:
         dog = c(RED, dog)
     lines.append(dog)
+    # elastic supervisor (train/supervisor.py; present when the target is
+    # a tools/launch.py --metrics-port endpoint)
+    gsz = metric_value(m, "supervisor_group_size")
+    if gsz is not None:
+        target = metric_value(m, "supervisor_target_size", gsz)
+        fails = m.get("worker_failures_total") or {}
+        fail_s = ", ".join(
+            f"{dict(k).get('signal', '?')}={int(v)}"
+            for k, v in sorted(fails.items()) if v
+        ) or "none"
+        restarts = m.get("elastic_restarts_total") or {}
+        rst_s = ", ".join(
+            f"{dict(k).get('direction', dict(k).get('kind', '?'))}={int(v)}"
+            for k, v in sorted(restarts.items()) if v
+        ) or "none"
+        p95r = hist_quantile(m, "supervisor_restart_seconds", 0.95)
+        budget = metric_value(m, "supervisor_restart_budget_remaining")
+        sup_line = (
+            f"supervisor  group {int(gsz)}/{int(target)}  "
+            f"failures: {fail_s}  restarts: {rst_s}"
+            + (f"  restart p95<={p95r:.3g}s" if p95r is not None else "")
+            + (f"  budget left: {int(budget)}" if budget is not None else "")
+        )
+        if sum(fails.values()) or int(gsz) < int(target):
+            sup_line = c(YELLOW, sup_line)
+        lines.append(sup_line)
     phases = m.get("phase_seconds_total") or {}
     if phases:
         lines.append(
