@@ -199,14 +199,24 @@ class ChaosMonkey:
     def perturb(self, step, loss, grad_norm, all_finite):
         if step in self.spike_at and ("spike", step) not in self._fired:
             self._fired.add(("spike", step))
+            self._flight("spike", step, scale=float(self.spike_scale))
             self.log(f"(chaos: spiking observed loss at step {step} "
                      f"x{self.spike_scale:g})")
             loss = loss * self.spike_scale
         return loss, grad_norm, all_finite
 
+    @staticmethod
+    def _flight(what, step, **fields):
+        # chaos is exactly the event class a postmortem must show: the
+        # injected fault sits in the ring right before the crash it causes
+        from ..utils.obs import flight_event
+
+        flight_event("chaos", step=int(step), what=what, **fields)
+
     def after_step(self, step) -> None:
         if step in self.stall_at and ("stall", step) not in self._fired:
             self._fired.add(("stall", step))
+            self._flight("stall", step, seconds=float(self.stall_s))
             self.log(
                 f"(chaos: stalling the step loop for {self.stall_s:g}s "
                 f"after step {step})"
@@ -227,6 +237,7 @@ class ChaosMonkey:
             and "shrink" not in self._fired
         ):
             self._fired.add("shrink")
+            self._flight("shrink", step)
             self.log(
                 f"(chaos: requesting SHRINK preemption after step {step})"
             )
@@ -238,6 +249,7 @@ class ChaosMonkey:
             and "sigterm" not in self._fired
         ):
             self._fired.add("sigterm")
+            self._flight("sigterm", step)
             self.log(f"(chaos: delivering SIGTERM after step {step})")
             os.kill(os.getpid(), _signal.SIGTERM)
 

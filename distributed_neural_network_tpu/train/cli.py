@@ -397,6 +397,18 @@ def run_training(args, regime: str, *, log=print) -> Engine:
     trace_out = getattr(args, "trace_out", None)
     want_stats = getattr(args, "step_stats", False)
     tracer = TR.Tracer(enabled=bool(trace_out))
+    # fleet identity (multi-process groups, e.g. under the elastic
+    # supervisor): rank-stamped process metadata + per-rank trace shards
+    # tools/trace_merge.py can merge (utils/tracing.py)
+    rank = TR.detect_rank()
+    if rank is not None:
+        import socket as _socket
+
+        tracer.set_process(rank=rank, hostname=_socket.gethostname())
+        if trace_out:
+            trace_out = TR.rank_trace_path(trace_out, rank)
+            args.trace_out = trace_out
+            log(f"(per-rank trace shard: {trace_out})")
 
     from .guard import PreemptionGuard
     from .monitor import WatchdogConfig, attach_monitor
@@ -417,6 +429,13 @@ def run_training(args, regime: str, *, log=print) -> Engine:
                 else 0
             ),
         ),
+        # on-demand /profile captures land next to the Chrome trace; the
+        # whole-run --profile-dir capture is a separate (exclusive) path
+        profile_dir=(
+            os.path.dirname(os.path.abspath(trace_out)) if trace_out
+            else None
+        ),
+        rank=rank,
         log=log,
     )
     try:

@@ -286,6 +286,12 @@ def elastic_restore(
         else "restore"
     events.labels(kind=kind).inc()
     seconds.observe(dt)
+    from ..utils.obs import flight_event
+
+    flight_event(
+        "elastic_reshard", step=step, what=kind, seconds=round(dt, 3),
+        saved=_axes_desc(saved_axes), target=_axes_desc(dict(mesh.shape)),
+    )
     log(
         f"(elastic: resharded checkpoint step {step} "
         f"[{_axes_desc(saved_axes)}, {saved_optimizer}] -> "

@@ -319,6 +319,12 @@ class TrainingGuard:
                 "guard", track="guard", step=int(step), action=action,
                 kind=kind, zscore=zscore,
             )
+        from ..utils.obs import flight_event
+
+        flight_event(
+            "guard_anomaly", step=int(step), action=action, anomaly=kind,
+            zscore=zscore,
+        )
         if self.step_stats is not None:
             self.step_stats.count_anomaly(kind)
         self.log(f"(guard: step {step} {kind} -> {action}: {reason})")
@@ -353,6 +359,12 @@ class TrainingGuard:
             )
         self.counters["rollbacks"] += 1
         self._rollback_counter.inc()
+        from ..utils.obs import flight_event
+
+        flight_event(
+            "guard_rollback", lr_scale=self.lr_scale * self.cfg.lr_backoff,
+            retries_used=self.retries_used,
+        )
         self.lr_scale *= self.cfg.lr_backoff
         self._lr_scale_gauge.set(self.lr_scale)
         self.detector.reset()  # re-warm against the restored trajectory
@@ -465,6 +477,9 @@ class PreemptionGuard:
             return
         self.requested = True
         self.signame = signal.Signals(signum).name
+        from ..utils.obs import flight_event
+
+        flight_event("preempt", signal=self.signame)
         self.log(
             f"({self.signame} received: finishing the current step, then "
             "writing an emergency checkpoint and exiting; send again to "
@@ -481,6 +496,9 @@ class PreemptionGuard:
             return
         self.requested = True
         self.signame = reason
+        from ..utils.obs import flight_event
+
+        flight_event("preempt", signal=reason)
         self.log(
             f"({reason} preemption requested: finishing the current step, "
             "then writing an emergency checkpoint and exiting)"

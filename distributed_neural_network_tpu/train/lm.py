@@ -784,6 +784,12 @@ def make_traced_step(
 
     def traced_step(*args, **kwargs):
         i = next(counter)
+        # begin-mark BEFORE the dispatch: the begin/beat pair is what
+        # lets the fleet federation attribute a host-side wedge to the
+        # rank that never STARTED the next step, even though every
+        # rank's completion is held back equally by the collectives
+        # (utils/obs.py begin_step; train/supervisor.py FleetFederation)
+        reg.begin_step(i)
         t0 = time.perf_counter()
         with tracer.span(
             _tracing.TRAIN_STEP, track="train", step=i, fenced=fence

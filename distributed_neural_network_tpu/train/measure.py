@@ -938,8 +938,11 @@ def measure_watchdog_overhead(
     """Live-observability overhead A/B: the identical LM config with no
     monitoring vs the full ``--metrics-port`` stack live - metrics
     registry, /metrics + /healthz HTTP server thread, stall/recompile
-    watchdog thread, and the per-step publish sites (heartbeat, step
-    counter, step-time histogram, one ``_cache_size()`` read).
+    watchdog thread, the per-step publish sites (heartbeat, step
+    counter, step-time histogram, one ``_cache_size()`` read), PLUS the
+    fleet-observability extras a supervised worker carries: the
+    heartbeat-FILE writer thread and the armed write-through crash
+    flight recorder (`utils/obs.py HeartbeatFileWriter` / `FLIGHT`).
 
     Two claims, both asserted into the returned row:
     - ``within_budget``: steady-step overhead under `budget_pct` (default
@@ -975,7 +978,22 @@ def measure_watchdog_overhead(
             cfg, mesh, lr=0.01, attn_impl=attn
         )
         monitor = None
+        tmpdir = None
+        env_keys = ("DNN_TPU_HEARTBEAT_FILE", "DNN_TPU_FLIGHT_FILE")
         if monitored:
+            # the FULL fleet stack: registry + server + watchdog as
+            # before, PLUS the supervised-worker extras - heartbeat-file
+            # writer thread and the armed (write-through) crash flight
+            # recorder - so the <1% budget covers fleet observability too
+            import tempfile
+
+            tmpdir = tempfile.mkdtemp(prefix="dnn_fleet_obs_bench_")
+            os.environ["DNN_TPU_HEARTBEAT_FILE"] = os.path.join(
+                tmpdir, "hb.json"
+            )
+            os.environ["DNN_TPU_FLIGHT_FILE"] = os.path.join(
+                tmpdir, "flight.json"
+            )
             monitor = attach_monitor(
                 metrics_port=0, config=WatchdogConfig(),
                 log=lambda *_: None,
@@ -1008,6 +1026,12 @@ def measure_watchdog_overhead(
         finally:
             if monitor is not None:
                 monitor.close()
+            if tmpdir is not None:
+                from ..utils.obs import FLIGHT
+
+                FLIGHT.reset()  # disarm the process-global recorder
+                for k in env_keys:
+                    os.environ.pop(k, None)
         return dt, float(loss)
 
     base_dt, base_loss = run(False)

@@ -40,12 +40,14 @@ server + watchdog, one handle to close on exit.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from dataclasses import dataclass
 
 from ..utils import obs as O
 from ..utils import tracing as TR
+from ..utils.obs import flight_event
 
 WATCHDOG_STALL = "watchdog/stall"
 WATCHDOG_RECOMPILE = "watchdog/recompile_storm"
@@ -160,6 +162,9 @@ class RecompileDetector:
             "watchdog/recompile", track="watchdog",
             step=step, new_entries=grew, cache_size=size,
         )
+        flight_event(
+            "recompile", step=step, new_entries=grew, cache_size=size
+        )
         return len(self.events)
 
     def recent(self, window_s: float) -> int:
@@ -271,6 +276,11 @@ class Watchdog:
                         heartbeat_age_s=round(age, 3),
                         threshold_s=round(thr, 3),
                     )
+                    flight_event(
+                        "watchdog_stall", step=step,
+                        heartbeat_age_s=round(age, 3),
+                        threshold_s=round(thr, 3),
+                    )
                     self.log(
                         f"(watchdog: STALL - no step heartbeat for "
                         f"{age:.1f}s, threshold {thr:.1f}s "
@@ -290,6 +300,10 @@ class Watchdog:
                         self.tracer.instant(
                             WATCHDOG_STALL, track="watchdog", step=step,
                             action="escalate",
+                        )
+                        flight_event(
+                            "watchdog_escalate", step=step,
+                            action="preempt",
                         )
                         self.log(
                             "(watchdog: stall persists - requesting "
@@ -313,6 +327,10 @@ class Watchdog:
                 self.tracer.instant(
                     WATCHDOG_RECOMPILE, track="watchdog",
                     recompiles_in_window=n,
+                    window_s=self.cfg.recompile_window_s,
+                )
+                flight_event(
+                    "watchdog_recompile_storm", recompiles_in_window=n,
                     window_s=self.cfg.recompile_window_s,
                 )
                 self.log(
@@ -340,6 +358,11 @@ class Watchdog:
                         checkpoint_age_s=round(age, 1),
                         threshold_s=self.cfg.checkpoint_stale_s,
                     )
+                    flight_event(
+                        "watchdog_checkpoint_stale",
+                        checkpoint_age_s=round(age, 1),
+                        threshold_s=self.cfg.checkpoint_stale_s,
+                    )
                     self.log(
                         f"(watchdog: checkpoint is {age:.0f}s old "
                         f"[threshold {self.cfg.checkpoint_stale_s:.0f}s] "
@@ -357,20 +380,129 @@ class Watchdog:
                          f"{e}; continuing)")
 
 
+# ------------------------------------------------- on-demand profiling
+
+
+class ProfileController:
+    """On-demand `jax.profiler` capture, armed from the live HTTP layer.
+
+    ``GET /profile?steps=N`` (utils/obs.py ObsServer) calls ``request(N)``;
+    the capture then starts at the NEXT step boundary and stops N steps
+    later - step boundaries are delivered via the registry's beat hook
+    (`MetricsRegistry.beat_hook`), which both training loops already
+    drive, so no step-loop signature changes anywhere. Each capture
+    writes ``profile_step{S}_x{N}`` under ``out_dir`` (next to the
+    Chrome trace when the run has one) for TensorBoard/XProf.
+
+    The idle fast path is two attribute reads per step. All profiler
+    errors (an already-active whole-run ``--profile-dir`` trace, an
+    unwritable dir) are caught, recorded on ``error``, and reported by
+    the next ``/profile`` response - never raised into the step loop.
+    """
+
+    def __init__(self, out_dir: str, *, log=print):
+        self.out_dir = os.path.abspath(out_dir)
+        self.log = log
+        self._lock = threading.Lock()
+        self._pending = 0
+        self._stop_at: int | None = None
+        self._active_dir: str | None = None
+        self.captures = 0
+        self.last_dir: str | None = None
+        self.error: str | None = None
+
+    def request(self, steps: int) -> dict:
+        """Arm a capture for the next ``steps`` steps (the /profile body)."""
+        with self._lock:
+            if self._pending or self._stop_at is not None:
+                return {
+                    "ok": False,
+                    "error": "a profile capture is already pending/active",
+                    "dir": self._active_dir,
+                }
+            self._pending = int(steps)
+        doc = {
+            "ok": True, "steps": int(steps), "out_dir": self.out_dir,
+            "note": "capture starts at the next step boundary",
+            "captures_completed": self.captures,
+        }
+        if self.error:
+            doc["last_error"] = self.error
+        return doc
+
+    def on_step(self, step) -> None:
+        """Step-boundary hook (registry beat). Starts/stops captures."""
+        if not self._pending and self._stop_at is None:
+            return
+        with self._lock:
+            pending, stop_at = self._pending, self._stop_at
+            if pending and stop_at is None:
+                self._pending = 0
+                i = int(step) if step is not None else 0
+                d = os.path.join(
+                    self.out_dir, f"profile_step{i}_x{pending}"
+                )
+                try:
+                    import jax
+
+                    os.makedirs(d, exist_ok=True)
+                    jax.profiler.start_trace(d)
+                except Exception as e:
+                    self.error = f"{type(e).__name__}: {e}"
+                    self.log(f"(profile: start failed - {self.error})")
+                    return
+                self._stop_at = i + pending
+                self._active_dir = d
+                self.log(
+                    f"(profile: capturing {pending} step(s) -> {d})"
+                )
+                return
+            if stop_at is not None and step is not None \
+                    and int(step) >= stop_at:
+                self._finish_locked()
+
+    def _finish_locked(self) -> None:
+        d = self._active_dir
+        self._stop_at = None
+        self._active_dir = None
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception as e:
+            self.error = f"{type(e).__name__}: {e}"
+            self.log(f"(profile: stop failed - {self.error})")
+            return
+        self.captures += 1
+        self.last_dir = d
+        flight_event("profile_capture", dir=d)
+        self.log(f"(profile: capture complete - {d})")
+
+    def close(self) -> None:
+        """Stop a capture left active at run end (trace stays valid)."""
+        with self._lock:
+            if self._stop_at is not None:
+                self._finish_locked()
+            self._pending = 0
+
+
 # ----------------------------------------------------------- CLI wiring
 
 
 class Monitor:
-    """registry + server + watchdog + heartbeat file, one close()."""
+    """registry + server + watchdog + heartbeat + flight + profiler,
+    one close()."""
 
     def __init__(self, registry, server=None, watchdog=None,
                  recompiles: RecompileDetector | None = None,
-                 heartbeat=None):
+                 heartbeat=None, flight=None, profiler=None):
         self.registry = registry
         self.server = server
         self.watchdog = watchdog
         self.recompiles = recompiles
         self.heartbeat = heartbeat
+        self.flight = flight
+        self.profiler = profiler
         self._closed = False
 
     @property
@@ -381,12 +513,19 @@ class Monitor:
         if self._closed:
             return
         self._closed = True
+        if self.profiler is not None:
+            self.profiler.close()
         if self.watchdog is not None:
             self.watchdog.stop()
         if self.server is not None:
             self.server.close()
         if self.heartbeat is not None:
             self.heartbeat.close()
+        if self.flight is not None:
+            # final write-through: the ring's last state with the clean
+            # cause recorded (a crash never reaches here - the
+            # per-event write-through already has the file current)
+            self.flight.dump(cause="close")
 
 
 def attach_monitor(
@@ -396,6 +535,8 @@ def attach_monitor(
     preemption=None,
     watchdog: bool = True,
     config: WatchdogConfig | None = None,
+    profile_dir: str | None = None,
+    rank: int | None = None,
     log=print,
 ) -> Monitor:
     """The shared `--metrics-port` wiring for both CLIs.
@@ -409,23 +550,42 @@ def attach_monitor(
     ephemeral) additionally starts the HTTP server and (unless
     ``watchdog=False``) the watchdog thread. The caller logs
     ``monitor.url`` and closes the monitor on exit.
-    """
-    import os as _os
 
-    hb_path = _os.environ.get("DNN_TPU_HEARTBEAT_FILE")
+    Fleet extensions: a supervisor-exported DNN_TPU_FLIGHT_FILE arms the
+    process flight recorder's write-through dump (`utils/obs.py FLIGHT`);
+    ``rank`` stamps the heartbeat file (and the flight dump) so
+    attribution survives file relocation; the heartbeat also advertises
+    this worker's ``metrics_url`` when a server is up - the federation
+    scraper's handshake. ``profile_dir`` (with a server) wires the
+    ``/profile?steps=N`` on-demand `jax.profiler` endpoint
+    (`ProfileController`), driven from the registry's beat hook.
+    """
+    flight = None
+    fl_path = os.environ.get(O.FLIGHT_ENV)
+    if fl_path:
+        O.FLIGHT.configure(fl_path, rank=rank)
+        flight = O.FLIGHT
+        flight_event("run_start", pid=os.getpid())
+        log(f"(flight recorder: {fl_path})")
+    hb_path = os.environ.get("DNN_TPU_HEARTBEAT_FILE")
     if metrics_port is None and not hb_path:
-        return Monitor(O.NULL_REGISTRY)
-    if metrics_port is None:
-        registry = O.MetricsRegistry()
-        hb = O.HeartbeatFileWriter(registry, hb_path)
-        log(f"(supervisor heartbeat file: {hb_path})")
-        return Monitor(registry, heartbeat=hb)
+        return Monitor(O.NULL_REGISTRY, flight=flight)
     registry = O.MetricsRegistry()
+    server = prof = None
+    if metrics_port is not None:
+        if profile_dir:
+            prof = ProfileController(profile_dir, log=log)
+            registry.beat_hook = prof.on_step
+        server = O.ObsServer(registry, port=metrics_port, profiler=prof)
     hb = None
     if hb_path:
-        hb = O.HeartbeatFileWriter(registry, hb_path)
+        hb = O.HeartbeatFileWriter(
+            registry, hb_path, rank=rank,
+            metrics_url=server.url if server is not None else None,
+        )
         log(f"(supervisor heartbeat file: {hb_path})")
-    server = O.ObsServer(registry, port=metrics_port)
+    if server is None:
+        return Monitor(registry, heartbeat=hb, flight=flight)
     rec = RecompileDetector(registry=registry, tracer=tracer)
     dog = None
     if watchdog:
@@ -435,6 +595,10 @@ def attach_monitor(
         ).start()
     log(
         f"(metrics server: {server.url}/metrics , {server.url}/healthz"
+        + (f" , {server.url}/profile" if prof is not None else "")
         + (" ; watchdog on)" if dog is not None else " ; watchdog off)")
     )
-    return Monitor(registry, server, dog, rec, heartbeat=hb)
+    return Monitor(
+        registry, server, dog, rec, heartbeat=hb, flight=flight,
+        profiler=prof,
+    )

@@ -51,12 +51,24 @@ detect -> checkpoint -> reshard -> resume story is exercised end to end
 across genuine process boundaries (`tools/launch.py --chaos-kill-*`,
 tests/test_supervisor.py, the supervisor-chaos-smoke CI job).
 
+- **Fleet federation + postmortems** (the observability layer on top):
+  `FleetFederation` turns the per-worker heartbeat files and (when
+  workers open ``--metrics-port``) their scraped ``/metrics`` endpoints
+  into rank-labeled fleet metrics with per-step straggler attribution
+  (``fleet_worker_step{rank}``, ``fleet_step_skew_seconds``,
+  ``fleet_straggler_rank``); on every failure restart or abort the
+  supervisor bundles each rank's crash flight-recorder dump
+  (`utils/obs.py FlightRecorder`, pointed at ``run_dir/flight/...`` via
+  ``DNN_TPU_FLIGHT_FILE``) plus exit causes into ``postmortem.json``
+  (docs/OBSERVABILITY.md "Fleet observability").
+
 Everything here is stdlib-only (no jax import): the supervisor must keep
 running when a worker's runtime is wedged, and the unit tests drive it
 with plain-python dummy workers. Live metrics ride the same registry as
 everything else (`utils/obs.py`): ``supervisor_group_size``,
 ``worker_failures_total{signal}``, ``elastic_restarts_total{direction}``,
-``supervisor_restart_seconds`` - rendered by `tools/live_top.py`.
+``supervisor_restart_seconds``, the ``fleet_*`` family - rendered by
+`tools/live_top.py`'s fleet view.
 Semantics: docs/ROBUSTNESS.md "Elastic supervisor".
 """
 
@@ -69,9 +81,12 @@ import socket
 import subprocess
 import sys
 import time
+import urllib.error
+import urllib.request
 from dataclasses import dataclass
 
 HEARTBEAT_ENV = "DNN_TPU_HEARTBEAT_FILE"
+FLIGHT_ENV = "DNN_TPU_FLIGHT_FILE"
 
 # exit code a SUPERVISED worker uses for "preempted cleanly" (emergency
 # checkpoint written, exiting on request) - EX_TEMPFAIL. Exit 0 means the
@@ -108,7 +123,9 @@ def reserve_port(host: str = "127.0.0.1") -> int:
 
 def read_heartbeat(path: str) -> dict | None:
     """Parse one heartbeat file (`utils/obs.py HeartbeatFileWriter`
-    schema: {"t", "beat_unix", "step", "pid"}); None when absent or
+    schema: {"t", "beat_unix", "step", "pid", "rank", "hostname",
+    "metrics_url"} - the last three are fleet-attribution additions and
+    absent from old files, which stay parseable); None when absent or
     torn (the writer publishes atomically, but the first write may not
     have landed yet)."""
     try:
@@ -133,6 +150,259 @@ def signal_label(returncode: int) -> str:
     return f"exit:{returncode}"
 
 
+# step-skew histogram bounds (seconds): sub-poll-resolution lockstep up
+# to a multi-minute wedged straggler
+SKEW_SECONDS_BUCKETS = (
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 300.0,
+)
+
+
+class FleetFederation:
+    """Aggregate per-rank worker telemetry into the SUPERVISOR's registry
+    - the fleet view a single-process `/metrics` endpoint cannot give.
+
+    Two input channels per worker, both already flowing:
+
+    - the **heartbeat file** (`utils/obs.py HeartbeatFileWriter`:
+      begin_step + step + rank + metrics_url), read every supervisor
+      poll. Step ARRIVALS are timestamped here: the first poll at which
+      rank r reports step >= S is r's arrival at S (resolution = the
+      poll cadence, fine for straggler work - a stall is seconds, a
+      poll is 0.2 s). From arrivals come ``fleet_worker_step{rank}``,
+      ``fleet_worker_step_seconds{rank}`` (per-rank step time), and two
+      attribution signals: **begin-step divergence** - in a
+      synchronized SPMD group a host-wedged rank sits at begin_step S
+      while its peers (already dispatched, blocked in the collective)
+      report S+1, so the minimum-begin rank is the straggler even
+      though COMPLETIONS are delayed equally on every rank - and
+      **arrival skew**: once every live rank has arrived at step S, the
+      arrival spread is one ``fleet_step_skew_seconds`` histogram
+      observation and spreads over ``attrib_min_skew_s`` attribute the
+      last arriver (``fleet_straggler_rank`` gauge +
+      ``fleet_straggler_total{rank}``; lockstep spreads with no begin
+      divergence set the gauge to -1).
+    - the worker's **/metrics endpoint** when it opened one
+      (``--metrics-port``; the URL is advertised in the heartbeat file),
+      scraped every ``scrape_interval_s``: a whitelist of families is
+      re-exported with a ``rank`` label (``fleet_train_loss{rank}``,
+      ``fleet_train_steps_total{rank}``, ...), and the worker's
+      ``train_step_seconds`` histogram sum/count deltas refine the
+      heartbeat-derived step-time gauge with fenced wall time.
+
+    Validated against the `parallel/fault.py` stall injector: a
+    ``--chaos-stall-step`` rank arrives late at its next step and is
+    flagged as the straggler (tests/test_fleet_obs.py, the fleet-obs CI
+    smoke). Stdlib-only, like everything else in this module.
+    """
+
+    SCRAPE_FAMILIES = (
+        "train_loss",
+        "train_steps_total",
+        "train_throughput_items_per_s",
+        "recompiles_total",
+        "watchdog_stall_total",
+        "guard_rollbacks_total",
+    )
+
+    def __init__(
+        self,
+        registry,
+        *,
+        scrape_interval_s: float = 2.0,
+        http_timeout_s: float = 1.0,
+        attrib_min_skew_s: float = 0.25,
+    ):
+        self.registry = registry
+        self.scrape_interval_s = float(scrape_interval_s)
+        self.http_timeout_s = float(http_timeout_s)
+        self.attrib_min_skew_s = float(attrib_min_skew_s)
+        self._m_step = registry.gauge(
+            "fleet_worker_step", "Last heartbeat step, per rank"
+        )
+        self._m_up = registry.gauge(
+            "fleet_worker_up", "1 while the rank's process is alive"
+        )
+        self._m_step_s = registry.gauge(
+            "fleet_worker_step_seconds",
+            "Per-rank step time (heartbeat arrivals, refined by scrape)",
+        )
+        self._m_straggler = registry.gauge(
+            "fleet_straggler_rank",
+            "Rank attributed straggler of the newest completed step "
+            "(-1 = none / lockstep)",
+        )
+        self._m_straggler_total = registry.counter(
+            "fleet_straggler_total",
+            "Steps on which a rank was attributed straggler, by rank",
+        )
+        self._m_skew_last = registry.gauge(
+            "fleet_last_step_skew_seconds",
+            "Arrival spread (max-min) of the newest completed step",
+        )
+        self._m_skew = registry.histogram(
+            "fleet_step_skew_seconds",
+            "Per-step cross-rank arrival spread (max-min)",
+            buckets=SKEW_SECONDS_BUCKETS,
+        )
+        self._m_scrapes = registry.counter(
+            "fleet_scrapes_total", "Worker /metrics scrapes attempted"
+        )
+        self._m_scrape_errors = registry.counter(
+            "fleet_scrape_errors_total", "Worker /metrics scrapes failed"
+        )
+        self._m_straggler.set(-1)
+        # per-rank (step, t) of the newest arrival; per-step {rank: t}
+        self._arrival: dict[int, tuple[int, float]] = {}
+        self._step_t: dict[int, dict[int, float]] = {}
+        self._begin: dict[int, int] = {}
+        self._last_begin_attrib: tuple | None = None
+        self._last_scrape: dict[int, float] = {}
+        self._scrape_hist: dict[int, tuple[float, float]] = {}
+
+    def observe(self, rank: int, hb: dict, *, alive: bool = True,
+                now: float | None = None) -> None:
+        """One rank's heartbeat doc, once per poll."""
+        now = time.time() if now is None else now
+        r = str(rank)
+        self._m_up.labels(rank=r).set(1 if alive else 0)
+        begin = hb.get("begin_step")
+        if begin is not None:
+            self._begin[rank] = int(begin)
+        step = hb.get("step")
+        if step is None:
+            return
+        step = int(step)
+        self._m_step.labels(rank=r).set(step)
+        last = self._arrival.get(rank)
+        if last is None or step > last[0]:
+            if last is not None:
+                per = (now - last[1]) / (step - last[0])
+                self._m_step_s.labels(rank=r).set(per)
+            self._arrival[rank] = (step, now)
+            self._step_t.setdefault(step, {})[rank] = now
+
+    def finish_poll(self, live_ranks) -> None:
+        """Close out this poll's attribution. Two signals, by failure
+        shape:
+
+        - **begin-step divergence** (synchronized SPMD wedges): a rank
+          stalled host-side sits at begin_step S while its peers -
+          whose NEXT steps are already dispatched and merely blocked in
+          the collective - report S+1; the minimum-begin rank is the
+          straggler. Completion times cannot tell them apart (the
+          collective delays everyone equally), begins can.
+        - **arrival skew** (unsynchronized phases, distinct processes):
+          once every live rank has arrived at step S, the arrival
+          spread feeds the skew histogram, and spreads over
+          ``attrib_min_skew_s`` attribute the last arriver.
+        """
+        live = set(live_ranks)
+        if not live:
+            return
+        begins = {
+            r: self._begin[r] for r in live if r in self._begin
+        }
+        lagging = None
+        if len(begins) > 1 and max(begins.values()) > min(begins.values()):
+            lagging = min(begins, key=lambda r: begins[r])
+            self._m_straggler.set(lagging)
+            key = (lagging, begins[lagging])
+            if key != self._last_begin_attrib:
+                self._last_begin_attrib = key
+                self._m_straggler_total.labels(rank=str(lagging)).inc()
+        for step in sorted(self._step_t):
+            t = self._step_t[step]
+            if not live <= set(t):
+                continue
+            if len(live) > 1:
+                # skew/straggler only exist across >= 2 ranks; a group
+                # shrunk to one rank keeps its last attribution instead
+                # of being reset by meaningless single-rank "steps"
+                ts = {r: t[r] for r in live}
+                skew = max(ts.values()) - min(ts.values())
+                self._m_skew.observe(skew)
+                self._m_skew_last.set(skew)
+                if skew >= self.attrib_min_skew_s:
+                    straggler = max(ts, key=lambda r: ts[r])
+                    self._m_straggler.set(straggler)
+                    self._m_straggler_total.labels(
+                        rank=str(straggler)
+                    ).inc()
+                elif lagging is None:
+                    # lockstep arrivals only clear the gauge when no
+                    # begin-divergence attribution is live this poll
+                    self._m_straggler.set(-1)
+            del self._step_t[step]
+        # bound memory: a rank that died mid-step leaves its steps open
+        if len(self._step_t) > 128:
+            for step in sorted(self._step_t)[:-64]:
+                del self._step_t[step]
+
+    def drop_rank(self, rank: int) -> None:
+        """Forget a dead rank's arrival state (a relaunch re-learns it)."""
+        self._arrival.pop(rank, None)
+        self._begin.pop(rank, None)
+        self._last_scrape.pop(rank, None)
+        self._scrape_hist.pop(rank, None)
+        self._m_up.labels(rank=str(rank)).set(0)
+
+    # ------------------------------------------------------------ scraping
+
+    def maybe_scrape(self, rank: int, url: str,
+                     now: float | None = None) -> bool:
+        """Scrape one worker's /metrics (rate-limited) and re-export the
+        whitelisted families with a rank label. Returns True on a scrape
+        attempt (tests drive cadence with the now parameter)."""
+        now = time.time() if now is None else now
+        last = self._last_scrape.get(rank)
+        if last is not None and now - last < self.scrape_interval_s:
+            return False
+        self._last_scrape[rank] = now
+        self._m_scrapes.inc()
+        try:
+            with urllib.request.urlopen(
+                url.rstrip("/") + "/metrics", timeout=self.http_timeout_s
+            ) as r:
+                text = r.read().decode()
+        except (urllib.error.URLError, OSError, ValueError):
+            self._m_scrape_errors.inc()
+            return True
+        self.ingest(rank, text)
+        return True
+
+    def ingest(self, rank: int, text: str) -> None:
+        """Fold one scraped exposition body into the fleet registry."""
+        from ..utils.obs import parse_prom_samples
+
+        fams = parse_prom_samples(text)
+        r = str(rank)
+        for name in self.SCRAPE_FAMILIES:
+            fam = fams.get(name)
+            if not fam:
+                continue
+            counter = name.endswith("_total")
+            m = (
+                self.registry.counter(f"fleet_{name}")
+                if counter else self.registry.gauge(f"fleet_{name}")
+            )
+            for key, val in fam.items():
+                labels = dict(key)
+                labels["rank"] = r
+                child = m.labels(**labels)
+                # re-exported counters move monotonically even if a
+                # scrape raced a worker restart
+                (child.set_max if counter else child.set)(val)
+        # refine the per-rank step time with the worker's own fenced
+        # step-seconds histogram (sum/count delta since the last scrape)
+        s = sum((fams.get("train_step_seconds_sum") or {}).values())
+        c = sum((fams.get("train_step_seconds_count") or {}).values())
+        if c > 0:
+            ps, pc = self._scrape_hist.get(rank, (0.0, 0.0))
+            if c > pc:
+                self._m_step_s.labels(rank=r).set((s - ps) / (c - pc))
+            self._scrape_hist[rank] = (s, c)
+
+
 @dataclass
 class SupervisorConfig:
     """Knobs for `Supervisor`; `tools/launch.py` maps them 1:1 to flags."""
@@ -155,6 +425,12 @@ class SupervisorConfig:
     # SIGTERM -> SIGKILL grace when stopping survivors (long enough for a
     # healthy worker to finish its step + emergency checkpoint)
     grace_s: float = 10.0
+    # after a failure is detected, wait this long (or until everyone has
+    # exited) before freezing the failure set: a gang crash's deaths
+    # straddle poll boundaries, and without the settle a whole-group
+    # crash can be misread as a partial one (spurious below-min-procs
+    # abort instead of a same-size restart)
+    failure_settle_s: float = 0.5
     # 0 = exit codes only; > 0 additionally treats a worker whose TRAINING
     # heartbeat (beat_unix) is older than this as dead (armed only after
     # the worker's first beat - compilation produces none)
@@ -183,6 +459,8 @@ class SupervisorConfig:
         for name in ("restart_backoff_s", "grace_s", "poll_s"):
             if getattr(self, name) <= 0:
                 raise ValueError(f"{name} must be > 0")
+        if self.failure_settle_s < 0:
+            raise ValueError("failure_settle_s must be >= 0")
 
 
 @dataclass
@@ -192,6 +470,7 @@ class _Worker:
     hb_path: str
     log_path: str
     log_file: object
+    flight_path: str = ""
     returncode: int | None = None
     ever_beat: bool = False
 
@@ -244,6 +523,7 @@ class Supervisor:
         base_env: dict | None = None,
         registry=None,
         capacity_fn=None,
+        federation: FleetFederation | None = None,
         log=print,
     ):
         self.command = [str(c) for c in command]
@@ -281,6 +561,18 @@ class Supervisor:
             "Failure detection -> group respawned latency",
             buckets=RESTART_SECONDS_BUCKETS,
         )
+        self._m_postmortems = registry.counter(
+            "supervisor_postmortems_total",
+            "Postmortem bundles written (failure restarts + aborts)",
+        )
+        # per-rank fleet metrics + straggler attribution + /metrics
+        # federation, on the same registry tools/launch.py serves
+        self.federation = (
+            federation if federation is not None
+            else FleetFederation(registry)
+        )
+        self.postmortem_path = os.path.join(self.run_dir, "postmortem.json")
+        self.postmortems_written = 0
         self.workers: list[_Worker] = []
         self.generation = -1
         self.n = config.nprocs
@@ -292,6 +584,7 @@ class Supervisor:
         self._healthy_since: float | None = None
         os.makedirs(os.path.join(self.run_dir, "hb"), exist_ok=True)
         os.makedirs(os.path.join(self.run_dir, "logs"), exist_ok=True)
+        os.makedirs(os.path.join(self.run_dir, "flight"), exist_ok=True)
         self._m_target.set(config.nprocs)
         self._m_budget.set(config.max_restarts)
 
@@ -310,7 +603,8 @@ class Supervisor:
             out.append(arg)
         return out
 
-    def _worker_env(self, rank: int, n: int, port: int, hb_path: str) -> dict:
+    def _worker_env(self, rank: int, n: int, port: int, hb_path: str,
+                    flight_path: str) -> dict:
         env = dict(self.base_env)
         if self.cfg.force_host_devices:
             # replace (not append) any inherited device-count flag: the
@@ -329,6 +623,10 @@ class Supervisor:
         env["JAX_NUM_PROCESSES"] = str(n)
         env["JAX_PROCESS_ID"] = str(rank)
         env[HEARTBEAT_ENV] = hb_path
+        # per-worker crash flight recorder (utils/obs.py FLIGHT): the
+        # worker's write-through dump lands here and is bundled into
+        # postmortem.json on failure - even after a SIGKILL
+        env[FLIGHT_ENV] = flight_path
         env["DNN_TPU_SUPERVISOR"] = "1"
         env["DNN_TPU_SUPERVISOR_GEN"] = str(self.generation)
         return env
@@ -346,16 +644,22 @@ class Supervisor:
             log_path = os.path.join(
                 self.run_dir, "logs", f"gen{g}_rank{rank}.log"
             )
+            flight_path = os.path.join(
+                self.run_dir, "flight", f"gen{g}_rank{rank}.json"
+            )
             log_file = open(log_path, "w")
             argv = self._worker_argv(rank, n)
             proc = subprocess.Popen(
                 argv,
-                env=self._worker_env(rank, n, self.port, hb_path),
+                env=self._worker_env(
+                    rank, n, self.port, hb_path, flight_path
+                ),
                 stdout=log_file,
                 stderr=subprocess.STDOUT,
             )
             self.workers.append(
-                _Worker(rank, proc, hb_path, log_path, log_file)
+                _Worker(rank, proc, hb_path, log_path, log_file,
+                        flight_path)
             )
         self._group_started = time.monotonic()
         self._healthy_since = None
@@ -405,11 +709,66 @@ class Supervisor:
         except OSError:
             return "(no log)"
 
+    # --------------------------------------------------------- postmortem
+
+    def _write_postmortem(self, failed: list, *, reason: str) -> str | None:
+        """Bundle the stopped generation into ``postmortem.json``: per
+        rank the exit cause, last heartbeat, flight-recorder dump (the
+        write-through ring survives even a SIGKILL - utils/obs.py
+        FlightRecorder), and a log tail; plus the run-level failure
+        history. Written atomically on every failure restart and on
+        SUPERVISOR ABORT - the newest bundle describes the newest crash.
+        Never raises (a postmortem must not break the restart path)."""
+        from ..utils.obs import read_flight_dump
+
+        failed_ranks = {w.rank for w in failed}
+        workers = []
+        for w in self.workers:
+            rc = w.poll()
+            workers.append({
+                "rank": w.rank,
+                "pid": w.proc.pid,
+                "generation": self.generation,
+                "returncode": rc,
+                "cause": signal_label(rc) if rc is not None else None,
+                "failed": w.rank in failed_ranks,
+                "ever_beat": w.ever_beat,
+                "heartbeat": read_heartbeat(w.hb_path),
+                "flight": read_flight_dump(w.flight_path),
+                "log_tail": self._tail(w, 10),
+            })
+        doc = {
+            "version": 1,
+            "written_unix": time.time(),
+            "reason": reason,
+            "generation": self.generation,
+            "group_size": self.n,
+            "target_nprocs": self.cfg.nprocs,
+            "restarts_used": self.restarts_used,
+            "rendezvous_used": self.rendezvous_used,
+            "failures": list(self.failures),
+            "workers": workers,
+        }
+        tmp = self.postmortem_path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1, default=str)
+            os.replace(tmp, self.postmortem_path)
+        except OSError:
+            return None
+        self.postmortems_written += 1
+        self._m_postmortems.inc()
+        self.log(f"(supervisor: postmortem bundle -> {self.postmortem_path})")
+        return self.postmortem_path
+
     # ------------------------------------------------------------ monitor
 
     def _observe(self) -> dict:
-        """One poll: worker liveness + heartbeat steps; fires due chaos."""
+        """One poll: worker liveness + heartbeat steps + fleet federation
+        (per-rank gauges, step-arrival skew, /metrics scrapes); fires due
+        chaos."""
         steps: dict[int, int | None] = {}
+        beating: list[int] = []
         for w in self.workers:
             # read even for dead workers: the file's existence proves the
             # worker got through rendezvous, however briefly it lived
@@ -417,7 +776,14 @@ class Supervisor:
             if hb is not None:
                 w.ever_beat = True
                 if not w.alive():
+                    self.federation.drop_rank(w.rank)
                     continue
+                self.federation.observe(w.rank, hb, alive=True)
+                if hb.get("step") is not None:
+                    beating.append(w.rank)
+                url = hb.get("metrics_url")
+                if url:
+                    self.federation.maybe_scrape(w.rank, url)
                 steps[w.rank] = hb.get("step")
                 if self.cfg.heartbeat_timeout_s > 0:
                     beat = hb.get("beat_unix")
@@ -433,6 +799,7 @@ class Supervisor:
                             "declaring it dead)"
                         )
                         w.kill(signal.SIGKILL)
+        self.federation.finish_poll(beating)
         if self.chaos is not None:
             for rank, sig in self.chaos.due(steps):
                 for w in self.workers:
@@ -534,6 +901,20 @@ class Supervisor:
         """Tear the group down and decide: relaunch (None) or abort (rc)."""
         cfg = self.cfg
         t0 = time.monotonic()
+        if failed and not rendezvous_timeout and cfg.failure_settle_s > 0:
+            # settle: a gang crash's other deaths may be microseconds
+            # behind the one this poll caught - wait briefly (or until
+            # nobody is left) and re-collect, so the failure set is the
+            # EVENT's, not one poll's worth of it
+            deadline = time.monotonic() + cfg.failure_settle_s
+            while time.monotonic() < deadline and any(
+                w.alive() for w in self.workers
+            ):
+                time.sleep(min(cfg.poll_s, 0.05))
+            failed = [
+                w for w in self.workers
+                if w.poll() is not None and w.returncode != 0
+            ]
         rendezvous = rendezvous_timeout or not self._group_ready()
         for w in failed:
             label = signal_label(w.returncode)
@@ -549,8 +930,36 @@ class Supervisor:
         self._stop_group(
             reason="worker failure" if failed else "rendezvous timeout"
         )
-        # deaths during teardown are collateral of the group stop, not new
-        # failures; they are visible in the logs either way
+        # deaths BY OUR OWN STOP are collateral (cooperative exit 0 /
+        # PREEMPT_RC, or our SIGTERM/SIGKILL): not new failures. A worker
+        # that exits with its own non-zero code during the teardown,
+        # though, crashed in the same event - its death just straddled a
+        # poll. Folding those in keeps a whole-group crash detected as
+        # one (same-size restart) instead of racing the poll cadence
+        # into a spurious below-min-procs abort.
+        if failed:
+            collateral = {0, None, PREEMPT_RC,
+                          -int(signal.SIGTERM), -int(signal.SIGKILL)}
+            late = [
+                w for w in self.workers
+                if w not in failed and w.returncode not in collateral
+            ]
+            for w in late:
+                label = signal_label(w.returncode)
+                self._m_failures.labels(signal=label).inc()
+                self.failures.append(
+                    {"gen": self.generation, "rank": w.rank, "cause": label}
+                )
+                self.log(
+                    f"(supervisor: rank {w.rank} also died [{label}] "
+                    "during the group stop - counting it into the same "
+                    "failure)"
+                )
+            failed = failed + late
+        self._write_postmortem(
+            failed,
+            reason="rendezvous failure" if rendezvous else "worker failure",
+        )
         if rendezvous:
             self.rendezvous_used += 1
             if self.rendezvous_used > cfg.rendezvous_retries:
@@ -628,6 +1037,10 @@ class Supervisor:
             "restarts": self.restarts_used,
             "rendezvous_retries": self.rendezvous_used,
             "worker_failures": self.failures,
+            "postmortems": self.postmortems_written,
+            "postmortem_path": (
+                self.postmortem_path if self.postmortems_written else None
+            ),
         }))
 
 

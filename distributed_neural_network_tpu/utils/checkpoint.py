@@ -330,12 +330,18 @@ class _CkptMetrics:
     def saved(self, step: int) -> None:
         import time
 
+        from .obs import flight_event
+
         self.saves.inc()
         self.last_save.set(time.time())
         self.last_step.set(int(step))
+        flight_event("checkpoint_save", step=int(step))
 
     def elastic(self, kind: str) -> None:
+        from .obs import flight_event
+
         self.elastic_events.labels(kind=kind).inc()
+        flight_event("elastic", what=kind)
 
 
 class TreeCheckpointer:

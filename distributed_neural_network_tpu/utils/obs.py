@@ -18,13 +18,21 @@ load-bearing). This module is that layer:
   (mirroring ``tracing.NULL_TRACER``): with no ``--metrics-port`` the
   whole layer costs one attribute call per publish site.
 - ``ObsServer`` - a daemon-thread HTTP server exposing ``/metrics``
-  (Prometheus text) and ``/healthz`` (JSON liveness/readiness: liveness =
+  (Prometheus text), ``/healthz`` (JSON liveness/readiness: liveness =
   heartbeat age under a threshold, readiness = the first step - i.e. XLA
-  compilation - has completed). Port 0 binds an ephemeral port; ``.port``
-  reports what the OS chose.
+  compilation - has completed), and ``/profile?steps=N`` (on-demand
+  `jax.profiler` capture via `train/monitor.py ProfileController`).
+  Port 0 binds an ephemeral port; ``.port`` reports what the OS chose.
 - heartbeat plumbing - ``registry.beat(step)`` records (time, step) and
   the recent beat-interval window the stall watchdog
-  (`train/monitor.py`) sizes its detection threshold from.
+  (`train/monitor.py`) sizes its detection threshold from;
+  ``begin_step(step)`` marks step STARTS, the fleet federation's
+  wedge-attribution signal (`train/supervisor.py`).
+- ``FlightRecorder`` / ``flight_event()`` - the crash flight recorder:
+  a bounded ring of structured anomaly/lifecycle events with an atomic
+  write-through dump that survives SIGKILL, bundled per rank into the
+  supervisor's ``postmortem.json`` (docs/OBSERVABILITY.md "Fleet
+  observability").
 
 Stdlib-only (no jax import), so the registry and server work on any host
 - including the dashboard/test side (`tools/live_top.py`).
@@ -36,9 +44,17 @@ import http.server
 import json
 import math
 import os
+import socket
 import threading
 import time
+import urllib.parse
 from collections import deque
+
+# env var naming the per-worker flight-recorder dump file; the elastic
+# supervisor (train/supervisor.py) exports it next to the heartbeat file
+# so every supervised worker's last-seconds event ring survives even a
+# SIGKILL (write-through) and lands in the postmortem bundle
+FLIGHT_ENV = "DNN_TPU_FLIGHT_FILE"
 
 # default histogram bucket bounds (seconds) for step-time histograms:
 # spans 1 ms compiled CPU smoke steps to multi-minute fused spans
@@ -264,9 +280,16 @@ class MetricsRegistry:
         self._beat_lock = threading.Lock()
         self._last_beat: float | None = None
         self._last_step: int | None = None
+        self._last_begin: int | None = None
         self._intervals: deque[float] = deque(maxlen=beat_window)
         self.ready = False
         self._ready_unix: float | None = None
+        # optional per-beat callback (step) - the step-boundary hook both
+        # training loops already drive via beat(); the on-demand profiler
+        # (train/monitor.py ProfileController) rides it so no step-loop
+        # signature changes are needed. Exceptions are swallowed: a hook
+        # bug must never kill a training step.
+        self.beat_hook = None
 
     # ------------------------------------------------------------ metrics
 
@@ -313,6 +336,28 @@ class MetricsRegistry:
             self._last_beat = now
             if step is not None:
                 self._last_step = int(step)
+        hook = self.beat_hook
+        if hook is not None:
+            try:
+                hook(step)
+            except Exception:
+                pass
+
+    def begin_step(self, step: int) -> None:
+        """Mark step ``step`` as STARTED (called before the dispatch,
+        where ``beat`` marks completion). The begin/beat pair is the
+        fleet straggler-attribution channel for synchronized SPMD
+        groups: a rank wedged host-side never begins step S+1 while its
+        peers (blocked in the collective, steps already dispatched)
+        have - so begin-step divergence names the guilty rank even
+        though every rank's COMPLETION is delayed equally
+        (`train/supervisor.py FleetFederation`)."""
+        with self._beat_lock:
+            self._last_begin = int(step)
+
+    def last_begin_step(self) -> int | None:
+        with self._beat_lock:
+            return self._last_begin
 
     def mark_ready(self) -> None:
         """Flip readiness (first compiled step completed). /healthz
@@ -433,6 +478,11 @@ class NullRegistry:
 
     def beat(self, step: int | None = None) -> None: ...
 
+    def begin_step(self, step: int) -> None: ...
+
+    def last_begin_step(self):
+        return None
+
     def mark_ready(self) -> None: ...
 
     def heartbeat_age(self):
@@ -463,17 +513,37 @@ class HeartbeatFileWriter:
     Schema (all the supervisor's failure detection and chaos step
     triggers need): ``{"t": <writer wall time>, "beat_unix": <last
     training-step heartbeat or null while compiling>, "step": <last
-    heartbeat step or null>, "pid": ...}``. Written atomically
-    (tmp + rename) every ``interval_s`` so a reader never sees a torn
-    file; the file's very existence doubles as the worker's
-    "rendezvous done" signal (the writer is attached after
+    heartbeat step or null>, "pid": ..., "rank": <process rank or null>,
+    "hostname": ..., "metrics_url": <this worker's /metrics base URL or
+    null>}``. ``rank``/``hostname`` make attribution survive file
+    relocation (the supervisor used to infer rank from the file PATH
+    alone); ``metrics_url`` is the federation handshake - the
+    supervisor's scraper (`train/supervisor.py FleetFederation`) learns
+    each worker's endpoint from here instead of any port convention.
+    Old files without the new keys stay parseable (readers ``.get``).
+    Written atomically (tmp + rename) every ``interval_s`` so a reader
+    never sees a torn file; the file's very existence doubles as the
+    worker's "rendezvous done" signal (the writer is attached after
     `parallel/distributed.py initialize()` succeeded).
     """
 
-    def __init__(self, registry, path: str, *, interval_s: float = 0.5):
+    def __init__(
+        self, registry, path: str, *, interval_s: float = 0.5,
+        rank: int | None = None, hostname: str | None = None,
+        metrics_url: str | None = None,
+    ):
         self.registry = registry
         self.path = os.path.abspath(path)
         self.interval_s = float(interval_s)
+        if rank is None:
+            env_rank = os.environ.get("JAX_PROCESS_ID")
+            try:
+                rank = int(env_rank) if env_rank is not None else None
+            except ValueError:
+                rank = None
+        self.rank = rank
+        self.hostname = hostname if hostname is not None else _hostname()
+        self.metrics_url = metrics_url
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -488,7 +558,11 @@ class HeartbeatFileWriter:
             "t": time.time(),
             "beat_unix": (time.time() - age) if age is not None else None,
             "step": self.registry.last_step(),
+            "begin_step": self.registry.last_begin_step(),
             "pid": os.getpid(),
+            "rank": self.rank,
+            "hostname": self.hostname,
+            "metrics_url": self.metrics_url,
         }
         tmp = f"{self.path}.tmp.{os.getpid()}"
         try:
@@ -522,6 +596,258 @@ def publish_phase_timers(registry, timers) -> None:
         c.labels(phase=phase).set_max(seconds)
 
 
+# --------------------------------------------------------- flight recorder
+
+
+def _hostname() -> str:
+    try:
+        return socket.gethostname()
+    except OSError:  # pragma: no cover - defensive
+        return "unknown"
+
+
+def _json_safe(x):
+    """Sanitize a flight event for strict JSON: non-finite floats become
+    None, anything non-serializable becomes its repr."""
+    if isinstance(x, float):
+        return x if math.isfinite(x) else None
+    if isinstance(x, dict):
+        return {str(k): _json_safe(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return [_json_safe(v) for v in x]
+    if isinstance(x, (str, int, bool)) or x is None:
+        return x
+    return repr(x)
+
+
+class FlightRecorder:
+    """Crash flight recorder: a bounded in-memory ring of structured
+    events (guard anomalies, watchdog flags, chaos/elastic events,
+    checkpoint saves, recompiles, preemptions) with an atomic
+    write-through dump.
+
+    The design constraint is the SIGKILL case: a hard-killed worker gets
+    no exit path, so the last-seconds record must already be on disk.
+    Events are therefore LOW-RATE by contract (step-boundary anomalies
+    and lifecycle transitions, never per-step hot-path publishes), which
+    makes write-through affordable: every ``record()`` on a configured
+    recorder rewrites the dump file atomically (tmp + rename, same idiom
+    as `HeartbeatFileWriter`), so the file on disk is always the complete
+    current ring. The elastic supervisor points each worker at a dump
+    path via ``DNN_TPU_FLIGHT_FILE`` (`FLIGHT_ENV`) and bundles the
+    per-rank dumps plus exit causes into ``postmortem.json`` on any
+    failure restart or SUPERVISOR ABORT (`train/supervisor.py`).
+
+    Unconfigured (no path - the default), the ring still records in
+    memory: one deque append per event, dumpable on demand. The
+    module-level ``FLIGHT`` singleton is the process's recorder; call
+    sites use ``flight_event(kind, step=..., **fields)``.
+    """
+
+    def __init__(self, capacity: int = 256):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self._events: deque = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self.dropped = 0
+        self.path: str | None = None
+        self.rank: int | None = None
+        self.hostname = _hostname()
+        self.started_unix = time.time()
+
+    def configure(
+        self, path: str, *, rank: int | None = None,
+        hostname: str | None = None,
+    ) -> None:
+        """Arm write-through dumping to ``path`` (created on first event;
+        an immediate dump marks the recorder live)."""
+        self.path = os.path.abspath(path)
+        if rank is not None:
+            self.rank = int(rank)
+        elif self.rank is None:
+            env_rank = os.environ.get("JAX_PROCESS_ID")
+            try:
+                self.rank = int(env_rank) if env_rank is not None else None
+            except ValueError:
+                self.rank = None
+        if hostname is not None:
+            self.hostname = hostname
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        self.dump()
+
+    def record(self, kind: str, /, *, step: int | None = None,
+               **fields) -> dict:
+        """Append one structured event (and write through when armed).
+        ``kind`` is positional-only so a field may also be named kind;
+        the reserved keys (t/kind) shadow rather than being shadowed."""
+        ev = {"t": round(time.time(), 3), "kind": str(kind)}
+        if step is not None:
+            ev["step"] = int(step)
+        for k, v in fields.items():
+            k = str(k)
+            if k in ("t", "kind"):
+                k = f"arg_{k}"
+            ev[k] = _json_safe(v)
+        with self._lock:
+            if len(self._events) == self.capacity:
+                self.dropped += 1
+            self._events.append(ev)
+        if self.path is not None:
+            self.dump()
+        return ev
+
+    def events(self) -> list[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def snapshot(self, *, cause: str | None = None) -> dict:
+        """The dump document (schema: docs/OBSERVABILITY.md)."""
+        with self._lock:
+            events = list(self._events)
+            dropped = self.dropped
+        return {
+            "version": 1,
+            "pid": os.getpid(),
+            "rank": self.rank,
+            "hostname": self.hostname,
+            "started_unix": self.started_unix,
+            "written_unix": time.time(),
+            "cause": cause,
+            "capacity": self.capacity,
+            "dropped": dropped,
+            "events": events,
+        }
+
+    def dump(self, *, cause: str | None = None, path: str | None = None):
+        """Atomically write the ring to ``path`` (default the configured
+        one); returns the path, or None when there is nowhere to write.
+        Never raises - a full disk must not kill the run being recorded."""
+        p = path or self.path
+        if p is None:
+            return None
+        doc = self.snapshot(cause=cause)
+        tmp = f"{p}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(doc, f, allow_nan=False)
+            os.replace(tmp, p)
+        except (OSError, ValueError):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return None
+        return p
+
+    def reset(self) -> None:
+        """Clear ring + config (test hygiene for the shared singleton)."""
+        with self._lock:
+            self._events.clear()
+            self.dropped = 0
+        self.path = None
+        self.rank = None
+
+
+FLIGHT = FlightRecorder()
+
+
+def flight_event(kind: str, /, *, step: int | None = None,
+                 **fields) -> dict:
+    """Record one event on the process flight recorder (`FLIGHT`).
+
+    Always cheap (a deque append; plus one small atomic file write when a
+    dump path is armed - see FlightRecorder's low-rate contract). This is
+    the one-line hook every anomaly/lifecycle site uses
+    (train/guard.py, train/monitor.py, utils/checkpoint.py,
+    parallel/fault.py, train/elastic.py)."""
+    return FLIGHT.record(kind, step=step, **fields)
+
+
+def read_flight_dump(path: str) -> dict | None:
+    """Parse one flight-recorder dump; None when absent/torn (the writer
+    publishes atomically, but the worker may have died pre-configure)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
+# ------------------------------------------------- Prometheus text parsing
+
+
+def parse_prom_samples(text: str) -> dict:
+    """{metric_name: {((label, value), ...): float}} from Prometheus text
+    exposition - the supervisor-side parser the federation scraper uses
+    (`train/supervisor.py`). Histogram series keep their _bucket/_sum/
+    _count suffixes as distinct names; malformed lines are skipped.
+    `tools/live_top.py` carries its own equivalent copy by design: the
+    dashboard must stay free of repo imports.
+    """
+    out: dict[str, dict[tuple, float]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            if "{" in line:
+                name, rest = line.split("{", 1)
+                labels_s, value_s = rest.rsplit("}", 1)
+                labels = []
+                for part in _split_label_pairs(labels_s):
+                    k, v = part.split("=", 1)
+                    labels.append((k, _prom_unescape(v.strip('"'))))
+                key = tuple(sorted(labels))
+            else:
+                name, value_s = line.rsplit(None, 1)
+                key = ()
+            v = value_s.strip()
+            value = float("inf") if v == "+Inf" else (
+                float("-inf") if v == "-Inf" else float(v)
+            )
+        except ValueError:
+            continue
+        out.setdefault(name.strip(), {})[key] = value
+    return out
+
+
+def _prom_unescape(s: str) -> str:
+    return (
+        s.replace("\\\\", "\0")
+        .replace('\\"', '"')
+        .replace("\\n", "\n")
+        .replace("\0", "\\")
+    )
+
+
+def _split_label_pairs(s: str):
+    """Split 'a="x",b="y,z"' on commas outside quotes."""
+    parts, buf, in_q, esc = [], [], False, False
+    for ch in s:
+        if esc:
+            buf.append(ch)
+            esc = False
+            continue
+        if ch == "\\":
+            buf.append(ch)
+            esc = True
+            continue
+        if ch == '"':
+            in_q = not in_q
+            buf.append(ch)
+            continue
+        if ch == "," and not in_q:
+            parts.append("".join(buf))
+            buf = []
+            continue
+        buf.append(ch)
+    if buf:
+        parts.append("".join(buf))
+    return [p for p in (p.strip() for p in parts) if p]
+
+
 # ------------------------------------------------------------- HTTP server
 
 
@@ -529,7 +855,12 @@ class _ObsHandler(http.server.BaseHTTPRequestHandler):
     # the registry rides on the server instance (set by ObsServer)
     def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
         reg = self.server.registry  # type: ignore[attr-defined]
-        path = self.path.split("?", 1)[0]
+        parts = self.path.split("?", 1)
+        path = parts[0]
+        query = parts[1] if len(parts) > 1 else ""
+        if path == "/profile":
+            self._do_profile(query)
+            return
         if path == "/metrics":
             body = reg.render().encode()
             self.send_response(200)
@@ -548,7 +879,8 @@ class _ObsHandler(http.server.BaseHTTPRequestHandler):
         elif path == "/":
             body = (
                 b"distributed_neural_network_tpu run\n"
-                b"endpoints: /metrics (Prometheus), /healthz (JSON)\n"
+                b"endpoints: /metrics (Prometheus), /healthz (JSON), "
+                b"/profile?steps=N (on-demand jax.profiler capture)\n"
             )
             self.send_response(200)
             self.send_header("Content-Type", "text/plain")
@@ -556,6 +888,40 @@ class _ObsHandler(http.server.BaseHTTPRequestHandler):
             body = b"not found\n"
             self.send_response(404)
             self.send_header("Content-Type", "text/plain")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _do_profile(self, query: str) -> None:
+        """GET /profile?steps=N -> arm an on-demand profiler capture for
+        the next N steps (train/monitor.py ProfileController; 501 when
+        the run was started without a profile directory)."""
+        prof = getattr(self.server, "profiler", None)
+        if prof is None:
+            doc, code = {
+                "ok": False,
+                "error": "profiling not wired: start the run with "
+                "--metrics-port and a profile directory (--profile-dir, "
+                "or --trace-out whose directory is reused)",
+            }, 501
+        else:
+            qs = urllib.parse.parse_qs(query)
+            try:
+                steps = int(qs.get("steps", ["10"])[0])
+            except ValueError:
+                steps = -1
+            if steps < 1:
+                doc, code = {
+                    "ok": False,
+                    "error": "steps must be a positive integer "
+                    "(/profile?steps=N)",
+                }, 400
+            else:
+                doc = prof.request(steps)
+                code = 200 if doc.get("ok") else 409
+        body = (json.dumps(doc) + "\n").encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -581,6 +947,7 @@ class ObsServer:
         port: int = 0,
         host: str = "127.0.0.1",
         stall_after_s: float = 300.0,
+        profiler=None,
     ):
         self.registry = registry
         self._httpd = http.server.ThreadingHTTPServer(
@@ -589,6 +956,9 @@ class ObsServer:
         self._httpd.daemon_threads = True
         self._httpd.registry = registry  # type: ignore[attr-defined]
         self._httpd.stall_after_s = stall_after_s  # type: ignore
+        # /profile target (train/monitor.py ProfileController; None =
+        # the endpoint answers 501 with the wiring hint)
+        self._httpd.profiler = profiler  # type: ignore[attr-defined]
         self.host = host
         self.port = int(self._httpd.server_address[1])
         self.url = f"http://{host}:{self.port}"

@@ -143,6 +143,24 @@ class Tracer:
         self._tls = threading.local()
         self._epoch_ns = time.perf_counter_ns()
         self.epoch_unix = time.time()
+        # fleet identity (set_process): rank-stamped process metadata so
+        # per-rank trace shards merge into one readable timeline
+        # (tools/trace_merge.py) that stays stable across supervisor
+        # relaunches - pids change per (re)launch, ranks do not
+        self.rank: int | None = None
+        self.hostname: str | None = None
+
+    def set_process(
+        self, *, rank: int | None = None, hostname: str | None = None
+    ) -> "Tracer":
+        """Stamp this tracer's process identity. With a rank set, the
+        exported Chrome document's ``process_name`` metadata becomes
+        ``rank{N}`` (not the pid-keyed default) and ``otherData`` carries
+        ``rank``/``hostname`` - the keys `tools/trace_merge.py` aligns
+        and labels shards by."""
+        self.rank = int(rank) if rank is not None else None
+        self.hostname = hostname
+        return self
 
     # ------------------------------------------------------------ recording
 
@@ -217,9 +235,12 @@ class Tracer:
         file alone.
         """
         pid = os.getpid()
+        pname = (
+            f"rank{self.rank}" if self.rank is not None else "dnn-tpu-train"
+        )
         events = [
             {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
-             "ts": 0, "args": {"name": "dnn-tpu-train"}},
+             "ts": 0, "args": {"name": pname}},
         ]
         with self._lock:
             tracks = dict(self._tracks)
@@ -238,10 +259,15 @@ class Tracer:
             if ev.ph == "X":
                 out["dur"] = ev.dur if ev.dur is not None else 0.0
             events.append(out)
+        other = {"epoch_unix": self.epoch_unix, "pid": pid}
+        if self.rank is not None:
+            other["rank"] = self.rank
+        if self.hostname is not None:
+            other["hostname"] = self.hostname
         doc = {
             "traceEvents": events,
             "displayTimeUnit": "ms",
-            "otherData": {"epoch_unix": self.epoch_unix},
+            "otherData": other,
         }
         if step_stats is not None:
             doc["stepStats"] = _finite_tree(step_stats.summary())
@@ -602,6 +628,34 @@ class StepStats:
 
 
 # ----------------------------------------------------------------- helpers
+
+
+def detect_rank() -> int | None:
+    """This process's rank in a multi-process group, from the standard
+    env handshake (``JAX_PROCESS_ID``, exported by `train/supervisor.py`
+    and cluster launchers); None for a plain single-process run. Pure
+    env read - usable before (or without) any jax import."""
+    v = os.environ.get("JAX_PROCESS_ID")
+    if v is None:
+        return None
+    try:
+        return int(v)
+    except ValueError:
+        return None
+
+
+def rank_trace_path(path: str, rank: int | None) -> str:
+    """Per-rank trace-shard path: ``trace.json`` -> ``trace_rank{N}.json``.
+
+    Supervised workers all run the same argv, so a shared ``--trace-out``
+    would have every rank clobbering one file; the rank suffix gives each
+    worker its own shard, which `tools/trace_merge.py` reassembles into
+    one timeline. rank=None (single process) returns the path unchanged.
+    """
+    if rank is None:
+        return path
+    root, ext = os.path.splitext(path)
+    return f"{root}_rank{int(rank)}{ext or '.json'}"
 
 
 def param_bytes(tree) -> int:

@@ -197,6 +197,12 @@ def main() -> int:
                    metavar="SEC",
                    help="keep the metrics server up this many seconds "
                    "after the run finishes (final scrape window)")
+    p.add_argument("--profile-dir", default=None, metavar="DIR",
+                   help="with --metrics-port: serve /profile?steps=N - "
+                   "an on-demand jax.profiler capture of the next N "
+                   "steps, written under DIR (default: next to "
+                   "--trace-out when set; without either the endpoint "
+                   "answers 501)")
     p.add_argument("--watchdog", choices=("on", "off"), default="on",
                    help="with --metrics-port: background watchdog flagging "
                    "stalled steps (no heartbeat for N x steady p95 step "
@@ -278,6 +284,15 @@ def main() -> int:
     p.add_argument("--chaos-stall-seconds", type=float, default=2.0,
                    metavar="SEC",
                    help="stall duration for --chaos-stall-step")
+    p.add_argument("--chaos-stall-rank", type=int, default=None,
+                   metavar="R",
+                   help="restrict --chaos-stall-step to process rank R "
+                   "of a multi-process group (every rank runs the same "
+                   "argv under tools/launch.py, so without this the "
+                   "whole fleet stalls in lockstep); single-process runs "
+                   "treat their rank as 0. Drives the supervisor's "
+                   "straggler attribution validation "
+                   "(fleet_straggler_rank)")
     p.add_argument("--chaos-shrink-at-step", type=int, default=None,
                    metavar="N",
                    help="fault injection (parallel/fault.py): after step N "
@@ -403,6 +418,9 @@ def main() -> int:
     if args.chaos_stall_seconds <= 0:
         p.error(f"--chaos-stall-seconds must be > 0, got "
                 f"{args.chaos_stall_seconds}")
+    if args.chaos_stall_rank is not None and not args.chaos_stall_step:
+        p.error("--chaos-stall-rank restricts --chaos-stall-step, which "
+                "was not given")
     if args.elastic and not args.resume and args.chaos_shrink_at_step is None:
         p.error("--elastic configures how --resume (or a SHRINK "
                 "preemption) maps a checkpoint onto this mesh; add "
@@ -689,9 +707,27 @@ def main() -> int:
     from distributed_neural_network_tpu.utils import tracing as TRC
 
     tracer = TRC.Tracer(enabled=bool(args.trace_out))
+    # fleet identity: under a supervised / multi-process group every rank
+    # runs this same argv, so the tracer stamps rank{N} process metadata
+    # and --trace-out becomes a per-rank shard (trace_rank{N}.json) that
+    # tools/trace_merge.py reassembles into one aligned timeline
+    rank = TRC.detect_rank()
+    if rank is None and jax.process_count() > 1:
+        rank = jax.process_index()
+    if rank is not None:
+        import socket as _socket
+
+        tracer.set_process(rank=rank, hostname=_socket.gethostname())
+        if args.trace_out:
+            args.trace_out = TRC.rank_trace_path(args.trace_out, rank)
+            print(f"(per-rank trace shard: {args.trace_out})")
     preempt = None
     if args.on_sigterm == "checkpoint":
         preempt = G.PreemptionGuard().install()
+    profile_dir = args.profile_dir or (
+        os.path.dirname(os.path.abspath(args.trace_out))
+        if args.trace_out else None
+    )
     monitor = attach_monitor(
         metrics_port=args.metrics_port,
         tracer=tracer,
@@ -703,6 +739,8 @@ def main() -> int:
                 and preempt is not None else 0
             ),
         ),
+        profile_dir=profile_dir,
+        rank=rank,
     )
     registry = monitor.registry
     m_loss_gauge = registry.gauge(
@@ -1052,7 +1090,11 @@ def main() -> int:
 
     # self-healing layer (train/guard.py; docs/ROBUSTNESS.md)
     monkey = None
-    if (args.chaos_spike_step or args.chaos_stall_step
+    stall_at = tuple(args.chaos_stall_step or ())
+    if stall_at and args.chaos_stall_rank is not None \
+            and (rank if rank is not None else 0) != args.chaos_stall_rank:
+        stall_at = ()  # this rank is not the designated straggler
+    if (args.chaos_spike_step or stall_at
             or args.chaos_sigterm_after is not None
             or args.chaos_shrink_at_step is not None):
         from distributed_neural_network_tpu.parallel.fault import ChaosMonkey
@@ -1060,7 +1102,7 @@ def main() -> int:
         monkey = ChaosMonkey(
             spike_at=tuple(args.chaos_spike_step or ()),
             sigterm_after=args.chaos_sigterm_after,
-            stall_at=tuple(args.chaos_stall_step or ()),
+            stall_at=stall_at,
             stall_s=args.chaos_stall_seconds,
             shrink_at=args.chaos_shrink_at_step,
             preempt=preempt,
@@ -1396,6 +1438,9 @@ def main() -> int:
         "model_tflops_per_s": round(model_flops_s / 1e12, 2),
         "mfu_pct": round(mfu, 2) if mfu is not None else None,
     }))
+    from distributed_neural_network_tpu.utils.obs import flight_event
+
+    flight_event("run_end", step=last_step, preempted=preempted)
     if monitor.server is not None and args.metrics_linger > 0:
         print(f"(metrics server lingering {args.metrics_linger:g}s for "
               "final scrapes)")

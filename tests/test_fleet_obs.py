@@ -1,0 +1,711 @@
+"""Fleet observability (ISSUE 9): crash flight recorder, cross-rank
+trace merging, supervisor metrics federation + straggler attribution,
+postmortem bundles, and the /profile endpoint.
+
+Layers under test: `utils/obs.py` (FlightRecorder, heartbeat rank/
+hostname/metrics_url fields, /profile route, parse_prom_samples),
+`utils/tracing.py` (rank-stamped process metadata, per-rank shard
+paths), `tools/trace_merge.py` (clock-offset alignment, step_align
+markers), `train/supervisor.py` (FleetFederation, postmortem.json),
+`train/monitor.py` (ProfileController, attach_monitor fleet wiring),
+`tools/live_top.py` (fleet view) and `tools/trace_summary.py --rank`.
+Federation rendering is asserted through live_top's OWN Prometheus
+parser - the same path a live scrape takes.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+import pytest
+
+from distributed_neural_network_tpu.train.supervisor import (
+    FleetFederation,
+    Supervisor,
+    SupervisorConfig,
+    read_heartbeat,
+)
+from distributed_neural_network_tpu.utils import tracing as TR
+from distributed_neural_network_tpu.utils.obs import (
+    FLIGHT,
+    FlightRecorder,
+    HeartbeatFileWriter,
+    MetricsRegistry,
+    ObsServer,
+    flight_event,
+    parse_prom_samples,
+    read_flight_dump,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import live_top  # noqa: E402
+import trace_merge  # noqa: E402
+import trace_summary  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_flight():
+    """The module-level FLIGHT singleton must not leak config between
+    tests (attach_monitor arms it from the environment)."""
+    FLIGHT.reset()
+    yield
+    FLIGHT.reset()
+
+
+# -------------------------------------------------------- flight recorder
+
+
+def test_flight_ring_bounds():
+    fr = FlightRecorder(capacity=8)
+    for i in range(20):
+        fr.record("e", step=i)
+    evs = fr.events()
+    assert len(evs) == 8
+    assert fr.dropped == 12
+    # the ring keeps the NEWEST events - the last seconds before a crash
+    assert [e["step"] for e in evs] == list(range(12, 20))
+    with pytest.raises(ValueError, match="capacity"):
+        FlightRecorder(capacity=0)
+
+
+def test_flight_write_through_and_schema(tmp_path):
+    fr = FlightRecorder(capacity=16)
+    path = tmp_path / "fl.json"
+    fr.configure(str(path), rank=3)
+    assert path.exists()  # configure() writes the live marker immediately
+    fr.record("guard_anomaly", step=7, anomaly="spikes", zscore=9.5)
+    fr.record("weird", step=8, bad=float("nan"), obj={"x": (1, 2)})
+    doc = read_flight_dump(str(path))
+    assert doc["version"] == 1 and doc["rank"] == 3
+    assert doc["hostname"] and doc["pid"] == os.getpid()
+    ev = doc["events"][-2]
+    assert ev["kind"] == "guard_anomaly" and ev["step"] == 7
+    assert ev["zscore"] == 9.5
+    # strict JSON: non-finite sanitized, non-serializable repr'd
+    assert doc["events"][-1]["bad"] is None
+    assert isinstance(doc["events"][-1]["obj"], dict)
+    # no torn tmp files left behind
+    assert [p for p in os.listdir(tmp_path) if ".tmp" in p] == []
+
+
+def test_flight_dump_survives_sigterm(tmp_path):
+    """Atomic write-through: a worker killed by an un-handled SIGTERM
+    (no exit path runs) still leaves its complete event ring on disk -
+    the property the postmortem bundle depends on for SIGKILLed ranks."""
+    path = tmp_path / "fl.json"
+    code = (
+        "import os, sys, time\n"
+        "sys.path.insert(0, %r)\n"
+        "from distributed_neural_network_tpu.utils.obs import FLIGHT, "
+        "flight_event\n"
+        "FLIGHT.configure(%r, rank=1)\n"
+        "flight_event('chaos', step=3, what='stall')\n"
+        "flight_event('checkpoint_save', step=4)\n"
+        "print('READY', flush=True)\n"
+        "time.sleep(60)\n" % (REPO, str(path))
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code], stdout=subprocess.PIPE, text=True,
+    )
+    try:
+        assert proc.stdout.readline().strip() == "READY"
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=30)
+    finally:
+        proc.kill()
+    assert proc.returncode == -signal.SIGTERM
+    doc = read_flight_dump(str(path))
+    assert doc is not None and doc["rank"] == 1
+    kinds = [e["kind"] for e in doc["events"]]
+    assert kinds == ["chaos", "checkpoint_save"]
+
+
+def test_flight_event_singleton_unconfigured_is_memory_only(tmp_path):
+    ev = flight_event("x", step=1)
+    assert ev["kind"] == "x"
+    assert FLIGHT.events()[-1]["step"] == 1
+    assert FLIGHT.dump() is None  # nowhere to write
+    # on-demand dump to an explicit path still works
+    p = FLIGHT.dump(path=str(tmp_path / "demand.json"), cause="test")
+    assert read_flight_dump(p)["cause"] == "test"
+
+
+# ------------------------------------------- heartbeat rank/hostname/url
+
+
+def test_heartbeat_gains_rank_hostname_url(tmp_path, monkeypatch):
+    monkeypatch.setenv("JAX_PROCESS_ID", "3")
+    reg = MetricsRegistry()
+    reg.beat(5)
+    hb = HeartbeatFileWriter(
+        reg, str(tmp_path / "hb.json"), metrics_url="http://127.0.0.1:9"
+    )
+    hb.close()
+    doc = read_heartbeat(str(tmp_path / "hb.json"))
+    assert doc["rank"] == 3  # from the env handshake
+    assert doc["hostname"]
+    assert doc["metrics_url"] == "http://127.0.0.1:9"
+    assert doc["step"] == 5
+
+
+def test_heartbeat_explicit_rank_beats_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("JAX_PROCESS_ID", "3")
+    reg = MetricsRegistry()
+    hb = HeartbeatFileWriter(reg, str(tmp_path / "hb.json"), rank=7)
+    hb.close()
+    assert read_heartbeat(str(tmp_path / "hb.json"))["rank"] == 7
+
+
+def test_old_heartbeat_files_stay_parseable(tmp_path):
+    # a pre-fleet file without the new keys (the PR 8 schema)
+    p = tmp_path / "old.json"
+    p.write_text(json.dumps(
+        {"t": 1.0, "beat_unix": 1.0, "step": 9, "pid": 1}
+    ))
+    doc = read_heartbeat(str(p))
+    assert doc["step"] == 9
+    assert doc.get("rank") is None and doc.get("metrics_url") is None
+
+
+# ------------------------------------------------ rank-stamped trace shards
+
+
+def test_tracer_rank_process_metadata():
+    t = TR.Tracer(enabled=True).set_process(rank=2, hostname="host-a")
+    with t.span("train_step", track="train", step=0):
+        pass
+    doc = t.to_chrome()
+    pname = next(
+        e for e in doc["traceEvents"]
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    )
+    assert pname["args"]["name"] == "rank2"
+    assert doc["otherData"]["rank"] == 2
+    assert doc["otherData"]["hostname"] == "host-a"
+    # default stays the pre-fleet label (single-process traces unchanged)
+    d2 = TR.Tracer(enabled=True).to_chrome()
+    p2 = next(
+        e for e in d2["traceEvents"] if e.get("name") == "process_name"
+    )
+    assert p2["args"]["name"] == "dnn-tpu-train"
+    assert "rank" not in d2["otherData"]
+
+
+def test_rank_trace_path():
+    assert TR.rank_trace_path("a/trace.json", 0) == "a/trace_rank0.json"
+    assert TR.rank_trace_path("trace", 3) == "trace_rank3.json"
+    assert TR.rank_trace_path("t.json", None) == "t.json"
+
+
+def test_detect_rank(monkeypatch):
+    monkeypatch.delenv("JAX_PROCESS_ID", raising=False)
+    assert TR.detect_rank() is None
+    monkeypatch.setenv("JAX_PROCESS_ID", "4")
+    assert TR.detect_rank() == 4
+    monkeypatch.setenv("JAX_PROCESS_ID", "bogus")
+    assert TR.detect_rank() is None
+
+
+# ------------------------------------------------------------ trace merge
+
+
+def _make_shard(tmp_path, rank, epoch_unix, steps, *, slow_step=None):
+    """One synthetic per-rank shard: train_step spans at 1s cadence."""
+    t = TR.Tracer(enabled=True).set_process(rank=rank, hostname=f"h{rank}")
+    t.epoch_unix = epoch_unix
+    for s in range(steps):
+        dur = 600000.0 if s == slow_step else 100000.0
+        t._record(
+            "train_step", "X", s * 1e6, track="train", dur_us=dur,
+            args={"step": s},
+        )
+    path = str(tmp_path / f"trace_rank{rank}.json")
+    t.export(path)
+    return path
+
+
+def test_merge_aligns_known_clock_skew(tmp_path):
+    """Two shards whose tracer epochs differ by exactly 2.5s: the merge
+    must rebase rank 1's timestamps by +2.5e6 us so one wall moment is
+    one x position."""
+    a = _make_shard(tmp_path, 0, 1000.0, 3)
+    b = _make_shard(tmp_path, 1, 1002.5, 3)
+    out = str(tmp_path / "merged.json")
+    assert trace_merge.main([a, b, "-o", out]) == 0
+    doc = json.load(open(out))
+    assert doc["otherData"]["clock_offsets_s"] == {"0": 0.0, "1": 2.5}
+    assert doc["otherData"]["base_epoch_unix"] == 1000.0
+    r0 = [e for e in doc["traceEvents"]
+          if e.get("pid") == 0 and e.get("ph") == "X"]
+    r1 = [e for e in doc["traceEvents"]
+          if e.get("pid") == 1 and e.get("ph") == "X"]
+    # same step index, same shard-local ts -> 2.5e6 us apart after align
+    assert r1[0]["ts"] - r0[0]["ts"] == pytest.approx(2.5e6)
+    # rank-stable process lanes: pid == rank, named rank{N} (hostname)
+    names = {
+        e["pid"]: e["args"]["name"] for e in doc["traceEvents"]
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    assert names[0].startswith("rank0") and names[1].startswith("rank1")
+    # --align none keeps raw clocks
+    assert trace_merge.main([a, b, "-o", out, "--align", "none"]) == 0
+    doc = json.load(open(out))
+    assert doc["otherData"]["clock_offsets_s"] == {"0": 0.0, "1": 0.0}
+
+
+def test_merge_step_align_markers_flag_straggler(tmp_path):
+    """The chaos-stall shape: rank 1's step 1 takes 0.6s instead of
+    0.1s - the step_align marker for that step must name rank 1 as the
+    straggler and the ragged boundary must show as end-time skew."""
+    a = _make_shard(tmp_path, 0, 1000.0, 3)
+    b = _make_shard(tmp_path, 1, 1000.0, 3, slow_step=1)
+    out = str(tmp_path / "merged.json")
+    assert trace_merge.main([a, b, "-o", out, "--summary"]) == 0
+    doc = json.load(open(out))
+    aligns = {
+        e["args"]["step"]: e["args"] for e in doc["traceEvents"]
+        if e.get("name") == "step_align"
+    }
+    assert set(aligns) == {0, 1, 2}
+    assert aligns[1]["straggler_rank"] == 1
+    assert aligns[1]["end_skew_us"] == pytest.approx(500000.0)
+    assert aligns[0]["end_skew_us"] == pytest.approx(0.0)
+    assert doc["fleet"]["straggler_rank"] == 1
+    assert doc["fleet"]["max_step_skew_s"] == pytest.approx(0.5)
+    # strict JSON out (no bare NaN), events sorted by ts after metadata
+    trace_summary.load_trace(out)
+
+
+def test_merge_rejects_single_shard(tmp_path, capsys):
+    a = _make_shard(tmp_path, 0, 1000.0, 1)
+    assert trace_merge.main([a, "-o", str(tmp_path / "m.json")]) == 2
+
+
+def test_trace_summary_rank_filter(tmp_path, capsys):
+    a = _make_shard(tmp_path, 0, 1000.0, 3)
+    b = _make_shard(tmp_path, 1, 1000.0, 3, slow_step=1)
+    out = str(tmp_path / "merged.json")
+    trace_merge.main([a, b, "-o", out])
+    capsys.readouterr()
+    # default aggregates with an explicit multi-rank note
+    assert trace_summary.main([out]) == 0
+    text = capsys.readouterr().out
+    assert "merged multi-rank trace" in text and "ranks [0, 1]" in text
+    # --rank filters to one rank's spans (3, not 6)
+    assert trace_summary.main([out, "--rank", "1"]) == 0
+    text = capsys.readouterr().out
+    assert "merged multi-rank trace" not in text
+    assert "train_step        3" in text.replace("  ", "  ")
+    # unknown rank: actionable error naming the available ranks
+    assert trace_summary.main([out, "--rank", "9"]) == 1
+    assert "ranks: [0, 1]" in capsys.readouterr().err
+    # --diff accepts merged traces with --rank applied to both sides
+    assert trace_summary.main(["--diff", out, out, "--rank", "0"]) == 0
+
+
+# ------------------------------------------------------------- federation
+
+
+def test_federation_straggler_attribution_and_skew():
+    """Synthetic arrivals with a stalled rank: the skew histogram sees
+    the spread, the straggler gauge names the late rank, and lockstep
+    steps (skew under attrib_min_skew_s) attribute nobody."""
+    reg = MetricsRegistry()
+    fed = FleetFederation(reg, attrib_min_skew_s=0.5)
+    # step 1: lockstep
+    fed.observe(0, {"step": 1}, now=10.0)
+    fed.observe(1, {"step": 1}, now=10.1)
+    fed.finish_poll([0, 1])
+    assert reg.get("fleet_straggler_rank").value == -1
+    # step 2: rank 1 stalls 2s (the --chaos-stall-step signature)
+    fed.observe(0, {"step": 2}, now=11.0)
+    fed.finish_poll([0, 1])  # incomplete: nothing attributed yet
+    fed.observe(1, {"step": 2}, now=13.0)
+    fed.finish_poll([0, 1])
+    assert reg.get("fleet_straggler_rank").value == 1
+    assert reg.get("fleet_straggler_total").labels(rank="1").value == 1
+    assert reg.get("fleet_last_step_skew_seconds").value == \
+        pytest.approx(2.0)
+    hist = reg.get("fleet_step_skew_seconds").labels()
+    assert hist.count == 2  # both completed steps observed
+    # per-rank step time from arrivals: rank1 took (13-10.1)/1 s
+    assert reg.get("fleet_worker_step_seconds").labels(
+        rank="1"
+    ).value == pytest.approx(2.9)
+
+
+def test_federation_begin_divergence_names_wedged_rank():
+    """The synchronized-SPMD wedge: every rank COMPLETES step S at the
+    same wall time (the collective gates them all), but the wedged rank
+    never BEGINS S+1 while its peers have - begin-step divergence must
+    attribute it, and completions alone must not."""
+    reg = MetricsRegistry()
+    fed = FleetFederation(reg, attrib_min_skew_s=0.5)
+    # lockstep completions of step 1, rank 0 wedged before beginning 2
+    fed.observe(0, {"step": 1, "begin_step": 1}, now=10.0)
+    fed.observe(1, {"step": 1, "begin_step": 2}, now=10.0)
+    fed.finish_poll([0, 1])
+    assert reg.get("fleet_straggler_rank").value == 0
+    assert reg.get("fleet_straggler_total").labels(rank="0").value == 1
+    # persists across polls without double-counting the same divergence
+    fed.observe(0, {"step": 1, "begin_step": 1}, now=10.5)
+    fed.observe(1, {"step": 1, "begin_step": 2}, now=10.5)
+    fed.finish_poll([0, 1])
+    assert reg.get("fleet_straggler_total").labels(rank="0").value == 1
+    # the wedge clears: both begin 3 in lockstep, completions lockstep
+    # -> arrival logic clears the gauge
+    fed.observe(0, {"step": 2, "begin_step": 3}, now=13.0)
+    fed.observe(1, {"step": 2, "begin_step": 3}, now=13.0)
+    fed.finish_poll([0, 1])
+    assert reg.get("fleet_straggler_rank").value == -1
+
+
+def test_traced_step_marks_begin_before_dispatch():
+    """make_traced_step publishes begin_step(i) BEFORE the compiled call
+    - the property the wedge attribution depends on (a step that never
+    returns still advanced the begin marker)."""
+    from distributed_neural_network_tpu.train.lm import make_traced_step
+    from distributed_neural_network_tpu.utils import tracing as TRC
+
+    reg = MetricsRegistry()
+    seen = []
+
+    def fake_step(x):
+        seen.append(reg.last_begin_step())
+        return x
+
+    wrapped = make_traced_step(
+        fake_step, tracer=TRC.NULL_TRACER, fence=False,
+        first_step=5, registry=reg,
+    )
+    wrapped(1.0)
+    wrapped(2.0)
+    assert seen == [5, 6]  # begin was visible inside the step call
+    assert reg.last_step() == 6  # beat still marks completion
+
+
+def test_federation_renders_rank_labels_via_live_top_parser():
+    """The satellite contract: the federated exposition parses with
+    tools/live_top.py's OWN Prometheus parser and carries rank labels."""
+    reg = MetricsRegistry()
+    fed = FleetFederation(reg, attrib_min_skew_s=0.1)
+    fed.observe(0, {"step": 4}, now=1.0)
+    fed.observe(1, {"step": 3}, now=1.0)
+    fed.finish_poll([0, 1])
+    parsed = live_top.parse_prometheus(reg.render())
+    assert parsed["fleet_worker_step"][(("rank", "0"),)] == 4.0
+    assert parsed["fleet_worker_step"][(("rank", "1"),)] == 3.0
+    assert parsed["fleet_worker_up"][(("rank", "0"),)] == 1.0
+    frame = live_top.render(
+        {"metrics": parsed, "health": None, "loss_history": [],
+         "skew_history": [], "source": "test"},
+        color=False,
+    )
+    assert "fleet" in frame and "rank 0" in frame and "rank 1" in frame
+
+
+def test_federation_scrape_reexports_whitelist(tmp_path):
+    """A worker /metrics endpoint is scraped and its whitelisted families
+    come back rank-labeled as fleet_*; the worker's step-seconds
+    histogram refines the per-rank step-time gauge."""
+    worker_reg = MetricsRegistry()
+    worker_reg.gauge("train_loss").set(2.5)
+    worker_reg.counter("train_steps_total").inc(7)
+    worker_reg.histogram("train_step_seconds").observe(0.25)
+    worker_reg.histogram("train_step_seconds").observe(0.35)
+    worker_reg.gauge("some_private_metric").set(1.0)  # not whitelisted
+    srv = ObsServer(worker_reg, port=0)
+    try:
+        sup_reg = MetricsRegistry()
+        fed = FleetFederation(sup_reg, scrape_interval_s=5.0)
+        assert fed.maybe_scrape(1, srv.url, now=100.0)
+        # rate limit: a second scrape inside the interval is skipped
+        assert not fed.maybe_scrape(1, srv.url, now=101.0)
+        assert fed.maybe_scrape(1, srv.url, now=106.0)
+    finally:
+        srv.close()
+    parsed = parse_prom_samples(sup_reg.render())
+    assert parsed["fleet_train_loss"][(("rank", "1"),)] == 2.5
+    assert parsed["fleet_train_steps_total"][(("rank", "1"),)] == 7.0
+    assert "fleet_some_private_metric" not in parsed
+    assert parsed["fleet_worker_step_seconds"][(("rank", "1"),)] == \
+        pytest.approx(0.3)
+    assert parsed["fleet_scrapes_total"][()] == 2.0
+
+
+def test_federation_scrape_error_counts_not_raises():
+    reg = MetricsRegistry()
+    fed = FleetFederation(reg, http_timeout_s=0.2)
+    assert fed.maybe_scrape(0, "http://127.0.0.1:9", now=1.0)
+    assert reg.get("fleet_scrape_errors_total").value == 1
+
+
+# ----------------------------------------- supervised runs (dummy workers)
+
+# dummy worker (test_supervisor.py idiom): heartbeats with rank metadata
+# and a per-rank cadence; writes a flight dump the way the real recorder
+# does (write-through) so the postmortem bundle has something to collect
+FLEET_WORKER = """\
+import json, os, signal, sys, time
+
+hb_path = os.environ["DNN_TPU_HEARTBEAT_FILE"]
+fl_path = os.environ["DNN_TPU_FLIGHT_FILE"]
+rank = int(os.environ["JAX_PROCESS_ID"])
+spec = json.loads(sys.argv[1])
+me = spec.get(str(rank)) or spec.get("*") or {}
+signal.signal(signal.SIGTERM, lambda s, f: sys.exit(0))
+
+sys.path.insert(0, %r)
+from distributed_neural_network_tpu.utils.obs import FLIGHT, flight_event
+FLIGHT.configure(fl_path, rank=rank)
+flight_event("run_start", pid=os.getpid())
+
+def beat(step):
+    tmp = hb_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"t": time.time(), "beat_unix": time.time(),
+                   "step": step, "pid": os.getpid(), "rank": rank,
+                   "hostname": "testhost", "metrics_url": None}, f)
+    os.replace(tmp, hb_path)
+
+for s in range(me.get("steps", 5)):
+    beat(s)
+    flight_event("step_note", step=s)
+    time.sleep(me.get("dt", 0.05))
+sys.exit(0)
+""" % (REPO,)
+
+
+def _run_fleet_group(tmp_path, spec, cfg, *, chaos=None, registry=None,
+                     federation=None):
+    worker = tmp_path / "worker.py"
+    worker.write_text(FLEET_WORKER)
+    logs = []
+    sup = Supervisor(
+        [sys.executable, str(worker), json.dumps(spec)],
+        cfg,
+        run_dir=str(tmp_path / "run"),
+        chaos=chaos,
+        registry=registry,
+        federation=federation,
+        log=lambda *a: logs.append(" ".join(str(x) for x in a)),
+    )
+    rc = sup.run()
+    return rc, sup, logs
+
+
+def test_supervised_straggler_attribution_flags_slow_rank(tmp_path):
+    """End-to-end over real processes: rank 1 steps 6x slower than rank
+    0; the supervisor's federation must attribute rank 1 as the
+    straggler from heartbeat arrivals alone."""
+    reg = MetricsRegistry()
+    fed = FleetFederation(reg, attrib_min_skew_s=0.1)
+    cfg = SupervisorConfig(
+        nprocs=2, poll_s=0.03, grace_s=2.0, restart_backoff_s=0.05,
+        rendezvous_timeout_s=30.0,
+    )
+    spec = {"0": {"steps": 10, "dt": 0.05}, "1": {"steps": 10, "dt": 0.3}}
+    rc, sup, logs = _run_fleet_group(
+        tmp_path, spec, cfg, registry=reg, federation=fed,
+    )
+    assert rc == 0
+    assert reg.get("fleet_straggler_rank").value == 1
+    assert reg.get("fleet_straggler_total").labels(rank="1").value >= 1
+    assert reg.get("fleet_step_skew_seconds").labels().count >= 1
+    # per-rank rows exist for both ranks
+    parsed = parse_prom_samples(reg.render())
+    assert (("rank", "0"),) in parsed["fleet_worker_step"]
+    assert (("rank", "1"),) in parsed["fleet_worker_step"]
+
+
+def test_postmortem_bundle_on_chaos_sigkill(tmp_path):
+    """A chaos-SIGKILLed rank leaves no exit path, but its write-through
+    flight dump is on disk: the supervisor's postmortem.json must bundle
+    both ranks' dumps, name the SIGKILL, and count the bundle."""
+    from distributed_neural_network_tpu.parallel.fault import (
+        KillEvent,
+        ProcessChaos,
+    )
+
+    reg = MetricsRegistry()
+    cfg = SupervisorConfig(
+        nprocs=2, poll_s=0.03, grace_s=2.0, restart_backoff_s=0.05,
+        rendezvous_timeout_s=30.0,
+    )
+    spec = {"*": {"steps": 60, "dt": 0.05}}
+    chaos = ProcessChaos(events=(KillEvent(rank=1, at_step=3, sig="KILL"),))
+    rc, sup, logs = _run_fleet_group(
+        tmp_path, spec, cfg, chaos=chaos, registry=reg,
+    )
+    assert rc == 0
+    pm_path = os.path.join(str(tmp_path / "run"), "postmortem.json")
+    assert sup.postmortem_path == pm_path
+    assert os.path.exists(pm_path)
+    with open(pm_path) as f:
+        pm = json.load(f)
+    assert pm["reason"] == "worker failure"
+    by_rank = {w["rank"]: w for w in pm["workers"]}
+    assert set(by_rank) == {0, 1}
+    assert by_rank[1]["failed"] and by_rank[1]["cause"] == "SIGKILL"
+    # the killed rank's flight dump made it into the bundle, with the
+    # pre-kill events intact
+    fl = by_rank[1]["flight"]
+    assert fl is not None and fl["rank"] == 1
+    kinds = [e["kind"] for e in fl["events"]]
+    assert "run_start" in kinds and "step_note" in kinds
+    # heartbeat attribution rides the file CONTENT, not the path
+    assert by_rank[1]["heartbeat"]["rank"] == 1
+    assert by_rank[1]["heartbeat"]["hostname"] == "testhost"
+    assert reg.get("supervisor_postmortems_total").value >= 1
+    assert sup.postmortems_written >= 1
+    assert any("postmortem bundle" in ln for ln in logs)
+
+
+# -------------------------------------------------------- /profile + hook
+
+
+def test_profile_endpoint_roundtrip_and_errors():
+    calls = []
+
+    class FakeProf:
+        def request(self, n):
+            calls.append(n)
+            return {"ok": True, "steps": n}
+
+    reg = MetricsRegistry()
+    srv = ObsServer(reg, port=0, profiler=FakeProf())
+    try:
+        body = json.loads(
+            urllib.request.urlopen(srv.url + "/profile?steps=5").read()
+        )
+        assert body == {"ok": True, "steps": 5} and calls == [5]
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(srv.url + "/profile?steps=zero")
+        assert e.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(srv.url + "/profile?steps=0")
+        assert e.value.code == 400
+    finally:
+        srv.close()
+    # unwired endpoint: 501 with the wiring hint
+    srv = ObsServer(reg, port=0)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(srv.url + "/profile?steps=2")
+        assert e.value.code == 501
+        assert b"profile" in e.value.read()
+    finally:
+        srv.close()
+
+
+def test_profile_controller_captures_n_steps(tmp_path):
+    """Real jax.profiler on CPU: request(2) -> capture spans exactly the
+    next two step boundaries and lands on disk; a second request during
+    the active capture is rejected; the controller re-arms after."""
+    from distributed_neural_network_tpu.train.monitor import (
+        ProfileController,
+    )
+
+    pc = ProfileController(str(tmp_path), log=lambda *_: None)
+    r = pc.request(2)
+    assert r["ok"] and r["steps"] == 2
+    assert not pc.request(1)["ok"]  # already pending
+    pc.on_step(10)  # starts
+    assert not pc.request(1)["ok"]  # already active
+    pc.on_step(11)
+    assert pc.captures == 0
+    pc.on_step(12)  # 12 >= 10 + 2: stops
+    assert pc.captures == 1, pc.error
+    assert pc.last_dir and os.path.isdir(pc.last_dir)
+    assert "profile_step10_x2" in pc.last_dir
+    assert pc.request(1)["ok"]  # re-armed
+    pc.close()
+
+
+def test_registry_beat_hook_drives_profiler(tmp_path):
+    reg = MetricsRegistry()
+    seen = []
+    reg.beat_hook = seen.append
+    reg.beat(3)
+    reg.beat(4)
+    assert seen == [3, 4]
+    # a hook exception must never propagate into the step loop
+    reg.beat_hook = lambda s: 1 / 0
+    reg.beat(5)
+    assert reg.last_step() == 5
+
+
+def test_attach_monitor_fleet_wiring(tmp_path, monkeypatch):
+    """attach_monitor under supervisor envs: flight recorder armed,
+    heartbeat advertises rank + metrics_url, /profile wired through the
+    registry beat hook."""
+    from distributed_neural_network_tpu.train import monitor as mon
+
+    hb_path = tmp_path / "hb.json"
+    fl_path = tmp_path / "fl.json"
+    monkeypatch.setenv("DNN_TPU_HEARTBEAT_FILE", str(hb_path))
+    monkeypatch.setenv("DNN_TPU_FLIGHT_FILE", str(fl_path))
+    m = mon.attach_monitor(
+        metrics_port=0, watchdog=False,
+        profile_dir=str(tmp_path / "prof"), rank=1,
+        log=lambda *_: None,
+    )
+    try:
+        assert m.flight is FLIGHT and FLIGHT.rank == 1
+        assert m.profiler is not None
+        assert m.registry.beat_hook == m.profiler.on_step
+        hb = read_heartbeat(str(hb_path))
+        assert hb["rank"] == 1 and hb["metrics_url"] == m.url
+        body = json.loads(
+            urllib.request.urlopen(m.url + "/profile?steps=1").read()
+        )
+        assert body["ok"]
+        m.registry.beat(0)
+        m.registry.beat(1)
+        assert m.profiler.captures == 1, m.profiler.error
+    finally:
+        m.close()
+    doc = read_flight_dump(str(fl_path))
+    assert doc["cause"] == "close"
+    kinds = [e["kind"] for e in doc["events"]]
+    assert "run_start" in kinds and "profile_capture" in kinds
+
+
+def test_attach_monitor_heartbeat_only_arms_flight(tmp_path, monkeypatch):
+    """The portless supervised worker (metrics_port=None + env) still
+    gets a real registry, heartbeat writer, and armed flight recorder."""
+    from distributed_neural_network_tpu.train import monitor as mon
+
+    monkeypatch.setenv("DNN_TPU_HEARTBEAT_FILE", str(tmp_path / "h.json"))
+    monkeypatch.setenv("DNN_TPU_FLIGHT_FILE", str(tmp_path / "f.json"))
+    m = mon.attach_monitor(metrics_port=None, log=lambda *_: None)
+    try:
+        assert m.server is None and m.heartbeat is not None
+        assert m.flight is FLIGHT
+        hb = read_heartbeat(str(tmp_path / "h.json"))
+        assert hb["metrics_url"] is None
+    finally:
+        m.close()
+    assert read_flight_dump(str(tmp_path / "f.json"))["cause"] == "close"
+
+
+def test_flight_events_from_guard_and_chaos_sites():
+    """The wired call sites land structured events on the ring: a guard
+    anomaly, a chaos stall, and a preemption request."""
+    from distributed_neural_network_tpu.parallel.fault import ChaosMonkey
+    from distributed_neural_network_tpu.train.guard import PreemptionGuard
+
+    monkey = ChaosMonkey(stall_at=(2,), stall_s=0.01, log=lambda *_: None)
+    monkey.after_step(2)
+    pre = PreemptionGuard(log=lambda *_: None)
+    pre.request("SHRINK")
+    kinds = [e["kind"] for e in FLIGHT.events()]
+    assert "chaos" in kinds and "preempt" in kinds
+    chaos_ev = next(e for e in FLIGHT.events() if e["kind"] == "chaos")
+    assert chaos_ev["what"] == "stall" and chaos_ev["step"] == 2

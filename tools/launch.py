@@ -29,7 +29,11 @@ Examples:
 Exit codes: 0 = the group completed; 3 = restart budget exhausted /
 below --min-procs (SUPERVISOR ABORT names the last failure); 4 =
 rendezvous never succeeded. One machine-readable
-`SUPERVISOR_SUMMARY {json}` line is always printed.
+`SUPERVISOR_SUMMARY {json}` line is always printed. Every failure
+restart (and any abort) also writes `<run-dir>/postmortem.json` - the
+per-rank exit causes, last heartbeats, crash flight-recorder dumps, and
+log tails of the generation that died (docs/OBSERVABILITY.md "Fleet
+observability").
 """
 
 from __future__ import annotations
@@ -80,6 +84,12 @@ def main(argv=None) -> int:
     p.add_argument("--grace", type=float, default=10.0, metavar="SEC",
                    help="SIGTERM -> SIGKILL grace when stopping workers "
                    "(long enough for an emergency checkpoint)")
+    p.add_argument("--failure-settle", type=float, default=0.5,
+                   metavar="SEC",
+                   help="after a worker death is detected, wait this long "
+                   "(or until the group is fully down) before freezing "
+                   "the failure set - a gang crash then restarts "
+                   "same-size instead of being misread as partial")
     p.add_argument("--heartbeat-timeout", type=float, default=0.0,
                    metavar="SEC",
                    help="treat a worker whose training heartbeat is this "
@@ -96,9 +106,25 @@ def main(argv=None) -> int:
     p.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
                    help="serve the SUPERVISOR's live metrics "
                    "(supervisor_group_size, worker_failures_total, "
-                   "elastic_restarts_total, restart latency) on "
-                   "http://127.0.0.1:PORT/metrics; 0 = ephemeral. Watch "
-                   "with tools/live_top.py")
+                   "elastic_restarts_total, restart latency) PLUS the "
+                   "federated fleet view - per-rank step/step-time "
+                   "gauges, fleet_step_skew_seconds, fleet_straggler_rank "
+                   "and, for workers started with their own "
+                   "--metrics-port, scraped rank-labeled fleet_* "
+                   "re-exports - on http://127.0.0.1:PORT/metrics; 0 = "
+                   "ephemeral. Watch with tools/live_top.py (fleet view)")
+    p.add_argument("--scrape-interval", type=float, default=2.0,
+                   metavar="SEC",
+                   help="how often the supervisor scrapes each worker's "
+                   "/metrics endpoint for the federation (workers "
+                   "advertise their URL in the heartbeat file; heartbeat-"
+                   "derived fleet metrics flow regardless)")
+    p.add_argument("--straggler-min-skew", type=float, default=0.25,
+                   metavar="SEC",
+                   help="smallest cross-rank step-arrival spread that "
+                   "attributes a straggler (fleet_straggler_rank); "
+                   "smaller spreads are lockstep noise at the poll "
+                   "cadence and set the gauge to -1")
     p.add_argument("--chaos-kill-rank", type=int, action="append",
                    default=None, metavar="R",
                    help="fault injection (parallel/fault.py ProcessChaos): "
@@ -125,6 +151,7 @@ def main(argv=None) -> int:
         ProcessChaos,
     )
     from distributed_neural_network_tpu.train.supervisor import (
+        FleetFederation,
         Supervisor,
         SupervisorConfig,
     )
@@ -161,6 +188,7 @@ def main(argv=None) -> int:
         rendezvous_retries=args.rendezvous_retries,
         rendezvous_timeout_s=args.rendezvous_timeout,
         grace_s=args.grace,
+        failure_settle_s=args.failure_settle,
         heartbeat_timeout_s=args.heartbeat_timeout,
         grow_after_s=args.grow_after,
         poll_s=args.poll,
@@ -177,6 +205,11 @@ def main(argv=None) -> int:
         run_dir=args.run_dir or os.path.join(os.getcwd(), "supervisor_run"),
         chaos=chaos,
         registry=registry,
+        federation=FleetFederation(
+            registry,
+            scrape_interval_s=args.scrape_interval,
+            attrib_min_skew_s=args.straggler_min_skew,
+        ),
     )
     try:
         return sup.run()

@@ -15,7 +15,10 @@ server, and on dead runs' files). One compact ANSI frame per refresh:
     recompile storm, stale checkpoint) - red when non-zero;
   - when pointed at a tools/launch.py --metrics-port endpoint: the
     elastic supervisor's group size vs target, worker failures by
-    signal, shrink/grow/rendezvous restarts, and restart latency.
+    signal, shrink/grow/rendezvous restarts, and restart latency -
+    plus the FLEET view (train/supervisor.py FleetFederation): one row
+    per rank (step, step time, loss, up/DOWN), the attributed straggler
+    rank, a step-skew sparkline, and restart/postmortem counters.
 
 Stdlib-only (no jax, no repo imports) so it runs anywhere - including a
 laptop pointed at a forwarded TPU host port.
@@ -121,6 +124,18 @@ def metric_value(metrics: dict, name: str, default=None):
     return next(iter(fam.values()))
 
 
+def labeled_value(metrics: dict, name: str, default=None, **labels):
+    """The sample of ``name`` whose label set contains ``labels``."""
+    fam = metrics.get(name)
+    if not fam:
+        return default
+    want = set(labels.items())
+    for key, v in fam.items():
+        if want <= set(key):
+            return v
+    return default
+
+
 def metric_sum(metrics: dict, name: str) -> float:
     return sum((metrics.get(name) or {}).values())
 
@@ -163,6 +178,7 @@ class EndpointSource:
             self.base = self.base[: -len("/metrics")]
         self.timeout = timeout
         self.loss_history: list[float] = []
+        self.skew_history: list[float] = []
         self.error: str | None = None
 
     def _get(self, path: str) -> str | None:
@@ -200,8 +216,13 @@ class EndpointSource:
             if not self.loss_history or self.loss_history[-1] != loss:
                 self.loss_history.append(loss)
                 del self.loss_history[:-512]
+        skew = metric_value(metrics, "fleet_last_step_skew_seconds")
+        if skew is not None and math.isfinite(skew):
+            self.skew_history.append(skew)
+            del self.skew_history[:-512]
         return {"metrics": metrics, "health": health,
                 "loss_history": list(self.loss_history),
+                "skew_history": list(self.skew_history),
                 "source": self.base}
 
 
@@ -428,6 +449,46 @@ def render(snap: dict, *, color: bool = True, width: int = 72) -> str:
         if sum(fails.values()) or int(gsz) < int(target):
             sup_line = c(YELLOW, sup_line)
         lines.append(sup_line)
+    # fleet view (train/supervisor.py FleetFederation): one row per rank
+    # plus straggler attribution, the step-skew sparkline, and
+    # restart/postmortem counters
+    fleet_steps = m.get("fleet_worker_step") or {}
+    if fleet_steps:
+        straggler = metric_value(m, "fleet_straggler_rank")
+        skew_last = metric_value(m, "fleet_last_step_skew_seconds")
+        pm = metric_value(m, "supervisor_postmortems_total", 0)
+        restarts = metric_sum(m, "elastic_restarts_total")
+        head = "fleet       straggler: " + (
+            f"rank {int(straggler)}"
+            if straggler is not None and straggler >= 0 else "none"
+        )
+        if skew_last is not None:
+            head += f"  skew {skew_last:.3g}s"
+        spark = sparkline(snap.get("skew_history") or [], 16)
+        if spark:
+            head += f"  {spark}"
+        head += f"  restarts: {int(restarts)}  postmortems: {int(pm)}"
+        if (straggler is not None and straggler >= 0) or pm:
+            head = c(YELLOW, head)
+        lines.append(head)
+        for key in sorted(
+            fleet_steps, key=lambda k: int(dict(k).get("rank", -1))
+        ):
+            r = dict(key).get("rank", "?")
+            step_s = labeled_value(
+                m, "fleet_worker_step_seconds", rank=r
+            )
+            loss_r = labeled_value(m, "fleet_train_loss", rank=r)
+            up = labeled_value(m, "fleet_worker_up", 0, rank=r)
+            row = (
+                f"  rank {r:<3} step {int(fleet_steps[key]):>6}  "
+                + (f"{step_s:.3g}s/step  " if step_s is not None else "")
+                + (f"loss {loss_r:.5g}  " if loss_r is not None else "")
+            )
+            row += c(GREEN, "up") if up else c(RED, "DOWN")
+            if straggler is not None and str(int(straggler)) == str(r):
+                row = c(YELLOW, row)
+            lines.append(row)
     phases = m.get("phase_seconds_total") or {}
     if phases:
         lines.append(

@@ -1,0 +1,322 @@
+#!/usr/bin/env python
+"""trace_merge: merge per-rank Chrome trace shards into one fleet timeline.
+
+A supervised multi-process run (`tools/launch.py` + `lm_train.py
+--trace-out trace.json`) writes one trace shard per worker -
+``trace_rank0.json``, ``trace_rank1.json``, ... (`utils/tracing.py
+rank_trace_path`). Each shard's timestamps are microseconds since ITS OWN
+tracer epoch (a per-process `perf_counter` origin), so loading two shards
+side by side in Perfetto puts both at t=0 and every cross-rank comparison
+lies. This tool merges N shards into ONE Perfetto document with:
+
+- **clock alignment** - every shard records its epoch as Unix time
+  (``otherData.epoch_unix``, the same wall clock the rendezvous/heartbeat
+  files stamp); the merge rebases all events onto the earliest shard's
+  epoch, so "the same wall moment" lands at the same x position. The
+  per-rank offsets are recorded in the merged ``otherData.clock_offsets_s``
+  (and printable with --summary). Cross-HOST shards inherit whatever NTP
+  skew the hosts have; single-node groups (the supervisor's domain) share
+  one clock exactly.
+- **rank-stable process lanes** - each shard becomes one Perfetto process
+  whose pid IS the rank and whose ``process_name`` is ``rank{N}`` (the
+  tracer stamps it; the merge falls back to the filename), so merged
+  timelines stay readable across supervisor relaunches where pids change.
+- **per-step alignment markers** - for every step index that appears as a
+  ``train_step`` span in two or more shards, one global ``step_align``
+  instant at the earliest rank's span end, with the cross-rank end-time
+  skew and the last-finishing (straggler) rank in its args: stragglers
+  are visible as ragged step boundaries without squinting at spans.
+
+Per-rank ``stepStats`` embeds are preserved under ``rankStepStats`` (keyed
+by rank) so `tools/trace_summary.py --rank N` still reports them.
+
+Usage:
+  python tools/trace_merge.py trace_rank0.json trace_rank1.json -o merged.json
+  python tools/trace_merge.py svrun/trace_rank*.json -o merged.json --summary
+  python tools/trace_summary.py merged.json --rank 1
+
+Stdlib-only (no jax, no repo imports) - runs anywhere, like the other
+trace tools.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import re
+import sys
+from collections import defaultdict
+
+
+def _reject_constant(name: str):
+    raise ValueError(
+        f"non-strict JSON token {name!r} (bare NaN/Infinity); the writer "
+        "must serialize non-finite floats as null"
+    )
+
+
+def load_shard(path: str) -> dict:
+    with open(path) as f:
+        doc = json.loads(f.read(), parse_constant=_reject_constant)
+    if isinstance(doc, list):
+        doc = {"traceEvents": doc}
+    if not isinstance(doc, dict) or "traceEvents" not in doc:
+        raise ValueError(f"{path}: not a Chrome trace-event document")
+    return doc
+
+
+def shard_rank(doc: dict, path: str, fallback: int) -> int:
+    """Rank of one shard: otherData.rank, else the process_name metadata
+    (``rank{N}``), else a ``rank{N}`` filename component, else the
+    position in the argument list."""
+    other = doc.get("otherData") or {}
+    if isinstance(other.get("rank"), int):
+        return other["rank"]
+    for ev in doc.get("traceEvents", []):
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            m = re.fullmatch(r"rank(\d+)", str((ev.get("args") or {}).get("name", "")))
+            if m:
+                return int(m.group(1))
+    m = re.search(r"rank(\d+)", os.path.basename(path))
+    if m:
+        return int(m.group(1))
+    return fallback
+
+
+def merge_shards(
+    shards: list[tuple[str, dict]], *, align: str = "epoch"
+) -> dict:
+    """Merge [(path, doc), ...] into one aligned Chrome document."""
+    ranks: list[int] = []
+    for i, (path, doc) in enumerate(shards):
+        r = shard_rank(doc, path, i)
+        while r in ranks:  # duplicate rank labels must not collide
+            r = max(ranks) + 1
+        ranks.append(r)
+
+    # ---- clock alignment: rebase every shard onto the earliest epoch
+    epochs = [
+        (doc.get("otherData") or {}).get("epoch_unix")
+        for _, doc in shards
+    ]
+    base = min(
+        (e for e in epochs if isinstance(e, (int, float))), default=None
+    )
+    offsets: dict[int, float] = {}
+    unaligned: list[int] = []
+    for r, e in zip(ranks, epochs):
+        if align == "epoch" and base is not None \
+                and isinstance(e, (int, float)):
+            offsets[r] = float(e) - float(base)
+        else:
+            offsets[r] = 0.0
+            if align == "epoch":
+                unaligned.append(r)
+
+    events: list[dict] = []
+    rank_stats: dict[str, dict] = {}
+    step_spans: dict[int, dict[int, dict]] = defaultdict(dict)
+    for (path, doc), r in zip(shards, ranks):
+        off_us = offsets[r] * 1e6
+        hostname = (doc.get("otherData") or {}).get("hostname")
+        stats = doc.get("stepStats")
+        if isinstance(stats, dict) and stats:
+            rank_stats[str(r)] = stats
+        seen_pname = False
+        for ev in doc.get("traceEvents", []):
+            out = dict(ev)
+            out["pid"] = r  # rank-stable lane, not the dead worker's pid
+            if ev.get("ph") == "M":
+                if ev.get("name") == "process_name":
+                    seen_pname = True
+                    args = dict(ev.get("args") or {})
+                    args["name"] = f"rank{r}" + (
+                        f" ({hostname})" if hostname else ""
+                    )
+                    out["args"] = args
+                events.append(out)
+                continue
+            if "ts" in out:
+                out["ts"] = float(out["ts"]) + off_us
+            events.append(out)
+            if ev.get("ph") == "X" and ev.get("name") == "train_step":
+                step = (ev.get("args") or {}).get("step")
+                if isinstance(step, int):
+                    end = float(out["ts"]) + float(out.get("dur", 0.0))
+                    step_spans[step][r] = {
+                        "start_us": float(out["ts"]), "end_us": end,
+                        "dur_us": float(out.get("dur", 0.0)),
+                    }
+        if not seen_pname:
+            events.append({
+                "name": "process_name", "ph": "M", "pid": r, "tid": 0,
+                "ts": 0, "args": {"name": f"rank{r}"},
+            })
+        # rank ordering in the Perfetto process list
+        events.append({
+            "name": "process_sort_index", "ph": "M", "pid": r, "tid": 0,
+            "ts": 0, "args": {"sort_index": r},
+        })
+
+    # ---- per-step alignment markers + skew stats
+    skews: list[tuple[int, float, int]] = []  # (step, skew_s, straggler)
+    for step in sorted(step_spans):
+        by_rank = step_spans[step]
+        if len(by_rank) < 2:
+            continue
+        ends = {r: v["end_us"] for r, v in by_rank.items()}
+        straggler = max(ends, key=lambda r: ends[r])
+        skew_us = max(ends.values()) - min(ends.values())
+        skews.append((step, skew_us / 1e6, straggler))
+        events.append({
+            "name": "step_align", "ph": "i", "s": "g",
+            "pid": min(by_rank), "tid": 0,
+            "ts": min(ends.values()),
+            "cat": "fleet",
+            "args": {
+                "step": step,
+                "end_skew_us": round(skew_us, 1),
+                "straggler_rank": straggler,
+                "ranks": sorted(by_rank),
+            },
+        })
+
+    events.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0.0)))
+    fleet = {
+        "ranks": sorted(ranks),
+        "aligned_steps": len(skews),
+        "max_step_skew_s": round(max((s for _, s, _ in skews),
+                                     default=0.0), 6),
+        "straggler_rank": (
+            max(
+                set(r for _, _, r in skews),
+                key=lambda r: sum(
+                    s for _, s, rr in skews if rr == r
+                ),
+            ) if skews else None
+        ),
+    }
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "merged_from": len(shards),
+            "ranks": sorted(ranks),
+            "align": align,
+            "base_epoch_unix": base,
+            "clock_offsets_s": {
+                str(r): round(o, 6) for r, o in offsets.items()
+            },
+            "unaligned_ranks": unaligned,
+        },
+        "fleet": fleet,
+        "rankStepStats": rank_stats,
+    }
+
+
+def summarize(doc: dict) -> str:
+    """Per-rank step table + skew summary of a merged document."""
+    spans: dict[int, list[float]] = defaultdict(list)
+    skews: list[float] = []
+    straggles: dict[int, int] = defaultdict(int)
+    for ev in doc.get("traceEvents", []):
+        if ev.get("ph") == "X" and ev.get("name") == "train_step":
+            spans[ev.get("pid")].append(float(ev.get("dur", 0.0)) / 1e6)
+        elif ev.get("ph") == "i" and ev.get("name") == "step_align":
+            args = ev.get("args") or {}
+            skews.append(float(args.get("end_skew_us", 0.0)) / 1e6)
+            if args.get("straggler_rank") is not None:
+                straggles[args["straggler_rank"]] += 1
+    lines = []
+    other = doc.get("otherData") or {}
+    lines.append(
+        f"Merged timeline: {other.get('merged_from')} shard(s), ranks "
+        f"{other.get('ranks')}, clock offsets "
+        f"{other.get('clock_offsets_s')} s"
+    )
+    if other.get("unaligned_ranks"):
+        lines.append(
+            f"  WARNING: rank(s) {other['unaligned_ranks']} had no "
+            "epoch_unix - left unaligned (offset 0)"
+        )
+    head = f"{'rank':>5}  {'steps':>6}  {'mean_ms':>9}  {'p95_ms':>9}  {'straggled':>9}"
+    lines += [head, "-" * len(head)]
+    for r in sorted(spans):
+        xs = sorted(spans[r])
+        p95 = xs[max(0, min(len(xs) - 1,
+                            int(math.ceil(0.95 * len(xs))) - 1))]
+        lines.append(
+            f"{r:>5}  {len(xs):>6}  {sum(xs) / len(xs) * 1e3:>9.2f}  "
+            f"{p95 * 1e3:>9.2f}  {straggles.get(r, 0):>9}"
+        )
+    if skews:
+        lines.append(
+            f"step-boundary skew: {len(skews)} aligned step(s), max "
+            f"{max(skews) * 1e3:.1f} ms, mean "
+            f"{sum(skews) / len(skews) * 1e3:.1f} ms"
+        )
+        fleet = doc.get("fleet") or {}
+        if fleet.get("straggler_rank") is not None:
+            lines.append(
+                f"dominant straggler: rank {fleet['straggler_rank']} "
+                "(largest summed end-skew)"
+            )
+    else:
+        lines.append(
+            "step-boundary skew: n/a (no step appears in >= 2 shards)"
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "shards", nargs="+",
+        help="two or more per-rank trace shards (trace_rank*.json)",
+    )
+    ap.add_argument(
+        "-o", "--out", default="merged_trace.json",
+        help="merged Perfetto document path (default merged_trace.json)",
+    )
+    ap.add_argument(
+        "--align", choices=("epoch", "none"), default="epoch",
+        help="clock alignment: 'epoch' (default) rebases each shard by "
+        "its recorded Unix epoch; 'none' keeps raw per-shard clocks",
+    )
+    ap.add_argument(
+        "--summary", action="store_true",
+        help="print the per-rank step table + skew summary",
+    )
+    args = ap.parse_args(argv)
+    if len(args.shards) < 2:
+        print("error: need at least two shards to merge", file=sys.stderr)
+        return 2
+    shards = []
+    for path in args.shards:
+        try:
+            shards.append((path, load_shard(path)))
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+    doc = merge_shards(shards, align=args.align)
+    d = os.path.dirname(args.out)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = args.out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, allow_nan=False)
+        f.write("\n")
+    os.replace(tmp, args.out)
+    print(
+        f"(merged {len(shards)} shard(s) -> {args.out}; open in Perfetto, "
+        "or tools/trace_summary.py [--rank N])"
+    )
+    if args.summary:
+        print(summarize(doc))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

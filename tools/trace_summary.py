@@ -17,6 +17,15 @@ Usage:
   python tools/trace_summary.py trace.json [metrics.jsonl]
   python tools/trace_summary.py trace.json --lint lm_zero_overlap
   python tools/trace_summary.py --diff end.json overlap.json
+  python tools/trace_summary.py merged.json --rank 1   # one rank of a
+                                                       # trace_merge doc
+
+Multi-rank traces (per-rank shards merged by `tools/trace_merge.py`, or
+any rank-stamped trace) are detected from their ``rank{N}`` process
+metadata: the default report aggregates every rank WITH AN EXPLICIT NOTE
+(it used to mix ranks' spans silently), and ``--rank N`` restricts the
+phase table / step stats to one rank - including that rank's own
+``stepStats`` embed from the merged document's ``rankStepStats``.
 
 --diff A B prints the side-by-side phase breakdown and StepStats delta
 between two traces - the manual compare-two-runs-by-eye workflow (e.g.
@@ -43,6 +52,7 @@ import argparse
 import json
 import math
 import os
+import re
 import sys
 from collections import defaultdict
 
@@ -87,6 +97,50 @@ def load_trace(path: str) -> dict:
     if not isinstance(doc, dict) or "traceEvents" not in doc:
         raise ValueError(f"{path}: not a Chrome trace-event document")
     return doc
+
+
+def trace_ranks(doc: dict) -> dict:
+    """{rank: pid} from ``rank{N}`` process_name metadata - present in
+    rank-stamped shards (`utils/tracing.py set_process`) and merged
+    timelines (`tools/trace_merge.py`, where pid == rank). Empty for
+    plain single-process traces."""
+    out: dict[int, int] = {}
+    for ev in doc.get("traceEvents", []):
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            m = re.match(
+                r"rank(\d+)\b", str((ev.get("args") or {}).get("name", ""))
+            )
+            if m:
+                out[int(m.group(1))] = ev.get("pid")
+    return out
+
+
+def filter_rank(doc: dict, rank: int) -> dict:
+    """A view of ``doc`` restricted to one rank's events (by pid).
+
+    Raises ValueError naming the available ranks when ``rank`` is not in
+    the trace - silently returning an empty table would look like a run
+    with no spans. The rank's own stepStats embed (merged docs carry
+    them under ``rankStepStats``) is promoted to the top level.
+    """
+    ranks = trace_ranks(doc)
+    if rank not in ranks:
+        raise ValueError(
+            f"rank {rank} not in trace (ranks: "
+            f"{sorted(ranks) if ranks else 'none - not a rank-stamped trace'})"
+        )
+    pid = ranks[rank]
+    out = dict(doc)
+    out["traceEvents"] = [
+        ev for ev in doc.get("traceEvents", []) if ev.get("pid") == pid
+    ]
+    per_rank = (doc.get("rankStepStats") or {}).get(str(rank))
+    if isinstance(per_rank, dict):
+        out["stepStats"] = per_rank
+    elif len(ranks) > 1:
+        # a multi-rank doc's top-level embed (if any) is not THIS rank's
+        out.pop("stepStats", None)
+    return out
 
 
 def phase_table(events) -> str:
@@ -497,6 +551,13 @@ def main(argv=None) -> int:
         "StepStats delta (B vs A)",
     )
     ap.add_argument(
+        "--rank", type=int, default=None, metavar="N",
+        help="restrict a rank-stamped or merged multi-rank trace "
+        "(tools/trace_merge.py) to rank N's events before reporting; "
+        "default aggregates every rank (noted when the trace is "
+        "multi-rank). Applies to --diff's two traces as well",
+    )
+    ap.add_argument(
         "--lint", metavar="CONFIG", default=None,
         help="compare measured collective bytes against the shardlint "
         "manifest for CONFIG and print the delta",
@@ -512,10 +573,26 @@ def main(argv=None) -> int:
     )
     args = ap.parse_args(argv)
 
+    def apply_rank(doc, name):
+        """--rank filter / multi-rank aggregation note for one trace."""
+        ranks = trace_ranks(doc)
+        if args.rank is not None:
+            label = f" [rank {args.rank}]"
+            return filter_rank(doc, args.rank), name + label
+        if len(ranks) > 1:
+            print(
+                f"({name}: merged multi-rank trace, ranks "
+                f"{sorted(ranks)} - tables aggregate ALL ranks; "
+                "--rank N filters to one)"
+            )
+        return doc, name
+
     if args.diff is not None:
         path_a, path_b = args.diff
         try:
             doc_a, doc_b = load_trace(path_a), load_trace(path_b)
+            doc_a, path_a = apply_rank(doc_a, path_a)
+            doc_b, path_b = apply_rank(doc_b, path_b)
         except (ValueError, OSError) as e:
             print(f"error: {e}", file=sys.stderr)
             return 1
@@ -526,6 +603,7 @@ def main(argv=None) -> int:
 
     try:
         doc = load_trace(args.trace)
+        doc, _ = apply_rank(doc, args.trace)
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
