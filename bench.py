@@ -251,9 +251,12 @@ def _rows(epochs: int) -> list[dict]:
             # live-observability overhead A/B at the flagship shape: no
             # monitoring vs the full --metrics-port stack (registry +
             # /metrics server + watchdog threads + per-step publishes,
-            # utils/obs.py + train/monitor.py). Asserts within_budget
-            # (<1% steady-step overhead) and final_loss_bitwise_equal
-            # (monitoring is observation-only), like the guard row above
+            # utils/obs.py + train/monitor.py) PLUS the supervised-worker
+            # extras - heartbeat-file writer, armed flight recorder, and
+            # the armed goodput ledger with its write-through run record
+            # (utils/goodput.py). Asserts within_budget (<1% steady-step
+            # overhead) and final_loss_bitwise_equal (observation-only),
+            # like the guard row above
             "id": "lm_watchdog_overhead_d512_L8_seq2048_bf16",
             "kind": "watchdog_overhead",
             "est_s": 600,

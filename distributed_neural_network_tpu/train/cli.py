@@ -93,6 +93,16 @@ def add_common_flags(p: argparse.ArgumentParser, *, epochs: int, batch_size: int
     )
     p.add_argument("--log-dir", default="log", help="phase-time log directory")
     p.add_argument("--metrics-jsonl", default=None, help="metrics JSONL path")
+    p.add_argument(
+        "--run-record",
+        default=None,
+        metavar="RECORD.json",
+        help="write the goodput run record here (utils/goodput.py: "
+        "goodput ratio + per-cause badput seconds; written through "
+        "during the run; render/diff/gate with tools/goodput.py). "
+        "Defaults to the DNN_TPU_RUN_RECORD env the elastic supervisor "
+        "exports; a GOODPUT summary line is printed either way",
+    )
     p.add_argument("--neptune", action="store_true", help="also log to Neptune (env creds)")
     p.add_argument("--eval-batch-size", type=int, default=None)
     p.add_argument(
@@ -359,6 +369,16 @@ def run_training(args, regime: str, *, log=print) -> Engine:
     an optional `--metrics-linger` window so external scrapers can read
     the final counters.
     """
+    # goodput wall clock zero: before data load / rendezvous / compile so
+    # the init bucket owns them (utils/goodput.py; no-op when it is the
+    # process ledger already started by an outer harness)
+    from ..utils.goodput import LEDGER as G_LEDGER
+
+    G_LEDGER.reset()  # one ledger per run (tests reuse the process)
+    G_LEDGER.start()
+    if getattr(args, "run_record", None):
+        G_LEDGER.arm(args.run_record)
+
     honor_platform_env()
     from ..parallel.distributed import initialize as distributed_initialize
 
@@ -500,6 +520,23 @@ def _run_training_body(
     engine = Engine(
         cfg, train_split, test_split, tracer=tracer, registry=registry
     )
+    from ..utils.goodput import LEDGER as G_LEDGER
+
+    G_LEDGER.describe(
+        config={
+            "regime": regime, "epochs": cfg.epochs,
+            "batch_size": cfg.batch_size, "lr": cfg.lr,
+            "nb_proc": cfg.nb_proc, "sync_mode": cfg.sync_mode,
+            "seed": cfg.seed, "compute_dtype": cfg.compute_dtype,
+            "input_mode": cfg.input_mode, "kernels": cfg.kernels,
+        },
+        mesh={
+            "axes": {"data": engine.n_workers},
+            "devices": engine.n_workers,
+            "desc": f"data{engine.n_workers}",
+            "optimizer": "sgd",
+        },
+    )
 
     stats = None
     if want_stats or trace_out:
@@ -634,11 +671,30 @@ def _run_training_body(
     if guard is not None:
         log(f"(guard summary: {json.dumps(guard.summary())})")
 
+    # goodput close-out: conservation-asserted breakdown + run record
+    goodput_rec = G_LEDGER.finalize(metrics={
+        "final_train_loss": engine.history[-1].train_loss
+        if engine.history else None,
+        "final_val_acc": engine.history[-1].val_acc
+        if engine.history else None,
+        "epochs": cfg.epochs,
+        "preempted": bool(preemption.requested) if preemption else False,
+    })
+    log("GOODPUT " + json.dumps({
+        "goodput_ratio": goodput_rec["goodput_ratio"],
+        "wall_s": goodput_rec["wall_s"],
+        "goodput_s": goodput_rec["goodput_s"],
+        "badput_s": {k: v for k, v in goodput_rec["badput_s"].items()
+                     if v > 0},
+        "steps": goodput_rec["steps"],
+        "record": G_LEDGER.path,
+    }))
+
     if stats is not None and want_stats:
         for line in stats.report().splitlines():
             log(line)
     if trace_out:
-        tracer.export(trace_out, step_stats=stats)
+        tracer.export(trace_out, step_stats=stats, goodput=goodput_rec)
         log(
             f"(Chrome trace written to {trace_out}; open in Perfetto / "
             "chrome://tracing, or summarize with tools/trace_summary.py)"

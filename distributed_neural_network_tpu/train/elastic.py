@@ -216,13 +216,15 @@ def elastic_restore(
         from ..parallel.pipeline import pp_param_specs
 
         pp_specs = pp_param_specs(cfg)
+    from ..utils.goodput import ledger_interval
+
     t0 = time.perf_counter()
     with tracer.span(
         TR.RESHARD, track="elastic",
         saved_axes=dict(saved_axes),
         target_axes={k: int(v) for k, v in mesh.shape.items()},
         saved_optimizer=saved_optimizer, optimizer=optimizer,
-    ):
+    ), ledger_interval("reshard"):
         saved_template = saved_state_template(cfg, saved)
         restored = ck.restore_latest(saved_template, log=log)
         if restored is None:

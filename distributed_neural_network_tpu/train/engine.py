@@ -146,6 +146,7 @@ class Engine:
         tracer: TR.Tracer | None = None,
         step_stats: TR.StepStats | None = None,
         registry=None,
+        ledger=None,
     ):
         # step-level telemetry (utils/tracing.py): NULL_TRACER costs one
         # attribute check per span when disabled; step_stats is opt-in.
@@ -153,6 +154,12 @@ class Engine:
         # construction (the CLI builds StepStats from the live engine).
         self.tracer = tracer if tracer is not None else TR.NULL_TRACER
         self.step_stats = step_stats
+        # goodput accounting (utils/goodput.py): one epoch dispatch is
+        # one step span on the ledger (compile vs steady, train+sync
+        # wall); defaults to the process ledger - a no-op while disarmed
+        from ..utils.goodput import LEDGER as _LEDGER
+
+        self.ledger = ledger if ledger is not None else _LEDGER
         # live-metrics registry (utils/obs.py, --metrics-port): children
         # resolved once here so per-epoch publishing is lock-free adds
         from ..utils.obs import NULL_REGISTRY
@@ -805,6 +812,19 @@ class Engine:
                 and not self.step_stats.records,
             )
             self.step_stats.capture_memory(self.tracer)
+        # one fused dispatch = one ledger step span covering the whole
+        # span's epochs (compile separation mirrors step_stats above)
+        self.ledger.step_span(
+            epoch0 + span - 1,
+            time.perf_counter() - t_step,
+            tokens=span * self.images_per_epoch,
+            # AOT-precompiled spans (compile_span) dispatch steady; a
+            # first cold dispatch is the compile step (ledger default)
+            is_compile=(
+                None if (span, eval_inside) not in self._span_compiled
+                else False
+            ),
+        )
         # one fused dispatch = one heartbeat (the watchdog's stall
         # threshold adapts to whatever cadence the run actually has)
         self.registry.beat(epoch0 + span - 1)
@@ -955,6 +975,13 @@ class Engine:
                     params_stacked, mask_dev, loss_sums, n_batches
                 )
                 t.value = (self.params, train_loss)
+        # goodput: train + sync together are the epoch's training
+        # progress (the reference's two progress phases); eval and
+        # host bookkeeping below fall to idle_other honestly
+        self.ledger.step_span(
+            epoch, time.perf_counter() - t_step,
+            tokens=self.images_per_epoch,
+        )
 
         val_loss = val_acc = None
         if do_eval and self._eval_fn is not None:

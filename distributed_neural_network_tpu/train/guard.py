@@ -340,12 +340,18 @@ class TrainingGuard:
 
     # ----------------------------------------------------------- rollback
 
-    def rollback(self):
+    def rollback(self, at_step: int | None = None):
         """Consume one retry and return (step, host_state) of the rolling
         snapshot - or None when no snapshot exists yet (the caller then
         falls back to the newest on-disk checkpoint). Applies the LR
         backoff (`lr_scale *= lr_backoff`) and emits a `guard` rollback
-        event. Raises GuardAbort when the retry budget is exhausted."""
+        event. Raises GuardAbort when the retry budget is exhausted.
+
+        ``at_step`` (the step the training loop had reached) sizes the
+        goodput ledger's recompute window: the ``at_step - snapshot_step``
+        replayed steps are lost progress being re-earned, so their wall
+        time is attributed to ``rollback_recompute`` instead of goodput
+        (utils/goodput.py)."""
         self.retries_used += 1
         if self.retries_used > self.cfg.max_retries:
             raise GuardAbort(
@@ -373,6 +379,10 @@ class TrainingGuard:
         if self._snapshot is None:
             return None
         step, state = self._snapshot
+        if at_step is not None and at_step > step:
+            from ..utils.goodput import LEDGER
+
+            LEDGER.mark_recompute(at_step - step)
         if self.tracer is not None:
             self.tracer.instant(
                 "guard", track="guard", step=step, action="restore",

@@ -736,6 +736,7 @@ def make_traced_step(
     compile_first: bool = True,
     registry=None,
     recompiles=None,
+    ledger=None,
 ):
     """Wrap a compiled LM train step with span tracing + StepStats.
 
@@ -762,15 +763,21 @@ def make_traced_step(
     readiness flipped after the first completed (compiled) call.
     ``recompiles`` (train/monitor.py RecompileDetector) is observed once
     per call - one ``_cache_size()`` read - to count silent recompiles.
+    ``ledger`` (utils/goodput.py GoodputLedger; None = the process
+    ledger, a no-op while disarmed) receives each step's wall time as a
+    compile/steady_step/rollback_recompute interval - the goodput
+    accounting's compile-vs-steady feed.
     """
     import itertools
 
+    from ..utils import goodput as _goodput
     from ..utils import tracing as _tracing
     from ..utils.obs import NULL_REGISTRY
     from ..utils.timers import hard_block
 
     counter = itertools.count(first_step)
     reg = registry if registry is not None else NULL_REGISTRY
+    led = ledger if ledger is not None else _goodput.LEDGER
     m_steps = reg.counter(
         "train_steps_total", "Completed training steps"
     )
@@ -803,6 +810,10 @@ def make_traced_step(
                 i, dt, items=items_per_step,
                 is_compile=None if compile_first else False,
             )
+        led.step_span(
+            i, dt, tokens=items_per_step,
+            is_compile=None if compile_first else False,
+        )
         reg.beat(i)
         m_steps.inc()
         m_wall.observe(dt)

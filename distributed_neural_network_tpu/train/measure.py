@@ -693,12 +693,24 @@ def measure_lm_training(
     around the whole loop; `step_stats` (StepStats) gets one steady
     record per timed step from the same unfenced walls - trend data, not
     the headline (which stays the fenced-window tokens/s below).
+
+    The row also carries the run's own goodput accounting
+    (utils/goodput.py: a private ledger over setup -> warmup -> timed
+    window): ``goodput_ratio`` and the non-zero ``badput_breakdown``
+    seconds, so the bench matrix reports not just how fast the steady
+    state is but how much of the measurement's wall-clock WAS steady
+    state (init/compile being the honest overhead of short benches).
     """
     import jax.numpy as jnp
 
     from ..models import transformer as tfm
     from ..ops.flash import _on_tpu
+    from ..utils.goodput import GOODPUT_CAUSE, GoodputLedger
     from . import lm as lmtrain
+
+    # a private ledger (never the process singleton: rows must not leak
+    # accounting into each other when several run in one process)
+    ledger = GoodputLedger().start()
 
     cfg = tfm.TransformerConfig(
         vocab_size=vocab, d_model=d_model, n_heads=n_heads,
@@ -745,9 +757,13 @@ def measure_lm_training(
         step_stats.static_comm_bytes_per_step = static_comm
 
     with tracer.span("warmup", track="train", steps=max(warmup, 1)):
+        t_warm = time.perf_counter()
         for _ in range(max(warmup, 1)):
             params, mom, loss = step(params, mom, tokens, targets)
         hard_block(loss)
+        # the warmup window absorbs compilation: one compile span on the
+        # ledger (it also closes the setup-side init interval)
+        ledger.step_span(0, time.perf_counter() - t_warm, is_compile=True)
     # the fence is a value fetch (block_until_ready alone is a no-op on the
     # axon tunnel); subtract its pure round-trip cost so the ~60-70 ms
     # tunnel RTT is not charged to the steps (utils/timers.py fence_rtt)
@@ -769,6 +785,12 @@ def measure_lm_training(
             params, mom, loss = timed(params, mom, tokens, targets)
         hard_block(loss)
         dt = max(time.perf_counter() - t0 - rtt, 1e-9)
+    # the fenced steady window is the goodput; everything around it
+    # (model build, warmup/compile, fences) is the bench's own badput
+    ledger.step_span(
+        1, dt, tokens=batch * seq_len * steps, is_compile=False
+    )
+    goodput_rec = ledger.finalize()
     tok_s = batch * seq_len * steps / dt
     flops_tok = model_flops_per_token(cfg, seq_len)
     dev = jax.devices()[0]
@@ -816,6 +838,14 @@ def measure_lm_training(
         "device_kind": dev.device_kind,
         "tokens_per_s": round(tok_s),
         "wall_s": round(dt, 3),
+        # goodput accounting of this measurement's own wall-clock
+        # (utils/goodput.py; steady window / total incl. setup+compile)
+        "goodput_ratio": goodput_rec["goodput_ratio"],
+        "badput_breakdown": {
+            k: round(v, 3)
+            for k, v in goodput_rec["badput_s"].items()
+            if v > 0 and k != GOODPUT_CAUSE
+        },
         "model_tflops_per_s": round(flops_tok * tok_s / 1e12, 2),
         "mfu_pct": round(mfu, 2) if mfu is not None else None,
         # provenance: hardware FLOPs per step straight from the compiled
@@ -942,7 +972,11 @@ def measure_watchdog_overhead(
     counter, step-time histogram, one ``_cache_size()`` read), PLUS the
     fleet-observability extras a supervised worker carries: the
     heartbeat-FILE writer thread and the armed write-through crash
-    flight recorder (`utils/obs.py HeartbeatFileWriter` / `FLIGHT`).
+    flight recorder (`utils/obs.py HeartbeatFileWriter` / `FLIGHT`),
+    PLUS the armed goodput ledger (`utils/goodput.py LEDGER`: per-step
+    interval recording, registry export, and the write-through run
+    record) - the FULL supervised-worker observability surface under
+    the same <1% steady-step budget.
 
     Two claims, both asserted into the returned row:
     - ``within_budget``: steady-step overhead under `budget_pct` (default
@@ -979,13 +1013,18 @@ def measure_watchdog_overhead(
         )
         monitor = None
         tmpdir = None
-        env_keys = ("DNN_TPU_HEARTBEAT_FILE", "DNN_TPU_FLIGHT_FILE")
+        env_keys = ("DNN_TPU_HEARTBEAT_FILE", "DNN_TPU_FLIGHT_FILE",
+                    "DNN_TPU_RUN_RECORD")
         if monitored:
             # the FULL fleet stack: registry + server + watchdog as
             # before, PLUS the supervised-worker extras - heartbeat-file
-            # writer thread and the armed (write-through) crash flight
-            # recorder - so the <1% budget covers fleet observability too
+            # writer thread, the armed (write-through) crash flight
+            # recorder, and the armed goodput ledger with its run-record
+            # write-through - so the <1% budget covers the whole
+            # observability surface a supervised worker carries
             import tempfile
+
+            from ..utils.goodput import LEDGER
 
             tmpdir = tempfile.mkdtemp(prefix="dnn_fleet_obs_bench_")
             os.environ["DNN_TPU_HEARTBEAT_FILE"] = os.path.join(
@@ -994,14 +1033,21 @@ def measure_watchdog_overhead(
             os.environ["DNN_TPU_FLIGHT_FILE"] = os.path.join(
                 tmpdir, "flight.json"
             )
+            os.environ["DNN_TPU_RUN_RECORD"] = os.path.join(
+                tmpdir, "run_record.json"
+            )
+            LEDGER.reset()
+            LEDGER.start()
             monitor = attach_monitor(
                 metrics_port=0, config=WatchdogConfig(),
                 log=lambda *_: None,
             )
             monitor.recompiles.swap(step)
         reg = monitor.registry if monitor is not None else None
-        m_steps = m_wall = None
+        m_steps = m_wall = led = None
         if reg is not None:
+            from ..utils.goodput import LEDGER as led
+
             m_steps = reg.counter("train_steps_total")
             m_wall = reg.histogram("train_step_seconds")
         loss = None
@@ -1016,19 +1062,25 @@ def measure_watchdog_overhead(
                 params, mom, loss = step(params, mom, tokens, targets)[:3]
                 if reg is not None:
                     # the exact per-step publish set --metrics-port wires
+                    step_dt = time.perf_counter() - ts
                     reg.beat(i)
                     reg.mark_ready()
                     m_steps.inc()
-                    m_wall.observe(time.perf_counter() - ts)
+                    m_wall.observe(step_dt)
                     monitor.recompiles.observe(i)
+                    led.step_span(i, step_dt, tokens=batch * seq_len,
+                                  is_compile=False)
             hard_block(loss)
             dt = max(time.perf_counter() - t0 - rtt, 1e-9)
         finally:
             if monitor is not None:
                 monitor.close()
             if tmpdir is not None:
+                from ..utils.goodput import LEDGER
                 from ..utils.obs import FLIGHT
 
+                LEDGER.finalize()
+                LEDGER.reset()  # disarm the process-global ledger
                 FLIGHT.reset()  # disarm the process-global recorder
                 for k in env_keys:
                     os.environ.pop(k, None)

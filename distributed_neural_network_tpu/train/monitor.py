@@ -266,6 +266,15 @@ class Watchdog:
             age = self.registry.heartbeat_age()
             step = self.registry.last_step()
             if age is not None and age > thr:
+                # goodput accounting: the no-heartbeat window is stall
+                # badput. Re-reported each poll as [now-age, now]; the
+                # ledger's sweep coalesces the growing episode and any
+                # overhang into the step that finally completes
+                # (utils/goodput.py - instrumented intervals outrank the
+                # coarse stall window)
+                from ..utils.goodput import LEDGER
+
+                LEDGER.add_ending_now("stall", age)
                 if self._stall_flagged_at_step != step:
                     self._stall_flagged_at_step = step
                     self._stall_polls = 0
@@ -551,6 +560,12 @@ def attach_monitor(
     ``watchdog=False``) the watchdog thread. The caller logs
     ``monitor.url`` and closes the monitor on exit.
 
+    Goodput: a DNN_TPU_RUN_RECORD env (exported per worker by the
+    supervisor, or set by hand) arms the process goodput ledger's
+    write-through run record (`utils/goodput.py LEDGER` - SIGKILL-safe,
+    like the flight recorder), and any real registry gets the ledger's
+    ``goodput_ratio`` / ``badput_seconds_total{cause}`` export.
+
     Fleet extensions: a supervisor-exported DNN_TPU_FLIGHT_FILE arms the
     process flight recorder's write-through dump (`utils/obs.py FLIGHT`);
     ``rank`` stamps the heartbeat file (and the flight dump) so
@@ -567,10 +582,17 @@ def attach_monitor(
         flight = O.FLIGHT
         flight_event("run_start", pid=os.getpid())
         log(f"(flight recorder: {fl_path})")
+    from ..utils import goodput as GP
+
+    rec_path = os.environ.get(GP.RUN_RECORD_ENV)
+    if rec_path:
+        GP.LEDGER.arm(rec_path)
+        log(f"(goodput run record: {rec_path})")
     hb_path = os.environ.get("DNN_TPU_HEARTBEAT_FILE")
     if metrics_port is None and not hb_path:
         return Monitor(O.NULL_REGISTRY, flight=flight)
     registry = O.MetricsRegistry()
+    GP.LEDGER.publish(registry)
     server = prof = None
     if metrics_port is not None:
         if profile_dir:

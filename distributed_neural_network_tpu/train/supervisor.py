@@ -51,6 +51,16 @@ detect -> checkpoint -> reshard -> resume story is exercised end to end
 across genuine process boundaries (`tools/launch.py --chaos-kill-*`,
 tests/test_supervisor.py, the supervisor-chaos-smoke CI job).
 
+- **Goodput accounting** (utils/goodput.py): every worker gets a
+  ``DNN_TPU_RUN_RECORD`` path next to its heartbeat/flight files; the
+  supervisor aggregates the per-rank write-through run records plus its
+  own restart-gap measurements (death -> respawn, with a failure-
+  relaunched generation's init+compile reclassified into the
+  ``restart_gap`` bucket) into one fleet record - exported live as
+  ``goodput_ratio`` / ``badput_seconds_total{cause}``, written to
+  ``run_dir/run_record.json``, and embedded in ``postmortem.json`` and
+  the ``SUPERVISOR_SUMMARY`` line (docs/OBSERVABILITY.md "Goodput
+  accounting"; gate with ``tools/goodput.py --check``).
 - **Fleet federation + postmortems** (the observability layer on top):
   `FleetFederation` turns the per-worker heartbeat files and (when
   workers open ``--metrics-port``) their scraped ``/metrics`` endpoints
@@ -87,6 +97,7 @@ from dataclasses import dataclass
 
 HEARTBEAT_ENV = "DNN_TPU_HEARTBEAT_FILE"
 FLIGHT_ENV = "DNN_TPU_FLIGHT_FILE"
+RUN_RECORD_ENV = "DNN_TPU_RUN_RECORD"
 
 # exit code a SUPERVISED worker uses for "preempted cleanly" (emergency
 # checkpoint written, exiting on request) - EX_TEMPFAIL. Exit 0 means the
@@ -202,6 +213,9 @@ class FleetFederation:
         "recompiles_total",
         "watchdog_stall_total",
         "guard_rollbacks_total",
+        # per-rank goodput (utils/goodput.py ledger export) -> the fleet
+        # view shows each worker's own efficiency next to the aggregate
+        "goodput_ratio",
     )
 
     def __init__(
@@ -565,6 +579,21 @@ class Supervisor:
             "supervisor_postmortems_total",
             "Postmortem bundles written (failure restarts + aborts)",
         )
+        # fleet goodput accounting (utils/goodput.py): the supervisor
+        # aggregates the per-worker write-through run records plus its
+        # own restart-gap measurements and re-exports the fleet view
+        self._m_goodput = registry.gauge(
+            "goodput_ratio",
+            "Fleet fraction of capacity-seconds spent in steady steps",
+        )
+        self._m_badput = registry.counter(
+            "badput_seconds_total",
+            "Fleet capacity-seconds lost to non-goodput causes, by cause",
+        )
+        self._m_gap_last = registry.gauge(
+            "supervisor_restart_gap_seconds",
+            "Newest worker-death -> first-post-restart-step window",
+        )
         # per-rank fleet metrics + straggler attribution + /metrics
         # federation, on the same registry tools/launch.py serves
         self.federation = (
@@ -582,11 +611,62 @@ class Supervisor:
         self.failures: list[dict] = []
         self._group_started = 0.0
         self._healthy_since: float | None = None
+        # goodput bookkeeping: supervisor-measured restart gaps
+        # (death -> respawn, in capacity-seconds at the relaunched size),
+        # the generations that exist BECAUSE of a failure restart (their
+        # ranks' init+compile reclassify into restart_gap at aggregation),
+        # and the open death -> first-post-restart-step window
+        self.restart_gaps: list[dict] = []
+        self.restart_generations: set[int] = set()
+        self._gap_open: float | None = None
+        self._goodput_published = 0.0
+        self.fleet_goodput: dict | None = None
+        # a reused run dir must not leak the previous run's liveness or
+        # crash state into this one (mirrors the checkpointers' stale
+        # step_*.tmp sweep): a relaunch reading an old heartbeat would
+        # see a phantom live worker, an old flight dump would corrupt the
+        # next postmortem, an old run record the goodput aggregation
+        swept = self._sweep_stale_run_dir()
+        if swept:
+            self.log(
+                f"(supervisor: swept {swept} stale heartbeat/flight/"
+                f"record/postmortem file(s) from reused {self.run_dir})"
+            )
         os.makedirs(os.path.join(self.run_dir, "hb"), exist_ok=True)
         os.makedirs(os.path.join(self.run_dir, "logs"), exist_ok=True)
         os.makedirs(os.path.join(self.run_dir, "flight"), exist_ok=True)
+        os.makedirs(os.path.join(self.run_dir, "records"), exist_ok=True)
         self._m_target.set(config.nprocs)
         self._m_budget.set(config.max_restarts)
+
+    def _sweep_stale_run_dir(self) -> int:
+        """Remove a previous run's state files from this run dir (the
+        subdirs this supervisor owns, plus postmortem.json and the fleet
+        run_record.json); never raises - a sweep failure must not block
+        the launch. Logs are kept (they are the previous run's evidence,
+        and generation-numbered names make them non-ambiguous)."""
+        swept = 0
+        for sub in ("hb", "flight", "records"):
+            d = os.path.join(self.run_dir, sub)
+            try:
+                names = os.listdir(d)
+            except OSError:
+                continue
+            for name in names:
+                if not (name.endswith(".json") or ".json.tmp" in name):
+                    continue
+                try:
+                    os.unlink(os.path.join(d, name))
+                    swept += 1
+                except OSError:
+                    pass
+        for name in ("postmortem.json", "run_record.json"):
+            try:
+                os.unlink(os.path.join(self.run_dir, name))
+                swept += 1
+            except OSError:
+                pass
+        return swept
 
     # ------------------------------------------------------------- spawn
 
@@ -604,7 +684,7 @@ class Supervisor:
         return out
 
     def _worker_env(self, rank: int, n: int, port: int, hb_path: str,
-                    flight_path: str) -> dict:
+                    flight_path: str, record_path: str = "") -> dict:
         env = dict(self.base_env)
         if self.cfg.force_host_devices:
             # replace (not append) any inherited device-count flag: the
@@ -627,6 +707,10 @@ class Supervisor:
         # worker's write-through dump lands here and is bundled into
         # postmortem.json on failure - even after a SIGKILL
         env[FLIGHT_ENV] = flight_path
+        if record_path:
+            # per-worker goodput run record (utils/goodput.py LEDGER):
+            # write-through like the flight dump, aggregated fleet-wide
+            env[RUN_RECORD_ENV] = record_path
         env["DNN_TPU_SUPERVISOR"] = "1"
         env["DNN_TPU_SUPERVISOR_GEN"] = str(self.generation)
         return env
@@ -647,12 +731,15 @@ class Supervisor:
             flight_path = os.path.join(
                 self.run_dir, "flight", f"gen{g}_rank{rank}.json"
             )
+            record_path = os.path.join(
+                self.run_dir, "records", f"gen{g}_rank{rank}.json"
+            )
             log_file = open(log_path, "w")
             argv = self._worker_argv(rank, n)
             proc = subprocess.Popen(
                 argv,
                 env=self._worker_env(
-                    rank, n, self.port, hb_path, flight_path
+                    rank, n, self.port, hb_path, flight_path, record_path
                 ),
                 stdout=log_file,
                 stderr=subprocess.STDOUT,
@@ -747,6 +834,9 @@ class Supervisor:
             "restarts_used": self.restarts_used,
             "rendezvous_used": self.rendezvous_used,
             "failures": list(self.failures),
+            # fleet goodput accounting as of this crash (the killed
+            # rank's write-through record is already folded in)
+            "goodput": self._publish_goodput(),
             "workers": workers,
         }
         tmp = self.postmortem_path + ".tmp"
@@ -800,6 +890,15 @@ class Supervisor:
                         )
                         w.kill(signal.SIGKILL)
         self.federation.finish_poll(beating)
+        if self._gap_open is not None and beating:
+            # first post-restart step: the issue-defined restart window
+            # (worker death -> first step of the relaunched group)
+            self._m_gap_last.set(time.monotonic() - self._gap_open)
+            self._gap_open = None
+        now = time.monotonic()
+        if now - self._goodput_published >= 5.0:
+            self._goodput_published = now
+            self._publish_goodput()
         if self.chaos is not None:
             for rank, sig in self.chaos.due(steps):
                 for w in self.workers:
@@ -816,6 +915,71 @@ class Supervisor:
 
     def _group_ready(self) -> bool:
         return all(w.ever_beat for w in self.workers)
+
+    # ------------------------------------------------------------- goodput
+
+    def _publish_goodput(self) -> dict | None:
+        """Aggregate every generation's per-rank run records (partial
+        write-through ones from killed workers included) plus the
+        supervisor-measured restart gaps into ONE fleet record
+        (`utils/goodput.py fleet_goodput_record`), re-exported as
+        ``goodput_ratio`` / ``badput_seconds_total{cause}`` on the
+        supervisor's registry and stashed for the postmortem bundle and
+        SUPERVISOR_SUMMARY. Never raises."""
+        from ..utils.goodput import (
+            BADPUT_CAUSES,
+            fleet_goodput_record,
+            validate_record,
+        )
+
+        records = []
+        d = os.path.join(self.run_dir, "records")
+        try:
+            names = sorted(os.listdir(d))
+        except OSError:
+            names = []
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(d, name)) as f:
+                    records.append(validate_record(json.load(f), name))
+            except (OSError, ValueError):
+                continue  # torn/partial write or a non-record file
+        if not records and not self.restart_gaps:
+            return None
+        try:
+            fleet = fleet_goodput_record(
+                records,
+                restart_gaps=self.restart_gaps,
+                restart_generations=self.restart_generations,
+            )
+        except ValueError:
+            return None
+        self.fleet_goodput = fleet
+        if fleet.get("goodput_ratio") is not None:
+            self._m_goodput.set(fleet["goodput_ratio"])
+        for cause in BADPUT_CAUSES:
+            v = (fleet.get("badput_s") or {}).get(cause, 0.0)
+            if v > 0:
+                self._m_badput.labels(cause=cause).set_max(v)
+        return fleet
+
+    def _goodput_brief(self) -> dict | None:
+        """The compact fleet-goodput block for log-line summaries."""
+        fleet = self.fleet_goodput
+        if fleet is None:
+            return None
+        return {
+            "goodput_ratio": fleet.get("goodput_ratio"),
+            "wall_s": fleet.get("wall_s"),
+            "goodput_s": fleet.get("goodput_s"),
+            "badput_s": {
+                k: v for k, v in (fleet.get("badput_s") or {}).items()
+                if v > 0
+            },
+            "n_records": fleet.get("n_records"),
+        }
 
     # --------------------------------------------------------------- run
 
@@ -1024,10 +1188,32 @@ class Supervisor:
         time.sleep(pause)
         self._m_restarts.labels(direction=direction).inc()
         self._spawn_group(new_n)
-        self._m_restart_s.observe(time.monotonic() - t0)
+        gap = time.monotonic() - t0
+        self._m_restart_s.observe(gap)
+        # goodput: death-detection -> respawn is capacity the fleet lost
+        # with NO worker process alive - the supervisor-side half of the
+        # restart_gap bucket (the relaunched generation's init+compile is
+        # the other half, reclassified at aggregation; utils/goodput.py
+        # fleet_goodput_record). The death -> first-post-restart-step
+        # window closes in _observe once the new group heartbeats a step.
+        self.restart_gaps.append({
+            "seconds": round(gap, 3), "group_size": new_n,
+            "generation": self.generation, "detected_unix": time.time(),
+        })
+        self.restart_generations.add(self.generation)
+        self._gap_open = t0
         return None
 
     def _summary(self, rc: int) -> None:
+        fleet = self._publish_goodput()
+        if fleet is not None:
+            # the final fleet-level record, checkable by tools/goodput.py
+            # (render / --diff / --check against a baseline)
+            from ..utils.goodput import _atomic_write_json
+
+            path = os.path.join(self.run_dir, "run_record.json")
+            if _atomic_write_json(path, fleet):
+                self.log(f"(supervisor: fleet goodput record -> {path})")
         self.log("SUPERVISOR_SUMMARY " + json.dumps({
             "exit": {0: "ok", 3: "budget", 4: "rendezvous"}.get(rc, "error"),
             "rc": rc,
@@ -1041,6 +1227,7 @@ class Supervisor:
             "postmortem_path": (
                 self.postmortem_path if self.postmortems_written else None
             ),
+            "goodput": self._goodput_brief(),
         }))
 
 

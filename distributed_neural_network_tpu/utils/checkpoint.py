@@ -360,11 +360,16 @@ class TreeCheckpointer:
         self._metrics = _CkptMetrics(registry)
 
     def save(self, step: int, state, meta: dict | None = None) -> None:
+        from .goodput import ledger_interval
+
         # host conversion on EVERY rank (it may be collective for
-        # cross-process-sharded leaves); file writes on rank 0 only
-        host = _host_tree(state)
-        if _is_writer_rank():
-            self._b.save(step, host, meta or {})
+        # cross-process-sharded leaves); file writes on rank 0 only.
+        # The whole save (gather + write) is checkpoint_save badput on
+        # the goodput ledger - it blocks the step loop.
+        with ledger_interval("checkpoint_save"):
+            host = _host_tree(state)
+            if _is_writer_rank():
+                self._b.save(step, host, meta or {})
         self._metrics.saved(step)
 
     def latest_step(self):
@@ -453,24 +458,28 @@ class Checkpointer:
 
     def save(self, epoch: int, engine) -> None:
         from ..train.guard import resume_cursor
+        from .goodput import ledger_interval
 
-        state = _host_tree(engine.state_tree())
-        meta = {
-            "epoch": epoch,
-            "n_workers": engine.n_workers,
-            "regime": engine.config.regime,
-            "history": [dataclasses.asdict(m) for m in engine.history],
-            # save-time mesh topology so a restore into a different worker
-            # count is DETECTED and (with elastic=True) resharded instead
-            # of crashing on a momentum-stack shape mismatch
-            "mesh_meta": engine.mesh_meta(),
-            # versioned exact-resume cursor: every shuffle/fault stream is
-            # a pure function of (seed, epoch), so these two pin the
-            # continuation's data order bit-exactly (train/guard.py)
-            **resume_cursor(step=epoch, seed=engine.config.seed),
-        }
-        if _is_writer_rank():
-            self._b.save(epoch, state, meta)
+        with ledger_interval("checkpoint_save"):
+            state = _host_tree(engine.state_tree())
+            meta = {
+                "epoch": epoch,
+                "n_workers": engine.n_workers,
+                "regime": engine.config.regime,
+                "history": [dataclasses.asdict(m) for m in engine.history],
+                # save-time mesh topology so a restore into a different
+                # worker count is DETECTED and (with elastic=True)
+                # resharded instead of crashing on a momentum-stack shape
+                # mismatch
+                "mesh_meta": engine.mesh_meta(),
+                # versioned exact-resume cursor: every shuffle/fault
+                # stream is a pure function of (seed, epoch), so these two
+                # pin the continuation's data order bit-exactly
+                # (train/guard.py)
+                **resume_cursor(step=epoch, seed=engine.config.seed),
+            }
+            if _is_writer_rank():
+                self._b.save(epoch, state, meta)
         self._metrics.saved(epoch)
 
     # --------------------------------------------------------------- restore

@@ -1,0 +1,984 @@
+"""Goodput ledger: wall-clock efficiency accounting over a closed badput
+taxonomy, run records, and the fleet-level aggregation.
+
+Every observability layer before this one emits RAW signals - spans
+(`utils/tracing.py`), StepStats, flight-recorder events (`utils/obs.py`),
+guard rollbacks (`train/guard.py`), reshard spans (`train/elastic.py`),
+watchdog stall episodes (`train/monitor.py`), supervisor restarts
+(`train/supervisor.py`). None of them answers the one question production
+TPU fleets are run by (arXiv 2204.06514's utilization accounting, arXiv
+2412.14374's bubble accounting): *what fraction of total wall-clock
+produced training progress, and which failure/overhead class consumed the
+rest?* This module is that synthesis layer.
+
+**Taxonomy** (closed - every wall-clock second lands in exactly one
+bucket; `CAUSES` is the schema):
+
+- ``init``            - process start -> first step dispatch (mesh build,
+                        param init, data load, rendezvous).
+- ``compile``         - the compile step(s) (first dispatch pays XLA).
+- ``steady_step``     - compiled steps that advanced training. THE
+                        goodput bucket; everything else is badput.
+- ``data_wait``       - host-side input pipeline blocking the step loop.
+- ``checkpoint_save`` - writing checkpoints (periodic + emergency).
+- ``reshard``         - elastic checkpoint->mesh redistribution.
+- ``rollback_recompute`` - steps re-executed after a guard rollback
+                        (lost steps x steady step time, attributed on the
+                        replayed steps themselves so the cost is the
+                        MEASURED recompute, not an estimate).
+- ``stall``           - no-progress episodes flagged by the watchdog
+                        (wedged collective, host sleep, dead thread).
+- ``restart_gap``     - worker death -> first post-restart step, measured
+                        supervisor-side across relaunches (the fleet
+                        aggregation reclassifies a restart generation's
+                        init+compile into this bucket - those seconds are
+                        restart cost, not fresh-run startup).
+- ``idle_other``      - the residual (eval, logging, host overhead);
+                        computed as total - attributed, never recorded
+                        directly.
+
+**Conservation.** Intervals are attributed ONCE: overlapping recordings
+are resolved by a priority sweep (instrumented intervals beat the
+watchdog's coarse stall window, which beats nothing), the residual is
+``idle_other``, and ``finalize()`` asserts the buckets sum to total
+wall-clock to float precision. Concurrent publishers (step loop, watchdog
+thread, checkpoint writer) therefore cannot double-count a second.
+
+**Records.** Each run emits a schema-versioned ``run_record.json``
+(`RECORD_VERSION`): config fingerprint, mesh topology, step/token counts,
+goodput ratio, per-cause badput seconds, final metrics. While the run is
+live the ledger writes the record THROUGH at a bounded cadence (atomic
+tmp+rename, the `HeartbeatFileWriter`/`FlightRecorder` idiom), so a
+SIGKILLed worker's accounting up to the last write is already on disk and
+lands in the supervisor's fleet aggregation (`fleet_goodput_record`) and
+``postmortem.json``. `tools/goodput.py` renders, diffs, and - against a
+checked-in baseline with per-cause tolerances - gates regressions in CI.
+
+Stdlib-only (no jax import): the ledger runs identically in workers, the
+supervisor, `tools/goodput.py`, and tests. Live export rides the metrics
+registry (``goodput_ratio`` gauge + ``badput_seconds_total{cause}``
+counter, `utils/obs.py`); docs/OBSERVABILITY.md "Goodput accounting".
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import socket
+import threading
+import time
+
+# bump when the run-record schema changes shape; readers accept same-or-
+# older versions and refuse newer ones with a clear message
+RECORD_VERSION = 1
+
+# env var naming the per-worker run-record path; the elastic supervisor
+# (train/supervisor.py) exports it next to the heartbeat/flight files
+RUN_RECORD_ENV = "DNN_TPU_RUN_RECORD"
+
+# the closed taxonomy, in report order. steady_step is goodput;
+# idle_other is the computed residual (never recorded directly).
+GOODPUT_CAUSE = "steady_step"
+IDLE_CAUSE = "idle_other"
+CAUSES = (
+    "init",
+    "compile",
+    GOODPUT_CAUSE,
+    "data_wait",
+    "checkpoint_save",
+    "reshard",
+    "rollback_recompute",
+    "stall",
+    "restart_gap",
+    IDLE_CAUSE,
+)
+BADPUT_CAUSES = tuple(c for c in CAUSES if c != GOODPUT_CAUSE)
+
+# overlap-resolution priority (lower wins): precisely instrumented
+# intervals (step walls, checkpoint saves, reshard spans, data waits)
+# always beat the watchdog's coarse stall window, which covers the idle
+# gap between heartbeats and may overhang into the next completed step.
+# Fill intervals (internal: the untelemetered fast path's whole-window
+# coarse attribution, and the synthesized open-init prefix) rank below
+# everything, so any precisely recorded interval carves itself out of a
+# fill instead of being swallowed by it.
+_PRIORITY = {c: 0 for c in CAUSES}
+_PRIORITY["stall"] = 1
+_PRIORITY["restart_gap"] = 1
+_FILL_CAUSES = {"_steady_fill": GOODPUT_CAUSE, "_init_fill": "init"}
+_PRIORITY["_steady_fill"] = 2
+_PRIORITY["_init_fill"] = 3
+
+
+class _Interval:
+    __slots__ = ("t0", "t1", "cause")
+
+    def __init__(self, t0: float, t1: float, cause: str):
+        self.t0 = t0
+        self.t1 = t1
+        self.cause = cause
+
+
+class _LedgerSpan:
+    """Context manager recording one interval on exit (never raises)."""
+
+    __slots__ = ("_ledger", "cause", "_t0", "dur_s")
+
+    def __init__(self, ledger, cause):
+        self._ledger = ledger
+        self.cause = cause
+        self.dur_s = 0.0
+
+    def __enter__(self):
+        self._t0 = self._ledger._now()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = self._ledger._now()
+        self.dur_s = t1 - self._t0
+        self._ledger.add(self.cause, self._t0, t1)
+        return False
+
+
+class _NullSpan:
+    __slots__ = ()
+    dur_s = 0.0
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+def attribute_intervals(
+    intervals, start: float, end: float, *, priority=None
+) -> dict:
+    """Sweep-line attribution: partition ``[start, end]`` over the
+    recorded intervals so every second is counted exactly once.
+
+    Overlaps are resolved by ``(priority, start-time, sequence)`` - the
+    highest-priority (lowest number), earliest interval owns the overlap;
+    uncovered time is ``idle_other``. Same-cause overlapping intervals
+    (the watchdog re-reporting a growing stall episode every poll)
+    therefore coalesce instead of double-counting. Returns a full
+    ``{cause: seconds}`` dict over `CAUSES`; the values sum to
+    ``end - start`` to float precision BY CONSTRUCTION - the conservation
+    rule `GoodputLedger.finalize` asserts.
+    """
+    import heapq
+
+    prio = priority if priority is not None else _PRIORITY
+    out = {c: 0.0 for c in CAUSES}
+    if end <= start:
+        return out
+    ivs = sorted(
+        (
+            (max(iv.t0, start), min(iv.t1, end), iv.cause, seq)
+            for seq, iv in enumerate(intervals)
+            if iv.t1 > start and iv.t0 < end and iv.t1 > iv.t0
+        ),
+        key=lambda x: x[0],
+    )
+    heap: list = []  # (priority, t0, seq, t1, cause)
+    t = start
+    i = 0
+    n = len(ivs)
+    while t < end:
+        while i < n and ivs[i][0] <= t:
+            t0, t1, cause, seq = ivs[i]
+            if t1 > t:
+                heapq.heappush(
+                    heap, (prio.get(cause, 0), t0, seq, t1, cause)
+                )
+            i += 1
+        while heap and heap[0][3] <= t:
+            heapq.heappop(heap)
+        next_start = ivs[i][0] if i < n else end
+        if heap:
+            winner_t1, winner_cause = heap[0][3], heap[0][4]
+            seg_end = min(winner_t1, next_start, end)
+            out[winner_cause] = out.get(winner_cause, 0.0) + (seg_end - t)
+        else:
+            seg_end = min(next_start, end)
+            out[IDLE_CAUSE] += seg_end - t
+        t = seg_end
+    # fold internal fill causes into their public buckets
+    for fill, public in _FILL_CAUSES.items():
+        if fill in out:
+            out[public] += out.pop(fill)
+    return out
+
+
+class GoodputLedger:
+    """Event-sourced wall-clock accounting for one process.
+
+    Disabled by default (every call is a cheap no-op - the `NULL_TRACER`
+    / `NULL_REGISTRY` convention); ``start()`` arms it. Thread-safe: the
+    step loop, the watchdog thread, and the checkpoint writer all publish
+    into one ledger, and the sweep (`attribute_intervals`) guarantees
+    each second is attributed once regardless of interleaving.
+
+    Feeds (all optional, all additive):
+    - ``step_span(step, dur_s)``  - one completed step's wall time
+      (`train/lm.py make_traced_step`, `train/engine.py run_epoch`).
+      The first span closes the implicit ``init`` interval and counts as
+      ``compile`` unless told otherwise; spans inside a rollback-replay
+      window count as ``rollback_recompute`` (see ``mark_recompute``).
+    - ``interval(cause)``         - context manager for instrumented
+      blocks (checkpoint saves, reshards, data waits).
+    - ``add`` / ``add_ending_now``- retroactive attribution (the
+      watchdog's stall episodes).
+    - ``mark_recompute(n)``       - the next ``n`` step spans are
+      rollback recompute, not goodput (`train/guard.py rollback`).
+    """
+
+    def __init__(self, *, clock=time.monotonic):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.enabled = False
+        self.reset()
+
+    # ------------------------------------------------------------- control
+
+    def reset(self) -> None:
+        """Back to the disarmed zero state (test hygiene for `LEDGER`)."""
+        with self._lock:
+            self.enabled = False
+            self._intervals: list[_Interval] = []
+            self._t_start: float | None = None
+            self._t_init_open: float | None = None
+            self.started_unix: float | None = None
+            self.steps = 0
+            self.goodput_steps = 0
+            self.tokens = 0.0
+            self._recompute_budget = 0
+            self._seen_compile = False
+            self.path: str | None = None
+            self.write_interval_s = 5.0
+            self._last_write = 0.0
+            self.publish_interval_s = 2.0
+            self._last_publish = 0.0
+            self._registry = None
+            self._m_ratio = None
+            self._m_badput = None
+            self.config: dict = {}
+            self.config_fingerprint: str | None = None
+            self.mesh: dict = {}
+            self.rank: int | None = None
+            self.generation: int | None = None
+            self.metrics: dict = {}
+
+    def start(self, *, rank: int | None = None) -> "GoodputLedger":
+        """Arm the ledger; wall-clock zero is NOW and an ``init``
+        interval opens, closed by the first ``step_span``."""
+        with self._lock:
+            self.enabled = True
+            self._t_start = self._clock()
+            self._t_init_open = self._t_start
+            self.started_unix = time.time()
+            if rank is not None:
+                self.rank = int(rank)
+            elif self.rank is None:
+                env = os.environ.get("JAX_PROCESS_ID")
+                try:
+                    self.rank = int(env) if env is not None else None
+                except ValueError:
+                    self.rank = None
+            gen = os.environ.get("DNN_TPU_SUPERVISOR_GEN")
+            try:
+                self.generation = int(gen) if gen is not None else None
+            except ValueError:
+                self.generation = None
+        return self
+
+    def arm(self, path: str, *, write_interval_s: float = 5.0) -> None:
+        """Write the (partial) run record through to ``path`` at a
+        bounded cadence - the SIGKILL-survival channel (armed from
+        `RUN_RECORD_ENV` by `train/monitor.py attach_monitor`)."""
+        self.path = os.path.abspath(path)
+        self.write_interval_s = float(write_interval_s)
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        self.write_record(final=False)
+
+    def publish(self, registry) -> None:
+        """Export ``goodput_ratio`` + ``badput_seconds_total{cause}`` on
+        ``registry`` (utils/obs.py), refreshed at a bounded cadence from
+        ``step_span`` and once on ``finalize``."""
+        self._registry = registry
+        self._m_ratio = registry.gauge(
+            "goodput_ratio",
+            "Fraction of wall-clock spent in steady training steps",
+        )
+        self._m_badput = registry.counter(
+            "badput_seconds_total",
+            "Wall-clock lost to non-goodput causes (utils/goodput.py)",
+        )
+
+    def describe(self, *, config: dict | None = None, mesh: dict | None = None,
+                 metrics: dict | None = None) -> None:
+        """Attach run identity to the record: ``config`` is fingerprinted
+        (sha256 over sorted JSON), ``mesh`` is the topology block,
+        ``metrics`` the final numbers (merged - call any time)."""
+        if config is not None:
+            self.config = _json_safe(config)
+            self.config_fingerprint = config_fingerprint(config)
+        if mesh is not None:
+            self.mesh = _json_safe(mesh)
+        if metrics is not None:
+            self.metrics.update(_json_safe(metrics))
+
+    # ------------------------------------------------------------ recording
+
+    def _now(self) -> float:
+        return self._clock()
+
+    def interval(self, cause: str, **_meta):
+        """``with ledger.interval("checkpoint_save"): ...`` - no-op when
+        disarmed."""
+        if not self.enabled:
+            return _NULL_SPAN
+        _check_cause(cause)
+        return _LedgerSpan(self, cause)
+
+    def add(self, cause: str, t0: float, t1: float) -> None:
+        """Record one closed interval on the ledger's own clock."""
+        if not self.enabled or t1 <= t0:
+            return
+        _check_cause(cause)
+        with self._lock:
+            self._intervals.append(_Interval(t0, t1, cause))
+
+    def add_ending_now(self, cause: str, dur_s: float) -> None:
+        """Record an interval of ``dur_s`` seconds ending now - the
+        retroactive form (the watchdog knows how long the heartbeat has
+        been missing, not when the stall will end; re-reporting a growing
+        episode every poll coalesces in the sweep)."""
+        if not self.enabled or dur_s <= 0:
+            return
+        now = self._now()
+        self.add(cause, now - dur_s, now)
+
+    def now(self) -> float:
+        """The ledger's own clock (for retroactive ``add`` timestamps)."""
+        return self._now()
+
+    def fill_ending_now(self, cause: str, dur_s: float) -> None:
+        """Record a COARSE fill interval of ``dur_s`` seconds ending now:
+        it ranks below every precisely recorded interval in the sweep, so
+        instrumented activity inside the window (checkpoint saves, stall
+        episodes) still carves out its own attribution - the
+        untelemetered fast path's whole-steady-window accounting
+        (`lm_train.py` without trace/metrics, where fencing each step
+        just to time it would change the run)."""
+        if not self.enabled or dur_s <= 0:
+            return
+        fill = {v: k for k, v in _FILL_CAUSES.items()}.get(cause)
+        if fill is None:
+            raise ValueError(
+                f"no fill bucket for cause {cause!r} "
+                f"(fills: {sorted(_FILL_CAUSES.values())})"
+            )
+        now = self._now()
+        with self._lock:
+            self._intervals.append(_Interval(now - dur_s, now, fill))
+
+    def mark_recompute(self, n_steps: int) -> None:
+        """The next ``n_steps`` completed steps are rollback replay
+        (lost progress being re-earned), attributed to
+        ``rollback_recompute`` instead of ``steady_step``."""
+        if not self.enabled or n_steps <= 0:
+            return
+        with self._lock:
+            self._recompute_budget += int(n_steps)
+
+    def note_steps(self, n: int, *, tokens: float = 0.0) -> None:
+        """Bookkeeping-only step counting for callers that attribute
+        wall-clock coarsely via ``add``/``add_ending_now`` instead of
+        per-step spans (the untelemetered fast path, where fencing every
+        step just to time it would change the run being accounted)."""
+        if not self.enabled or n <= 0:
+            return
+        with self._lock:
+            self.steps += int(n)
+            self.goodput_steps += int(n)
+            self.tokens += float(tokens)
+            self._seen_compile = True
+
+    def step_span(
+        self, step: int, dur_s: float, *,
+        tokens: float = 0.0, is_compile: bool | None = None,
+    ) -> None:
+        """One completed training step of ``dur_s`` seconds ending now.
+
+        The first span (unless ``is_compile=False``) is the compile step;
+        it also closes the implicit ``init`` interval at its own start.
+        """
+        if not self.enabled:
+            return
+        now = self._now()
+        t0 = now - max(float(dur_s), 0.0)
+        with self._lock:
+            if self._t_init_open is not None:
+                if t0 > self._t_init_open:
+                    self._intervals.append(
+                        _Interval(self._t_init_open, t0, "init")
+                    )
+                self._t_init_open = None
+            if is_compile is None:
+                is_compile = not self._seen_compile
+            if is_compile:
+                cause = "compile"
+                self._seen_compile = True
+            elif self._recompute_budget > 0:
+                self._recompute_budget -= 1
+                cause = "rollback_recompute"
+            else:
+                cause = GOODPUT_CAUSE
+                self.goodput_steps += 1
+                self.tokens += float(tokens)
+            self.steps += 1
+            self._intervals.append(_Interval(t0, now, cause))
+        if self._registry is not None and (
+            now - self._last_publish >= self.publish_interval_s
+        ):
+            self._last_publish = now
+            self._publish_breakdown(self.breakdown(at=now))
+        if self.path is not None and (
+            now - self._last_write >= self.write_interval_s
+        ):
+            self._last_write = now
+            self.write_record(final=False)
+
+    # ------------------------------------------------------------- summary
+
+    def breakdown(self, at: float | None = None) -> dict:
+        """``{cause: seconds}`` over the full taxonomy up to ``at`` (now
+        by default); values sum to total wall-clock by construction."""
+        if self._t_start is None:
+            return {c: 0.0 for c in CAUSES}
+        end = self._now() if at is None else at
+        with self._lock:
+            intervals = list(self._intervals)
+            if self._t_init_open is not None:
+                # init never closed by a step span: synthesize the prefix
+                # up to the first recorded activity (whole window when
+                # nothing was recorded), as a low-priority fill so
+                # retroactive adds that reach back before the first
+                # activity still win their overlap
+                first = min((iv.t0 for iv in intervals), default=end)
+                stop = min(max(first, self._t_init_open), end)
+                if stop > self._t_init_open:
+                    intervals.append(
+                        _Interval(self._t_init_open, stop, "_init_fill")
+                    )
+        return attribute_intervals(intervals, self._t_start, end)
+
+    def wall_s(self, at: float | None = None) -> float:
+        if self._t_start is None:
+            return 0.0
+        return (self._now() if at is None else at) - self._t_start
+
+    def _publish_breakdown(self, buckets: dict) -> None:
+        total = sum(buckets.values())
+        if total > 0:
+            self._m_ratio.set(buckets[GOODPUT_CAUSE] / total)
+        for cause in BADPUT_CAUSES:
+            if buckets[cause] > 0:
+                # set_max: totals only accumulate, so a re-publish (or a
+                # sweep re-resolution shaving an overlap) never regresses
+                # the counter
+                self._m_badput.labels(cause=cause).set_max(buckets[cause])
+
+    def finalize(self, *, metrics: dict | None = None) -> dict:
+        """Close the ledger into a run record: compute the breakdown,
+        ASSERT conservation (buckets sum to total wall-clock, every
+        bucket non-negative), publish the final registry export, write
+        the record through when armed, and return it."""
+        if metrics is not None:
+            self.describe(metrics=metrics)
+        end = self._now()
+        buckets = self.breakdown(at=end)
+        total = self.wall_s(at=end)
+        attributed = sum(buckets.values())
+        if any(v < 0 for v in buckets.values()) or (
+            abs(attributed - total) > max(1e-6 * max(total, 1.0), 1e-9)
+        ):
+            raise AssertionError(
+                "goodput conservation violated: buckets sum to "
+                f"{attributed:.9f}s over a {total:.9f}s wall clock "
+                f"({json.dumps({k: round(v, 6) for k, v in buckets.items()})})"
+                " - an interval was attributed twice or clocks ran "
+                "backwards; this is a ledger bug, please report it"
+            )
+        if self._registry is not None:
+            self._publish_breakdown(buckets)
+        rec = self._record(buckets, total, final=True)
+        if self.path is not None:
+            _atomic_write_json(self.path, rec)
+        try:
+            from .obs import flight_event
+
+            flight_event(
+                "goodput_final",
+                goodput_ratio=rec["goodput_ratio"], wall_s=rec["wall_s"],
+            )
+        except Exception:
+            pass
+        return rec
+
+    def _record(self, buckets: dict, total: float, *, final: bool) -> dict:
+        return {
+            "version": RECORD_VERSION,
+            "kind": "rank",
+            "final": final,
+            "rank": self.rank,
+            "generation": self.generation,
+            "hostname": _hostname(),
+            "pid": os.getpid(),
+            "started_unix": self.started_unix,
+            "written_unix": time.time(),
+            "config_fingerprint": self.config_fingerprint,
+            "config": self.config,
+            "mesh": self.mesh,
+            "steps": self.steps,
+            "goodput_steps": self.goodput_steps,
+            "tokens": self.tokens,
+            "wall_s": round(total, 6),
+            "goodput_s": round(buckets[GOODPUT_CAUSE], 6),
+            "goodput_ratio": round(
+                buckets[GOODPUT_CAUSE] / total, 6
+            ) if total > 0 else None,
+            "badput_s": {
+                c: round(buckets[c], 6) for c in BADPUT_CAUSES
+            },
+            "metrics": self.metrics,
+        }
+
+    def write_record(self, *, final: bool = False) -> str | None:
+        """Atomically write the current record (partial unless ``final``)
+        to the armed path; never raises (full-disk rule)."""
+        if self.path is None or self._t_start is None:
+            return None
+        end = self._now()
+        try:
+            rec = self._record(self.breakdown(at=end),
+                               self.wall_s(at=end), final=final)
+            return _atomic_write_json(self.path, rec)
+        except Exception:
+            return None
+
+
+LEDGER = GoodputLedger()
+
+
+def ledger_interval(cause: str, **meta):
+    """The one-line call-site hook (mirrors `obs.flight_event`):
+    ``with ledger_interval("checkpoint_save"): ...`` on the process
+    ledger - a shared no-op when the ledger is disarmed."""
+    return LEDGER.interval(cause, **meta)
+
+
+# ---------------------------------------------------------------- records
+
+
+def config_fingerprint(config: dict) -> str:
+    """Stable sha256 over the sorted JSON form of a config dict - two
+    runs with the same fingerprint trained the same thing."""
+    blob = json.dumps(_json_safe(config), sort_keys=True,
+                      separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def read_record(path: str) -> dict:
+    """Load + validate one record (rank or fleet); raises ValueError with
+    an actionable message on schema problems."""
+    with open(path) as f:
+        doc = json.load(f)
+    return validate_record(doc, what=path)
+
+
+def validate_record(doc, what: str = "record") -> dict:
+    if not isinstance(doc, dict):
+        raise ValueError(f"{what}: not a JSON object")
+    ver = doc.get("version")
+    if not isinstance(ver, int):
+        raise ValueError(
+            f"{what}: missing integer 'version' - not a goodput run record"
+        )
+    if ver > RECORD_VERSION:
+        raise ValueError(
+            f"{what}: record version {ver} is newer than this build's "
+            f"{RECORD_VERSION} - read it with the build that wrote it"
+        )
+    if "badput_s" not in doc or "wall_s" not in doc:
+        raise ValueError(
+            f"{what}: missing badput_s/wall_s - not a goodput run record"
+        )
+    # forward compat inside a version: unknown badput causes are carried
+    # through untouched (rendered under their own name), never dropped
+    return doc
+
+
+def fleet_goodput_record(
+    records: list, *,
+    restart_gaps: list | None = None,
+    restart_generations=None,
+) -> dict:
+    """Aggregate per-rank records (+ supervisor-side restart gaps) into
+    one fleet-level record.
+
+    - ``records``: per-rank rank records (partial ones from SIGKILLed
+      workers included - their write-through accounting stands).
+    - ``restart_gaps``: ``[{"seconds", "group_size", ...}]`` - the
+      supervisor-measured death -> respawn windows, charged to
+      ``restart_gap`` x the relaunched group size (capacity-seconds in
+      which no worker existed - disjoint from every rank record).
+    - ``restart_generations``: generations launched BY a failure restart;
+      their ranks' ``init`` + ``compile`` seconds are reclassified into
+      ``restart_gap`` (re-rendezvous and recompile are restart cost, not
+      fresh-run startup) - together the bucket spans the issue-defined
+      window: worker death -> first post-restart step.
+
+    Conservation holds in capacity-seconds: fleet ``wall_s`` =
+    sum(rank walls) + sum(gap x size), and the buckets partition it.
+    """
+    restart_gens = set(restart_generations or ())
+    buckets = {c: 0.0 for c in CAUSES}
+    wall = 0.0
+    steps = goodput_steps = 0
+    tokens = 0.0
+    ranks = []
+    for rec in records:
+        rec = validate_record(rec)
+        bad = dict(rec.get("badput_s") or {})
+        reclassified = 0.0
+        if rec.get("generation") in restart_gens:
+            reclassified = float(bad.get("init", 0.0)) + float(
+                bad.get("compile", 0.0)
+            )
+            bad["restart_gap"] = bad.get("restart_gap", 0.0) + reclassified
+            bad["init"] = bad["compile"] = 0.0
+        for c, v in bad.items():
+            buckets[c] = buckets.get(c, 0.0) + float(v)
+        buckets[GOODPUT_CAUSE] += float(rec.get("goodput_s") or 0.0)
+        wall += float(rec.get("wall_s") or 0.0)
+        steps += int(rec.get("steps") or 0)
+        goodput_steps += int(rec.get("goodput_steps") or 0)
+        tokens += float(rec.get("tokens") or 0.0)
+        ranks.append({
+            "rank": rec.get("rank"),
+            "generation": rec.get("generation"),
+            "final": rec.get("final"),
+            "wall_s": rec.get("wall_s"),
+            "goodput_ratio": rec.get("goodput_ratio"),
+            "steps": rec.get("steps"),
+            "restart_reclassified_s": round(reclassified, 6),
+        })
+    gap_capacity = 0.0
+    for g in restart_gaps or ():
+        gap_capacity += float(g.get("seconds", 0.0)) * max(
+            int(g.get("group_size", 1)), 1
+        )
+    buckets["restart_gap"] += gap_capacity
+    wall += gap_capacity
+    return {
+        "version": RECORD_VERSION,
+        "kind": "fleet",
+        "final": all(r.get("final", False) for r in ranks) if ranks else False,
+        "written_unix": time.time(),
+        "n_records": len(ranks),
+        "restart_gaps": list(restart_gaps or ()),
+        "steps": steps,
+        "goodput_steps": goodput_steps,
+        "tokens": tokens,
+        "wall_s": round(wall, 6),
+        "goodput_s": round(buckets[GOODPUT_CAUSE], 6),
+        "goodput_ratio": round(buckets[GOODPUT_CAUSE] / wall, 6)
+        if wall > 0 else None,
+        "badput_s": {
+            c: round(v, 6) for c, v in buckets.items()
+            if c != GOODPUT_CAUSE
+        },
+        "ranks": ranks,
+    }
+
+
+# ------------------------------------------------------- trace derivation
+
+# span/cause mapping for the trace-derived breakdown: the same taxonomy
+# computed from a (merged) Chrome trace alone - tools/trace_summary.py
+# --goodput; cross-checked against the ledger record by tests
+_TRACE_SPAN_CAUSE = {
+    "train_step": None,  # compile/steady split below
+    "straggler": "stall",
+    "reshard": "reshard",
+    "data_loading": "data_wait",
+    "checkpoint_save": "checkpoint_save",
+}
+
+
+def breakdown_from_trace(doc: dict) -> dict:
+    """Derive the taxonomy breakdown from a Chrome trace document
+    (single-rank or `tools/trace_merge.py` merged).
+
+    Per pid (rank): ``train_step`` spans become compile (first span) /
+    steady intervals, ``straggler`` spans stall, ``reshard``/
+    ``data_loading``/``checkpoint_save`` their causes; the window is
+    [0, last event end] (the tracer's clock zero is tracer creation, so
+    the pre-first-step prefix is ``init``); uncovered time inside the
+    window is ``idle_other``. Multi-rank docs aggregate the per-rank
+    breakdowns (capacity-seconds, like the fleet record). Returns
+    ``{"wall_s", "goodput_ratio", "goodput_s", "badput_s", "per_rank"}``.
+    """
+    per_pid: dict = {}
+    for ev in doc.get("traceEvents", ()):
+        if ev.get("ph") != "X":
+            continue
+        name = ev.get("name")
+        if name not in _TRACE_SPAN_CAUSE:
+            continue
+        pid = ev.get("pid", 0)
+        t0 = float(ev.get("ts", 0.0)) / 1e6
+        t1 = t0 + float(ev.get("dur") or 0.0) / 1e6
+        per_pid.setdefault(pid, []).append((t0, t1, name))
+    buckets = {c: 0.0 for c in CAUSES}
+    wall = 0.0
+    per_rank = {}
+    for pid, spans in sorted(per_pid.items()):
+        spans.sort()
+        intervals = []
+        first_step = True
+        first_step_t0 = None
+        for t0, t1, name in spans:
+            cause = _TRACE_SPAN_CAUSE[name]
+            if cause is None:
+                cause = "compile" if first_step else GOODPUT_CAUSE
+                if first_step:
+                    first_step_t0 = t0
+                first_step = False
+            intervals.append(_Interval(t0, t1, cause))
+        if first_step_t0 is not None and first_step_t0 > 0:
+            intervals.append(_Interval(0.0, first_step_t0, "init"))
+        end = max(iv.t1 for iv in intervals)
+        b = attribute_intervals(intervals, 0.0, end)
+        per_rank[pid] = {
+            "wall_s": round(end, 6),
+            "goodput_ratio": round(b[GOODPUT_CAUSE] / end, 6)
+            if end > 0 else None,
+            "buckets": {c: round(v, 6) for c, v in b.items()},
+        }
+        for c, v in b.items():
+            buckets[c] += v
+        wall += end
+    return {
+        "kind": "trace",
+        "wall_s": round(wall, 6),
+        "goodput_s": round(buckets[GOODPUT_CAUSE], 6),
+        "goodput_ratio": round(buckets[GOODPUT_CAUSE] / wall, 6)
+        if wall > 0 else None,
+        "badput_s": {
+            c: round(v, 6) for c, v in buckets.items()
+            if c != GOODPUT_CAUSE
+        },
+        "per_rank": per_rank,
+    }
+
+
+# ------------------------------------------------------ rendering / gate
+
+
+def record_causes(rec: dict) -> dict:
+    """Full ``{cause: seconds}`` view of a record (goodput + badput,
+    unknown forward-compat causes preserved)."""
+    out = {c: 0.0 for c in CAUSES}
+    out[GOODPUT_CAUSE] = float(rec.get("goodput_s") or 0.0)
+    for c, v in (rec.get("badput_s") or {}).items():
+        out[c] = out.get(c, 0.0) + float(v)
+    return out
+
+
+def render_record(rec: dict, *, title: str | None = None) -> str:
+    """Human-readable breakdown table of one record (rank/fleet/trace)."""
+    causes = record_causes(rec)
+    total = float(rec.get("wall_s") or sum(causes.values()) or 0.0)
+    lines = []
+    head = title or f"Goodput breakdown ({rec.get('kind', 'rank')} record)"
+    lines.append(head)
+    ratio = rec.get("goodput_ratio")
+    meta = []
+    if ratio is not None:
+        meta.append(f"goodput {100.0 * ratio:.2f}%")
+    meta.append(f"wall {total:.2f}s")
+    if rec.get("steps"):
+        meta.append(f"{rec['steps']} step(s)")
+    if rec.get("tokens"):
+        meta.append(f"{rec['tokens']:,.0f} tokens")
+    if rec.get("final") is False:
+        meta.append("PARTIAL (write-through; the run did not finalize)")
+    lines.append("  " + ", ".join(meta))
+    lines.append(f"  {'cause':<20} {'seconds':>12} {'share':>8}")
+    order = [c for c in CAUSES if c in causes] + sorted(
+        c for c in causes if c not in CAUSES
+    )
+    for c in order:
+        v = causes[c]
+        if v <= 0 and c not in (GOODPUT_CAUSE, IDLE_CAUSE):
+            continue
+        share = v / total if total > 0 else 0.0
+        tag = "  <- goodput" if c == GOODPUT_CAUSE else ""
+        lines.append(f"  {c:<20} {v:>12.3f} {share:>7.2%}{tag}")
+    return "\n".join(lines)
+
+
+def diff_records(a: dict, b: dict, name_a: str = "A",
+                 name_b: str = "B") -> str:
+    """Side-by-side share comparison of two records."""
+    ca, cb = record_causes(a), record_causes(b)
+    ta = float(a.get("wall_s") or sum(ca.values()) or 0.0)
+    tb = float(b.get("wall_s") or sum(cb.values()) or 0.0)
+    lines = [
+        f"Goodput diff: {name_a} vs {name_b}",
+        f"  wall: {ta:.2f}s vs {tb:.2f}s; goodput ratio: "
+        f"{_fmt_ratio(a.get('goodput_ratio'))} vs "
+        f"{_fmt_ratio(b.get('goodput_ratio'))}",
+        f"  {'cause':<20} {name_a:>12} {name_b:>12} {'d-share':>9}",
+    ]
+    order = [c for c in CAUSES if c in ca or c in cb] + sorted(
+        set(list(ca) + list(cb)) - set(CAUSES)
+    )
+    for c in order:
+        va, vb = ca.get(c, 0.0), cb.get(c, 0.0)
+        if va <= 0 and vb <= 0:
+            continue
+        sa = va / ta if ta > 0 else 0.0
+        sb = vb / tb if tb > 0 else 0.0
+        lines.append(
+            f"  {c:<20} {va:>11.3f}s {vb:>11.3f}s {sb - sa:>+8.2%}"
+        )
+    return "\n".join(lines)
+
+
+def _fmt_ratio(r) -> str:
+    return f"{100.0 * r:.2f}%" if r is not None else "n/a"
+
+
+DEFAULT_RATIO_TOL = 0.10
+DEFAULT_SHARE_TOL = 0.10
+
+
+def check_record(
+    current: dict, baseline: dict, *,
+    ratio_tol: float | None = None,
+    share_tol: float | None = None,
+    cause_tols: dict | None = None,
+) -> list:
+    """The regression gate: compare a record against a checked-in
+    baseline in SHARES of wall-clock (so runs of different length and
+    hardware speed compare), returning a list of violation strings
+    (empty = pass).
+
+    - ``goodput_ratio`` may not DROP more than ``ratio_tol`` (absolute).
+    - each badput cause's share may not GROW more than its tolerance
+      (``cause_tols[cause]``, falling back to ``share_tol``); causes the
+      baseline never saw are held to the same tolerance from zero.
+
+    Tolerances resolve CLI > baseline-embedded ``check_tolerances``
+    block > defaults - so the committed baseline carries its own
+    contract, shardlint-manifest style.
+    """
+    embedded = baseline.get("check_tolerances") or {}
+    if ratio_tol is None:
+        ratio_tol = float(embedded.get("goodput_ratio", DEFAULT_RATIO_TOL))
+    if share_tol is None:
+        share_tol = float(embedded.get("share", DEFAULT_SHARE_TOL))
+    tols = dict(embedded.get("causes") or {})
+    tols.update(cause_tols or {})
+    for c in tols:
+        if c not in BADPUT_CAUSES:
+            raise ValueError(
+                f"unknown badput cause {c!r} in tolerances "
+                f"(known: {', '.join(BADPUT_CAUSES)})"
+            )
+    problems = []
+    r_cur = current.get("goodput_ratio")
+    r_base = baseline.get("goodput_ratio")
+    if r_base is not None:
+        if r_cur is None:
+            problems.append(
+                "goodput_ratio: absent from the current record "
+                f"(baseline {r_base:.4f})"
+            )
+        elif r_base - r_cur > ratio_tol:
+            problems.append(
+                f"goodput_ratio: {r_cur:.4f} dropped more than "
+                f"{ratio_tol:.3f} below the baseline {r_base:.4f}"
+            )
+    cc, cb = record_causes(current), record_causes(baseline)
+    t_cur = float(current.get("wall_s") or 0.0)
+    t_base = float(baseline.get("wall_s") or 0.0)
+    for c in sorted(set(list(cc) + list(cb))):
+        if c == GOODPUT_CAUSE:
+            continue
+        s_cur = cc.get(c, 0.0) / t_cur if t_cur > 0 else 0.0
+        s_base = cb.get(c, 0.0) / t_base if t_base > 0 else 0.0
+        tol = float(tols.get(c, share_tol))
+        if s_cur - s_base > tol:
+            problems.append(
+                f"badput '{c}': share {s_cur:.2%} grew more than "
+                f"{tol:.2%} over the baseline {s_base:.2%} "
+                f"({cc.get(c, 0.0):.3f}s of {t_cur:.3f}s)"
+            )
+    return problems
+
+
+# ----------------------------------------------------------------- helpers
+
+
+def _check_cause(cause: str) -> None:
+    if cause not in CAUSES or cause == IDLE_CAUSE:
+        raise ValueError(
+            f"unknown goodput cause {cause!r} (closed taxonomy: "
+            f"{', '.join(c for c in CAUSES if c != IDLE_CAUSE)}; "
+            f"{IDLE_CAUSE} is the computed residual)"
+        )
+
+
+def _hostname() -> str:
+    try:
+        return socket.gethostname()
+    except OSError:  # pragma: no cover - defensive
+        return "unknown"
+
+
+def _json_safe(x):
+    import math
+
+    if isinstance(x, float):
+        return x if math.isfinite(x) else None
+    if isinstance(x, dict):
+        return {str(k): _json_safe(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return [_json_safe(v) for v in x]
+    if isinstance(x, (str, int, bool)) or x is None:
+        return x
+    return repr(x)
+
+
+def _atomic_write_json(path: str, doc: dict) -> str | None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(doc, f, allow_nan=False)
+        os.replace(tmp, path)
+    except (OSError, ValueError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+    return path

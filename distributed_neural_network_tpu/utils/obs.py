@@ -55,6 +55,10 @@ from collections import deque
 # so every supervised worker's last-seconds event ring survives even a
 # SIGKILL (write-through) and lands in the postmortem bundle
 FLIGHT_ENV = "DNN_TPU_FLIGHT_FILE"
+# env var naming the per-worker goodput run record (the third supervisor-
+# exported write-through channel; `utils/goodput.py` owns the value -
+# re-exported here so the env-var surface reads in one place)
+RUN_RECORD_ENV = "DNN_TPU_RUN_RECORD"
 
 # default histogram bucket bounds (seconds) for step-time histograms:
 # spans 1 ms compiled CPU smoke steps to multi-minute fused spans

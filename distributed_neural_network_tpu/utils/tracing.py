@@ -226,13 +226,16 @@ class Tracer:
         with self._lock:
             return list(self._events)
 
-    def to_chrome(self, *, step_stats: "StepStats | None" = None) -> dict:
+    def to_chrome(self, *, step_stats: "StepStats | None" = None,
+                  goodput: dict | None = None) -> dict:
         """The Chrome trace-event document as a dict (sorted by ts).
 
         Perfetto/chrome://tracing load the ``traceEvents`` list; the
         ``stepStats`` key (ignored by viewers) embeds the StepStats summary
         so tools/trace_summary.py can report throughput/MFU from the trace
-        file alone.
+        file alone. ``goodput`` embeds the run's goodput record
+        (utils/goodput.py) the same way - `tools/trace_summary.py
+        --goodput` cross-checks its span-derived breakdown against it.
         """
         pid = os.getpid()
         pname = (
@@ -271,9 +274,12 @@ class Tracer:
         }
         if step_stats is not None:
             doc["stepStats"] = _finite_tree(step_stats.summary())
+        if goodput is not None:
+            doc["goodput"] = _finite_tree(goodput)
         return doc
 
-    def export(self, path: str, *, step_stats: "StepStats | None" = None) -> str:
+    def export(self, path: str, *, step_stats: "StepStats | None" = None,
+               goodput: dict | None = None) -> str:
         """Write strict Chrome trace-event JSON (never a bare NaN/Inf
         token - `allow_nan=False` with non-finite floats nulled first).
 
@@ -284,7 +290,7 @@ class Tracer:
         truncated half-JSON trace where a previous good one stood - the
         reader sees the old complete file or the new complete file,
         never a partial write."""
-        doc = self.to_chrome(step_stats=step_stats)
+        doc = self.to_chrome(step_stats=step_stats, goodput=goodput)
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)

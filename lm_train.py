@@ -175,6 +175,16 @@ def main() -> int:
                    help="append train/loss (+ val/loss on --eval-every) "
                    "series to this JSONL file - the reference's metric "
                    "channel (utils/metrics.py), shared with the CNN engine")
+    p.add_argument("--run-record", default=None, metavar="RECORD.json",
+                   help="write the goodput run record here (wall-clock "
+                   "efficiency accounting, utils/goodput.py: goodput "
+                   "ratio + per-cause badput seconds, config fingerprint, "
+                   "mesh, step/token counts; written through during the "
+                   "run so even a SIGKILL leaves the accounting on disk; "
+                   "render/diff/gate with tools/goodput.py). Defaults to "
+                   "the DNN_TPU_RUN_RECORD env the elastic supervisor "
+                   "exports; the breakdown is always printed as a "
+                   "GOODPUT line either way")
     p.add_argument("--trace-out", default=None, metavar="TRACE.json",
                    help="write a Chrome trace-event JSON of the run (one "
                    "train_step span per step, fenced - adds one scalar "
@@ -458,6 +468,17 @@ def main() -> int:
     if args.max_retries < 0:
         p.error(f"--max-retries must be >= 0, got {args.max_retries}")
 
+    # the goodput ledger's wall clock starts BEFORE the jax import and
+    # distributed rendezvous so the init bucket owns them honestly
+    # (utils/goodput.py; docs/OBSERVABILITY.md "Goodput accounting")
+    from distributed_neural_network_tpu.utils.goodput import (
+        LEDGER as G_LEDGER,
+    )
+
+    G_LEDGER.start()
+    if args.run_record:
+        G_LEDGER.arm(args.run_record)
+
     from distributed_neural_network_tpu.train.cli import (
         enable_compilation_cache,
         honor_platform_env,
@@ -694,6 +715,22 @@ def main() -> int:
     mesh_desc = "x".join(
         f"{k}{v}" for k, v in mesh.shape.items() if v > 1
     ) or "single"
+
+    # run-record identity: the config fingerprint hashes everything that
+    # shapes the training computation; output paths/ports are excluded so
+    # the same run in a different directory fingerprints identically
+    _volatile = {
+        "run_record", "metrics_port", "metrics_linger", "trace_out",
+        "profile_dir", "metrics_jsonl", "checkpoint_dir",
+        "compilation_cache_dir", "log_every",
+    }
+    G_LEDGER.describe(
+        config={k: v for k, v in sorted(vars(args).items())
+                if k not in _volatile},
+        mesh={"axes": {k: int(v) for k, v in mesh.shape.items()},
+              "devices": int(mesh.devices.size), "desc": mesh_desc,
+              "optimizer": args.optimizer},
+    )
 
     # live observability (utils/obs.py + train/monitor.py): the tracer,
     # preemption guard, and --metrics-port monitor exist BEFORE the
@@ -1068,6 +1105,15 @@ def main() -> int:
                 axis_size=n_sync, accum_steps=args.accum_steps,
             )
 
+    # telemetered = the traced wrapper (and with it the goodput ledger's
+    # per-step feed) is active; the bare fast path attributes coarsely at
+    # run end instead (fencing every step just to time it would change
+    # the run being accounted)
+    telemetered = (
+        stats is not None or monitor.server is not None
+        or monitor.heartbeat is not None
+    )
+
     def wrap_step(fn, first_step):
         """Span tracing + StepStats + live registry publishing around a
         compiled step (identity when all telemetry is off); re-applied
@@ -1076,8 +1122,7 @@ def main() -> int:
         as cache misses."""
         if monitor.recompiles is not None:
             monitor.recompiles.swap(fn)
-        if stats is None and monitor.server is None \
-                and monitor.heartbeat is None:
+        if not telemetered:
             return fn
         return lmtrain.make_traced_step(
             fn, tracer=tracer, step_stats=stats,
@@ -1152,6 +1197,8 @@ def main() -> int:
         if ck is not None:
             ck.close()
         run.stop()
+        G_LEDGER.finalize(metrics={"last_step": step0 - 1,
+                                   "nothing_to_do": True})
         monitor.close()
         return 0
     i = last_step = step0
@@ -1162,7 +1209,10 @@ def main() -> int:
         nonlocal params, mom, step, i
         if v is None or v.action in ("ok", "warn", "skip"):
             return False
-        rb = guard.rollback()  # raises GuardAbort when budget exhausted
+        # at_step sizes the ledger's rollback_recompute window (the
+        # replayed steps are lost progress being re-earned, not goodput);
+        # raises GuardAbort when the retry budget is exhausted
+        rb = guard.rollback(at_step=i)
         if rb is None and ck is not None:
             # no in-memory snapshot yet: fall back to the newest on-disk
             # checkpoint (same exact-resume contract)
@@ -1173,6 +1223,8 @@ def main() -> int:
             if restored is not None:
                 state, _meta, last = restored
                 rb = (last + 1, state)
+                if i > last + 1:
+                    G_LEDGER.mark_recompute(i - (last + 1))
                 print(f"(guard: no snapshot yet; restored the on-disk "
                       f"checkpoint at step {last})")
         if rb is None:
@@ -1254,8 +1306,10 @@ def main() -> int:
         if stream is not None:
             # refresh at EVERY step (including step0): on resume the
             # pre-loop batch is batch_at(0), not batch_at(step0), and a
-            # continuous run must see the same stream as a fresh one
-            tokens, targets = batch_at(i)
+            # continuous run must see the same stream as a fresh one.
+            # Host-side sampling blocks the dispatch - data_wait badput
+            with G_LEDGER.interval("data_wait"):
+                tokens, targets = batch_at(i)
         if takes_step:
             out = step(params, mom, tokens, targets, jnp.int32(i))
         else:
@@ -1332,6 +1386,21 @@ def main() -> int:
     from distributed_neural_network_tpu.utils.timers import hard_block
 
     hard_block(loss)  # value-fetch fence; block_until_ready no-ops on axon
+    if not telemetered and t0 is not None:
+        # coarse goodput attribution for the bare fast path: the first
+        # dispatch (incl. XLA compile) and the post-compile window, as a
+        # low-priority FILL so checkpoint saves recorded inside it keep
+        # their own bucket (utils/goodput.py fill_ending_now)
+        now_l, pc = G_LEDGER.now(), time.perf_counter()
+        G_LEDGER.add("compile", now_l - (pc - t_compile),
+                     now_l - (pc - t0))
+        G_LEDGER.fill_ending_now(
+            "steady_step", max(pc - t0 - eval_s, 0.0)
+        )
+        G_LEDGER.note_steps(
+            timed_steps,
+            tokens=float(args.batch_size * args.seq_len * timed_steps),
+        )
     if preempt is not None:
         preempt.uninstall()
     if hpipe is not None:
@@ -1401,12 +1470,23 @@ def main() -> int:
                 print(f"gen[{i}] prompt={row[:cut].tolist()} "
                       f"completion={row[cut:].tolist()}")
 
+    # goodput accounting close-out: finalize ASSERTS conservation (the
+    # taxonomy buckets + goodput partition total wall-clock), writes the
+    # run record through when armed, and updates the registry export
+    goodput_rec = G_LEDGER.finalize(metrics={
+        "final_loss": float(loss), "first_loss": first_loss,
+        "last_step": last_step, "preempted": preempted,
+        "tokens_per_s": round(tok_s),
+        "mfu_pct": round(mfu, 2) if mfu is not None else None,
+    })
+
     if stats is not None:
         stats.capture_memory(tracer)
         if args.step_stats:
             print(stats.report())
     if args.trace_out:
-        tracer.export(args.trace_out, step_stats=stats)
+        tracer.export(args.trace_out, step_stats=stats,
+                      goodput=goodput_rec)
         print(f"(Chrome trace written to {args.trace_out}; open in "
               "Perfetto / chrome://tracing, or summarize with "
               "tools/trace_summary.py)")
@@ -1424,6 +1504,15 @@ def main() -> int:
     )
     if guard is not None:
         print("(guard summary: " + json.dumps(guard.summary()) + ")")
+    print("GOODPUT " + json.dumps({
+        "goodput_ratio": goodput_rec["goodput_ratio"],
+        "wall_s": goodput_rec["wall_s"],
+        "goodput_s": goodput_rec["goodput_s"],
+        "badput_s": {k: v for k, v in goodput_rec["badput_s"].items()
+                     if v > 0},
+        "steps": goodput_rec["steps"],
+        "record": G_LEDGER.path,
+    }))
     print("SUMMARY " + json.dumps({
         "mesh": mesh_desc, "steps": args.steps, "start_step": step0,
         "last_step": last_step, "preempted": preempted,

@@ -1,0 +1,636 @@
+"""Goodput ledger: conservation, taxonomy attribution, records, gate.
+
+Covers the utils/goodput.py accounting layer end to end without training
+runs: interval sweep conservation (incl. concurrent publishers), the
+compile/steady/rollback step attribution, every instrumented feed site
+(checkpoint saves, watchdog stall episodes, guard rollbacks, the traced
+step wrapper), run-record schema round-trip + forward compatibility,
+SIGKILL survival of the write-through record, fleet aggregation with
+supervisor restart gaps, the trace-derived breakdown (cross-checked
+against tools/trace_summary.py's independent implementation AND the
+ledger's own record), and the tools/goodput.py render/--diff/--check CLI
+with its shardlint-style exit codes.
+"""
+
+import importlib.util
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from distributed_neural_network_tpu.utils import goodput as gp
+from distributed_neural_network_tpu.utils.goodput import (
+    BADPUT_CAUSES,
+    CAUSES,
+    GOODPUT_CAUSE,
+    GoodputLedger,
+    attribute_intervals,
+    breakdown_from_trace,
+    check_record,
+    config_fingerprint,
+    diff_records,
+    fleet_goodput_record,
+    read_record,
+    render_record,
+    validate_record,
+)
+from distributed_neural_network_tpu.utils.obs import MetricsRegistry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOODPUT_TOOL = os.path.join(REPO, "tools", "goodput.py")
+
+
+@pytest.fixture(autouse=True)
+def _clean_singleton():
+    """The module LEDGER is process-global (like obs.FLIGHT); tests that
+    arm it must not leak state into each other."""
+    gp.LEDGER.reset()
+    yield
+    gp.LEDGER.reset()
+
+
+def fake_ledger():
+    clk = [0.0]
+    led = GoodputLedger(clock=lambda: clk[0])
+    return led, clk
+
+
+def _total(buckets: dict) -> float:
+    return sum(buckets.values())
+
+
+# ------------------------------------------------------------ conservation
+
+
+def test_breakdown_partitions_wall_clock_exactly():
+    led, clk = fake_ledger()
+    led.start()
+    clk[0] = 2.0
+    led.step_span(0, 1.5)  # init [0, 0.5], compile [0.5, 2]
+    clk[0] = 3.0
+    led.step_span(1, 1.0)  # steady [2, 3]
+    clk[0] = 4.5
+    b = led.breakdown()
+    assert b["init"] == pytest.approx(0.5)
+    assert b["compile"] == pytest.approx(1.5)
+    assert b[GOODPUT_CAUSE] == pytest.approx(1.0)
+    assert b["idle_other"] == pytest.approx(1.5)
+    assert _total(b) == pytest.approx(4.5, abs=1e-9)
+    assert set(b) == set(CAUSES)
+
+
+def test_overlap_attributed_once_instrumented_beats_stall():
+    led, clk = fake_ledger()
+    led.start()
+    clk[0] = 1.0
+    led.step_span(0, 1.0, is_compile=True)  # [0, 1]
+    clk[0] = 3.0
+    led.step_span(1, 1.0)  # steady [2, 3]
+    # watchdog re-reports a growing stall episode overlapping the step:
+    # [1, 3] then [1, 3.5] - coalesces, and the step carves itself out
+    led.add_ending_now("stall", 2.0)
+    clk[0] = 3.5
+    led.add_ending_now("stall", 2.5)
+    clk[0] = 4.0
+    b = led.breakdown()
+    assert b["stall"] == pytest.approx(1.5)  # [1,2] + [3,3.5], not 4.5
+    assert b[GOODPUT_CAUSE] == pytest.approx(1.0)
+    assert b["idle_other"] == pytest.approx(0.5)
+    assert _total(b) == pytest.approx(4.0, abs=1e-9)
+
+
+def test_same_priority_overlap_goes_to_earlier_interval():
+    ivs = [gp._Interval(0.0, 10.0, GOODPUT_CAUSE),
+           gp._Interval(5.0, 15.0, "checkpoint_save")]
+    out = attribute_intervals(ivs, 0.0, 15.0)
+    assert out[GOODPUT_CAUSE] == pytest.approx(10.0)
+    assert out["checkpoint_save"] == pytest.approx(5.0)
+    assert _total(out) == pytest.approx(15.0, abs=1e-9)
+
+
+def test_intervals_clamped_to_window():
+    ivs = [gp._Interval(-5.0, 2.0, "compile"),
+           gp._Interval(8.0, 99.0, "stall")]
+    out = attribute_intervals(ivs, 0.0, 10.0)
+    assert out["compile"] == pytest.approx(2.0)
+    assert out["stall"] == pytest.approx(2.0)
+    assert _total(out) == pytest.approx(10.0, abs=1e-9)
+
+
+def test_conservation_under_concurrent_publishers():
+    """Threads hammering overlapping intervals + step spans must still
+    partition wall-clock exactly (the sweep resolves, never double
+    counts); finalize's conservation assert must hold."""
+    led = GoodputLedger()
+    led.start()
+    causes = ["checkpoint_save", "data_wait", "reshard", "stall"]
+
+    def worker(seed):
+        for k in range(120):
+            c = causes[(seed + k) % len(causes)]
+            led.add_ending_now(c, 0.0005 * ((seed + k) % 7 + 1))
+            if k % 10 == 0:
+                led.step_span(k, 0.0004)
+
+    threads = [threading.Thread(target=worker, args=(s,)) for s in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    rec = led.finalize()  # raises AssertionError on any double count
+    total = rec["goodput_s"] + sum(rec["badput_s"].values())
+    # the record rounds each bucket to 6 decimals - compare up to that
+    assert total == pytest.approx(rec["wall_s"], abs=1e-5)
+
+
+def test_finalize_detects_negative_wall():
+    led, clk = fake_ledger()
+    led.start()
+    clk[0] = -1.0  # clock ran backwards
+    with pytest.raises(AssertionError, match="conservation"):
+        led.finalize()
+
+
+# -------------------------------------------------- taxonomy attribution
+
+
+def test_rollback_recompute_window():
+    led, clk = fake_ledger()
+    led.start()
+    clk[0] = 1.0
+    led.step_span(0, 1.0)  # compile
+    for i in range(1, 4):
+        clk[0] = 1.0 + i
+        led.step_span(i, 1.0)
+    led.mark_recompute(2)
+    for i in range(2, 5):  # replay 2 and 3, then fresh 4
+        clk[0] = 3.0 + i
+        led.step_span(i, 1.0)
+    b = led.breakdown(at=clk[0])
+    assert b["rollback_recompute"] == pytest.approx(2.0)
+    assert b[GOODPUT_CAUSE] == pytest.approx(4.0)  # 3 fresh + 1 post-replay
+    assert led.goodput_steps == 4 and led.steps == 7
+
+
+def test_guard_rollback_feeds_recompute_window():
+    np = pytest.importorskip("numpy")
+    from distributed_neural_network_tpu.train.guard import (
+        GuardConfig,
+        TrainingGuard,
+    )
+
+    gp.LEDGER.start()
+    guard = TrainingGuard(
+        GuardConfig(policy="rollback"), log=lambda *_: None
+    )
+    guard.snapshot(10, {"x": np.zeros(2)})
+    step, _state = guard.rollback(at_step=14)
+    assert step == 10
+    assert gp.LEDGER._recompute_budget == 4
+    # the next 4 step spans are recompute, the 5th is goodput again
+    for i in range(5):
+        gp.LEDGER.step_span(10 + i, 0.01, is_compile=False)
+    b = gp.LEDGER.breakdown()
+    assert b["rollback_recompute"] > 0
+    assert gp.LEDGER.goodput_steps == 1
+
+
+def test_watchdog_stall_episode_lands_in_stall_bucket():
+    from distributed_neural_network_tpu.train.monitor import (
+        Watchdog,
+        WatchdogConfig,
+    )
+
+    gp.LEDGER.start()
+    reg = MetricsRegistry()
+    for i in range(5):  # fast steady beats to arm the detector
+        reg.beat(i)
+        time.sleep(0.01)
+    dog = Watchdog(
+        reg,
+        config=WatchdogConfig(
+            min_stall_s=0.05, stall_factor=1.5, warmup_beats=3
+        ),
+        log=lambda *_: None,
+    )
+    time.sleep(0.3)  # the "stall": no beats
+    raised = dog.check_once()
+    assert raised["stall"]
+    b = gp.LEDGER.breakdown()
+    assert b["stall"] > 0.2
+    # the bucket is the heartbeat gap, conservation intact
+    rec = gp.LEDGER.finalize()
+    assert rec["badput_s"]["stall"] > 0.2
+
+
+def test_checkpoint_save_interval_recorded(tmp_path):
+    np = pytest.importorskip("numpy")
+    from distributed_neural_network_tpu.utils.checkpoint import (
+        TreeCheckpointer,
+    )
+
+    gp.LEDGER.start()
+    ck = TreeCheckpointer(str(tmp_path / "ck"), backend="npz")
+    ck.save(0, {"x": np.arange(4.0)}, {"note": "t"})
+    ck.close()
+    assert gp.LEDGER.breakdown()["checkpoint_save"] > 0
+
+
+def test_traced_step_feeds_ledger():
+    from distributed_neural_network_tpu.train.lm import make_traced_step
+    from distributed_neural_network_tpu.utils.tracing import NULL_TRACER
+
+    led = GoodputLedger()
+    led.start()
+    calls = []
+    step = make_traced_step(
+        lambda *a: calls.append(a) or 0.0,
+        tracer=NULL_TRACER, fence=False, ledger=led,
+        items_per_step=32.0,
+    )
+    for _ in range(3):
+        step("x")
+    assert led.steps == 3 and led.goodput_steps == 2  # first = compile
+    assert led.tokens == pytest.approx(64.0)
+    at = led.now()
+    b = led.breakdown(at=at)
+    assert b["compile"] >= 0 and _total(b) == pytest.approx(
+        led.wall_s(at=at), abs=1e-6
+    )
+
+
+def test_fill_yields_to_instrumented_intervals():
+    led, clk = fake_ledger()
+    led.start()
+    clk[0] = 10.0
+    led.add("checkpoint_save", 4.0, 5.0)
+    led.fill_ending_now(GOODPUT_CAUSE, 8.0)  # [2, 10] coarse window
+    led.note_steps(7, tokens=70.0)
+    b = led.breakdown()
+    assert b["checkpoint_save"] == pytest.approx(1.0)
+    assert b[GOODPUT_CAUSE] == pytest.approx(7.0)
+    assert b["init"] == pytest.approx(2.0)  # open-init prefix synthesis
+    assert led.goodput_steps == 7 and led.tokens == pytest.approx(70.0)
+    with pytest.raises(ValueError, match="fill"):
+        led.fill_ending_now("stall", 1.0)
+
+
+def test_disarmed_ledger_is_a_noop_and_causes_are_closed():
+    led = GoodputLedger()
+    led.step_span(0, 1.0)
+    led.add_ending_now("stall", 1.0)
+    led.mark_recompute(3)
+    with led.interval("checkpoint_save"):
+        pass
+    assert led.steps == 0 and led.breakdown() == {c: 0.0 for c in CAUSES}
+    led.start()
+    with pytest.raises(ValueError, match="closed taxonomy"):
+        led.add_ending_now("gremlins", 1.0)
+    with pytest.raises(ValueError, match="residual"):
+        led.interval("idle_other")
+
+
+# ------------------------------------------------------------ run records
+
+
+def test_record_roundtrip_and_fingerprint(tmp_path):
+    led, clk = fake_ledger()
+    led.start()
+    led.describe(
+        config={"dp": 2, "steps": 8, "lr": 0.1},
+        mesh={"axes": {"data": 2}, "devices": 2},
+        metrics={"final_loss": 1.25},
+    )
+    clk[0] = 2.0
+    led.step_span(0, 1.0)
+    path = tmp_path / "rr.json"
+    led.path = str(path)  # direct arm (arm() would write immediately)
+    rec = led.finalize()
+    on_disk = read_record(str(path))
+    assert on_disk == json.loads(json.dumps(rec))  # strict-JSON stable
+    assert on_disk["version"] == gp.RECORD_VERSION
+    assert on_disk["final"] is True
+    assert on_disk["config_fingerprint"] == config_fingerprint(
+        {"dp": 2, "steps": 8, "lr": 0.1}
+    )
+    assert on_disk["metrics"]["final_loss"] == 1.25
+    assert on_disk["mesh"]["devices"] == 2
+    # fingerprint is order-insensitive and value-sensitive
+    assert config_fingerprint({"lr": 0.1, "steps": 8, "dp": 2}) == \
+        on_disk["config_fingerprint"]
+    assert config_fingerprint({"dp": 4, "steps": 8, "lr": 0.1}) != \
+        on_disk["config_fingerprint"]
+
+
+def test_record_schema_validation_and_forward_compat(tmp_path):
+    with pytest.raises(ValueError, match="not a goodput run record"):
+        validate_record({"hello": 1})
+    with pytest.raises(ValueError, match="newer"):
+        validate_record({"version": gp.RECORD_VERSION + 1,
+                         "badput_s": {}, "wall_s": 1.0})
+    # forward compat INSIDE a version: an unknown badput cause written by
+    # a newer build is preserved, rendered, and gated - never dropped
+    rec = {
+        "version": gp.RECORD_VERSION, "wall_s": 10.0, "goodput_s": 5.0,
+        "goodput_ratio": 0.5,
+        "badput_s": {"init": 1.0, "quantum_decoherence": 4.0},
+    }
+    assert validate_record(rec) is rec
+    assert "quantum_decoherence" in render_record(rec)
+    problems = check_record(rec, {**rec, "badput_s": {"init": 1.0},
+                                  "goodput_s": 9.0, "goodput_ratio": 0.9})
+    assert any("quantum_decoherence" in p for p in problems)
+
+
+def test_write_through_record_survives_sigkill(tmp_path):
+    """The armed ledger's partial record must already be on disk when the
+    process is SIGKILLed mid-run (the FlightRecorder contract)."""
+    script = f"""
+import os, signal, sys, time
+sys.path.insert(0, {REPO!r})
+from distributed_neural_network_tpu.utils.goodput import LEDGER
+LEDGER.start()
+LEDGER.arm(sys.argv[1], write_interval_s=0.0)
+LEDGER.step_span(0, 0.01, is_compile=True)
+LEDGER.step_span(1, 0.01)
+print("ARMED", flush=True)
+time.sleep(60)
+"""
+    path = tmp_path / "rr.json"
+    proc = subprocess.Popen(
+        [sys.executable, "-c", script, str(path)],
+        stdout=subprocess.PIPE, text=True,
+    )
+    try:
+        assert proc.stdout.readline().strip() == "ARMED"
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    rec = read_record(str(path))
+    assert rec["final"] is False  # write-through partial, by contract
+    assert rec["steps"] == 2
+    assert rec["goodput_s"] > 0
+
+
+def test_registry_export_ratio_and_badput():
+    led, clk = fake_ledger()
+    led.start()
+    reg = MetricsRegistry()
+    led.publish(reg)
+    clk[0] = 2.0
+    led.step_span(0, 1.0, is_compile=True)
+    clk[0] = 4.0
+    led.step_span(1, 1.0)
+    led.finalize()
+    ratio = reg.get("goodput_ratio").value
+    assert ratio == pytest.approx(0.25)  # 1s steady of 4s
+    bad = reg.get("badput_seconds_total")
+    assert bad.labels(cause="compile").value == pytest.approx(1.0)
+    assert bad.labels(cause="init").value == pytest.approx(1.0)
+
+
+# ------------------------------------------------------ fleet aggregation
+
+
+def _rank_record(**kw):
+    base = {
+        "version": gp.RECORD_VERSION, "kind": "rank", "final": True,
+        "rank": 0, "generation": 0, "wall_s": 10.0, "goodput_s": 6.0,
+        "goodput_ratio": 0.6, "steps": 10, "goodput_steps": 9,
+        "tokens": 900.0,
+        "badput_s": {"init": 1.0, "compile": 2.0, "idle_other": 1.0},
+    }
+    base.update(kw)
+    return base
+
+
+def test_fleet_record_conserves_capacity_seconds():
+    fleet = fleet_goodput_record(
+        [_rank_record(rank=0), _rank_record(rank=1)],
+        restart_gaps=[{"seconds": 3.0, "group_size": 2}],
+    )
+    assert fleet["kind"] == "fleet" and fleet["n_records"] == 2
+    assert fleet["wall_s"] == pytest.approx(26.0)  # 2x10 + 3x2
+    assert fleet["badput_s"]["restart_gap"] == pytest.approx(6.0)
+    total = fleet["goodput_s"] + sum(fleet["badput_s"].values())
+    assert total == pytest.approx(fleet["wall_s"], rel=1e-9)
+    assert fleet["goodput_ratio"] == pytest.approx(12.0 / 26.0, abs=1e-6)
+
+
+def test_fleet_reclassifies_restart_generation_startup():
+    """A failure-relaunched generation's init+compile is restart cost:
+    together with the supervisor-side death->respawn gap, the bucket
+    spans worker death -> first post-restart step."""
+    fleet = fleet_goodput_record(
+        [_rank_record(rank=0, generation=0),
+         _rank_record(rank=0, generation=1)],
+        restart_gaps=[{"seconds": 2.0, "group_size": 1, "generation": 1}],
+        restart_generations={1},
+    )
+    # gen1's init (1.0) + compile (2.0) moved into restart_gap + gap 2.0
+    assert fleet["badput_s"]["restart_gap"] == pytest.approx(5.0)
+    assert fleet["badput_s"]["init"] == pytest.approx(1.0)  # gen0 only
+    assert fleet["badput_s"]["compile"] == pytest.approx(2.0)
+    total = fleet["goodput_s"] + sum(fleet["badput_s"].values())
+    assert total == pytest.approx(fleet["wall_s"], rel=1e-9)
+    gen1 = [r for r in fleet["ranks"] if r["generation"] == 1][0]
+    assert gen1["restart_reclassified_s"] == pytest.approx(3.0)
+
+
+# ------------------------------------------------------- trace derivation
+
+
+def _trace_doc():
+    us = 1_000_000
+    evs = []
+
+    def span(pid, name, t0_s, dur_s, **args):
+        evs.append({"name": name, "ph": "X", "ts": t0_s * us,
+                    "dur": dur_s * us, "pid": pid, "tid": 0, "args": args})
+
+    span(0, "train_step", 2.0, 1.0, step=0)   # init [0,2], compile [2,3]
+    span(0, "data_loading", 3.0, 0.5)
+    span(0, "train_step", 3.5, 1.0, step=1)   # steady [3.5,4.5]
+    span(0, "straggler", 4.25, 1.0)           # stall, step wins overlap
+    span(0, "train_step", 5.5, 1.0, step=2)
+    span(0, "checkpoint_save", 6.5, 0.5)
+    span(1, "train_step", 1.0, 2.0, step=0)   # rank 1: init 1, compile 2
+    span(1, "reshard", 3.0, 1.0)
+    return {"traceEvents": evs, "otherData": {}}
+
+
+def test_breakdown_from_trace_taxonomy():
+    out = breakdown_from_trace(_trace_doc())
+    r0 = out["per_rank"][0]["buckets"]
+    assert r0["init"] == pytest.approx(2.0)
+    assert r0["compile"] == pytest.approx(1.0)
+    assert r0["data_wait"] == pytest.approx(0.5)
+    assert r0[GOODPUT_CAUSE] == pytest.approx(2.0)
+    assert r0["stall"] == pytest.approx(0.75)  # step carved [4.25,4.5] out
+    assert r0["checkpoint_save"] == pytest.approx(0.5)
+    r1 = out["per_rank"][1]["buckets"]
+    assert r1["reshard"] == pytest.approx(1.0)
+    assert out["wall_s"] == pytest.approx(7.0 + 4.0)
+    total = out["goodput_s"] + sum(out["badput_s"].values())
+    assert total == pytest.approx(out["wall_s"], rel=1e-9)
+
+
+def test_trace_summary_goodput_matches_utils_implementation():
+    """tools/trace_summary.py keeps its own repo-import-free derivation
+    (the live_top convention); the two implementations must agree."""
+    spec = importlib.util.spec_from_file_location(
+        "trace_summary", os.path.join(REPO, "tools", "trace_summary.py")
+    )
+    ts = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ts)
+    doc = _trace_doc()
+    ours = breakdown_from_trace(doc)
+    theirs = ts.goodput_from_trace(doc)
+    assert theirs["wall_s"] == pytest.approx(ours["wall_s"])
+    assert theirs["goodput_ratio"] == pytest.approx(ours["goodput_ratio"])
+    for cause, v in ours["badput_s"].items():
+        assert theirs["badput_s"][cause] == pytest.approx(v), cause
+
+
+def test_trace_derivation_agrees_with_ledger_within_tolerance():
+    """The same run accounted twice - per-step ledger spans AND tracer
+    spans - must agree on the breakdown within tolerance (the
+    trace_summary --goodput cross-check contract)."""
+    from distributed_neural_network_tpu.utils import tracing as tr
+
+    led = GoodputLedger()
+    tracer = tr.Tracer()
+    led.start()
+    for i in range(4):
+        t0 = time.perf_counter()
+        with tracer.span("train_step", track="train", step=i):
+            time.sleep(0.02)
+        led.step_span(i, time.perf_counter() - t0)
+    time.sleep(0.03)  # trailing idle both sides
+    rec = led.finalize()
+    derived = breakdown_from_trace(tracer.to_chrome())
+    # the tracer's clock zero is tracer creation; the ledger's is start()
+    # - both ~now, so wall and buckets line up within a loose tolerance
+    assert derived["goodput_s"] == pytest.approx(
+        rec["goodput_s"], rel=0.35, abs=0.02
+    )
+    assert derived["badput_s"]["compile"] == pytest.approx(
+        rec["badput_s"]["compile"], rel=0.35, abs=0.02
+    )
+
+
+# ------------------------------------------------------------ gate + CLI
+
+
+def test_check_record_tolerance_edges():
+    base = _rank_record()
+    # identical -> clean
+    assert check_record(_rank_record(), base) == []
+    # ratio drop within tol -> clean; beyond -> violation
+    ok = _rank_record(goodput_ratio=0.55)
+    assert check_record(ok, base, ratio_tol=0.10) == []
+    bad = _rank_record(goodput_ratio=0.40)
+    probs = check_record(bad, base, ratio_tol=0.10)
+    assert len(probs) == 1 and "goodput_ratio" in probs[0]
+    # per-cause share growth: default tol passes, tight per-cause fails
+    grew = _rank_record(
+        badput_s={"init": 1.0, "compile": 2.0, "stall": 1.5}
+    )
+    assert check_record(grew, base, share_tol=0.20) == []
+    probs = check_record(grew, base, share_tol=0.20,
+                         cause_tols={"stall": 0.10})
+    assert len(probs) == 1 and "stall" in probs[0]
+    # baseline-embedded tolerances are the default contract
+    embedded = dict(base)
+    embedded["check_tolerances"] = {"goodput_ratio": 0.05,
+                                    "causes": {"stall": 0.05}}
+    # a drop equal to the tolerance is the edge: NOT a violation
+    assert check_record(ok, embedded) == []
+    assert check_record(_rank_record(goodput_ratio=0.50), embedded)
+    assert any("stall" in p for p in check_record(grew, embedded))
+    with pytest.raises(ValueError, match="unknown badput cause"):
+        check_record(base, base, cause_tols={"naptime": 0.1})
+    # the diff view names the moved cause with its share delta
+    out = diff_records(base, grew, "before", "after")
+    assert "stall" in out and "d-share" in out
+
+
+def _run_tool(*argv):
+    return subprocess.run(
+        [sys.executable, GOODPUT_TOOL, *argv],
+        capture_output=True, text=True, timeout=120,
+    )
+
+
+def test_cli_render_diff_check_exit_codes(tmp_path):
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(json.dumps(_rank_record()))
+    b.write_text(json.dumps(_rank_record(
+        goodput_s=3.0, goodput_ratio=0.3,
+        badput_s={"init": 1.0, "compile": 2.0, "stall": 4.0},
+    )))
+    r = _run_tool(str(a))
+    assert r.returncode == 0 and "steady_step" in r.stdout
+    assert "<- goodput" in r.stdout
+    r = _run_tool("--diff", str(a), str(b))
+    assert r.returncode == 0 and "stall" in r.stdout
+    assert "d-share" in r.stdout
+    # gate: clean pass
+    r = _run_tool("--check", str(a), "--baseline", str(a))
+    assert r.returncode == 0 and "goodput check OK" in r.stdout
+    # gate: injected regression -> rc 1 with the cause named
+    r = _run_tool("--check", str(b), "--baseline", str(a),
+                  "--tol", "stall=0.05")
+    assert r.returncode == 1
+    assert "GOODPUT CHECK FAILED" in r.stdout and "stall" in r.stdout
+    # usage errors -> rc 2 (shardlint convention)
+    assert _run_tool().returncode == 2
+    assert _run_tool("--check", str(a)).returncode == 2
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert _run_tool(str(bad)).returncode == 2
+    assert _run_tool("--check", str(a), "--baseline", str(a),
+                     "--tol", "naptime=0.1").returncode == 2
+    assert _run_tool(str(tmp_path / "missing.json")).returncode == 2
+
+
+def test_cli_renders_trace_input_with_embedded_record(tmp_path):
+    from distributed_neural_network_tpu.utils import tracing as tr
+
+    led = GoodputLedger()
+    led.start()
+    tracer = tr.Tracer()
+    with tracer.span("train_step", track="train", step=0):
+        time.sleep(0.01)
+    led.step_span(0, 0.01)
+    rec = led.finalize()
+    path = tmp_path / "trace.json"
+    tracer.export(str(path), goodput=rec)
+    r = _run_tool(str(path))
+    assert r.returncode == 0
+    assert "Embedded ledger record" in r.stdout
+
+
+def test_committed_baseline_is_valid_and_self_consistent():
+    """The checked-in CI baseline must parse, validate, and pass a
+    self-check (a broken baseline would wave every regression through
+    as an input error)."""
+    base = read_record(os.path.join(REPO, "tools", "goodput_baseline.json"))
+    assert base["version"] == gp.RECORD_VERSION
+    assert base.get("check_tolerances"), "baseline must pin tolerances"
+    assert check_record(base, base) == []
+    for cause in base["badput_s"]:
+        assert cause in BADPUT_CAUSES
+    r = _run_tool("--check",
+                  os.path.join(REPO, "tools", "goodput_baseline.json"),
+                  "--baseline",
+                  os.path.join(REPO, "tools", "goodput_baseline.json"))
+    assert r.returncode == 0

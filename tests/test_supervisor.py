@@ -58,6 +58,18 @@ me = spec.get(f"g{gen}", {}).get(str(rank)) or \
 signal.signal(signal.SIGTERM,
               lambda s, f: sys.exit(me.get("term_rc", 0)))
 
+# goodput run record (utils/goodput.py schema): written up front like the
+# real ledger's write-through, so even a killed worker leaves one for the
+# supervisor's fleet aggregation
+rr = os.environ.get("DNN_TPU_RUN_RECORD")
+if rr and not me.get("no_record"):
+    with open(rr, "w") as f:
+        json.dump({"version": 1, "kind": "rank", "final": True,
+                   "rank": rank, "generation": gen, "wall_s": 1.0,
+                   "goodput_s": 0.6, "goodput_ratio": 0.6, "steps": 3,
+                   "goodput_steps": 2, "tokens": 48.0,
+                   "badput_s": {"init": 0.2, "compile": 0.2}}, f)
+
 def beat(step, beat_unix):
     tmp = hb_path + ".tmp"
     with open(tmp, "w") as f:
@@ -447,6 +459,122 @@ def test_live_top_renders_supervisor_metrics(tmp_path):
     assert "SIGKILL=1" in frame
     assert "shrink=1" in frame
     assert "restart p95<=0.5" in frame
+
+
+# ------------------------------------------------------------- goodput
+
+
+def test_stale_run_dir_sweep(tmp_path):
+    """A reused run dir's previous-run heartbeat/flight/record/postmortem
+    files are swept at supervisor start (mirroring the checkpointers'
+    stale step_*.tmp sweep), so a relaunch can never read a dead run's
+    liveness or crash state. Logs are kept."""
+    run = tmp_path / "run"
+    for sub in ("hb", "flight", "records", "logs"):
+        (run / sub).mkdir(parents=True)
+    stale = [
+        run / "hb" / "gen0_rank0.json",
+        run / "flight" / "gen0_rank1.json",
+        run / "records" / "gen0_rank0.json",
+        run / "postmortem.json",
+        run / "run_record.json",
+    ]
+    for p in stale:
+        p.write_text("{}")
+    keep = run / "logs" / "gen0_rank0.log"
+    keep.write_text("old log\n")
+    logs = []
+    Supervisor(
+        ["true"], _fast_cfg(), run_dir=str(run),
+        registry=MetricsRegistry(),
+        log=lambda *a: logs.append(" ".join(str(x) for x in a)),
+    )
+    for p in stale:
+        assert not p.exists(), p
+    assert keep.exists()
+    assert any("swept 5 stale" in ln for ln in logs)
+
+
+def test_fleet_goodput_aggregation_and_restart_gap(tmp_path):
+    """Workers' run records (written via the exported DNN_TPU_RUN_RECORD)
+    aggregate into one fleet record: restart_gap covers the supervisor-
+    measured death->respawn window PLUS the relaunched generation's
+    reclassified init+compile, the registry exports goodput_ratio /
+    badput_seconds_total, and the fleet record lands in run_record.json
+    and the SUPERVISOR_SUMMARY line."""
+    reg = MetricsRegistry()
+    spec = {
+        "g0": {"1": {"fail_at": 1, "rc": 1, "steps": 50},
+               "*": {"steps": 1000, "dt": 0.02}},
+        "g1": {"*": {"steps": 3}},
+    }
+    rc, summary, logs, sup, out = _supervise(
+        tmp_path, spec, _fast_cfg(nprocs=2), registry=reg,
+    )
+    assert rc == 0 and summary["restarts"] == 1
+    # per-worker records were exported and collected (both generations)
+    rec_dir = tmp_path / "run" / "records"
+    names = sorted(os.listdir(rec_dir))
+    assert "gen0_rank0.json" in names and "gen1_rank0.json" in names
+    fleet = sup.fleet_goodput
+    assert fleet is not None and fleet["kind"] == "fleet"
+    # the supervisor-side gap (death -> respawn) is in capacity-seconds,
+    # and gen 1 (a failure restart) had its init+compile reclassified
+    assert sup.restart_generations == {1}
+    gap = sup.restart_gaps[0]
+    assert gap["seconds"] > 0 and gap["generation"] == 1
+    assert fleet["badput_s"]["restart_gap"] >= gap["seconds"] + 0.4 - 1e-6
+    total = fleet["goodput_s"] + sum(fleet["badput_s"].values())
+    assert total == pytest.approx(fleet["wall_s"], rel=1e-6)
+    # registry export + summary embed + on-disk fleet record
+    assert 0 < reg.get("goodput_ratio").value < 1
+    assert reg.get("badput_seconds_total").labels(
+        cause="restart_gap"
+    ).value > 0
+    assert summary["goodput"]["goodput_ratio"] == fleet["goodput_ratio"]
+    from distributed_neural_network_tpu.utils.goodput import read_record
+
+    on_disk = read_record(str(tmp_path / "run" / "run_record.json"))
+    assert on_disk["kind"] == "fleet"
+    assert on_disk["badput_s"]["restart_gap"] == pytest.approx(
+        fleet["badput_s"]["restart_gap"], rel=0.5
+    )
+
+
+def test_postmortem_carries_goodput_block(tmp_path):
+    spec = {
+        "g0": {"1": {"fail_at": 1, "rc": 1, "steps": 50},
+               "*": {"steps": 1000, "dt": 0.02}},
+        "g1": {"*": {"steps": 3}},
+    }
+    rc, summary, logs, sup, out = _supervise(
+        tmp_path, spec, _fast_cfg(nprocs=2), registry=MetricsRegistry(),
+    )
+    with open(tmp_path / "run" / "postmortem.json") as f:
+        pm = json.load(f)
+    assert pm["goodput"] is not None
+    assert pm["goodput"]["kind"] == "fleet"
+    # the postmortem's aggregation already includes the dead worker's
+    # write-through record (gen 0 both ranks)
+    gens = {r["generation"] for r in pm["goodput"]["ranks"]}
+    assert 0 in gens
+
+
+def test_live_top_renders_goodput_line():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import live_top
+
+    reg = MetricsRegistry()
+    reg.gauge("goodput_ratio").set(0.42)
+    bad = reg.counter("badput_seconds_total")
+    bad.labels(cause="restart_gap").inc(12.0)
+    bad.labels(cause="stall").inc(3.5)
+    snap = {"metrics": live_top.parse_prometheus(reg.render()),
+            "health": None, "loss_history": [], "source": "test"}
+    frame = live_top.render(snap, color=False)
+    assert "goodput      42.0%" in frame
+    assert "restart_gap=12.0s" in frame
+    assert "stall=3.5s" in frame
 
 
 # ------------------------------------------------------------ launch CLI

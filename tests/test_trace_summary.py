@@ -290,3 +290,36 @@ def test_diff_without_stepstats_embeds_falls_back_to_spans(tmp_path):
 def test_plain_usage_without_trace_arg_errors(tmp_path):
     proc = _run_tool()
     assert proc.returncode != 0
+
+
+def test_goodput_view_derives_taxonomy_and_cross_checks_embed(tmp_path):
+    """--goodput derives the wall-clock taxonomy from spans alone
+    (train_step -> compile/steady, straggler -> stall) and prints the
+    cross-check against the ledger record embedded by the exporter."""
+    import time as _time
+
+    from distributed_neural_network_tpu.utils.goodput import GoodputLedger
+
+    led = GoodputLedger()
+    led.start()
+    tracer = tr.Tracer()
+    for i in range(3):
+        t0 = _time.perf_counter()
+        with tracer.span("train_step", track="train", step=i):
+            _time.sleep(0.01)
+        led.step_span(i, _time.perf_counter() - t0)
+    with tracer.span("straggler", track="train"):
+        _time.sleep(0.02)
+    led.add_ending_now("stall", 0.02)
+    rec = led.finalize()
+    path = str(tmp_path / "trace.json")
+    tracer.export(path, goodput=rec)
+    proc = _run_tool(path, "--goodput")
+    assert proc.returncode == 0, proc.stderr
+    out = proc.stdout
+    assert "Goodput (derived from trace spans):" in out
+    assert "steady_step" in out and "<- goodput" in out
+    assert "stall" in out
+    assert "ledger record embed" in out  # the cross-check line
+    # without the flag the section is absent (opt-in view)
+    assert "Goodput (derived" not in _run_tool(path).stdout

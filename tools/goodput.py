@@ -1,0 +1,171 @@
+#!/usr/bin/env python
+"""Render, diff, and gate goodput run records (utils/goodput.py).
+
+The training entry points (and the elastic supervisor, fleet-wide) emit
+schema-versioned run records: total wall-clock partitioned into goodput
+(steady training steps) and a closed badput taxonomy (init, compile,
+data_wait, checkpoint_save, reshard, rollback_recompute, stall,
+restart_gap, idle_other). This tool is the operator/CI surface:
+
+  # render the breakdown table (run record, fleet record, or a Chrome
+  # trace - merged traces work; trace input derives the same taxonomy
+  # from the spans alone)
+  python tools/goodput.py run_record.json
+  python tools/goodput.py merged_trace.json
+
+  # side-by-side share comparison of two runs
+  python tools/goodput.py --diff before.json after.json
+
+  # CI regression gate against a checked-in baseline (shardlint-style
+  # exit codes: 0 = within tolerances, 1 = regression, 2 = usage/input
+  # error). Tolerances are SHARES of wall-clock, so runs of different
+  # length/hardware compare; they resolve CLI > baseline-embedded
+  # `check_tolerances` block > defaults.
+  python tools/goodput.py --check run_record.json \
+      --baseline tools/goodput_baseline.json \
+      [--ratio-tol 0.1] [--share-tol 0.1] [--tol stall=0.05 ...]
+
+Semantics: docs/OBSERVABILITY.md "Goodput accounting".
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from distributed_neural_network_tpu.utils.goodput import (  # noqa: E402
+    BADPUT_CAUSES,
+    breakdown_from_trace,
+    check_record,
+    diff_records,
+    render_record,
+    validate_record,
+)
+
+
+def load_input(path: str) -> dict:
+    """Load a run record OR a Chrome trace (auto-detected: a doc with
+    ``traceEvents`` is a trace and the taxonomy is derived from its
+    spans; anything else must validate as a run record)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except OSError as e:
+        raise ValueError(f"{path}: {e}")
+    except json.JSONDecodeError as e:
+        raise ValueError(f"{path}: not valid JSON ({e})")
+    if isinstance(doc, dict) and "traceEvents" in doc:
+        derived = breakdown_from_trace(doc)
+        derived["source"] = "trace"
+        # a trace exported by a ledger-armed run embeds the authoritative
+        # record; keep it alongside for the cross-check view
+        if isinstance(doc.get("goodput"), dict):
+            derived["embedded_record"] = doc["goodput"]
+        return derived
+    return validate_record(doc, what=path)
+
+
+def _parse_cause_tols(pairs) -> dict:
+    out = {}
+    for pair in pairs or ():
+        if "=" not in pair:
+            raise ValueError(
+                f"--tol wants cause=share, got {pair!r} "
+                f"(causes: {', '.join(BADPUT_CAUSES)})"
+            )
+        cause, val = pair.split("=", 1)
+        try:
+            out[cause.strip()] = float(val)
+        except ValueError:
+            raise ValueError(f"--tol {pair!r}: {val!r} is not a number")
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("record", nargs="?",
+                   help="run record / fleet record / Chrome trace JSON")
+    p.add_argument("--diff", nargs=2, metavar=("A", "B"),
+                   help="compare two records (or traces) side by side")
+    p.add_argument("--check", metavar="RECORD",
+                   help="gate RECORD against --baseline; exit 1 on "
+                   "regression")
+    p.add_argument("--baseline", metavar="BASELINE.json",
+                   help="the checked-in baseline record for --check "
+                   "(may embed a check_tolerances block)")
+    p.add_argument("--ratio-tol", type=float, default=None,
+                   help="max allowed absolute DROP of goodput_ratio vs "
+                   "the baseline (default: baseline-embedded, else 0.10)")
+    p.add_argument("--share-tol", type=float, default=None,
+                   help="max allowed absolute GROWTH of any badput "
+                   "cause's wall-clock share (default: baseline-"
+                   "embedded, else 0.10)")
+    p.add_argument("--tol", action="append", metavar="CAUSE=SHARE",
+                   help="per-cause share tolerance override "
+                   "(repeatable), e.g. --tol stall=0.05")
+    args = p.parse_args(argv)
+
+    modes = sum(bool(x) for x in (args.record, args.diff, args.check))
+    if modes != 1:
+        p.print_usage(sys.stderr)
+        print("goodput: give exactly one of RECORD, --diff A B, or "
+              "--check RECORD --baseline BASE", file=sys.stderr)
+        return 2
+
+    try:
+        if args.diff:
+            a, b = (load_input(x) for x in args.diff)
+            print(diff_records(a, b, os.path.basename(args.diff[0]),
+                               os.path.basename(args.diff[1])))
+            return 0
+        if args.check:
+            if not args.baseline:
+                print("goodput: --check requires --baseline", file=sys.stderr)
+                return 2
+            current = load_input(args.check)
+            baseline = load_input(args.baseline)
+            problems = check_record(
+                current, baseline,
+                ratio_tol=args.ratio_tol, share_tol=args.share_tol,
+                cause_tols=_parse_cause_tols(args.tol),
+            )
+            print(render_record(
+                current, title=f"Goodput check: {args.check} vs "
+                f"baseline {args.baseline}"
+            ))
+            if problems:
+                print(f"\nGOODPUT CHECK FAILED ({len(problems)} "
+                      "regression(s)):")
+                for prob in problems:
+                    print(f"  - {prob}")
+                print("\nIf the regression is intended (new workload "
+                      "shape), regenerate the baseline record and commit "
+                      "it with the change that moved the breakdown.")
+                return 1
+            print("\ngoodput check OK (within tolerances)")
+            return 0
+        rec = load_input(args.record)
+        print(render_record(rec))
+        if rec.get("embedded_record"):
+            print()
+            print(render_record(
+                rec["embedded_record"],
+                title="Embedded ledger record (authoritative; table "
+                "above is span-derived)",
+            ))
+        return 0
+    except ValueError as e:
+        print(f"goodput: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -423,6 +423,26 @@ def render(snap: dict, *, color: bool = True, width: int = 72) -> str:
     if stall or storm or stale:
         dog = c(RED, dog)
     lines.append(dog)
+    # goodput accounting (utils/goodput.py; published by a worker's own
+    # ledger or the supervisor's fleet aggregation): what fraction of
+    # wall-clock produced training progress, and where the rest went
+    gp = metric_value(m, "goodput_ratio")
+    if gp is not None:
+        badput = m.get("badput_seconds_total") or {}
+        top = sorted(
+            ((dict(k).get("cause", "?"), v) for k, v in badput.items()
+             if v > 0),
+            key=lambda kv: -kv[1],
+        )[:4]
+        gp_line = f"goodput     {100.0 * gp:5.1f}%"
+        if top:
+            gp_line += "  badput: " + "  ".join(
+                f"{cause}={v:.1f}s" for cause, v in top
+            )
+        # color by ratio: the fleet's headline number reads at a glance
+        gp_line = c(GREEN if gp >= 0.8 else YELLOW if gp >= 0.5 else RED,
+                    gp_line)
+        lines.append(gp_line)
     # elastic supervisor (train/supervisor.py; present when the target is
     # a tools/launch.py --metrics-port endpoint)
     gsz = metric_value(m, "supervisor_group_size")

@@ -19,6 +19,8 @@ Usage:
   python tools/trace_summary.py --diff end.json overlap.json
   python tools/trace_summary.py merged.json --rank 1   # one rank of a
                                                        # trace_merge doc
+  python tools/trace_summary.py merged.json --goodput  # wall-clock
+                                                       # taxonomy view
 
 Multi-rank traces (per-rank shards merged by `tools/trace_merge.py`, or
 any rank-stamped trace) are detected from their ``rank{N}`` process
@@ -535,6 +537,155 @@ def lint_against_manifest(
     return lines, ok
 
 
+# ------------------------------------------------------------- goodput view
+
+# span -> taxonomy cause (None = train_step: first span per rank is
+# compile, the rest steady_step). Mirrors utils/goodput.py's trace
+# derivation - this module stays repo-import-free by design (like
+# tools/live_top.py's prometheus parser), and tests cross-check the two
+# implementations against each other AND against the ledger record.
+GOODPUT_SPAN_CAUSE = {
+    "train_step": None,
+    "straggler": "stall",
+    "reshard": "reshard",
+    "data_loading": "data_wait",
+    "checkpoint_save": "checkpoint_save",
+}
+GOODPUT_CAUSES = (
+    "init", "compile", "steady_step", "data_wait", "checkpoint_save",
+    "reshard", "rollback_recompute", "stall", "restart_gap", "idle_other",
+)
+# overlap priority (lower wins): instrumented spans beat the coarse
+# stall window; the residual is idle_other
+_GOODPUT_PRIO = {c: 0 for c in GOODPUT_CAUSES}
+_GOODPUT_PRIO["stall"] = 1
+_GOODPUT_PRIO["restart_gap"] = 1
+
+
+def _goodput_sweep(intervals, end: float) -> dict:
+    """Attribute [0, end] over (t0, t1, cause) intervals, each second
+    exactly once (priority, then earliest interval wins overlaps)."""
+    import heapq
+
+    out = {c: 0.0 for c in GOODPUT_CAUSES}
+    ivs = sorted(
+        (max(t0, 0.0), min(t1, end), cause, seq)
+        for seq, (t0, t1, cause) in enumerate(intervals)
+        if t1 > 0.0 and t0 < end and t1 > t0
+    )
+    heap: list = []
+    t, i, n = 0.0, 0, len(ivs)
+    while t < end:
+        while i < n and ivs[i][0] <= t:
+            t0, t1, cause, seq = ivs[i]
+            if t1 > t:
+                heapq.heappush(
+                    heap, (_GOODPUT_PRIO.get(cause, 0), t0, seq, t1, cause)
+                )
+            i += 1
+        while heap and heap[0][3] <= t:
+            heapq.heappop(heap)
+        nxt = ivs[i][0] if i < n else end
+        if heap:
+            seg = min(heap[0][3], nxt, end)
+            out[heap[0][4]] += seg - t
+        else:
+            seg = min(nxt, end)
+            out["idle_other"] += seg - t
+        t = seg
+    return out
+
+
+def goodput_from_trace(doc: dict) -> dict:
+    """The taxonomy breakdown derived from the trace's spans alone (per
+    rank/pid, aggregated in capacity-seconds); same shape as
+    utils/goodput.py breakdown_from_trace."""
+    per_pid: dict = {}
+    for ev in doc.get("traceEvents", ()):
+        if ev.get("ph") != "X" or ev.get("name") not in GOODPUT_SPAN_CAUSE:
+            continue
+        t0 = float(ev.get("ts", 0.0)) / 1e6
+        t1 = t0 + float(ev.get("dur") or 0.0) / 1e6
+        per_pid.setdefault(ev.get("pid", 0), []).append(
+            (t0, t1, ev.get("name"))
+        )
+    buckets = {c: 0.0 for c in GOODPUT_CAUSES}
+    wall = 0.0
+    per_rank = {}
+    for pid, spans in sorted(per_pid.items()):
+        spans.sort()
+        intervals = []
+        first = True
+        first_t0 = None
+        for t0, t1, name in spans:
+            cause = GOODPUT_SPAN_CAUSE[name]
+            if cause is None:
+                cause = "compile" if first else "steady_step"
+                if first:
+                    first_t0 = t0
+                first = False
+            intervals.append((t0, t1, cause))
+        if first_t0 is not None and first_t0 > 0:
+            intervals.append((0.0, first_t0, "init"))
+        end = max(t1 for _, t1, _ in intervals)
+        b = _goodput_sweep(intervals, end)
+        per_rank[pid] = {
+            "wall_s": round(end, 6),
+            "goodput_ratio": round(b["steady_step"] / end, 6)
+            if end > 0 else None,
+            "buckets": {c: round(v, 6) for c, v in b.items()},
+        }
+        for c, v in b.items():
+            buckets[c] += v
+        wall += end
+    return {
+        "kind": "trace",
+        "wall_s": round(wall, 6),
+        "goodput_s": round(buckets["steady_step"], 6),
+        "goodput_ratio": round(buckets["steady_step"] / wall, 6)
+        if wall > 0 else None,
+        "badput_s": {c: round(v, 6) for c, v in buckets.items()
+                     if c != "steady_step"},
+        "per_rank": per_rank,
+    }
+
+
+def goodput_report(doc: dict) -> str:
+    """The --goodput section: span-derived breakdown table, plus the
+    cross-check against the ledger's embedded record when the trace
+    carries one (`utils/tracing.py export(goodput=...)`)."""
+    derived = goodput_from_trace(doc)
+    total = derived["wall_s"]
+    if total <= 0:
+        return "Goodput: unavailable (no attributable spans in trace)"
+    lines = ["Goodput (derived from trace spans):"]
+    ratio = derived["goodput_ratio"]
+    lines.append(
+        f"  goodput {100.0 * ratio:.2f}% of {total:.2f}s"
+        + (f" across {len(derived['per_rank'])} rank(s)"
+           if len(derived["per_rank"]) > 1 else "")
+    )
+    lines.append(f"  {'cause':<20} {'seconds':>12} {'share':>8}")
+    causes = dict(derived["badput_s"])
+    causes["steady_step"] = derived["goodput_s"]
+    for c in GOODPUT_CAUSES:
+        v = causes.get(c, 0.0)
+        if v <= 0 and c not in ("steady_step", "idle_other"):
+            continue
+        tag = "  <- goodput" if c == "steady_step" else ""
+        lines.append(f"  {c:<20} {v:>12.3f} {v / total:>7.2%}{tag}")
+    embed = doc.get("goodput")
+    if isinstance(embed, dict) and embed.get("goodput_ratio") is not None:
+        er = float(embed["goodput_ratio"])
+        lines.append(
+            f"  ledger record embed: goodput {100.0 * er:.2f}% over "
+            f"{embed.get('wall_s', 0.0):.2f}s "
+            f"(delta vs span-derived {100.0 * (ratio - er):+.2f} pp; the "
+            "record also counts pre-tracer init and untraced host time)"
+        )
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
@@ -556,6 +707,14 @@ def main(argv=None) -> int:
         "(tools/trace_merge.py) to rank N's events before reporting; "
         "default aggregates every rank (noted when the trace is "
         "multi-rank). Applies to --diff's two traces as well",
+    )
+    ap.add_argument(
+        "--goodput", action="store_true",
+        help="append the wall-clock goodput/badput taxonomy breakdown "
+        "derived from the trace's spans (train_step/straggler/reshard/"
+        "data_loading), cross-checked against the ledger record the "
+        "trace embeds when present (docs/OBSERVABILITY.md 'Goodput "
+        "accounting'; tools/goodput.py renders run records directly)",
     )
     ap.add_argument(
         "--lint", metavar="CONFIG", default=None,
@@ -639,6 +798,9 @@ def main(argv=None) -> int:
             print(fmt_step_stats(derived, "derived from train_step spans"))
         else:
             print("Step stats: unavailable (no train_step spans, no embed)")
+    if args.goodput:
+        print()
+        print(goodput_report(doc))
     if args.jsonl:
         print()
         print(jsonl_step_series(args.jsonl))
