@@ -34,6 +34,14 @@ CPU, before a TPU ever sees the change (docs/STATIC_ANALYSIS.md):
   trace each candidate with ``trace``, score with ``cost``, pin the
   winner as a checked-in plan manifest (analysis/plans/*.json) that
   ``tools/autoshard.py --check`` gates in CI.
+- ``fleetsim`` - the fleet digital twin: a deterministic discrete-event
+  goodput simulator that replays a `SupervisorPolicy` over synthetic
+  failure traces using cost-model step seconds (`cost.step_seconds`)
+  and measured event-duration distributions (utils/goodput.py), ranks
+  robustness policies and autoshard plans by goodput-under-failures,
+  derives optimal checkpoint cadence (Young/Daly cross-checked), and
+  validates itself against real ledger records
+  (``tools/fleetsim.py --validate``, gated in CI).
 """
 
 from .autoshard import (
@@ -53,7 +61,30 @@ from .configs import (
     config_names,
     searchable_config_names,
 )
-from .cost import CostBreakdown, CostWeights, score_program
+from .cost import (
+    CostBreakdown,
+    CostWeights,
+    HARDWARE_MODELS,
+    HardwareModel,
+    StepTime,
+    dense_step_flops,
+    score_program,
+    step_seconds,
+)
+from .fleetsim import (
+    Distributions,
+    FailureEvent,
+    SimPolicy,
+    cadence_search,
+    compare_records,
+    policy_variants,
+    predict_from_ledger,
+    rank_plans_by_goodput,
+    rank_policies,
+    simulate,
+    synthesize_failure_trace,
+    young_daly_interval,
+)
 from .lint import Finding, lint_program
 from .manifest import (
     MANIFEST_SCHEMA,
@@ -73,16 +104,25 @@ __all__ = [
     "CollectiveSite",
     "CostBreakdown",
     "CostWeights",
+    "Distributions",
+    "FailureEvent",
     "Finding",
+    "HARDWARE_MODELS",
+    "HardwareModel",
     "MANIFEST_SCHEMA",
+    "SimPolicy",
+    "StepTime",
     "TraceFacts",
     "analyze_program",
     "build_manifest",
     "build_plan_doc",
     "build_program",
+    "cadence_search",
     "collect_trace",
+    "compare_records",
     "config_names",
     "default_manifest_dir",
+    "dense_step_flops",
     "diff_manifests",
     "diff_plans",
     "lint_program",
@@ -90,6 +130,10 @@ __all__ = [
     "load_plan",
     "manifest_path",
     "plan_path",
+    "policy_variants",
+    "predict_from_ledger",
+    "rank_plans_by_goodput",
+    "rank_policies",
     "run_autoshard",
     "run_shardlint",
     "save_manifest",
@@ -97,4 +141,8 @@ __all__ = [
     "score_program",
     "search_config",
     "search_plans",
+    "simulate",
+    "step_seconds",
+    "synthesize_failure_trace",
+    "young_daly_interval",
 ]

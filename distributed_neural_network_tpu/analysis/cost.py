@@ -341,3 +341,116 @@ def score_program(program, facts, weights: CostWeights | None = None,
         + w.leak_weight * bd.leaked_carry_bytes
     )
     return bd
+
+
+# ------------------------------------------------------ per-step seconds
+#
+# The score above RANKS plans; the fleet digital twin
+# (analysis/fleetsim.py) needs SECONDS - a predicted steady-step time it
+# can multiply into goodput under a failure process. `step_seconds`
+# converts the same byte/flop terms into a first-order roofline estimate:
+# compute and HBM weight-streaming overlap (the max rules), collective
+# wire time is charged serially on top (the conservative bound for
+# unoverlapped end-sync; the overlap schedule hides part of it, which the
+# estimate deliberately does not credit). Pure arithmetic over a
+# `CostBreakdown` OR a checked-in plan manifest's "chosen" dict - no jax,
+# so a supervisor-side tool can price a plan without a runtime.
+
+
+@dataclass(frozen=True)
+class HardwareModel:
+    """Nominal per-chip rates for step-time pricing. The defaults are
+    v5e-class datasheet numbers; calibrate against a measured record
+    (the twin prefers the measured step-time distribution whenever one
+    exists - this model is for fleets/plans never executed)."""
+
+    name: str = "tpu-v5e"
+    flops_per_s: float = 197e12  # bf16 peak, per chip
+    hbm_bytes_per_s: float = 819e9  # HBM bandwidth, per chip
+    ici_bytes_per_s: float = 45e9  # per-link ICI wire bandwidth
+    step_overhead_s: float = 50e-6  # dispatch/launch floor per step
+
+
+# named hardware presets for the CLIs (tools/fleetsim.py --hw)
+HARDWARE_MODELS = {
+    "tpu-v5e": HardwareModel(),
+    "tpu-v4": HardwareModel(
+        name="tpu-v4", flops_per_s=275e12, hbm_bytes_per_s=1228e9,
+        ici_bytes_per_s=100e9,
+    ),
+    "cpu-host": HardwareModel(
+        name="cpu-host", flops_per_s=2e11, hbm_bytes_per_s=40e9,
+        ici_bytes_per_s=10e9, step_overhead_s=1e-3,
+    ),
+}
+
+
+@dataclass
+class StepTime:
+    """One plan's predicted steady-step seconds, every term exposed."""
+
+    step_s: float
+    compute_s: float
+    memory_s: float
+    comm_s: float
+    overhead_s: float
+    bound: str  # "compute" | "memory" | "comm"
+    flops_per_step: float
+    hw: str
+
+    def why(self) -> str:
+        return (
+            f"step {self.step_s * 1e3:,.3f} ms on {self.hw} "
+            f"({self.bound}-bound: compute {self.compute_s * 1e3:,.3f} + "
+            f"hbm {self.memory_s * 1e3:,.3f} [max] + wire "
+            f"{self.comm_s * 1e3:,.3f} + overhead "
+            f"{self.overhead_s * 1e3:,.3f} ms)"
+        )
+
+
+def dense_step_flops(param_count: float, tokens_per_step: float) -> float:
+    """First-order dense-transformer training flops per step: 6 x params
+    x tokens (fwd 2PT + bwd 4PT, the standard accounting)."""
+    return 6.0 * float(param_count) * float(tokens_per_step)
+
+
+def step_seconds(
+    bd, hw: HardwareModel | None = None, *, flops_per_step: float = 0.0
+) -> StepTime:
+    """Predicted steady-step seconds from a plan's byte/flop terms.
+
+    ``bd`` is a `CostBreakdown` or any mapping exposing ``wire_bytes``,
+    ``untraced_grad_sync_bytes``, and ``peak_state_bytes`` (a plan
+    manifest's ``chosen`` block qualifies). Model: compute time and
+    HBM state-streaming time overlap (take the max - a step reads its
+    params+optimizer state at least once), collective wire time and the
+    dispatch floor are additive."""
+    hw = hw or HardwareModel()
+
+    def get(key):
+        if isinstance(bd, dict):
+            return float(bd.get(key) or 0.0)
+        return float(getattr(bd, key, 0.0) or 0.0)
+
+    compute_s = float(flops_per_step) / hw.flops_per_s
+    memory_s = get("peak_state_bytes") / hw.hbm_bytes_per_s
+    comm_s = (
+        get("wire_bytes") + get("untraced_grad_sync_bytes")
+    ) / hw.ici_bytes_per_s
+    body = max(compute_s, memory_s)
+    if comm_s > body:
+        bound = "comm"
+    elif compute_s >= memory_s:
+        bound = "compute"
+    else:
+        bound = "memory"
+    return StepTime(
+        step_s=body + comm_s + hw.step_overhead_s,
+        compute_s=compute_s,
+        memory_s=memory_s,
+        comm_s=comm_s,
+        overhead_s=hw.step_overhead_s,
+        bound=bound,
+        flops_per_step=float(flops_per_step),
+        hw=hw.name,
+    )

@@ -1,0 +1,994 @@
+"""Fleet digital twin: a deterministic discrete-event goodput simulator
+closed-loop-validated against the measured ledger.
+
+PR 7's cost model prices a plan WITHOUT executing it; PR 10's goodput
+ledger measures where wall-clock ACTUALLY went. This module connects
+them: replay a supervisor policy (`train/supervisor.py SupervisorPolicy`
+- the exact struct the real supervisor executes) over a synthetic
+failure trace at 2..1000+ chips and emit a *predicted*, schema-compatible
+goodput run record (`utils/goodput.py` taxonomy, capacity-seconds like
+the fleet aggregation). Every robustness knob - checkpoint cadence,
+restart budget, backoff, min-procs, grow hysteresis - becomes a search
+problem for a fleet we don't own (ROADMAP item 5; failure-aware
+efficiency as the first-class metric per arXiv 2204.06514, reshard and
+restart costs as modeled quantities per arXiv 2112.01075).
+
+**Inputs, in preference order:**
+
+- *measured distributions* (`utils/goodput.py extract_distributions`,
+  ``tools/goodput.py --distributions``): restart-gap / checkpoint-save /
+  reshard / init / compile / steady-step durations sampled from real
+  ``run_record.json`` events - the twin draws event durations from what
+  this hardware actually does;
+- *cost-model step times* (`analysis/cost.py step_seconds`): a roofline
+  per-step seconds estimate from a plan's byte/flop terms, for plans and
+  fleets never executed - which also gives autoshard its second scoring
+  axis (`rank_plans_by_goodput`): plans ranked by goodput-under-failures
+  instead of steady-state bytes alone;
+- *policy fallbacks* (`SimPolicy` fields) when neither exists.
+
+**Event model.** One elastic group, mirroring the supervisor's state
+machine: generations run init -> compile -> (k steps + checkpoint)
+cycles; a failure event loses the work since the last durable checkpoint
+(a *preemption* event writes a cooperative emergency checkpoint first,
+losing nothing), consumes one unit of the restart budget with the
+policy's own exponential backoff, and restarts shrunk by one - or at the
+same size when the event hits rank 0, the coordinator, taking the whole
+group - charging the gap at the relaunched size plus the new
+generation's init+compile into ``restart_gap`` (the fleet aggregation's
+reclassification rule). Below ``min_procs`` or past ``max_restarts`` the
+sim aborts exactly where the supervisor would. A shrunk group grows back
+to target after ``grow_after_s`` healthy seconds (planned: emergency
+checkpoints, no budget, no lost work). Conservation is ASSERTED like the
+ledger's: the buckets must partition simulated capacity-seconds computed
+independently from the generation windows.
+
+**Closing the loop.** ``predict_from_ledger`` replays the ACTUAL failure
+history recorded in a fleet record's generation list - measured
+init/compile/exogenous stalls per rank, measured step time and
+checkpoint cadence - and re-derives the bucket split from the event
+model alone; `compare_records` asserts sim-vs-ledger bucket agreement
+within tolerance (``tools/fleetsim.py --validate``, wired into the
+2-proc chaos CI job so prediction drift fails the build). The optimal
+checkpoint cadence from `cadence_search` is cross-checked against the
+Young/Daly first-order optimum ``sqrt(2 * delta * MTBF)`` on synthetic
+Poisson traces (tests/test_fleetsim.py).
+
+Stdlib-only (no jax, no numpy): the twin runs in the supervisor, in CI,
+and on a laptop; cost-model pricing imports `.cost` lazily. Determinism
+is a contract: same seed + trace + policy -> bitwise-identical record
+(`random.Random` over int seeds only; no wall-clock stamps).
+Semantics: docs/OBSERVABILITY.md "Fleet digital twin".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import random
+from dataclasses import dataclass
+
+from ..train.supervisor import SupervisorPolicy
+from ..utils.goodput import (
+    CAUSES,
+    GOODPUT_CAUSE,
+    IDLE_CAUSE,
+    RECORD_VERSION,
+    extract_distributions,
+    fleet_goodput_record,
+    record_causes,
+    validate_record,
+)
+
+_INF = float("inf")
+
+
+# ---------------------------------------------------------- distributions
+
+
+class Distributions:
+    """Empirical event-duration distributions (the ``--distributions``
+    document from `utils/goodput.py extract_distributions`). ``sample``
+    draws uniformly from the quantile-preserving sample list -
+    deterministic given the caller's seeded `random.Random` - and falls
+    back to the recorded mean, then to the caller's default."""
+
+    def __init__(self, doc: dict | None = None):
+        doc = doc or {}
+        if doc and doc.get("kind") not in (None, "distributions"):
+            raise ValueError(
+                f"not a distributions document (kind={doc.get('kind')!r}; "
+                "produce one with tools/goodput.py --distributions)"
+            )
+        self.doc = doc
+        self.causes = dict(doc.get("causes") or {})
+        self.derived = dict(doc.get("derived") or {})
+
+    @classmethod
+    def from_records(cls, records) -> "Distributions":
+        return cls(extract_distributions(records))
+
+    @classmethod
+    def load(cls, path: str) -> "Distributions":
+        with open(path) as f:
+            return cls(json.load(f))
+
+    def has(self, cause: str) -> bool:
+        return cause in self.causes
+
+    def mean(self, cause: str, default: float = 0.0) -> float:
+        info = self.causes.get(cause)
+        if not info:
+            return float(default)
+        return float(info.get("mean_s") or default)
+
+    def sample(self, cause: str, rng: random.Random,
+               default: float = 0.0) -> float:
+        info = self.causes.get(cause)
+        if not info:
+            return float(default)
+        xs = info.get("samples_s")
+        if xs:
+            return float(xs[rng.randrange(len(xs))])
+        return float(info.get("mean_s") or default)
+
+    def step_overhead_s(self, default: float = 0.0) -> float:
+        return float(self.derived.get("step_overhead_s") or default)
+
+
+# -------------------------------------------------------- failure traces
+
+
+@dataclass(frozen=True)
+class FailureEvent:
+    """One machine-level event on the failure trace. ``rank`` is taken
+    modulo the CURRENT group size at fire time (a chip that fails still
+    fails whoever runs on it after a shrink); rank 0 is the coordinator
+    - its death takes the whole group (same-size restart), matching the
+    supervisor's coordinator-death semantics. ``kind`` is ``failure``
+    (work since the last checkpoint is lost) or ``preemption`` (a
+    SIGTERM-style eviction: the cooperative emergency checkpoint lands
+    first, so no work is lost - but the restart budget is still spent,
+    exactly like a PREEMPT_RC worker exit)."""
+
+    t_s: float
+    rank: int
+    kind: str = "failure"
+
+
+def synthesize_failure_trace(
+    n_chips: int,
+    *,
+    rate_per_chip_per_h: float,
+    horizon_s: float,
+    seed: int = 0,
+    preempt_fraction: float = 0.0,
+) -> list:
+    """A seeded Poisson failure trace: exponential inter-arrivals at the
+    aggregate rate ``n_chips * rate_per_chip_per_h`` with uniform victim
+    ranks. Deterministic: same arguments -> identical trace (int-seeded
+    `random.Random`; never the wall clock)."""
+    if n_chips < 1:
+        raise ValueError(f"n_chips must be >= 1, got {n_chips}")
+    rate_s = n_chips * float(rate_per_chip_per_h) / 3600.0
+    if rate_s <= 0:
+        return []
+    rng = random.Random(int(seed) * 2654435761 % (2**31) + 17)
+    events = []
+    t = 0.0
+    while True:
+        t += rng.expovariate(rate_s)
+        if t >= horizon_s:
+            return events
+        kind = (
+            "preemption" if rng.random() < preempt_fraction else "failure"
+        )
+        events.append(FailureEvent(round(t, 6), rng.randrange(n_chips), kind))
+
+
+# --------------------------------------------------------------- policy
+
+
+@dataclass
+class SimPolicy:
+    """One simulated configuration: the shared `SupervisorPolicy` (the
+    struct the real supervisor runs) plus the workload knobs the
+    supervisor does not own - checkpoint cadence and step pricing - and
+    fallback durations used only where no empirical distribution sample
+    exists."""
+
+    supervisor: SupervisorPolicy
+    checkpoint_every_steps: int = 0  # 0 = never checkpoint
+    step_time_s: float = 1.0
+    step_overhead_s: float = 0.0  # host time between steps (idle_other)
+    tokens_per_step: float = 0.0
+    # fallback durations (overridden by Distributions samples)
+    init_s: float = 5.0
+    compile_s: float = 10.0
+    checkpoint_write_s: float = 1.0
+    restart_gap_s: float = 10.0
+    label: str = ""
+
+    def __post_init__(self):
+        if self.checkpoint_every_steps < 0:
+            raise ValueError("checkpoint_every_steps must be >= 0")
+        if self.step_time_s <= 0:
+            raise ValueError("step_time_s must be > 0")
+
+    def with_(self, **changes) -> "SimPolicy":
+        """A copy with knobs changed; `SupervisorPolicy` field names
+        route into the nested policy, so one sweep spec can mix both
+        levels (``with_(checkpoint_every_steps=200, max_restarts=8)``)."""
+        sup_fields = {f.name for f in dataclasses.fields(SupervisorPolicy)}
+        sup_changes = {k: v for k, v in changes.items() if k in sup_fields}
+        own = {k: v for k, v in changes.items() if k not in sup_fields}
+        sup = (
+            dataclasses.replace(self.supervisor, **sup_changes)
+            if sup_changes else self.supervisor
+        )
+        return dataclasses.replace(self, supervisor=sup, **own)
+
+    def describe(self) -> dict:
+        doc = {
+            f.name: getattr(self, f.name)
+            for f in dataclasses.fields(SimPolicy)
+            if f.name != "supervisor"
+        }
+        doc["supervisor"] = self.supervisor.policy_dict()
+        return doc
+
+
+def policy_variants(base: SimPolicy, sweep: dict) -> list:
+    """The cartesian product of ``{knob: [values...]}`` over a base
+    policy, each labeled with its deviating knobs - the grid
+    `rank_policies` (and ``tools/fleetsim.py --sweep``) ranks."""
+    variants = [base]
+    for knob, values in sweep.items():
+        variants = [
+            v.with_(**{knob: val}) for v in variants for val in values
+        ]
+    for v in variants:
+        if not v.label:
+            v.label = ",".join(
+                f"{k}={_fmt_knob(v, k)}" for k in sweep
+            ) or "base"
+    return variants
+
+
+def _fmt_knob(policy: SimPolicy, knob: str):
+    sup_fields = {f.name for f in dataclasses.fields(SupervisorPolicy)}
+    src = policy.supervisor if knob in sup_fields else policy
+    v = getattr(src, knob)
+    return f"{v:g}" if isinstance(v, float) else v
+
+
+# ------------------------------------------------------------- simulator
+
+
+class _Sim:
+    """One simulation run's state; `simulate()` is the public face."""
+
+    def __init__(self, policy, trace, dists, horizon_s, target_steps, seed):
+        self.p = policy
+        self.sup = policy.supervisor
+        self.dists = dists or Distributions()
+        self.rng = random.Random((int(seed) * 1000003 + 1) % (2**31))
+        self.horizon = float(horizon_s)
+        self.target = target_steps
+        self.events = sorted(trace, key=lambda e: (e.t_s, e.rank))
+        self.ei = 0
+        self.t = 0.0
+        self.n = self.sup.nprocs
+        self.gen = -1
+        self.buckets = {c: 0.0 for c in CAUSES}
+        self.wall_check = 0.0
+        self.steps_executed = 0
+        self.steps_done = 0  # unique frontier (reverts on lost work)
+        self.last_ckpt = 0
+        self.tokens = 0.0
+        self.lost_steps = 0
+        self.lost_capacity_s = 0.0
+        self.restarts_used = 0
+        self.failures_seen = 0
+        self.preemptions_seen = 0
+        self.grows = 0
+        self.events_in_gaps = 0
+        self.gaps = []
+        self.aborted = None
+        self.restart_reason = None
+
+    # -------------------------------------------------------- primitives
+
+    def charge(self, cause: str, dur: float) -> None:
+        if dur > 0:
+            self.buckets[cause] += dur * self.n
+
+    def next_event_t(self) -> float:
+        return self.events[self.ei].t_s if self.ei < len(self.events) else _INF
+
+    def run_segment(self, cause: str, dur: float) -> str:
+        """Advance through one non-step segment; a failure event or the
+        horizon may interrupt it (the elapsed part is still charged)."""
+        end = self.t + max(dur, 0.0)
+        stop = min(self.next_event_t(), self.horizon)
+        if end <= stop:
+            self.charge(cause, end - self.t)
+            self.t = end
+            return "ok"
+        self.charge(cause, max(stop - self.t, 0.0))
+        self.t = stop
+        return "horizon" if stop >= self.horizon else "failure"
+
+    def charge_steps(self, m: int) -> None:
+        self.charge(GOODPUT_CAUSE, m * self.p.step_time_s)
+        self.charge(IDLE_CAUSE, m * self.p.step_overhead_s)
+        self.steps_executed += m
+        self.steps_done += m
+        self.tokens += m * self.p.tokens_per_step
+
+    def emergency_checkpoint(self) -> str:
+        """Cooperative save before a planned stop / preemption exit: the
+        unique-step frontier becomes durable."""
+        ck = self.dists.sample(
+            "checkpoint_save", self.rng, self.p.checkpoint_write_s
+        )
+        st = self.run_segment("checkpoint_save", ck)
+        if st != "failure":
+            self.last_ckpt = self.steps_done
+        return st
+
+    # -------------------------------------------------------- generation
+
+    def run_gen(self):
+        """One generation, start to teardown. Returns (status, event):
+        status in done|horizon|failure|grow; on failure the event is
+        consumed and lost work / the preemption checkpoint is already
+        accounted - the restart DECISION belongs to the outer loop."""
+        self.gen += 1
+        gen_t0 = self.t
+        n0 = self.n
+        # events that fired while no worker existed hit nobody
+        while self.ei < len(self.events) and self.events[self.ei].t_s <= self.t:
+            self.ei += 1
+            self.events_in_gaps += 1
+        # a failure-relaunched generation's init+compile is restart cost
+        # (the fleet aggregation's reclassification rule)
+        startup_cause = (
+            "restart_gap" if self.restart_reason == "failure" else None
+        )
+        st = self.run_segment(
+            startup_cause or "init",
+            self.dists.sample("init", self.rng, self.p.init_s),
+        )
+        if st == "ok":
+            st = self.run_segment(
+                startup_cause or "compile",
+                self.dists.sample("compile", self.rng, self.p.compile_s),
+            )
+        healthy_t = self.t
+        since_ckpt = 0
+        k = self.p.checkpoint_every_steps
+        cyc = self.p.step_time_s + self.p.step_overhead_s
+        grow_t = (
+            healthy_t + self.sup.grow_after_s
+            if self.sup.grow_after_s > 0 and self.n < self.sup.nprocs
+            else _INF
+        )
+        while st == "ok":
+            if self.target is not None and self.steps_done >= self.target:
+                st = "done"
+                break
+            if self.t >= grow_t:
+                st = "grow"
+                break
+            rem = (
+                self.target - self.steps_done
+                if self.target is not None else None
+            )
+            r = k - since_ckpt if k > 0 else (rem if rem is not None else 4096)
+            if rem is not None:
+                r = min(r, rem)
+            r = max(int(r), 1)
+            stop = min(self.next_event_t(), self.horizon, grow_t)
+            if self.t + r * cyc <= stop:
+                self.charge_steps(r)
+                self.t += r * cyc
+                since_ckpt += r
+                if k > 0 and since_ckpt >= k and not (
+                    self.target is not None and self.steps_done >= self.target
+                ):
+                    st = self.run_segment(
+                        "checkpoint_save",
+                        self.dists.sample(
+                            "checkpoint_save", self.rng,
+                            self.p.checkpoint_write_s,
+                        ),
+                    )
+                    if st == "ok":
+                        self.last_ckpt = self.steps_done
+                        since_ckpt = 0
+                continue
+            # an event/horizon/grow boundary lands inside the block
+            avail = max(stop - self.t, 0.0)
+            full = min(int(avail // cyc), r)
+            if full > 0:
+                self.charge_steps(full)
+                since_ckpt += full
+            part = avail - full * cyc
+            if part > 0:
+                # the interrupted step's partial wall was real compute;
+                # it completed no step, so no progress is counted
+                self.charge(GOODPUT_CAUSE, part)
+            self.t = stop
+            if stop >= self.horizon:
+                st = "horizon"
+            elif stop >= grow_t and stop < self.next_event_t():
+                st = "grow"
+            else:
+                st = "failure"
+        ev = None
+        if st == "failure":
+            ev = self.events[self.ei]
+            self.ei += 1
+            if ev.kind == "preemption":
+                self.preemptions_seen += 1
+                sub = self.emergency_checkpoint()
+                if sub == "horizon":
+                    st = "horizon"
+            else:
+                self.failures_seen += 1
+                lost = self.steps_done - self.last_ckpt
+                if lost > 0:
+                    self.lost_steps += lost
+                    self.lost_capacity_s += lost * self.p.step_time_s * n0
+                    self.steps_done = self.last_ckpt
+        elif st == "grow":
+            sub = self.emergency_checkpoint()
+            if sub == "horizon":
+                st = "horizon"
+            elif sub == "failure":
+                st = "failure-during-grow"
+        self.wall_check += (self.t - gen_t0) * n0
+        return st, ev
+
+    # -------------------------------------------------------------- run
+
+    def run(self) -> dict:
+        while True:
+            st, ev = self.run_gen()
+            if st in ("done", "horizon"):
+                break
+            if st == "grow":
+                self.grows += 1
+                # teardown -> respawn with no worker alive: the ledger
+                # never measures this window for PLANNED restarts (no
+                # restart_gaps entry), so no capacity is charged
+                self.t += self.dists.sample(
+                    "restart_gap", self.rng, self.p.restart_gap_s
+                )
+                self.n = self.sup.nprocs
+                self.restart_reason = "grow"
+                continue
+            if st == "failure-during-grow":
+                # the grow teardown collided with a failure event: the
+                # emergency checkpoint did not land, so work since the
+                # last durable one is lost - then the failure path runs
+                ev = self.events[self.ei]
+                self.ei += 1
+                self.failures_seen += 1
+                lost = self.steps_done - self.last_ckpt
+                if lost > 0:
+                    self.lost_steps += lost
+                    self.lost_capacity_s += (
+                        lost * self.p.step_time_s * self.n
+                    )
+                    self.steps_done = self.last_ckpt
+            # ---- the supervisor's restart decision
+            self.restarts_used += 1
+            if self.restarts_used > self.sup.max_restarts:
+                self.aborted = (
+                    f"restart budget ({self.sup.max_restarts}) exhausted"
+                )
+                break
+            whole_group = ev is not None and (ev.rank % self.n) == 0
+            new_n = self.n if whole_group else self.n - 1
+            if new_n < self.sup.min_procs:
+                self.aborted = (
+                    f"only {new_n} worker(s) survive but min_procs is "
+                    f"{self.sup.min_procs}"
+                )
+                break
+            pause = self.sup.backoff_for(self.restarts_used)
+            gap = pause + self.dists.sample(
+                "restart_gap", self.rng, self.p.restart_gap_s
+            )
+            gap = min(gap, max(self.horizon - self.t, 0.0))
+            self.n = new_n
+            self.charge("restart_gap", gap)
+            self.wall_check += gap * new_n
+            self.gaps.append({
+                "seconds": round(gap, 6), "group_size": new_n,
+                "generation": self.gen + 1, "backoff_s": round(pause, 6),
+            })
+            self.t += gap
+            self.restart_reason = "failure"
+            if self.t >= self.horizon:
+                break
+        return self.record()
+
+    def record(self) -> dict:
+        buckets = self.buckets
+        wall = sum(buckets.values())
+        if any(v < 0 for v in buckets.values()) or (
+            abs(wall - self.wall_check) > max(1e-6 * max(wall, 1.0), 1e-9)
+        ):
+            raise AssertionError(
+                "fleetsim conservation violated: buckets sum to "
+                f"{wall:.9f} capacity-seconds but the generation windows "
+                f"cover {self.wall_check:.9f} "
+                f"({json.dumps({k: round(v, 6) for k, v in buckets.items()})})"
+                " - a segment was charged twice or skipped; this is a "
+                "simulator bug, please report it"
+            )
+        goodput = buckets[GOODPUT_CAUSE]
+        effective = max(goodput - self.lost_capacity_s, 0.0)
+        return {
+            "version": RECORD_VERSION,
+            "kind": "sim",
+            "final": True,
+            "steps": self.steps_executed,
+            "goodput_steps": self.steps_executed,
+            "tokens": round(self.tokens, 6),
+            "wall_s": round(wall, 6),
+            "goodput_s": round(goodput, 6),
+            "goodput_ratio": round(goodput / wall, 6) if wall > 0 else None,
+            "badput_s": {
+                c: round(buckets[c], 6) for c in CAUSES
+                if c != GOODPUT_CAUSE
+            },
+            "restart_gaps": self.gaps,
+            "metrics": {
+                "unique_steps": self.steps_done,
+                "lost_steps": self.lost_steps,
+                "lost_step_capacity_s": round(self.lost_capacity_s, 6),
+                "effective_goodput_ratio": round(effective / wall, 6)
+                if wall > 0 else None,
+                "aborted": self.aborted is not None,
+                "abort_reason": self.aborted,
+                "restarts_used": self.restarts_used,
+                "generations": self.gen + 1,
+                "failures_seen": self.failures_seen,
+                "preemptions_seen": self.preemptions_seen,
+                "grows": self.grows,
+                "events_in_gaps": self.events_in_gaps,
+                "final_group_size": self.n,
+                "horizon_s": self.horizon,
+            },
+        }
+
+
+def simulate(
+    policy: SimPolicy,
+    trace,
+    dists: Distributions | None = None,
+    *,
+    horizon_s: float,
+    target_steps: int | None = None,
+    seed: int = 0,
+) -> dict:
+    """Run one policy over one failure trace and return the predicted
+    schema-compatible run record (``kind: "sim"``; renderable, diffable,
+    and gateable by ``tools/goodput.py`` like any measured record).
+
+    ``goodput_ratio`` mirrors the LEDGER's definition (every executed
+    steady step counts, replays included - what a measured record would
+    report); ``metrics.effective_goodput_ratio`` additionally subtracts
+    the capacity-seconds of steps whose progress a later failure erased
+    - the quantity policy search actually maximizes. Deterministic:
+    same (policy, trace, seed) -> bitwise-identical record."""
+    sim = _Sim(policy, trace, dists, horizon_s, target_steps, seed)
+    rec = sim.run()
+    rec["sim"] = {
+        "mode": "forward",
+        "seed": int(seed),
+        "n_events": len(sim.events),
+        "policy": policy.describe(),
+    }
+    return rec
+
+
+# ------------------------------------------------------- policy ranking
+
+
+def effective_ratio(rec: dict) -> float:
+    v = (rec.get("metrics") or {}).get("effective_goodput_ratio")
+    if v is None:
+        v = rec.get("goodput_ratio")
+    return float(v or 0.0)
+
+
+def rank_policies(
+    policies,
+    dists: Distributions | None = None,
+    *,
+    n_chips: int,
+    rate_per_chip_per_h: float,
+    horizon_s: float,
+    preempt_fraction: float = 0.0,
+    seeds=(0, 1, 2),
+) -> list:
+    """Simulate every policy over the SAME seeded traces (common random
+    numbers - policy deltas are not drowned by trace noise) and rank by
+    mean effective goodput ratio, aborting policies last. Returns
+    ``[{label, policy, effective_goodput_ratio, goodput_ratio, aborted,
+    record}, ...]`` best first; ``record`` is the first seed's."""
+    traces = [
+        synthesize_failure_trace(
+            n_chips, rate_per_chip_per_h=rate_per_chip_per_h,
+            horizon_s=horizon_s, seed=s,
+            preempt_fraction=preempt_fraction,
+        )
+        for s in seeds
+    ]
+    out = []
+    for policy in policies:
+        recs = [
+            simulate(policy, tr, dists, horizon_s=horizon_s, seed=s)
+            for s, tr in zip(seeds, traces)
+        ]
+        aborted = any(r["metrics"]["aborted"] for r in recs)
+        out.append({
+            "label": policy.label or "policy",
+            "policy": policy.describe(),
+            "effective_goodput_ratio": round(
+                sum(effective_ratio(r) for r in recs) / len(recs), 6
+            ),
+            "goodput_ratio": round(
+                sum(float(r.get("goodput_ratio") or 0.0) for r in recs)
+                / len(recs), 6
+            ),
+            "aborted": aborted,
+            "record": recs[0],
+        })
+    out.sort(key=lambda d: (d["aborted"], -d["effective_goodput_ratio"]))
+    return out
+
+
+# ------------------------------------------------------- cadence search
+
+
+def young_daly_interval(mtbf_s: float, checkpoint_s: float) -> float:
+    """The Young/Daly first-order optimal checkpoint interval
+    ``sqrt(2 * delta * M)`` (seconds of work between checkpoints) for
+    checkpoint cost ``delta`` and group MTBF ``M``."""
+    return math.sqrt(2.0 * float(checkpoint_s) * float(mtbf_s))
+
+
+def cadence_search(
+    policy: SimPolicy,
+    dists: Distributions | None = None,
+    *,
+    rate_per_chip_per_h: float,
+    horizon_s: float,
+    cadences=None,
+    seeds=(0, 1),
+    grid_ratio: float = 1.15,
+) -> dict:
+    """Derive the optimal checkpoint cadence for a policy by simulation,
+    cross-checked against the Young/Daly approximation.
+
+    The knob is isolated from elasticity: every synthesized event is
+    remapped to rank 0 (whole-group, same-size restarts - the classic
+    single-domain model Young/Daly assumes) and the restart budget is
+    lifted. The default cadence grid is geometric between the checkpoint
+    cost and the group MTBF (the a-priori bracket of the optimum).
+    Returns ``{"results", "best", "young_daly"}`` where ``results`` is
+    ``[(cadence_steps, interval_s, mean_effective_ratio), ...]``."""
+    sup = dataclasses.replace(
+        policy.supervisor, max_restarts=10**9, grow_after_s=0.0
+    )
+    base = dataclasses.replace(policy, supervisor=sup)
+    n = sup.nprocs
+    mtbf_s = 3600.0 / (n * rate_per_chip_per_h)
+    delta = (dists or Distributions()).mean(
+        "checkpoint_save", policy.checkpoint_write_s
+    )
+    cyc = policy.step_time_s + policy.step_overhead_s
+    if cadences is None:
+        cadences = []
+        tau = max(delta, cyc)
+        while tau <= mtbf_s:
+            k = max(int(round(tau / cyc)), 1)
+            if not cadences or k != cadences[-1]:
+                cadences.append(k)
+            tau *= grid_ratio
+    traces = [
+        [
+            FailureEvent(e.t_s, 0, e.kind)
+            for e in synthesize_failure_trace(
+                n, rate_per_chip_per_h=rate_per_chip_per_h,
+                horizon_s=horizon_s, seed=s,
+            )
+        ]
+        for s in seeds
+    ]
+    results = []
+    for k in cadences:
+        cand = base.with_(checkpoint_every_steps=int(k))
+        ratios = [
+            effective_ratio(
+                simulate(cand, tr, dists, horizon_s=horizon_s, seed=s)
+            )
+            for s, tr in zip(seeds, traces)
+        ]
+        results.append((
+            int(k), round(k * cyc, 6),
+            round(sum(ratios) / len(ratios), 6),
+        ))
+    best = max(results, key=lambda r: r[2]) if results else None
+    yd_s = young_daly_interval(mtbf_s, delta)
+    return {
+        "results": results,
+        "best": best,
+        "young_daly": {
+            "interval_s": round(yd_s, 6),
+            "cadence_steps": max(int(round(yd_s / cyc)), 1),
+            "mtbf_s": round(mtbf_s, 6),
+            "checkpoint_s": round(delta, 6),
+            "ratio_vs_best": round(best[1] / yd_s, 6)
+            if best and yd_s > 0 else None,
+        },
+    }
+
+
+# --------------------------------------------- closing the loop (validate)
+
+
+def _fill_window(avail_s: float, step_s: float, overhead_s: float,
+                 k: int, ck_mean_s: float):
+    """The shared cadence arithmetic: how many steps + periodic
+    checkpoints fit in ``avail_s`` seconds at ``step_s`` + per-step host
+    ``overhead_s``, checkpointing every ``k`` steps at ``ck_mean_s``.
+    Returns ``(steps, steady_s, checkpoint_s, idle_s)`` partitioning
+    ``avail_s`` exactly."""
+    if avail_s <= 0 or step_s <= 0:
+        return 0, 0.0, 0.0, max(avail_s, 0.0)
+    cyc = step_s + overhead_s
+    if k > 0 and ck_mean_s > 0:
+        block = k * cyc + ck_mean_s
+        full = int(avail_s // block)
+        rem = avail_s - full * block
+        steps = full * k + min(int(rem // cyc), k)
+        ckpts = full
+    else:
+        steps = int(avail_s // cyc)
+        ckpts = 0
+    steady = steps * step_s
+    ck = ckpts * ck_mean_s
+    return steps, steady, ck, max(avail_s - steady - ck, 0.0)
+
+
+# badput causes the sim cannot predict from policy alone (injected chaos,
+# input pipeline, elastic resharding, guard replays): replayed as
+# exogenous inputs in validation so conservation closes
+EXOGENOUS_CAUSES = ("stall", "data_wait", "reshard", "rollback_recompute")
+
+
+def _predict_rank(rec: dict) -> dict:
+    """Re-derive one rank record's bucket split from the event model +
+    the record's own measured inputs (wall window, init/compile, mean
+    step time, checkpoint cadence, exogenous chaos): the closed-loop
+    consistency check - if the sim's cycle arithmetic or taxonomy
+    semantics drift from the ledger's, the prediction diverges."""
+    bad = dict(rec.get("badput_s") or {})
+    events = rec.get("events") or {}
+    wall = float(rec.get("wall_s") or 0.0)
+    steps = int(rec.get("steps") or 0)
+    gsteps = int(rec.get("goodput_steps") or 0)
+    steady_ev = events.get("steady_step") or {}
+    step_s = float(steady_ev.get("mean_s") or 0.0)
+    if step_s <= 0 and gsteps > 0:
+        step_s = float(rec.get("goodput_s") or 0.0) / gsteps
+    init_s = float(bad.get("init") or 0.0)
+    compile_s = float(bad.get("compile") or 0.0)
+    exo = {c: float(bad.get(c) or 0.0) for c in EXOGENOUS_CAUSES}
+    ck_ev = events.get("checkpoint_save") or {}
+    ck_mean = float(ck_ev.get("mean_s") or 0.0)
+    cfg = rec.get("config") or {}
+    try:
+        k = int(cfg.get("checkpoint_every") or 0)
+    except (TypeError, ValueError):
+        k = 0
+    overhead = (
+        float(bad.get(IDLE_CAUSE) or 0.0) / steps if steps > 0 else 0.0
+    )
+    avail = max(wall - init_s - compile_s - sum(exo.values()), 0.0)
+    if ck_mean > 0 and k <= 0:
+        # saves observed but no cadence recorded (non-lm CLI): price the
+        # measured saves directly and fill the rest with steps
+        ck_total = float(ck_ev.get("total_s") or 0.0)
+        avail = max(avail - ck_total, 0.0)
+        steps_pred, steady_s, _, idle_s = _fill_window(
+            avail, step_s, overhead, 0, 0.0
+        )
+        ckpt_s = ck_total
+    else:
+        steps_pred, steady_s, ckpt_s, idle_s = _fill_window(
+            avail, step_s, overhead, k, ck_mean
+        )
+    badput = {c: 0.0 for c in CAUSES if c != GOODPUT_CAUSE}
+    badput.update({
+        "init": round(init_s, 6),
+        "compile": round(compile_s, 6),
+        "checkpoint_save": round(ckpt_s, 6),
+        IDLE_CAUSE: round(idle_s, 6),
+    })
+    badput.update({c: round(v, 6) for c, v in exo.items()})
+    return {
+        "version": RECORD_VERSION,
+        "kind": "rank",
+        "final": rec.get("final"),
+        "rank": rec.get("rank"),
+        "generation": rec.get("generation"),
+        "steps": steps_pred,
+        "goodput_steps": steps_pred,
+        "tokens": 0.0,
+        "wall_s": round(wall, 6),
+        "goodput_s": round(steady_s, 6),
+        "goodput_ratio": round(steady_s / wall, 6) if wall > 0 else None,
+        "badput_s": badput,
+    }
+
+
+def predict_from_ledger(fleet_record: dict, rank_records) -> dict:
+    """Replay the ACTUAL failure history a fleet record captured - its
+    generation list, per-rank windows, and measured restart gaps -
+    through the sim's event model, returning the predicted fleet record
+    (``kind: "sim"``). Agreement with the measured record (within
+    `compare_records` tolerances) is the closed-loop validation the CI
+    chaos job gates on."""
+    fleet = validate_record(fleet_record, "fleet record")
+    gaps = list(fleet.get("restart_gaps") or ())
+    restart_gens = {
+        int(g["generation"]) for g in gaps
+        if isinstance(g.get("generation"), int)
+    }
+    preds = [_predict_rank(validate_record(r)) for r in rank_records]
+    if not preds:
+        raise ValueError(
+            "no rank records to replay (need the run dir's "
+            "records/gen{g}_rank{r}.json write-through records)"
+        )
+    pred = fleet_goodput_record(
+        preds, restart_gaps=gaps, restart_generations=restart_gens
+    )
+    pred["kind"] = "sim"
+    pred["sim"] = {"mode": "validate", "n_rank_records": len(preds)}
+    return pred
+
+
+def compare_records(
+    predicted: dict, measured: dict, *,
+    ratio_tol: float = 0.1, share_tol: float = 0.1,
+) -> list:
+    """Bucket-level agreement check: |predicted - measured| of
+    ``goodput_ratio`` within ``ratio_tol`` and of every cause's
+    wall-clock SHARE within ``share_tol`` (absolute, both directions -
+    the sim must neither flatter nor slander a bucket). Returns
+    violation strings, empty = agree."""
+    problems = []
+    rp = predicted.get("goodput_ratio")
+    rm = measured.get("goodput_ratio")
+    if rp is None or rm is None:
+        problems.append(
+            "goodput_ratio missing from "
+            + ("the prediction" if rp is None else "the measured record")
+        )
+    elif abs(rp - rm) > ratio_tol:
+        problems.append(
+            f"goodput_ratio: predicted {rp:.4f} vs measured {rm:.4f} "
+            f"(|diff| {abs(rp - rm):.4f} > tol {ratio_tol:.3f})"
+        )
+    cp, cm = record_causes(predicted), record_causes(measured)
+    tp = float(predicted.get("wall_s") or 0.0)
+    tm = float(measured.get("wall_s") or 0.0)
+    for c in sorted(set(list(cp) + list(cm))):
+        sp = cp.get(c, 0.0) / tp if tp > 0 else 0.0
+        sm = cm.get(c, 0.0) / tm if tm > 0 else 0.0
+        if abs(sp - sm) > share_tol:
+            problems.append(
+                f"bucket '{c}': predicted share {sp:.2%} vs measured "
+                f"{sm:.2%} (|diff| {abs(sp - sm):.2%} > tol "
+                f"{share_tol:.2%})"
+            )
+    return problems
+
+
+# --------------------------------------- autoshard's second scoring axis
+
+
+def rank_plans_by_goodput(
+    plan_docs,
+    policy: SimPolicy,
+    dists: Distributions | None = None,
+    *,
+    hw=None,
+    flops_per_step: float = 0.0,
+    rate_per_chip_per_h: float,
+    horizon_s: float,
+    seeds=(0, 1),
+) -> list:
+    """Rank autoshard plan manifests (``analysis/plans/*.json`` docs) by
+    predicted goodput-under-failures instead of steady-state bytes: each
+    plan's ``chosen`` byte terms are priced into per-step seconds by
+    `analysis.cost.step_seconds` (the only lazy non-stdlib hop), then
+    every plan is simulated over the SAME seeded failure traces under
+    ``policy``.
+
+    The ranking metric is **surviving progress per capacity-second**
+    (``progress_steps_per_cap_s``: unique steps whose work no failure
+    erased, over fleet capacity-seconds) - NOT the time-fraction
+    ``goodput_ratio``, which cannot tell plans apart (a faster step does
+    not earn a larger SHARE of wall-clock, it earns more steps per
+    second; with a step-cadenced checkpoint policy a slower plan can
+    even post a higher time-fraction by checkpointing less often per
+    hour while making far less progress). Comparable across plans that
+    share the global batch. Returns ``[{plan, config, step_s, step_why,
+    progress_steps_per_cap_s, effective_goodput_ratio, goodput_ratio,
+    score}, ...]`` best first."""
+    from .cost import step_seconds
+
+    candidates = []
+    for doc in plan_docs:
+        chosen = doc.get("chosen") if isinstance(doc, dict) else None
+        if not chosen:
+            raise ValueError(
+                "not an autoshard plan manifest (no 'chosen' block); "
+                "generate one with tools/autoshard.py --write-manifest"
+            )
+        st = step_seconds(chosen, hw, flops_per_step=flops_per_step)
+        cand = policy.with_(step_time_s=max(st.step_s, 1e-9))
+        cand.label = str(chosen.get("plan") or doc.get("config") or "plan")
+        candidates.append((doc, chosen, st, cand))
+    traces = [
+        synthesize_failure_trace(
+            policy.supervisor.nprocs,
+            rate_per_chip_per_h=rate_per_chip_per_h,
+            horizon_s=horizon_s, seed=s,
+        )
+        for s in seeds
+    ]
+    out = []
+    for doc, chosen, st, cand in candidates:
+        recs = [
+            simulate(cand, tr, dists, horizon_s=horizon_s, seed=s)
+            for s, tr in zip(seeds, traces)
+        ]
+        progress = [
+            r["metrics"]["unique_steps"] / r["wall_s"]
+            if r["wall_s"] > 0 else 0.0
+            for r in recs
+        ]
+        out.append({
+            "plan": cand.label,
+            "config": doc.get("config"),
+            "step_s": round(st.step_s, 9),
+            "step_why": st.why(),
+            "progress_steps_per_cap_s": round(
+                sum(progress) / len(progress), 9
+            ),
+            "effective_goodput_ratio": round(
+                sum(effective_ratio(r) for r in recs) / len(recs), 6
+            ),
+            "goodput_ratio": round(
+                sum(float(r.get("goodput_ratio") or 0.0) for r in recs)
+                / len(recs), 6
+            ),
+            "aborted": any(r["metrics"]["aborted"] for r in recs),
+            "score": chosen.get("score"),
+        })
+    out.sort(
+        key=lambda d: (d["aborted"], -d["progress_steps_per_cap_s"])
+    )
+    return out

@@ -418,27 +418,91 @@ class FleetFederation:
 
 
 @dataclass
-class SupervisorConfig:
-    """Knobs for `Supervisor`; `tools/launch.py` maps them 1:1 to flags."""
+class SupervisorPolicy:
+    """The failure-response POLICY half of the supervisor's knobs: what
+    the supervisor DOES when workers die (how many restarts, at what
+    backoff, down to what size, growing back after how long) - as
+    opposed to HOW it runs processes (ports, polling, device flags,
+    `SupervisorConfig` below).
+
+    Extracted as its own type so the fleet digital twin
+    (`analysis/fleetsim.py`) replays EXACTLY the struct the real
+    supervisor executes: one config type, two consumers - a policy tuned
+    in simulation is the object a launch runs, field for field, and a
+    knob added here is automatically a searchable dimension there.
+    """
 
     nprocs: int
-    devices_per_proc: int = 1
-    # force_host_devices: append --xla_force_host_platform_device_count to
-    # each worker's XLA_FLAGS (the CPU dev/CI mode); off for real
-    # accelerators where the local device count is the hardware's
-    force_host_devices: bool = True
     min_procs: int = 1
     # failure-restart budget for the whole run; exhausted -> fail fast
     max_restarts: int = 3
     restart_backoff_s: float = 1.0
     backoff_cap_s: float = 30.0
+    # SIGTERM -> SIGKILL grace when stopping survivors (long enough for a
+    # healthy worker to finish its step + emergency checkpoint)
+    grace_s: float = 10.0
+    # 0 = never grow; > 0 = after a shrunk group has been healthy this
+    # long AND capacity_fn() reports free slots, do a planned grow restart
+    grow_after_s: float = 0.0
+
+    def __post_init__(self):
+        if self.nprocs < 1:
+            raise ValueError(f"nprocs must be >= 1, got {self.nprocs}")
+        if not 1 <= self.min_procs <= self.nprocs:
+            raise ValueError(
+                f"min_procs must be in [1, nprocs={self.nprocs}], got "
+                f"{self.min_procs}"
+            )
+        if self.max_restarts < 0:
+            raise ValueError("max_restarts must be >= 0")
+        for name in ("restart_backoff_s", "grace_s"):
+            if getattr(self, name) <= 0:
+                raise ValueError(f"{name} must be > 0")
+
+    def backoff_for(self, attempt: int) -> float:
+        """Backoff pause before failure restart number ``attempt``
+        (1-based): exponential from ``restart_backoff_s``, capped."""
+        return min(
+            self.restart_backoff_s * (2 ** (max(int(attempt), 1) - 1)),
+            self.backoff_cap_s,
+        )
+
+    def policy_dict(self) -> dict:
+        """The policy as a plain JSON-safe dict (fleetsim records embed
+        it so a simulated ranking names the exact knobs it ranked)."""
+        import dataclasses
+
+        return {
+            f.name: getattr(self, f.name)
+            for f in dataclasses.fields(SupervisorPolicy)
+        }
+
+    @classmethod
+    def from_policy_dict(cls, doc: dict) -> "SupervisorPolicy":
+        """Inverse of `policy_dict`; unknown keys are ignored so a
+        config-shaped dict (or an older record) loads as its policy."""
+        import dataclasses
+
+        known = {f.name for f in dataclasses.fields(SupervisorPolicy)}
+        return cls(**{k: v for k, v in doc.items() if k in known})
+
+
+@dataclass
+class SupervisorConfig(SupervisorPolicy):
+    """Knobs for `Supervisor`; `tools/launch.py` maps them 1:1 to flags.
+    Extends `SupervisorPolicy` (the failure-response knobs the fleetsim
+    twin shares) with the process-runner half: devices, rendezvous,
+    heartbeat staleness, polling."""
+
+    devices_per_proc: int = 1
+    # force_host_devices: append --xla_force_host_platform_device_count to
+    # each worker's XLA_FLAGS (the CPU dev/CI mode); off for real
+    # accelerators where the local device count is the hardware's
+    force_host_devices: bool = True
     # startup races (coordinator port lost, worker died before the full
     # group ever heartbeat) retry on a fresh port under their own budget
     rendezvous_retries: int = 2
     rendezvous_timeout_s: float = 120.0
-    # SIGTERM -> SIGKILL grace when stopping survivors (long enough for a
-    # healthy worker to finish its step + emergency checkpoint)
-    grace_s: float = 10.0
     # after a failure is detected, wait this long (or until everyone has
     # exited) before freezing the failure set: a gang crash's deaths
     # straddle poll boundaries, and without the settle a whole-group
@@ -449,32 +513,26 @@ class SupervisorConfig:
     # heartbeat (beat_unix) is older than this as dead (armed only after
     # the worker's first beat - compilation produces none)
     heartbeat_timeout_s: float = 0.0
-    # 0 = never grow; > 0 = after a shrunk group has been healthy this
-    # long AND capacity_fn() reports free slots, do a planned grow restart
-    grow_after_s: float = 0.0
     poll_s: float = 0.2
     host: str = "127.0.0.1"
 
     def __post_init__(self):
-        if self.nprocs < 1:
-            raise ValueError(f"nprocs must be >= 1, got {self.nprocs}")
-        if not 1 <= self.min_procs <= self.nprocs:
-            raise ValueError(
-                f"min_procs must be in [1, nprocs={self.nprocs}], got "
-                f"{self.min_procs}"
-            )
+        super().__post_init__()
         if self.devices_per_proc < 1:
             raise ValueError(
                 f"devices_per_proc must be >= 1, got {self.devices_per_proc}"
             )
-        for name in ("max_restarts", "rendezvous_retries"):
-            if getattr(self, name) < 0:
-                raise ValueError(f"{name} must be >= 0")
-        for name in ("restart_backoff_s", "grace_s", "poll_s"):
-            if getattr(self, name) <= 0:
-                raise ValueError(f"{name} must be > 0")
+        if self.rendezvous_retries < 0:
+            raise ValueError("rendezvous_retries must be >= 0")
+        if self.poll_s <= 0:
+            raise ValueError("poll_s must be > 0")
         if self.failure_settle_s < 0:
             raise ValueError("failure_settle_s must be >= 0")
+
+    def policy(self) -> SupervisorPolicy:
+        """The pure-policy view of this config (a `SupervisorPolicy`
+        copy - what `analysis/fleetsim.py` simulates)."""
+        return SupervisorPolicy.from_policy_dict(self.policy_dict())
 
 
 @dataclass
@@ -1174,10 +1232,7 @@ class Supervisor:
                     f"{last.get('rank')} [{last.get('cause')}]."
                 )
                 return 3
-        pause = min(
-            cfg.restart_backoff_s * (2 ** (self.restarts_used - 1)),
-            cfg.backoff_cap_s,
-        )
+        pause = cfg.backoff_for(self.restarts_used)
         direction = "shrink" if new_n < self.n else "same"
         self.log(
             f"(supervisor: restart {self.restarts_used}/{cfg.max_restarts} "
@@ -1196,9 +1251,15 @@ class Supervisor:
         # the other half, reclassified at aggregation; utils/goodput.py
         # fleet_goodput_record). The death -> first-post-restart-step
         # window closes in _observe once the new group heartbeats a step.
+        # backoff_s is recorded separately so distribution extraction
+        # (utils/goodput.py extract_distributions) can report the gap NET
+        # of the policy's own pause - the fleetsim twin re-adds whatever
+        # backoff the SIMULATED policy chooses instead of baking this
+        # run's schedule into the empirical sample
         self.restart_gaps.append({
             "seconds": round(gap, 3), "group_size": new_n,
             "generation": self.generation, "detected_unix": time.time(),
+            "backoff_s": round(pause, 3),
         })
         self.restart_generations.add(self.generation)
         self._gap_open = t0

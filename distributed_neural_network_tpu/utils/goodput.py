@@ -531,6 +531,23 @@ class GoodputLedger:
             pass
         return rec
 
+    def _event_stats(self) -> dict:
+        """Per-cause duration statistics over the RAW recorded intervals
+        (pre-sweep; the watchdog's re-reported stall episodes appear as
+        they were reported, coarse fills are excluded). This is the
+        record's ``events`` block - the empirical-distribution input the
+        fleet digital twin samples from (`extract_distributions`,
+        analysis/fleetsim.py): how long a checkpoint save, a reshard, or
+        a steady step ACTUALLY takes on this hardware."""
+        with self._lock:
+            ivs = list(self._intervals)
+        durs: dict = {}
+        for iv in ivs:
+            if iv.cause in _FILL_CAUSES:
+                continue
+            durs.setdefault(iv.cause, []).append(iv.t1 - iv.t0)
+        return {c: _dist_summary(d) for c, d in sorted(durs.items())}
+
     def _record(self, buckets: dict, total: float, *, final: bool) -> dict:
         return {
             "version": RECORD_VERSION,
@@ -556,6 +573,9 @@ class GoodputLedger:
             "badput_s": {
                 c: round(buckets[c], 6) for c in BADPUT_CAUSES
             },
+            # per-cause event-duration stats (additive, version-1
+            # compatible): the distribution inputs for the fleet twin
+            "events": self._event_stats(),
             "metrics": self.metrics,
         }
 
@@ -653,8 +673,16 @@ def fleet_goodput_record(
     steps = goodput_steps = 0
     tokens = 0.0
     ranks = []
+    pooled_events: dict = {}
     for rec in records:
         rec = validate_record(rec)
+        for cause, info in (rec.get("events") or {}).items():
+            pool = pooled_events.setdefault(
+                cause, {"count": 0, "total_s": 0.0, "samples": []}
+            )
+            pool["count"] += int(info.get("count") or 0)
+            pool["total_s"] += float(info.get("total_s") or 0.0)
+            pool["samples"].extend(info.get("samples_s") or ())
         bad = dict(rec.get("badput_s") or {})
         reclassified = 0.0
         if rec.get("generation") in restart_gens:
@@ -704,8 +732,200 @@ def fleet_goodput_record(
             c: round(v, 6) for c, v in buckets.items()
             if c != GOODPUT_CAUSE
         },
+        # per-cause event samples pooled across ranks (each rank's
+        # summary keeps count/total exactly; the sample list is the
+        # union of the ranks' quantile-preserving subsamples), so a
+        # fleet record alone feeds `extract_distributions`
+        "events": {
+            c: _dist_summary(
+                p["samples"], count=p["count"], total_s=p["total_s"]
+            )
+            for c, p in sorted(pooled_events.items())
+        },
         "ranks": ranks,
     }
+
+
+# ----------------------------------------------- distribution extraction
+
+# distributions-document schema version (tools/goodput.py --distributions
+# writes it; analysis/fleetsim.py Distributions reads it)
+DISTRIBUTIONS_VERSION = 1
+
+# events-block sample cap: sorted durations are subsampled evenly so
+# quantiles survive the cap deterministically
+_DIST_MAX_SAMPLES = 64
+
+
+def _dist_summary(samples, *, count: int | None = None,
+                  total_s: float | None = None,
+                  max_samples: int = _DIST_MAX_SAMPLES) -> dict:
+    """Summarize a list of durations into the events/distribution shape:
+    count, total, mean, p50/p95, max, plus an evenly-subsampled SORTED
+    sample list (deterministic, quantile-preserving) bounded to
+    ``max_samples`` - small enough to embed in every write-through
+    record, rich enough to resample from."""
+    xs = sorted(float(x) for x in samples if float(x) >= 0.0)
+    n = count if count is not None else len(xs)
+    tot = total_s if total_s is not None else sum(xs)
+    out = {
+        "count": int(n),
+        "total_s": round(float(tot), 6),
+        "mean_s": round(tot / n, 6) if n else 0.0,
+    }
+    if xs:
+        import math
+
+        def rank(q):  # nearest-rank quantile over the sorted samples
+            return xs[max(0, math.ceil(q * len(xs)) - 1)]
+
+        out["p50_s"] = round(rank(0.50), 6)
+        out["p95_s"] = round(rank(0.95), 6)
+        out["max_s"] = round(xs[-1], 6)
+        if len(xs) > max_samples:
+            step = (len(xs) - 1) / (max_samples - 1)
+            xs = [xs[round(i * step)] for i in range(max_samples)]
+        out["samples_s"] = [round(x, 6) for x in xs]
+    return out
+
+
+def extract_distributions(records) -> dict:
+    """Pool per-cause event-duration distributions out of run records -
+    the empirical inputs the fleet digital twin (`analysis/fleetsim.py`)
+    samples restart-gap / checkpoint-save / reshard / step durations
+    from, instead of guessing them.
+
+    ``records`` is an iterable of record dicts (rank, fleet, or sim).
+    Three source channels, all additive:
+
+    - each record's ``events`` block (raw recorded interval durations,
+      quantile-preserving subsamples);
+    - rank records WITHOUT events (the untelemetered ``note_steps`` fast
+      path, or pre-events builds): their aggregate ``badput_s`` /
+      ``goodput_s``-per-step values contribute single fallback samples;
+    - fleet records' ``restart_gaps``: the supervisor-measured
+      death -> respawn windows as ``restart_gap`` samples, NET of each
+      entry's recorded ``backoff_s`` (the simulated policy re-adds its
+      OWN backoff - this run's schedule must not leak into the sample).
+
+    Pass either the rank records or their fleet aggregate, not both -
+    the fleet record already pools its ranks' events.
+
+    Returns ``{"version", "kind": "distributions", "n_records",
+    "causes": {cause: {count, mean_s, p50_s, p95_s, max_s, samples_s}},
+    "derived": {"step_overhead_s": ...}}`` where ``step_overhead_s`` is
+    the pooled per-step host overhead (idle_other seconds per executed
+    step) - the twin charges it on every simulated step so predictions
+    include the host time real runs measurably spend between steps.
+    """
+    pooled: dict = {}
+    idle_s = 0.0
+    idle_steps = 0
+    n_records = 0
+
+    def pool(cause, samples, count=None, total=None):
+        p = pooled.setdefault(
+            cause, {"count": 0, "total_s": 0.0, "samples": []}
+        )
+        xs = [float(x) for x in samples if float(x) > 0.0]
+        p["samples"].extend(xs)
+        p["count"] += int(count if count is not None else len(xs))
+        p["total_s"] += float(total if total is not None else sum(xs))
+
+    for rec in records:
+        rec = validate_record(rec)
+        n_records += 1
+        events = rec.get("events") or {}
+        for cause, info in events.items():
+            pool(cause, info.get("samples_s") or (),
+                 count=info.get("count"), total=info.get("total_s"))
+        if not events:
+            # aggregate-only fallback: one sample per cause total, and a
+            # mean step time when the record counted steps
+            bad = rec.get("badput_s") or {}
+            for cause in ("init", "compile", "checkpoint_save", "reshard"):
+                v = float(bad.get(cause) or 0.0)
+                if v > 0:
+                    pool(cause, [v])
+            gsteps = int(rec.get("goodput_steps") or 0)
+            gs = float(rec.get("goodput_s") or 0.0)
+            if gsteps > 0 and gs > 0:
+                pool(GOODPUT_CAUSE, [gs / gsteps], count=gsteps, total=gs)
+        for gap in rec.get("restart_gaps") or ():
+            net = float(gap.get("seconds") or 0.0) - float(
+                gap.get("backoff_s") or 0.0
+            )
+            if net > 0:
+                pool("restart_gap", [net])
+        idle_s += float((rec.get("badput_s") or {}).get(IDLE_CAUSE) or 0.0)
+        idle_steps += int(rec.get("steps") or 0)
+    return {
+        "version": DISTRIBUTIONS_VERSION,
+        "kind": "distributions",
+        "n_records": n_records,
+        "causes": {
+            c: _dist_summary(
+                p["samples"], count=p["count"], total_s=p["total_s"]
+            )
+            for c, p in sorted(pooled.items())
+        },
+        "derived": {
+            "step_overhead_s": round(idle_s / idle_steps, 6)
+            if idle_steps > 0 else 0.0,
+        },
+    }
+
+
+def aggregate_records_dir(path: str) -> dict:
+    """Fleet-aggregate a directory of per-worker ``gen{g}_rank{r}.json``
+    records ON THE FLY - the render path for a run that crashed before
+    the supervisor wrote ``run_dir/run_record.json`` (its write-through
+    worker records are all that survived).
+
+    ``path`` may be the ``records/`` directory itself or a run dir
+    containing one. Without the supervisor's own bookkeeping the
+    death -> respawn gaps are unknowable (no process was alive to
+    measure them), and which generations were FAILURE relaunches is
+    approximated as every generation after the earliest seen - right
+    for crashed runs, pessimistic for planned grows (noted on the
+    record as ``aggregation: "directory"``)."""
+    d = path
+    sub = os.path.join(path, "records")
+    if os.path.isdir(sub):
+        d = sub
+    records = []
+    skipped = 0
+    try:
+        names = sorted(os.listdir(d))
+    except OSError as e:
+        raise ValueError(f"{path}: {e}")
+    for name in names:
+        if not name.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(d, name)) as f:
+                records.append(validate_record(json.load(f), name))
+        except (OSError, ValueError):
+            skipped += 1  # torn write-through tail or a non-record file
+    if not records:
+        raise ValueError(
+            f"{path}: no readable goodput run records "
+            f"({skipped} file(s) skipped) - expected per-worker "
+            "gen{g}_rank{r}.json records (utils/goodput.py)"
+        )
+    gens = [
+        int(r["generation"]) for r in records
+        if isinstance(r.get("generation"), int)
+    ]
+    restart_gens = (
+        set(g for g in gens if g > min(gens)) if gens else set()
+    )
+    fleet = fleet_goodput_record(
+        records, restart_generations=restart_gens
+    )
+    fleet["aggregation"] = "directory"
+    fleet["skipped_files"] = skipped
+    return fleet
 
 
 # ------------------------------------------------------- trace derivation

@@ -634,3 +634,158 @@ def test_committed_baseline_is_valid_and_self_consistent():
                   "--baseline",
                   os.path.join(REPO, "tools", "goodput_baseline.json"))
     assert r.returncode == 0
+
+
+# ------------------------------------- event stats + distribution export
+
+
+def _stepped_ledger(*, steps=5, step_s=1.0, init=0.5, comp=1.5,
+                    ck=(2.0, 3.0)):
+    led, clk = fake_ledger()
+    led.start()
+    clk[0] = init + comp
+    led.step_span(0, comp)
+    for i in range(steps):
+        clk[0] += step_s
+        led.step_span(i + 1, step_s)
+    for dur in ck:
+        t0 = clk[0]
+        clk[0] += dur
+        led.add("checkpoint_save", t0, clk[0])
+    return led, clk
+
+
+def test_record_carries_per_cause_event_stats():
+    """The events block: raw recorded interval durations, per cause -
+    the empirical-distribution input the fleet twin samples from."""
+    led, clk = _stepped_ledger()
+    rec = led.finalize()
+    ev = rec["events"]
+    assert ev["steady_step"]["count"] == 5
+    assert ev["steady_step"]["mean_s"] == pytest.approx(1.0)
+    assert ev["checkpoint_save"]["count"] == 2
+    assert ev["checkpoint_save"]["samples_s"] == [2.0, 3.0]  # sorted
+    assert ev["checkpoint_save"]["p95_s"] == pytest.approx(3.0)
+    assert ev["compile"]["count"] == 1
+    assert ev["init"]["total_s"] == pytest.approx(0.5)
+    # fills never pollute the distributions (coarse windows, not events)
+    led2, clk2 = fake_ledger()
+    led2.start()
+    clk2[0] = 10.0
+    led2.fill_ending_now(GOODPUT_CAUSE, 10.0)
+    assert "steady_step" not in led2.finalize()["events"]
+
+
+def test_event_sample_cap_preserves_quantiles_deterministically():
+    led, clk = fake_ledger()
+    led.start()
+    clk[0] = 1.0
+    led.step_span(0, 1.0)
+    for i in range(500):
+        clk[0] += 0.002 * (i + 1)
+        led.step_span(i + 1, 0.002 * (i + 1))
+    ev = led.finalize()["events"]["steady_step"]
+    assert ev["count"] == 500
+    assert len(ev["samples_s"]) == gp._DIST_MAX_SAMPLES
+    assert ev["samples_s"] == sorted(ev["samples_s"])
+    assert ev["samples_s"][0] == pytest.approx(0.002)
+    assert ev["samples_s"][-1] == pytest.approx(1.0)
+    assert ev["p50_s"] == pytest.approx(0.5, rel=0.02)
+
+
+def test_fleet_record_pools_rank_events():
+    led_a, _ = _stepped_ledger(ck=(2.0,))
+    led_b, _ = _stepped_ledger(ck=(4.0,))
+    fleet = fleet_goodput_record([led_a.finalize(), led_b.finalize()])
+    ev = fleet["events"]
+    assert ev["checkpoint_save"]["count"] == 2
+    assert ev["checkpoint_save"]["samples_s"] == [2.0, 4.0]
+    assert ev["steady_step"]["count"] == 10
+
+
+def test_extract_distributions_pools_and_nets_restart_gaps():
+    led, _ = _stepped_ledger()
+    fleet = fleet_goodput_record(
+        [led.finalize()],
+        restart_gaps=[
+            {"seconds": 6.0, "group_size": 2, "backoff_s": 2.0},
+            {"seconds": 3.0, "group_size": 1},  # legacy: no backoff_s
+        ],
+    )
+    doc = gp.extract_distributions([fleet])
+    assert doc["kind"] == "distributions"
+    assert doc["causes"]["restart_gap"]["samples_s"] == [3.0, 4.0]
+    assert doc["causes"]["steady_step"]["count"] == 5
+    # derived per-step host overhead: idle seconds over executed steps
+    assert doc["derived"]["step_overhead_s"] >= 0.0
+
+
+def test_extract_distributions_falls_back_without_events():
+    """Records from the untelemetered fast path (or pre-events builds)
+    still contribute aggregate-derived single samples."""
+    rec = _rank_record()  # no events block
+    doc = gp.extract_distributions([rec])
+    assert doc["causes"]["init"]["samples_s"] == [1.0]
+    assert doc["causes"]["compile"]["samples_s"] == [2.0]
+    # mean step time from goodput_s / goodput_steps
+    assert doc["causes"]["steady_step"]["mean_s"] == pytest.approx(
+        6.0 / 9.0)
+    assert doc["causes"]["steady_step"]["count"] == 9
+
+
+def test_aggregate_records_dir_renders_crashed_run(tmp_path):
+    """A run that crashed before the supervisor aggregated: the
+    per-worker write-through records alone render as a fleet view."""
+    d = tmp_path / "records"
+    d.mkdir()
+    (d / "gen0_rank0.json").write_text(
+        json.dumps(_rank_record(rank=0, generation=0)))
+    (d / "gen0_rank1.json").write_text(
+        json.dumps(_rank_record(rank=1, generation=0, final=False)))
+    (d / "gen1_rank0.json").write_text(
+        json.dumps(_rank_record(rank=0, generation=1)))
+    (d / "torn.json").write_text("{half a wri")
+    (d / "notes.txt").write_text("not a record")
+    fleet = gp.aggregate_records_dir(str(tmp_path))  # run dir form
+    assert fleet["kind"] == "fleet" and fleet["n_records"] == 3
+    assert fleet["aggregation"] == "directory"
+    assert fleet["skipped_files"] == 1
+    # generations after the earliest are treated as failure relaunches:
+    # gen1's init+compile reclassified into restart_gap
+    assert fleet["badput_s"]["restart_gap"] == pytest.approx(3.0)
+    assert fleet["badput_s"]["init"] == pytest.approx(2.0)  # gen0 only
+    total = fleet["goodput_s"] + sum(fleet["badput_s"].values())
+    assert total == pytest.approx(fleet["wall_s"], rel=1e-9)
+    # the records/ dir itself works too
+    assert gp.aggregate_records_dir(str(d))["n_records"] == 3
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    with pytest.raises(ValueError, match="no readable"):
+        gp.aggregate_records_dir(str(empty))
+
+
+def test_cli_renders_directory_and_exports_distributions(tmp_path):
+    d = tmp_path / "records"
+    d.mkdir()
+    led, _ = _stepped_ledger()
+    rec = led.finalize()
+    rec.update(rank=0, generation=0)
+    (d / "gen0_rank0.json").write_text(json.dumps(rec))
+    r = _run_tool(str(tmp_path))
+    assert r.returncode == 0, r.stderr
+    assert "fleet record" in r.stdout and "steady_step" in r.stdout
+    # --distributions to stdout and to a file
+    r = _run_tool("--distributions", str(tmp_path))
+    assert r.returncode == 0, r.stderr
+    doc = json.loads(r.stdout)
+    assert doc["kind"] == "distributions"
+    assert doc["causes"]["checkpoint_save"]["count"] == 2
+    out = tmp_path / "dists.json"
+    r = _run_tool("--distributions", str(d / "gen0_rank0.json"),
+                  "-o", str(out))
+    assert r.returncode == 0 and out.is_file()
+    # --distributions is a mode: combining with RECORD is a usage error
+    r = _run_tool(str(tmp_path), "--distributions", str(tmp_path))
+    assert r.returncode == 2
+    assert _run_tool("--distributions",
+                     str(tmp_path / "missing")).returncode == 2

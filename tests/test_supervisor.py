@@ -523,6 +523,11 @@ def test_fleet_goodput_aggregation_and_restart_gap(tmp_path):
     assert sup.restart_generations == {1}
     gap = sup.restart_gaps[0]
     assert gap["seconds"] > 0 and gap["generation"] == 1
+    # the policy's backoff pause is recorded separately, so distribution
+    # extraction (fleetsim's inputs) can report the gap NET of it
+    assert gap["backoff_s"] == pytest.approx(
+        sup.cfg.backoff_for(1), rel=0.01)
+    assert gap["seconds"] >= gap["backoff_s"] - 1e-6
     assert fleet["badput_s"]["restart_gap"] >= gap["seconds"] + 0.4 - 1e-6
     total = fleet["goodput_s"] + sum(fleet["badput_s"].values())
     assert total == pytest.approx(fleet["wall_s"], rel=1e-6)

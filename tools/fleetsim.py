@@ -1,0 +1,394 @@
+#!/usr/bin/env python
+"""Fleet digital twin CLI (analysis/fleetsim.py): predict goodput for a
+fleet you don't own, rank robustness policies, derive the optimal
+checkpoint cadence, and validate the simulator against a measured run.
+
+  # forward-simulate one policy over a synthetic Poisson failure trace
+  python tools/fleetsim.py --procs 64 --failure-rate 0.02 --horizon-h 24 \
+      --step-time 0.8 --checkpoint-every 200 \
+      [--distributions dists.json] [--seed 0] [-o fleetsim.json]
+
+  # rank a policy grid (repeatable --sweep KNOB=V1,V2,...; knobs may be
+  # SimPolicy fields or the shared SupervisorPolicy fields)
+  python tools/fleetsim.py --procs 64 --failure-rate 0.02 --horizon-h 24 \
+      --sweep checkpoint_every_steps=50,200,800 --sweep max_restarts=2,8
+
+  # optimal checkpoint cadence, cross-checked against Young/Daly
+  python tools/fleetsim.py --procs 64 --failure-rate 0.02 --horizon-h 24 \
+      --step-time 0.8 --checkpoint-write 12 --cadence-search
+
+  # rank autoshard plans by goodput-under-failures (the second scoring
+  # axis: cost-model step seconds x the failure process)
+  python tools/fleetsim.py --plans distributed_neural_network_tpu/analysis/plans/lm_*.json \
+      --procs 16 --failure-rate 0.05 --horizon-h 12 --hw tpu-v5e \
+      --params 1e9 --tokens-per-step 5e5
+
+  # closed-loop validation: replay the failure history a supervised run
+  # recorded (run_record.json + records/gen{g}_rank{r}.json) through the
+  # event model and assert bucket agreement within tolerance
+  # (exit 0 = agree, 1 = prediction drift, 2 = usage/input error)
+  python tools/fleetsim.py --validate svrun [--record OTHER.json] \
+      [--ratio-tol 0.1] [--share-tol 0.1] [-o fleetsim.json]
+
+Empirical inputs come from `tools/goodput.py --distributions` (restart
+gaps, checkpoint saves, init/compile, measured step times); without
+them the policy's fallback durations apply. Predicted records are
+schema-compatible (`kind: "sim"`): render/diff/gate them with
+tools/goodput.py, and drop `-o fleetsim.json` into a run dir for
+tools/live_top.py's predicted-vs-actual line.
+Semantics: docs/OBSERVABILITY.md "Fleet digital twin".
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from distributed_neural_network_tpu.analysis import fleetsim as fs  # noqa: E402
+from distributed_neural_network_tpu.train.supervisor import (  # noqa: E402
+    SupervisorPolicy,
+)
+from distributed_neural_network_tpu.utils.goodput import (  # noqa: E402
+    read_record,
+    render_record,
+    validate_record,
+)
+
+
+def _build_policy(args) -> fs.SimPolicy:
+    sup = SupervisorPolicy(
+        nprocs=args.procs,
+        min_procs=args.min_procs,
+        max_restarts=args.max_restarts,
+        restart_backoff_s=args.restart_backoff,
+        backoff_cap_s=args.backoff_cap,
+        grace_s=args.grace,
+        grow_after_s=args.grow_after,
+    )
+    return fs.SimPolicy(
+        supervisor=sup,
+        checkpoint_every_steps=args.checkpoint_every,
+        step_time_s=args.step_time,
+        step_overhead_s=args.step_overhead,
+        tokens_per_step=args.tokens_per_step,
+        init_s=args.init_s,
+        compile_s=args.compile_s,
+        checkpoint_write_s=args.checkpoint_write,
+        restart_gap_s=args.restart_gap,
+    )
+
+
+def _parse_sweep(pairs) -> dict:
+    out = {}
+    for pair in pairs or ():
+        if "=" not in pair:
+            raise ValueError(
+                f"--sweep wants KNOB=V1,V2,..., got {pair!r}"
+            )
+        knob, vals = pair.split("=", 1)
+        parsed = []
+        for v in vals.split(","):
+            v = v.strip()
+            try:
+                parsed.append(int(v))
+            except ValueError:
+                try:
+                    parsed.append(float(v))
+                except ValueError:
+                    raise ValueError(
+                        f"--sweep {pair!r}: {v!r} is not a number"
+                    )
+        out[knob.strip()] = parsed
+    return out
+
+
+def _write_out(path: str | None, rec: dict) -> None:
+    if not path:
+        return
+    with open(path, "w") as f:
+        json.dump(rec, f, indent=1)
+    print(f"(fleetsim: predicted record -> {path})")
+
+
+def _load_rank_records(run_dir: str) -> list:
+    d = os.path.join(run_dir, "records")
+    if not os.path.isdir(d):
+        d = run_dir
+    out = []
+    for name in sorted(os.listdir(d)):
+        if not name.endswith(".json") or name == "run_record.json":
+            continue
+        try:
+            with open(os.path.join(d, name)) as f:
+                out.append(validate_record(json.load(f), name))
+        except (OSError, ValueError):
+            continue  # torn write-through tail / non-record file
+    return out
+
+
+def run_validate(args) -> int:
+    run_dir = args.validate
+    record_path = args.record or os.path.join(run_dir, "run_record.json")
+    try:
+        fleet = read_record(record_path)
+    except (OSError, ValueError) as e:
+        print(f"fleetsim: cannot read the measured fleet record: {e}",
+              file=sys.stderr)
+        return 2
+    ranks = _load_rank_records(run_dir)
+    if not ranks:
+        print(
+            f"fleetsim: no per-worker records under {run_dir} "
+            "(expected records/gen{g}_rank{r}.json write-through "
+            "records from a supervised run)", file=sys.stderr,
+        )
+        return 2
+    pred = fs.predict_from_ledger(fleet, ranks)
+    problems = fs.compare_records(
+        pred, fleet, ratio_tol=args.ratio_tol, share_tol=args.share_tol
+    )
+    print(render_record(
+        pred, title=f"Fleetsim replay of {record_path} "
+        f"({len(ranks)} rank record(s))"
+    ))
+    print()
+    print(render_record(fleet, title="Measured ledger record"))
+    _write_out(args.json_out, pred)
+    if problems:
+        print(f"\nFLEETSIM VALIDATION FAILED ({len(problems)} "
+              "disagreement(s)):")
+        for prob in problems:
+            print(f"  - {prob}")
+        print("\nThe simulator's event model no longer reproduces the "
+              "measured ledger - fix the drift (or loosen the tolerance "
+              "with --ratio-tol/--share-tol if the run's accounting "
+              "legitimately changed).")
+        return 1
+    print(f"\nfleetsim validation OK: prediction within "
+          f"ratio-tol {args.ratio_tol:g} / share-tol {args.share_tol:g} "
+          "of the measured ledger")
+    return 0
+
+
+def run_cadence_search(args, policy, dists) -> int:
+    res = fs.cadence_search(
+        policy, dists,
+        rate_per_chip_per_h=args.failure_rate,
+        horizon_s=args.horizon_h * 3600.0,
+        seeds=tuple(range(args.seed, args.seed + args.seeds)),
+    )
+    if not res["results"]:
+        print("fleetsim: empty cadence grid (is --failure-rate 0?)",
+              file=sys.stderr)
+        return 2
+    yd = res["young_daly"]
+    print(f"Checkpoint-cadence search ({len(res['results'])} candidates, "
+          f"group MTBF {yd['mtbf_s']:,.0f}s, checkpoint "
+          f"{yd['checkpoint_s']:,.1f}s):")
+    print(f"  {'every':>8} {'interval':>12} {'eff-goodput':>12}")
+    best = res["best"]
+    for k, tau, ratio in res["results"]:
+        tag = "  <- best" if (k, tau, ratio) == best else ""
+        print(f"  {k:>8} {tau:>11,.1f}s {ratio:>11.2%}{tag}")
+    print(
+        f"  Young/Daly sqrt(2*delta*MTBF) = {yd['interval_s']:,.1f}s "
+        f"(cadence {yd['cadence_steps']}); simulated best "
+        f"{best[1]:,.1f}s = {100.0 * best[1] / yd['interval_s']:.0f}% "
+        "of the first-order optimum"
+    )
+    return 0
+
+
+def run_plans(args, policy, dists) -> int:
+    paths = []
+    for pat in args.plans:
+        hits = sorted(glob.glob(pat))
+        paths.extend(hits if hits else [pat])
+    docs = []
+    for path in paths:
+        with open(path) as f:
+            docs.append(json.load(f))
+    flops = args.flops_per_step
+    if not flops and args.params:
+        from distributed_neural_network_tpu.analysis.cost import (
+            dense_step_flops,
+        )
+
+        flops = dense_step_flops(args.params, args.tokens_per_step)
+    from distributed_neural_network_tpu.analysis.cost import (
+        HARDWARE_MODELS,
+    )
+
+    if args.hw not in HARDWARE_MODELS:
+        print(f"fleetsim: unknown --hw {args.hw!r} (known: "
+              f"{', '.join(sorted(HARDWARE_MODELS))})", file=sys.stderr)
+        return 2
+    ranked = fs.rank_plans_by_goodput(
+        docs, policy, dists,
+        hw=HARDWARE_MODELS[args.hw], flops_per_step=flops,
+        rate_per_chip_per_h=args.failure_rate,
+        horizon_s=args.horizon_h * 3600.0,
+        seeds=tuple(range(args.seed, args.seed + args.seeds)),
+    )
+    print(f"Plans ranked by predicted goodput-under-failures "
+          f"({args.procs} procs, {args.failure_rate:g}/chip/h, "
+          f"{args.horizon_h:g}h horizon, hw {args.hw}; metric = "
+          "surviving steps per capacity-second):")
+    for i, row in enumerate(ranked):
+        print(f"  #{i + 1} {row['plan']:<28} "
+              f"{row['progress_steps_per_cap_s']:,.3f} steps/cap-s  "
+              f"step {row['step_s'] * 1e3:,.3f} ms  "
+              f"eff-goodput {row['effective_goodput_ratio']:.2%}  "
+              f"(bytes-score {row['score']:,})"
+              + ("  [ABORTED]" if row["aborted"] else ""))
+        print(f"      {row['step_why']}")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    mode = p.add_argument_group("modes (default: forward-simulate once)")
+    mode.add_argument("--validate", metavar="RUN_DIR",
+                      help="replay a supervised run's measured failure "
+                      "history and assert sim-vs-ledger agreement")
+    mode.add_argument("--cadence-search", action="store_true",
+                      help="search checkpoint cadences, cross-checked "
+                      "against the Young/Daly optimum")
+    mode.add_argument("--sweep", action="append", metavar="KNOB=V1,V2",
+                      help="rank a policy grid over these knob values "
+                      "(repeatable; SimPolicy or SupervisorPolicy "
+                      "fields)")
+    mode.add_argument("--plans", nargs="+", metavar="PLAN.json",
+                      help="rank autoshard plan manifests by "
+                      "goodput-under-failures (cost-model step seconds)")
+    pol = p.add_argument_group("policy (shared SupervisorPolicy + workload)")
+    pol.add_argument("--procs", type=int, default=2)
+    pol.add_argument("--min-procs", type=int, default=1)
+    pol.add_argument("--max-restarts", type=int, default=3)
+    pol.add_argument("--restart-backoff", type=float, default=1.0)
+    pol.add_argument("--backoff-cap", type=float, default=30.0)
+    pol.add_argument("--grace", type=float, default=10.0)
+    pol.add_argument("--grow-after", type=float, default=0.0)
+    pol.add_argument("--checkpoint-every", type=int, default=0,
+                     metavar="STEPS")
+    pol.add_argument("--step-time", type=float, default=None, metavar="SEC",
+                     help="steady step seconds (default: the "
+                     "distributions' measured mean, else 1.0)")
+    pol.add_argument("--step-overhead", type=float, default=None,
+                     metavar="SEC", help="per-step host overhead "
+                     "(default: the distributions' derived value, else 0)")
+    pol.add_argument("--tokens-per-step", type=float, default=0.0)
+    pol.add_argument("--init-s", type=float, default=5.0)
+    pol.add_argument("--compile-s", type=float, default=10.0)
+    pol.add_argument("--checkpoint-write", type=float, default=1.0,
+                     metavar="SEC")
+    pol.add_argument("--restart-gap", type=float, default=10.0,
+                     metavar="SEC")
+    tr = p.add_argument_group("failure trace")
+    tr.add_argument("--chips", type=int, default=None,
+                    help="failing machines (default: --procs)")
+    tr.add_argument("--failure-rate", type=float, default=0.01,
+                    metavar="PER_CHIP_PER_H")
+    tr.add_argument("--preempt-fraction", type=float, default=0.0)
+    tr.add_argument("--horizon-h", type=float, default=24.0)
+    tr.add_argument("--target-steps", type=int, default=None)
+    tr.add_argument("--seed", type=int, default=0)
+    tr.add_argument("--seeds", type=int, default=2,
+                    help="seeds averaged in sweep/cadence/plan modes")
+    io = p.add_argument_group("inputs / outputs")
+    io.add_argument("--distributions", metavar="DISTS.json",
+                    help="empirical distributions from tools/goodput.py "
+                    "--distributions")
+    io.add_argument("--record", metavar="RECORD.json",
+                    help="--validate: measured fleet record override "
+                    "(default RUN_DIR/run_record.json)")
+    io.add_argument("--ratio-tol", type=float, default=0.1)
+    io.add_argument("--share-tol", type=float, default=0.1)
+    io.add_argument("--hw", default="tpu-v5e",
+                    help="--plans: hardware model for step pricing")
+    io.add_argument("--params", type=float, default=0.0,
+                    help="--plans: parameter count for 6*P*T step flops")
+    io.add_argument("--flops-per-step", type=float, default=0.0)
+    io.add_argument("-o", "--json-out", metavar="OUT.json",
+                    help="write the predicted record (drop fleetsim.json "
+                    "into a run dir for live_top's predicted line)")
+    args = p.parse_args(argv)
+
+    try:
+        if args.validate:
+            return run_validate(args)
+        dists = (
+            fs.Distributions.load(args.distributions)
+            if args.distributions else fs.Distributions()
+        )
+        if args.step_overhead is None:
+            args.step_overhead = dists.step_overhead_s(0.0)
+        if args.step_time is None:
+            # the measured step-time distribution wins over the default
+            args.step_time = dists.mean("steady_step", 1.0)
+        policy = _build_policy(args)
+        if args.cadence_search:
+            return run_cadence_search(args, policy, dists)
+        if args.plans:
+            return run_plans(args, policy, dists)
+        if args.sweep:
+            grid = fs.policy_variants(policy, _parse_sweep(args.sweep))
+            ranked = fs.rank_policies(
+                grid, dists,
+                n_chips=args.chips or args.procs,
+                rate_per_chip_per_h=args.failure_rate,
+                horizon_s=args.horizon_h * 3600.0,
+                preempt_fraction=args.preempt_fraction,
+                seeds=tuple(range(args.seed, args.seed + args.seeds)),
+            )
+            print(f"Policies ranked by effective goodput "
+                  f"({len(ranked)} candidate(s), "
+                  f"{args.seeds} seed(s) averaged):")
+            for i, row in enumerate(ranked):
+                print(f"  #{i + 1} {row['label']:<44} "
+                      f"eff {row['effective_goodput_ratio']:.2%}  "
+                      f"ledger {row['goodput_ratio']:.2%}"
+                      + ("  [ABORTED]" if row["aborted"] else ""))
+            _write_out(args.json_out, ranked[0]["record"])
+            return 0
+        trace = fs.synthesize_failure_trace(
+            args.chips or args.procs,
+            rate_per_chip_per_h=args.failure_rate,
+            horizon_s=args.horizon_h * 3600.0,
+            seed=args.seed,
+            preempt_fraction=args.preempt_fraction,
+        )
+        rec = fs.simulate(
+            policy, trace, dists,
+            horizon_s=args.horizon_h * 3600.0,
+            target_steps=args.target_steps, seed=args.seed,
+        )
+        m = rec["metrics"]
+        print(render_record(
+            rec, title=f"Fleetsim prediction ({args.procs} procs, "
+            f"{len(trace)} failure event(s), seed {args.seed})"
+        ))
+        print(f"  effective goodput {m['effective_goodput_ratio']:.2%} "
+              f"({m['lost_steps']} lost step(s), "
+              f"{m['restarts_used']} restart(s), "
+              f"{m['generations']} generation(s))"
+              + (f"; ABORTED: {m['abort_reason']}"
+                 if m["aborted"] else ""))
+        _write_out(args.json_out, rec)
+        return 0
+    except (OSError, ValueError) as e:
+        print(f"fleetsim: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

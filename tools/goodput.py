@@ -16,6 +16,11 @@ restart_gap, idle_other). This tool is the operator/CI surface:
   # side-by-side share comparison of two runs
   python tools/goodput.py --diff before.json after.json
 
+  # a run that crashed before the supervisor aggregated: point at the
+  # run dir (or its records/ directory) and the per-worker
+  # gen{g}_rank{r}.json write-through records aggregate on the fly
+  python tools/goodput.py svrun/records
+
   # CI regression gate against a checked-in baseline (shardlint-style
   # exit codes: 0 = within tolerances, 1 = regression, 2 = usage/input
   # error). Tolerances are SHARES of wall-clock, so runs of different
@@ -25,7 +30,13 @@ restart_gap, idle_other). This tool is the operator/CI surface:
       --baseline tools/goodput_baseline.json \
       [--ratio-tol 0.1] [--share-tol 0.1] [--tol stall=0.05 ...]
 
-Semantics: docs/OBSERVABILITY.md "Goodput accounting".
+  # export empirical event-duration distributions (restart gaps,
+  # checkpoint saves, init/compile, measured step times) for the fleet
+  # digital twin (tools/fleetsim.py --distributions)
+  python tools/goodput.py --distributions svrun/records -o dists.json
+
+Semantics: docs/OBSERVABILITY.md "Goodput accounting" and
+"Fleet digital twin".
 """
 
 from __future__ import annotations
@@ -40,9 +51,11 @@ sys.path.insert(0, _REPO)
 
 from distributed_neural_network_tpu.utils.goodput import (  # noqa: E402
     BADPUT_CAUSES,
+    aggregate_records_dir,
     breakdown_from_trace,
     check_record,
     diff_records,
+    extract_distributions,
     render_record,
     validate_record,
 )
@@ -51,7 +64,12 @@ from distributed_neural_network_tpu.utils.goodput import (  # noqa: E402
 def load_input(path: str) -> dict:
     """Load a run record OR a Chrome trace (auto-detected: a doc with
     ``traceEvents`` is a trace and the taxonomy is derived from its
-    spans; anything else must validate as a run record)."""
+    spans; anything else must validate as a run record). A DIRECTORY
+    aggregates its per-worker ``gen{g}_rank{r}.json`` records on the fly
+    - the render path for a run that crashed before the supervisor wrote
+    the fleet ``run_record.json``."""
+    if os.path.isdir(path):
+        return aggregate_records_dir(path)
     try:
         with open(path) as f:
             doc = json.load(f)
@@ -68,6 +86,53 @@ def load_input(path: str) -> dict:
             derived["embedded_record"] = doc["goodput"]
         return derived
     return validate_record(doc, what=path)
+
+
+def _collect_records(paths) -> list:
+    """Record dicts from files and/or directories (a directory
+    contributes every readable per-worker record under it / its
+    ``records`` subdir, plus a fleet ``run_record.json`` if present -
+    but not both channels' duplicates: rank records win)."""
+    records = []
+    for path in paths:
+        if os.path.isdir(path):
+            d = path
+            sub = os.path.join(path, "records")
+            if os.path.isdir(sub):
+                d = sub
+            found = []
+            for name in sorted(os.listdir(d)):
+                if not name.endswith(".json"):
+                    continue
+                try:
+                    with open(os.path.join(d, name)) as f:
+                        found.append(validate_record(json.load(f), name))
+                except (OSError, ValueError):
+                    continue
+            if not found:
+                raise ValueError(f"{path}: no readable run records")
+            records.extend(found)
+            # the supervisor's fleet record adds the restart gaps the
+            # rank records cannot know; its pooled events are skipped
+            # (the ranks above already contributed them)
+            fleet_path = os.path.join(path, "run_record.json")
+            if d != path and os.path.isfile(fleet_path):
+                try:
+                    fleet = validate_record(
+                        json.load(open(fleet_path)), fleet_path
+                    )
+                    records.append({
+                        "version": fleet["version"],
+                        "kind": "fleet",
+                        "wall_s": 0.0,
+                        "badput_s": {},
+                        "restart_gaps": fleet.get("restart_gaps") or [],
+                    })
+                except (OSError, ValueError, KeyError):
+                    pass
+        else:
+            records.append(load_input(path))
+    return records
 
 
 def _parse_cause_tols(pairs) -> dict:
@@ -111,16 +176,42 @@ def main(argv=None) -> int:
     p.add_argument("--tol", action="append", metavar="CAUSE=SHARE",
                    help="per-cause share tolerance override "
                    "(repeatable), e.g. --tol stall=0.05")
+    p.add_argument("--distributions", nargs="+", metavar="RECORD_OR_DIR",
+                   help="export empirical event-duration distributions "
+                   "(the fleet digital twin's inputs: restart gaps, "
+                   "checkpoint saves, init/compile, step times) from "
+                   "records and/or run dirs")
+    p.add_argument("-o", "--out", metavar="OUT.json",
+                   help="--distributions: write the document here "
+                   "instead of stdout")
     args = p.parse_args(argv)
 
-    modes = sum(bool(x) for x in (args.record, args.diff, args.check))
+    modes = sum(bool(x) for x in (args.record, args.diff, args.check,
+                                  args.distributions))
     if modes != 1:
         p.print_usage(sys.stderr)
-        print("goodput: give exactly one of RECORD, --diff A B, or "
-              "--check RECORD --baseline BASE", file=sys.stderr)
+        print("goodput: give exactly one of RECORD, --diff A B, "
+              "--check RECORD --baseline BASE, or --distributions ...",
+              file=sys.stderr)
         return 2
 
     try:
+        if args.distributions:
+            doc = extract_distributions(
+                _collect_records(args.distributions)
+            )
+            blob = json.dumps(doc, indent=1, sort_keys=True)
+            if args.out:
+                with open(args.out, "w") as f:
+                    f.write(blob + "\n")
+                causes = ", ".join(
+                    f"{c}({v['count']})" for c, v in doc["causes"].items()
+                )
+                print(f"(distributions from {doc['n_records']} record(s) "
+                      f"-> {args.out}: {causes or 'no events'})")
+            else:
+                print(blob)
+            return 0
         if args.diff:
             a, b = (load_input(x) for x in args.diff)
             print(diff_records(a, b, os.path.basename(args.diff[0]),

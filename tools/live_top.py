@@ -34,6 +34,7 @@ from __future__ import annotations
 import argparse
 import json
 import math
+import os
 import sys
 import time
 import urllib.error
@@ -427,6 +428,8 @@ def render(snap: dict, *, color: bool = True, width: int = 72) -> str:
     # ledger or the supervisor's fleet aggregation): what fraction of
     # wall-clock produced training progress, and where the rest went
     gp = metric_value(m, "goodput_ratio")
+    predicted = snap.get("predicted") or {}
+    pred_ratio = predicted.get("ratio")
     if gp is not None:
         badput = m.get("badput_seconds_total") or {}
         top = sorted(
@@ -442,7 +445,28 @@ def render(snap: dict, *, color: bool = True, width: int = 72) -> str:
         # color by ratio: the fleet's headline number reads at a glance
         gp_line = c(GREEN if gp >= 0.8 else YELLOW if gp >= 0.5 else RED,
                     gp_line)
+        if pred_ratio is not None:
+            # a fleetsim prediction (tools/fleetsim.py -o fleetsim.json
+            # in the run dir): show the predicted-vs-actual gap, color-
+            # banded by |gap| - a run drifting from its digital twin is
+            # the signal to re-extract distributions or suspect the run
+            gap = gp - pred_ratio
+            gap_col = (
+                GREEN if abs(gap) < 0.05
+                else YELLOW if abs(gap) < 0.15 else RED
+            )
+            gp_line += c(
+                gap_col,
+                f"  predicted {100.0 * pred_ratio:5.1f}% "
+                f"(gap {100.0 * gap:+.1f}%)",
+            )
         lines.append(gp_line)
+    elif pred_ratio is not None:
+        lines.append(c(
+            DIM,
+            f"goodput     n/a  predicted {100.0 * pred_ratio:5.1f}% "
+            "(fleetsim; no measured ratio yet)",
+        ))
     # elastic supervisor (train/supervisor.py; present when the target is
     # a tools/launch.py --metrics-port endpoint)
     gsz = metric_value(m, "supervisor_group_size")
@@ -529,6 +553,44 @@ def make_source(target: str):
     return JsonlSource(target)
 
 
+def find_predicted(target: str, explicit: str | None) -> str | None:
+    """Resolve the fleetsim prediction file: ``--predicted`` wins; a
+    file target auto-detects a sibling ``fleetsim.json`` in its run dir
+    (endpoint targets have no local run dir to search)."""
+    if explicit:
+        return explicit
+    if not target.startswith(("http://", "https://")):
+        cand = os.path.join(
+            os.path.dirname(os.path.abspath(target)), "fleetsim.json"
+        )
+        if os.path.isfile(cand):
+            return cand
+    return None
+
+
+def load_predicted(path: str | None) -> dict | None:
+    """{"ratio", "effective", "path"} from a fleetsim predicted record
+    (tools/fleetsim.py -o); None when absent/unreadable - a dashboard
+    never crashes on a half-written file."""
+    if not path:
+        return None
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        ratio = doc.get("goodput_ratio")
+        if ratio is None:
+            return None
+        return {
+            "ratio": float(ratio),
+            "effective": (doc.get("metrics") or {}).get(
+                "effective_goodput_ratio"
+            ),
+            "path": path,
+        }
+    except (OSError, ValueError, TypeError):
+        return None
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
@@ -542,15 +604,24 @@ def main(argv=None) -> int:
                     help="render a single frame and exit (CI/scripting)")
     ap.add_argument("--no-color", action="store_true")
     ap.add_argument("--width", type=int, default=72)
+    ap.add_argument("--predicted", metavar="FLEETSIM.json",
+                    help="fleetsim predicted record for the goodput "
+                    "predicted-vs-actual gap (auto-detected as "
+                    "fleetsim.json next to a file target)")
     args = ap.parse_args(argv)
 
     src = make_source(args.target)
+    predicted_path = find_predicted(args.target, args.predicted)
     color = not args.no_color and sys.stdout.isatty()
     if args.once:
         color = not args.no_color and False
     try:
         while True:
             snap = src.sample()
+            if snap is not None and predicted_path:
+                # re-read each frame: a rerun of tools/fleetsim.py may
+                # refresh the prediction mid-run
+                snap["predicted"] = load_predicted(predicted_path)
             if snap is None:
                 err = getattr(src, "error", None)
                 frame = (
