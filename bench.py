@@ -548,6 +548,19 @@ def _rows(epochs: int) -> list[dict]:
             "env": {"JAX_PLATFORMS": "cpu"},
             "args": {},
         },
+        # the serving stack priced end to end (serve/ + tools/loadgen.py,
+        # docs/SERVING.md): sustained requests/s, p50/p99 TTFT and
+        # inter-token p99 under open-loop load against a real in-process
+        # HTTP+SSE server - continuous batching + paged KV + admission
+        # all in the measured path, with the serving goodput breakdown
+        # (decode/prefill/queue_wait/...) attached to the row
+        {
+            "id": "serve_d512_L8_bf16_openloop",
+            "kind": "serving",
+            "est_s": 900,
+            "args": {"dtype": "bfloat16", "rate": 4.0, "requests": 24,
+                     "max_new": 32},
+        },
     ]
     return rows
 
@@ -633,6 +646,12 @@ def _run_worker(spec: dict) -> dict:
         )
 
         return measure_native_batcher(**spec["args"])
+    if spec["kind"] == "serving":
+        from distributed_neural_network_tpu.train.measure import (
+            measure_serving,
+        )
+
+        return measure_serving(**spec["args"])
     raise ValueError(f"unknown row kind {spec['kind']!r}")
 
 

@@ -444,6 +444,12 @@ def generate_sharded(
     prompt = jax.device_put(
         prompt, NamedSharding(mesh, PartitionSpec(data_axis))
     )
+    if kw.get("prompt_lens") is not None:
+        kw = dict(kw)
+        kw["prompt_lens"] = jax.device_put(
+            jnp.asarray(kw["prompt_lens"], jnp.int32),
+            NamedSharding(mesh, PartitionSpec(data_axis)),
+        )
     return generate(params, prompt, cfg, **kw)
 
 
@@ -460,6 +466,7 @@ def generate(
     top_k: int = 0,
     top_p: float = 0.0,
     key: jax.Array | None = None,
+    prompt_lens=None,
 ):
     """Autoregressive decoding with per-layer KV caches.
 
@@ -471,6 +478,20 @@ def generate(
     set of tokens whose cumulative probability (at this temperature,
     after any top-k cut) reaches top_p. Both filters always keep the
     most likely token, so sampling never degenerates.
+
+    ``prompt_lens`` (B,) int32 makes the batch LEFT-PADDED mixed-length:
+    sequence b's real tokens occupy the LAST ``prompt_lens[b]`` columns
+    (columns 0..S_p-len-1 are pad and fully ignored - their cache
+    entries are masked out of every attention and their position ids
+    never exist). Left padding aligns every sequence's last prompt token
+    at column S_p-1, so generation is the uniform region [S_p, total) -
+    exactly the batch shape a continuous-batching server feeds
+    (serve/engine.py). Per-sequence positions are 0..len-1 (position
+    embeddings offset by the pad width), so each row decodes exactly as
+    its unpadded single-sequence `generate` would (pinned by
+    tests/test_generate.py against the per-sequence oracle). Not
+    supported with the fused Pallas decode kernel (a scalar-pos kernel;
+    per-sequence masks need the XLA path) - explicitly rejected.
 
     TPU-shaped: one `lax.scan` over time steps (static total length
     S_p + max_new_tokens), an inner scan over the stacked layers, KV
@@ -494,6 +515,21 @@ def generate(
         raise ValueError(f"top_p must be in [0, 1], got {top_p}")
     dt = cfg.dtype
     b, s_p = prompt.shape
+    offsets = None
+    if prompt_lens is not None:
+        prompt_lens = jnp.asarray(prompt_lens, jnp.int32)
+        if prompt_lens.shape != (b,):
+            raise ValueError(
+                f"prompt_lens must be shape ({b},) to match the prompt "
+                f"batch, got {prompt_lens.shape}"
+            )
+        lens = np.asarray(prompt_lens)
+        if (lens < 1).any() or (lens > s_p).any():
+            raise ValueError(
+                f"prompt_lens must be in [1, {s_p}] (the padded prompt "
+                f"width), got {lens.tolist()}"
+            )
+        offsets = s_p - prompt_lens  # pad width per sequence
     total = s_p + max_new_tokens
     L, H, Dh = cfg.n_layers, cfg.n_heads, cfg.head_dim
     prompt_pad = jnp.pad(prompt, ((0, 0), (0, max_new_tokens)))
@@ -511,6 +547,12 @@ def generate(
         raise ValueError(f"unknown decode impl {impl!r} "
                          "(DNN_TPU_DECODE_IMPL)")
     use_kernel = impl in ("pallas", "pallas-interpret")
+    if use_kernel and offsets is not None:
+        raise ValueError(
+            "decode impl {!r} does not support left-padded batches "
+            "(prompt_lens): the fused kernel masks on a scalar position; "
+            "use impl=auto/xla for mixed-length prompts".format(impl)
+        )
     if use_kernel and not decode_kernel_ok(total):
         # an explicitly requested kernel must not silently measure XLA
         raise ValueError(
@@ -549,7 +591,14 @@ def generate(
                 "bqhd,bhsd->bhqs", q, ck
             ).astype(jnp.float32)
             scores = scores / np.sqrt(Dh)
-            live = (jnp.arange(total) <= pos)[None, None, None, :]
+            live = (jnp.arange(total) <= pos)[None, :]
+            if offsets is not None:
+                # left-padded batch: pad columns (before each row's
+                # offset) never existed - mask their cache entries out
+                live = live & (
+                    jnp.arange(total)[None, :] >= offsets[:, None]
+                )
+            live = live[:, None, None, :]
             probs = jax.nn.softmax(jnp.where(live, scores, neg), axis=-1)
             o = jnp.einsum("bhqs,bhsd->bqhd", probs.astype(dt), cv)
             o = o.reshape(b, 1, H * Dh)
@@ -582,7 +631,14 @@ def generate(
                                          keepdims=False),
             prev,
         )
-        x = params["embed"][tok].astype(dt)[:, None, :] + pe_all[pos][None, None]
+        if offsets is None:
+            pe = pe_all[pos][None, None]
+        else:
+            # per-sequence positions: global slot pos maps to local
+            # position pos - offset (clipped: pad slots get position 0,
+            # masked out of every attention anyway)
+            pe = pe_all[jnp.clip(pos - offsets, 0)][:, None, :]
+        x = params["embed"][tok].astype(dt)[:, None, :] + pe
         (x, _), (ck, cv) = jax.lax.scan(
             layer_step, (x, pos), (params["layers"], ck, cv),
             # unrolling the (short) layer scan lets XLA overlap across

@@ -1,0 +1,37 @@
+"""Production inference service: continuous batching over a paged KV
+cache, streamed over HTTP (ROADMAP item 1, the "millions of users"
+pillar).
+
+Layers, bottom up:
+
+- `kv_cache.py`  - the block/paged KV-cache allocator: fixed-size blocks
+  out of one shared device pool, a block table per sequence, so
+  thousands of concurrent mixed-length sequences share device memory
+  without per-request max-seq allocation.
+- `engine.py`    - the model-executing engine: one jitted decode step
+  per (batch, table-width) bucket that consumes exactly one token per
+  active slot - continuous (in-flight) batching falls out, sequences
+  join at any step boundary and retire without draining - plus a
+  chunked-prefill fast path so long prompts cannot starve decode.
+- `scheduler.py` - admission control (bounded queue -> 429), per-tenant
+  token-bucket fairness, the serve loop, and the serving goodput ledger
+  (queue_wait / prefill / decode / batch_formation_idle /
+  kv_alloc_stall - `utils/goodput.py` taxonomy "serve").
+- `http.py`      - the HTTP face: `POST /v1/generate` with
+  server-sent-event token streaming on the ObsServer route surface
+  (`/metrics` + `/healthz` come with it), and the `python -m
+  distributed_neural_network_tpu.serve` CLI.
+
+docs/SERVING.md covers architecture, batching semantics, the KV-block
+math, the ledger taxonomy, and the load-generator workflow
+(tools/loadgen.py).
+"""
+
+from .engine import EngineConfig, ServeEngine, Sequence  # noqa: F401
+from .kv_cache import KVCacheConfig, OutOfBlocks, PagedKVCache  # noqa: F401
+from .scheduler import (  # noqa: F401
+    AdmissionError,
+    SchedulerConfig,
+    ServeRequest,
+    ServeScheduler,
+)

@@ -1,0 +1,563 @@
+"""Continuous-batching decode engine over the paged KV cache.
+
+The execution model, in one paragraph: every engine tick runs ONE
+jitted decode step in which each active slot consumes exactly one token
+- a prompt token while the sequence is still prefilling (its logits
+discarded, except at the last prompt position, which yields the first
+generated token), a just-generated token afterwards. Because prompt and
+generation tokens ride the same step, sequences JOIN the batch at any
+step boundary and RETIRE without draining anyone else - continuous
+(in-flight) batching is the default behavior, not a special mode. KV
+state lives in the shared paged pool (`kv_cache.py`): the step
+scatter-writes each slot's new K/V at ``block_table[pos // bs] * bs +
+pos % bs`` and gather-reads each slot's whole table, so one compiled
+program serves any mix of sequence lengths at a given (batch,
+table-width) bucket.
+
+Two static-shape bucket axes bound compile count: batch size and table
+width both round up to powers of two, so a server that has seen B=4/W=2
+traffic never compiles again for B<=4/W<=2.
+
+**Prefill/decode separation** (``prefill_chunk > 1``): long prompts pay
+one model call per token on the default path - correct, and bitwise
+identical to `models/transformer.py generate` (the parity pin), but a
+1000-token prompt would occupy 1000 ticks. The chunked prefill path
+processes up to ``prefill_chunk`` prompt tokens of one sequence per
+call (causal within the chunk + attention to its cached history),
+bounded per tick by ``prefill_token_budget`` so a burst of long prompts
+cannot starve the decode batch - decode latency stays one decode step
+per tick regardless of prefill backlog. Chunked prefill changes matmul
+shapes, so its logits can differ from the token-at-a-time path by float
+ulps; greedy token streams are pinned equal in tests at serving shapes.
+
+**Backpressure**: a sequence whose next position needs a block the pool
+cannot give is PARKED for the tick (a ``kv_alloc_stall`` ledger
+second). If nothing at all could run, the youngest parked sequence is
+preempted - blocks freed, position reset - and re-admitted later;
+greedy decoding (and the per-position sampling keys) make the replay
+deterministic, and already-streamed tokens are not re-emitted.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.transformer import (
+    TransformerConfig,
+    _layer_norm,
+    _sinusoid_pe,
+)
+from .kv_cache import KVCacheConfig, OutOfBlocks, PagedKVCache
+
+
+@dataclass(frozen=True)
+class EngineConfig:
+    """Serving-side knobs (model geometry lives in TransformerConfig)."""
+
+    max_batch: int = 8          # decode-slot cap = largest batch bucket
+    num_blocks: int = 64        # shared pool size (incl. scratch block)
+    block_size: int = 16        # tokens per KV block
+    max_seq_len: int = 512      # prompt + generation hard cap
+    prefill_chunk: int = 1      # 1 = exact token-at-a-time prefill
+    prefill_token_budget: int = 0   # 0 = one chunk call per tick
+    eos_token: int | None = None    # retire on this token id
+
+    def kv(self) -> KVCacheConfig:
+        return KVCacheConfig(
+            num_blocks=self.num_blocks,
+            block_size=self.block_size,
+            max_seq_len=self.max_seq_len,
+        )
+
+
+@dataclass
+class Sequence:
+    """One in-flight request's decode state (engine-internal; the
+    scheduler owns queueing/streaming around it)."""
+
+    seq_id: int
+    prompt: list
+    max_new_tokens: int
+    temperature: float = 0.0
+    seed: int = 0
+    on_token: object = None  # callable(seq, token_id, done) or None
+
+    pos: int = 0               # tokens consumed (= KV entries written)
+    out: list = field(default_factory=list)
+    emitted: int = 0           # tokens already streamed (preempt replay)
+    finished: bool = False
+    preemptions: int = 0
+    t_first_token: float | None = None
+
+    @property
+    def prompt_len(self) -> int:
+        return len(self.prompt)
+
+    @property
+    def in_prefill(self) -> bool:
+        return self.pos < self.prompt_len
+
+    def next_input(self) -> int:
+        """The token this sequence consumes at its current position."""
+        if self.pos < self.prompt_len:
+            return int(self.prompt[self.pos])
+        return int(self.out[self.pos - self.prompt_len])
+
+    def total_len(self) -> int:
+        return self.prompt_len + self.max_new_tokens
+
+
+def _bucket(n: int, lo: int = 1) -> int:
+    """Smallest power of two >= n (>= lo)."""
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+class ServeEngine:
+    """The model executor: owns device params + KV pools and advances
+    all active sequences one tick at a time. Single-threaded by
+    contract - exactly one caller (the scheduler loop) drives
+    `step()`; admission/cancel mutate the active set under `lock`
+    between ticks."""
+
+    def __init__(self, params, cfg: TransformerConfig, ecfg: EngineConfig):
+        if cfg.n_experts:
+            raise ValueError(
+                "the serving engine supports dense models; MoE decode "
+                "routes through models/transformer.py generate()"
+            )
+        self.cfg = cfg
+        self.ecfg = ecfg
+        self.kv = PagedKVCache(ecfg.kv())
+        self.params = jax.device_put(params)
+        dt = cfg.dtype
+        L, H, Dh = cfg.n_layers, cfg.n_heads, cfg.head_dim
+        slots = self.kv.cfg.pool_slots
+        self.k_pool = jnp.zeros((L, slots, H, Dh), dt)
+        self.v_pool = jnp.zeros((L, slots, H, Dh), dt)
+        self.lock = threading.Lock()
+        self.active: list[Sequence] = []
+        self._step_fns: dict = {}
+        self._prefill_fns: dict = {}
+        self.ticks = 0
+        self.decode_tokens = 0
+        self.prefill_tokens = 0
+        self.stall_events = 0
+        self.preempted: list[Sequence] = []  # drained by the scheduler
+
+    # --------------------------------------------------------- lifecycle
+
+    def add(self, seq: Sequence) -> None:
+        """Join the batch at the next step boundary. Raises ValueError
+        on an over-long request (an admission-time check, not a crash
+        mid-flight) - block availability is the scheduler's gate."""
+        if seq.total_len() > self.ecfg.max_seq_len:
+            raise ValueError(
+                f"request needs {seq.total_len()} positions "
+                f"(prompt {seq.prompt_len} + {seq.max_new_tokens} new) "
+                f"> max_seq_len {self.ecfg.max_seq_len}"
+            )
+        if not seq.prompt:
+            raise ValueError("empty prompt")
+        if len(self.active) >= self.ecfg.max_batch:
+            raise ValueError(
+                f"engine full ({self.ecfg.max_batch} slots) - the "
+                "scheduler should hold admission"
+            )
+        with self.lock:
+            self.active.append(seq)
+
+    def cancel(self, seq_id: int) -> bool:
+        """Drop a sequence mid-flight (client disconnect); frees its
+        blocks. True when it was active."""
+        with self.lock:
+            for i, s in enumerate(self.active):
+                if s.seq_id == seq_id:
+                    self.active.pop(i)
+                    self.kv.free(seq_id)
+                    s.finished = True
+                    return True
+        return False
+
+    def has_work(self) -> bool:
+        with self.lock:
+            return bool(self.active)
+
+    # ------------------------------------------------------ jitted steps
+
+    def _decode_fn(self, B: int, W: int):
+        fn = self._step_fns.get((B, W))
+        if fn is not None:
+            return fn
+        cfg, kv = self.cfg, self.kv.cfg
+        dt = cfg.dtype
+        L, H, Dh = cfg.n_layers, cfg.n_heads, cfg.head_dim
+        bs = kv.block_size
+        S = W * bs
+        neg = jnp.asarray(-1e30, jnp.float32)
+
+        def step(params, k_pool, v_pool, tok, pos, table, temps, keys):
+            # tok/pos (B,), table (B, W), temps (B,), keys (B, 2)
+            x = params["embed"][tok].astype(dt)[:, None, :]
+            x = x + _sinusoid_pe(pos, cfg.d_model, dt)[:, None, :]
+            flat = table[jnp.arange(B), pos // bs] * bs + pos % bs
+            gather_idx = (
+                (table * bs)[:, :, None] + jnp.arange(bs)[None, None, :]
+            ).reshape(B, S)
+            live = (jnp.arange(S)[None, :] <= pos[:, None])[:, None, None, :]
+
+            def layer_step(x, lcaches):
+                lp, ck, cv = lcaches
+                h = _layer_norm(x, lp["ln1_scale"], lp["ln1_bias"]).astype(dt)
+                q = (h @ lp["wq"].astype(dt)).reshape(B, 1, H, Dh)
+                k = (h @ lp["wk"].astype(dt)).reshape(B, H, Dh)
+                v = (h @ lp["wv"].astype(dt)).reshape(B, H, Dh)
+                ck = ck.at[flat].set(k)
+                cv = cv.at[flat].set(v)
+                ks = ck[gather_idx].transpose(0, 2, 1, 3)  # (B, H, S, Dh)
+                vs = cv[gather_idx].transpose(0, 2, 1, 3)
+                scores = jnp.einsum(
+                    "bqhd,bhsd->bhqs", q, ks
+                ).astype(jnp.float32)
+                scores = scores / np.sqrt(Dh)
+                probs = jax.nn.softmax(
+                    jnp.where(live, scores, neg), axis=-1
+                )
+                o = jnp.einsum(
+                    "bhqs,bhsd->bqhd", probs.astype(dt), vs
+                ).reshape(B, 1, H * Dh)
+                x = x + o @ lp["wo"].astype(dt)
+                h2 = _layer_norm(
+                    x, lp["ln2_scale"], lp["ln2_bias"]
+                ).astype(dt)
+                h2 = jax.nn.gelu(
+                    h2 @ lp["w1"].astype(dt) + lp["b1"].astype(dt)
+                )
+                x = x + h2 @ lp["w2"].astype(dt) + lp["b2"].astype(dt)
+                return x, (ck, cv)
+
+            x, (k_pool, v_pool) = jax.lax.scan(
+                layer_step, x, (params["layers"], k_pool, v_pool),
+                unroll=min(L, 8),
+            )
+            h = _layer_norm(
+                x, params["lnf_scale"], params["lnf_bias"]
+            ).astype(dt)
+            logits = (h[:, 0] @ params["head"].astype(dt)).astype(
+                jnp.float32
+            )
+            greedy = jnp.argmax(logits, axis=-1)
+            sampled = jax.vmap(
+                lambda k_, lg, t: jax.random.categorical(
+                    k_, lg / jnp.maximum(t, 1e-6)
+                )
+            )(keys, logits, temps)
+            nxt = jnp.where(temps > 0.0, sampled, greedy).astype(jnp.int32)
+            return k_pool, v_pool, nxt, logits
+
+        fn = jax.jit(step)
+        self._step_fns[(B, W)] = fn
+        return fn
+
+    def _prefill_fn(self, C: int, W: int):
+        fn = self._prefill_fns.get((C, W))
+        if fn is not None:
+            return fn
+        cfg, kv = self.cfg, self.kv.cfg
+        dt = cfg.dtype
+        L, H, Dh = cfg.n_layers, cfg.n_heads, cfg.head_dim
+        bs = kv.block_size
+        S = W * bs
+        neg = jnp.asarray(-1e30, jnp.float32)
+
+        def prefill(params, k_pool, v_pool, toks, pos0, table, n_valid):
+            # toks (C,), pos0 scalar, table (W,), n_valid scalar
+            pv = pos0 + jnp.arange(C)
+            valid = jnp.arange(C) < n_valid
+            x = params["embed"][toks].astype(dt)[None]  # (1, C, d)
+            x = x + _sinusoid_pe(pv, cfg.d_model, dt)[None]
+            flat = table[pv // bs] * bs + pv % bs
+            flat = jnp.where(valid, flat, 0)  # dead tail -> scratch
+            gather_idx = (
+                (table * bs)[:, None] + jnp.arange(bs)[None, :]
+            ).reshape(S)
+            # query at chunk offset q attends to positions <= pos0 + q
+            live = (
+                jnp.arange(S)[None, :] <= pv[:, None]
+            )[None, None, :, :]  # (1, 1, C, S)
+
+            def layer_step(x, lcaches):
+                lp, ck, cv = lcaches
+                h = _layer_norm(x, lp["ln1_scale"], lp["ln1_bias"]).astype(dt)
+                q = (h @ lp["wq"].astype(dt)).reshape(1, C, H, Dh)
+                k = (h @ lp["wk"].astype(dt)).reshape(C, H, Dh)
+                v = (h @ lp["wv"].astype(dt)).reshape(C, H, Dh)
+                ck = ck.at[flat].set(k)
+                cv = cv.at[flat].set(v)
+                ks = ck[gather_idx][None].transpose(0, 2, 1, 3)
+                vs = cv[gather_idx][None].transpose(0, 2, 1, 3)
+                scores = jnp.einsum(
+                    "bqhd,bhsd->bhqs", q, ks
+                ).astype(jnp.float32)
+                scores = scores / np.sqrt(Dh)
+                probs = jax.nn.softmax(
+                    jnp.where(live, scores, neg), axis=-1
+                )
+                o = jnp.einsum(
+                    "bhqs,bhsd->bqhd", probs.astype(dt), vs
+                ).reshape(1, C, H * Dh)
+                x = x + o @ lp["wo"].astype(dt)
+                h2 = _layer_norm(
+                    x, lp["ln2_scale"], lp["ln2_bias"]
+                ).astype(dt)
+                h2 = jax.nn.gelu(
+                    h2 @ lp["w1"].astype(dt) + lp["b1"].astype(dt)
+                )
+                x = x + h2 @ lp["w2"].astype(dt) + lp["b2"].astype(dt)
+                return x, (ck, cv)
+
+            x, (k_pool, v_pool) = jax.lax.scan(
+                layer_step, x, (params["layers"], k_pool, v_pool),
+                unroll=min(L, 8),
+            )
+            h = _layer_norm(
+                x, params["lnf_scale"], params["lnf_bias"]
+            ).astype(dt)
+            logits = (h[0] @ params["head"].astype(dt)).astype(jnp.float32)
+            return k_pool, v_pool, logits  # logits (C, vocab)
+
+        fn = jax.jit(prefill)
+        self._prefill_fns[(C, W)] = fn
+        return fn
+
+    # ----------------------------------------------------------- warmup
+
+    def warmup(self, *, max_width_blocks: int | None = None) -> int:
+        """Pre-compile the (batch, width) bucket grid with dummy calls
+        (all writes land in the scratch block, so live state is
+        untouched). Without warmup each new bucket pays its XLA compile
+        on the first request that needs it - a TTFT spike production
+        serving cannot afford. Returns the number of programs built."""
+        bs = self.kv.cfg.block_size
+        max_w = _bucket(max_width_blocks or self.kv.cfg.max_blocks_per_seq)
+        widths = []
+        w = 1
+        while w <= max_w:
+            widths.append(w)
+            w *= 2
+        batches = []
+        b = 1
+        while b <= self.ecfg.max_batch:
+            batches.append(b)
+            b *= 2
+        n = 0
+        for B in batches:
+            for W in widths:
+                fn = self._decode_fn(B, W)
+                self.k_pool, self.v_pool, _, _ = fn(
+                    self.params, self.k_pool, self.v_pool,
+                    jnp.zeros((B,), jnp.int32), jnp.zeros((B,), jnp.int32),
+                    jnp.zeros((B, W), jnp.int32),
+                    jnp.zeros((B,), jnp.float32),
+                    jnp.zeros((B, 2), jnp.uint32),
+                )
+                n += 1
+        if self.ecfg.prefill_chunk > 1:
+            chunks = []
+            c = 1
+            while c <= self.ecfg.prefill_chunk:
+                chunks.append(c)
+                c *= 2
+            for C in chunks:
+                for W in widths:
+                    if C > W * bs:
+                        continue
+                    fn = self._prefill_fn(C, W)
+                    self.k_pool, self.v_pool, _ = fn(
+                        self.params, self.k_pool, self.v_pool,
+                        jnp.zeros((C,), jnp.int32), jnp.int32(0),
+                        jnp.zeros((W,), jnp.int32), jnp.int32(0),
+                    )
+                    n += 1
+        return n
+
+    # ------------------------------------------------------------ the tick
+
+    def _sample_key(self, seq: Sequence) -> np.ndarray:
+        """Per-(sequence, position) sampling key: deterministic across
+        preemption replay."""
+        k = jax.random.PRNGKey(seq.seed)
+        return np.asarray(jax.random.fold_in(k, seq.pos), np.uint32)
+
+    def _emit(self, seq: Sequence, tok: int) -> None:
+        """One NEW generated token: record, maybe retire, stream."""
+        seq.out.append(tok)
+        done = (
+            len(seq.out) >= seq.max_new_tokens
+            or (self.ecfg.eos_token is not None
+                and tok == self.ecfg.eos_token)
+        )
+        if done:
+            seq.finished = True
+        seq.emitted = len(seq.out)
+        if seq.on_token is not None:
+            seq.on_token(seq, tok, done)
+
+    def _retire_finished(self) -> list:
+        done = [s for s in self.active if s.finished]
+        if done:
+            with self.lock:
+                self.active = [s for s in self.active if not s.finished]
+            for s in done:
+                self.kv.free(s.seq_id)
+        return done
+
+    def _preempt_youngest(self, parked: list) -> None:
+        """Nothing could run: evict the youngest parked sequence so the
+        others' next allocation can succeed. Blocks freed, position
+        reset; generated tokens are kept for replay dedup (greedy /
+        per-position keys make the regeneration identical)."""
+        victim = parked[-1]
+        with self.lock:
+            self.active = [
+                s for s in self.active if s.seq_id != victim.seq_id
+            ]
+        self.kv.free(victim.seq_id)
+        victim.pos = 0
+        victim.preemptions += 1
+        self.preempted.append(victim)
+        self.stall_events += 1
+
+    def step(self) -> dict:
+        """One engine tick. Returns per-tick stats for the scheduler's
+        ledger/metrics: ``{"decode_tokens", "prefill_tokens",
+        "finished", "parked", "batch", "prefill_s", "decode_s"}``
+        (span seconds measured by the caller via the returned work
+        counts - the engine itself is clock-free for testability)."""
+        ecfg = self.ecfg
+        bs = self.kv.cfg.block_size
+        with self.lock:
+            todo = list(self.active)
+        parked: list[Sequence] = []
+        stats = {"decode_tokens": 0, "prefill_tokens": 0, "finished": 0,
+                 "parked": 0, "batch": 0}
+
+        # ---- chunked prefill phase (prefill_chunk > 1 only)
+        if ecfg.prefill_chunk > 1:
+            budget = ecfg.prefill_token_budget or ecfg.prefill_chunk
+            for seq in todo:
+                if budget <= 0:
+                    break
+                if not seq.in_prefill or seq.finished:
+                    continue
+                # leave the LAST prompt token to the decode batch: its
+                # logits produce the first generated token there, so
+                # first-token sampling/argmax runs on the same path for
+                # every sequence
+                remaining = seq.prompt_len - 1 - seq.pos
+                if remaining <= 0:
+                    continue
+                n = min(remaining, ecfg.prefill_chunk, budget)
+                try:
+                    self.kv.ensure_range(seq.seq_id, seq.pos + n - 1)
+                except OutOfBlocks:
+                    parked.append(seq)
+                    continue
+                C = _bucket(n)
+                W = _bucket(
+                    (seq.pos + n - 1) // bs + 1
+                )
+                toks = np.zeros((C,), np.int32)
+                toks[:n] = seq.prompt[seq.pos: seq.pos + n]
+                table = self.kv.table([seq.seq_id], W)[0]
+                fn = self._prefill_fn(C, W)
+                self.k_pool, self.v_pool, _ = fn(
+                    self.params, self.k_pool, self.v_pool,
+                    jnp.asarray(toks), jnp.int32(seq.pos),
+                    jnp.asarray(table), jnp.int32(n),
+                )
+                seq.pos += n
+                budget -= n
+                self.prefill_tokens += n
+                stats["prefill_tokens"] += n
+
+        # ---- decode batch: one token per remaining runnable sequence
+        batch: list[Sequence] = []
+        for seq in todo:
+            if seq.finished or seq in parked:
+                continue
+            if ecfg.prefill_chunk > 1 and seq.in_prefill and (
+                seq.pos < seq.prompt_len - 1
+            ):
+                continue  # still mid-chunked-prefill; next tick
+            try:
+                self.kv.ensure(seq.seq_id, seq.pos)
+            except OutOfBlocks:
+                parked.append(seq)
+                continue
+            batch.append(seq)
+
+        stats["parked"] = len(parked)
+        if parked:
+            self.stall_events += 1
+        if not batch:
+            if parked:
+                # every active sequence is parked on blocks: preempt the
+                # youngest so the others' next allocation can succeed
+                self._preempt_youngest(parked)
+            return stats
+
+        B = _bucket(len(batch))
+        if B > ecfg.max_batch:
+            B = ecfg.max_batch
+            batch = batch[:B]
+        W = _bucket(max(
+            s.pos // bs + 1 for s in batch
+        ))
+        tok = np.zeros((B,), np.int32)
+        pos = np.zeros((B,), np.int32)
+        temps = np.zeros((B,), np.float32)
+        keys = np.zeros((B, 2), np.uint32)
+        for i, s in enumerate(batch):
+            tok[i] = s.next_input()
+            pos[i] = s.pos
+            temps[i] = s.temperature
+            keys[i] = self._sample_key(s)
+        table = self.kv.table(
+            [s.seq_id for s in batch] + [-1] * (B - len(batch)), W
+        )
+        fn = self._decode_fn(B, W)
+        self.k_pool, self.v_pool, nxt, _ = fn(
+            self.params, self.k_pool, self.v_pool,
+            jnp.asarray(tok), jnp.asarray(pos), jnp.asarray(table),
+            jnp.asarray(temps), jnp.asarray(keys),
+        )
+        nxt = np.asarray(nxt)
+        self.ticks += 1
+        stats["batch"] = len(batch)
+        for i, s in enumerate(batch):
+            consumed_at = s.pos
+            s.pos += 1
+            if consumed_at >= s.prompt_len - 1:
+                # prediction for generated-token index j; after a
+                # preemption the replay re-derives tokens the sequence
+                # already holds (j < len(out)) - deterministic by
+                # construction (greedy, or the per-position sampling
+                # key), so they are dropped, not re-appended/re-streamed
+                j = consumed_at + 1 - s.prompt_len
+                if j == len(s.out):
+                    self._emit(s, int(nxt[i]))
+                self.decode_tokens += 1
+                stats["decode_tokens"] += 1
+            else:
+                self.prefill_tokens += 1
+                stats["prefill_tokens"] += 1
+        stats["finished"] = len(self._retire_finished())
+        return stats

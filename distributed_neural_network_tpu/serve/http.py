@@ -1,0 +1,361 @@
+"""HTTP face of the serving stack + the `python -m
+distributed_neural_network_tpu.serve` CLI.
+
+One `utils/obs.py ObsServer` carries everything: the observability
+endpoints every load balancer / scraper already knows (``/metrics``
+Prometheus text with the full serve_* series, ``/healthz`` liveness ->
+status-code mapping) plus the serving routes mounted through the
+pluggable route table:
+
+- ``POST /v1/generate`` - body ``{"prompt": [int, ...] | "text": str,
+  "max_new_tokens": N, "temperature": t, "seed": s, "stream": bool,
+  "api_key": k}`` (the key may also ride the ``X-API-Key`` header).
+  With ``stream`` (default true) the response is server-sent events:
+  one ``data: {"token": id}`` frame per generated token as it leaves
+  the decode step, then ``data: {"done": true, ...summary}``. A client
+  disconnect mid-stream cancels the request at the next step boundary
+  (blocks freed - a closed tab never holds KV memory). Without
+  ``stream``, one JSON body after completion. Admission rejections map
+  to HTTP status: 429 (queue full / tenant over rate, with
+  ``Retry-After``) and 400 (malformed / over-length), so standard
+  client backoff just works.
+- ``GET /v1/status`` - one JSON snapshot (active/queued/KV occupancy).
+
+``"text"`` prompts are byte-tokenized (the `data/tokens.py` .txt
+convention; needs vocab >= 256); responses for text prompts include the
+decoded completion.
+
+The CLI builds a seeded-random model (the same ``init_params(key(seed),
+cfg)`` any offline process can rebuild - `tools/loadgen.py
+--check-oracle` exploits exactly this to verify streamed completions
+bitwise against `models/transformer.py generate`), prints the bound URL
+for port-0 discovery, and on SIGTERM/SIGINT finalizes the serving
+goodput ledger (conservation asserted) before printing a
+``SERVE_SUMMARY`` JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import threading
+
+from ..utils.obs import MetricsRegistry, ObsServer
+from .engine import EngineConfig, ServeEngine
+from .scheduler import (
+    AdmissionError,
+    SchedulerConfig,
+    ServeRequest,
+    ServeScheduler,
+)
+
+# how long a streaming reader waits on the next token before declaring
+# the stream wedged (a generous multiple of any sane step time)
+STREAM_TIMEOUT_S = 300.0
+
+
+def _json_response(handler, code: int, doc: dict,
+                   extra_headers=()) -> None:
+    body = (json.dumps(doc) + "\n").encode()
+    handler.send_response(code)
+    handler.send_header("Content-Type", "application/json")
+    for k, v in extra_headers:
+        handler.send_header(k, v)
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
+class ServeServer:
+    """The scheduler behind an ObsServer with /v1/* routes mounted."""
+
+    def __init__(
+        self,
+        scheduler: ServeScheduler,
+        registry: MetricsRegistry,
+        *,
+        port: int = 0,
+        host: str = "127.0.0.1",
+    ):
+        self.scheduler = scheduler
+        self.registry = registry
+        self.obs = ObsServer(
+            registry,
+            port=port,
+            host=host,
+            routes={
+                ("POST", "/v1/generate"): self._generate,
+                ("GET", "/v1/status"): self._status,
+            },
+        )
+        self.port = self.obs.port
+        self.url = self.obs.url
+
+    def close(self) -> None:
+        self.obs.close()
+
+    # ------------------------------------------------------------ routes
+
+    def _status(self, handler) -> None:
+        eng = self.scheduler.engine
+        _json_response(handler, 200, {
+            "active_sequences": len(eng.active),
+            "queued": self.scheduler._queued,
+            "kv_blocks_in_use": eng.kv.blocks_in_use,
+            "kv_blocks_total": eng.kv.cfg.usable_blocks,
+            "kv_utilization": round(eng.kv.utilization(), 4),
+            "engine_ticks": eng.ticks,
+            "decode_tokens": eng.decode_tokens,
+            "prefill_tokens": eng.prefill_tokens,
+        })
+
+    def _parse_request(self, handler):
+        try:
+            n = int(handler.headers.get("Content-Length") or 0)
+        except ValueError:
+            n = 0
+        try:
+            body = json.loads(handler.rfile.read(n) or b"{}")
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object")
+        except (ValueError, UnicodeDecodeError) as e:
+            raise AdmissionError(400, "bad_json", f"invalid JSON body: {e}")
+        is_text = False
+        prompt = body.get("prompt")
+        if prompt is None and isinstance(body.get("text"), str):
+            vocab = self.scheduler.engine.cfg.vocab_size
+            if vocab < 256:
+                raise AdmissionError(
+                    400, "no_text_tokens",
+                    f"text prompts are byte-tokenized and need "
+                    f"vocab_size >= 256 (model has {vocab}); send "
+                    "integer 'prompt' tokens instead",
+                )
+            prompt = list(body["text"].encode())
+            is_text = True
+        if not isinstance(prompt, list) or not all(
+            isinstance(t, int) for t in prompt
+        ):
+            raise AdmissionError(
+                400, "bad_prompt",
+                "body needs 'prompt': [int token ids] or 'text': str",
+            )
+        api_key = (
+            handler.headers.get("X-API-Key")
+            or body.get("api_key")
+            or "anonymous"
+        )
+        req = ServeRequest(
+            prompt=prompt,
+            max_new_tokens=int(body.get("max_new_tokens", 16)),
+            temperature=float(body.get("temperature", 0.0)),
+            seed=int(body.get("seed", 0)),
+            api_key=str(api_key),
+        )
+        return req, bool(body.get("stream", True)), is_text
+
+    def _generate(self, handler) -> None:
+        try:
+            req, stream, is_text = self._parse_request(handler)
+            self.scheduler.submit(req)
+        except AdmissionError as e:
+            extra = (
+                (("Retry-After", "1"),) if e.status == 429 else ()
+            )
+            _json_response(handler, e.status, {
+                "error": str(e), "reason": e.reason,
+            }, extra)
+            return
+        if stream:
+            self._stream_response(handler, req, is_text)
+        else:
+            self._block_response(handler, req, is_text)
+
+    def _drain(self, req):
+        """Yield events until done/error/timeout (generator)."""
+        import queue as queue_mod
+
+        while True:
+            try:
+                kind, payload = req.events.get(timeout=STREAM_TIMEOUT_S)
+            except queue_mod.Empty:
+                yield "error", "stream timeout"
+                return
+            yield kind, payload
+            if kind in ("done", "error"):
+                return
+
+    def _summary_doc(self, req, is_text) -> dict:
+        doc = req.summary()
+        if is_text:
+            doc["text"] = bytes(
+                t for t in req.tokens if 0 <= t < 256
+            ).decode("utf-8", "replace")
+        return doc
+
+    def _stream_response(self, handler, req, is_text) -> None:
+        handler.send_response(200)
+        handler.send_header("Content-Type", "text/event-stream")
+        handler.send_header("Cache-Control", "no-store")
+        handler.send_header("Connection", "close")
+        handler.end_headers()
+        try:
+            for kind, payload in self._drain(req):
+                if kind == "token":
+                    frame = {"token": payload}
+                elif kind == "done":
+                    frame = dict(self._summary_doc(req, is_text))
+                    frame["done"] = True
+                else:
+                    frame = {"error": payload}
+                handler.wfile.write(
+                    f"data: {json.dumps(frame)}\n\n".encode()
+                )
+                handler.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            # client went away mid-stream: free its slot + KV blocks
+            self.scheduler.cancel(req)
+
+    def _block_response(self, handler, req, is_text) -> None:
+        last_err = None
+        for kind, payload in self._drain(req):
+            if kind == "error":
+                last_err = payload
+        if last_err is not None and req.status != "done":
+            _json_response(handler, 500, {"error": last_err})
+            return
+        _json_response(handler, 200, self._summary_doc(req, is_text))
+
+
+# ----------------------------------------------------------------- CLI
+
+
+def build_model(args):
+    """Seeded-random model from CLI geometry (rebuildable offline for
+    the oracle check)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.transformer import TransformerConfig, init_params
+
+    cfg = TransformerConfig(
+        vocab_size=args.vocab,
+        d_model=args.d_model,
+        n_heads=args.n_heads,
+        n_layers=args.n_layers,
+        d_ff=args.d_ff,
+        dtype=jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32,
+    )
+    params = init_params(jax.random.key(args.seed), cfg)
+    return params, cfg
+
+
+def add_model_args(p: argparse.ArgumentParser) -> None:
+    """The model-geometry flags, shared verbatim by `tools/loadgen.py
+    --check-oracle` so both sides always rebuild the same model."""
+    p.add_argument("--d-model", type=int, default=64)
+    p.add_argument("--n-heads", type=int, default=4)
+    p.add_argument("--n-layers", type=int, default=2)
+    p.add_argument("--d-ff", type=int, default=128)
+    p.add_argument("--vocab", type=int, default=256)
+    p.add_argument("--dtype", choices=("float32", "bfloat16"),
+                   default="float32")
+    p.add_argument("--seed", type=int, default=0,
+                   help="init_params seed (the oracle contract)")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m distributed_neural_network_tpu.serve",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("--port", type=int, default=8000,
+                   help="0 = ephemeral (the bound URL is printed)")
+    p.add_argument("--host", default="127.0.0.1")
+    add_model_args(p)
+    p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument("--num-blocks", type=int, default=128)
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--max-seq-len", type=int, default=512)
+    p.add_argument("--prefill-chunk", type=int, default=1,
+                   help="prompt tokens per chunked-prefill call (1 = "
+                   "exact token-at-a-time prefill)")
+    p.add_argument("--eos-token", type=int, default=None)
+    p.add_argument("--max-queue", type=int, default=64)
+    p.add_argument("--tenant-rate", type=float, default=0.0,
+                   help="per-API-key token-bucket rate (req/s; 0 = off)")
+    p.add_argument("--tenant-burst", type=int, default=8)
+    p.add_argument("--run-record", default=None,
+                   help="write the serving goodput record here "
+                   "(utils/goodput.py taxonomy 'serve')")
+    p.add_argument("--warmup", action="store_true",
+                   help="pre-compile the (batch, width) bucket grid "
+                   "before binding the port (no first-request compile "
+                   "TTFT spike)")
+    args = p.parse_args(argv)
+
+    params, cfg = build_model(args)
+    engine = ServeEngine(params, cfg, EngineConfig(
+        max_batch=args.max_batch,
+        num_blocks=args.num_blocks,
+        block_size=args.block_size,
+        max_seq_len=args.max_seq_len,
+        prefill_chunk=args.prefill_chunk,
+        eos_token=args.eos_token,
+    ))
+    if args.warmup:
+        n = engine.warmup()
+        print(f"(warmup: {n} bucket programs compiled)", flush=True)
+    registry = MetricsRegistry()
+    scheduler = ServeScheduler(
+        engine,
+        SchedulerConfig(
+            max_queue=args.max_queue,
+            tenant_rate=args.tenant_rate,
+            tenant_burst=args.tenant_burst,
+            run_record=args.run_record,
+        ),
+        registry=registry,
+    ).start()
+    server = ServeServer(
+        scheduler, registry, port=args.port, host=args.host
+    )
+    print(
+        f"serving on {server.url} "
+        f"(model d{args.d_model}/L{args.n_layers}/H{args.n_heads} "
+        f"vocab {args.vocab} seed {args.seed}; "
+        f"{engine.kv.cfg.usable_blocks} KV blocks x "
+        f"{args.block_size} tokens; endpoints: POST /v1/generate, "
+        "GET /v1/status, /metrics, /healthz)",
+        flush=True,
+    )
+
+    stop = threading.Event()
+
+    def _stop(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
+    while not stop.wait(0.2):
+        pass
+    record = scheduler.close()
+    server.close()
+    print("SERVE_SUMMARY " + json.dumps({
+        "requests_completed": int(
+            registry.counter("serve_requests_total")
+            .labels(status="completed").value
+        ),
+        "decode_tokens": engine.decode_tokens,
+        "prefill_tokens": engine.prefill_tokens,
+        "goodput_ratio": record.get("goodput_ratio") if record else None,
+        "run_record": args.run_record,
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

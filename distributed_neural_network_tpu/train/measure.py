@@ -1498,3 +1498,121 @@ def measure_native_batcher(
             "multi-core hosts add the pthread fan-out on top."
         ),
     }
+
+
+def measure_serving(
+    *,
+    d_model: int = 512,
+    n_layers: int = 8,
+    n_heads: int = 8,
+    d_ff: int = 2048,
+    vocab: int = 256,
+    dtype: str = "bfloat16",
+    rate: float = 4.0,
+    requests: int = 24,
+    prompt_lens=(16, 64, 128),
+    max_new: int = 32,
+    max_batch: int = 8,
+    num_blocks: int = 129,
+    block_size: int = 16,
+    max_seq_len: int = 256,
+    prefill_chunk: int = 16,
+    seed: int = 0,
+) -> dict:
+    """The serving row: sustained requests/s + TTFT / inter-token
+    latency under the open-loop load generator (tools/loadgen.py)
+    against a real in-process server (serve/ stack end to end: HTTP,
+    SSE streaming, admission, continuous batching, paged KV).
+
+    Open loop means offered load never slows to match the server -
+    queueing shows up in TTFT, which is the number a capacity plan
+    needs. The serving goodput ledger's breakdown (decode = goodput,
+    prefill, queue_wait, batch_formation_idle, kv_alloc_stall) rides
+    along, so the row says not just how fast but WHERE the wall-clock
+    went (docs/SERVING.md).
+    """
+    import sys as _sys
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.transformer import TransformerConfig, init_params
+    from ..serve import (
+        EngineConfig,
+        SchedulerConfig,
+        ServeEngine,
+        ServeScheduler,
+    )
+    from ..serve.http import ServeServer
+    from ..utils.obs import MetricsRegistry
+
+    tools_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)
+        ))), "tools",
+    )
+    if tools_dir not in _sys.path:
+        _sys.path.insert(0, tools_dir)
+    import loadgen
+
+    cfg = TransformerConfig(
+        vocab_size=vocab, d_model=d_model, n_heads=n_heads,
+        n_layers=n_layers, d_ff=d_ff,
+        dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32,
+    )
+    params = init_params(jax.random.key(seed), cfg)
+    engine = ServeEngine(params, cfg, EngineConfig(
+        max_batch=max_batch, num_blocks=num_blocks,
+        block_size=block_size, max_seq_len=max_seq_len,
+        prefill_chunk=prefill_chunk,
+    ))
+    # pre-compile the bucket grid: a bench row measures serving, not
+    # first-request XLA compiles (production pays these at deploy time)
+    n_compiled = engine.warmup()
+    registry = MetricsRegistry()
+    scheduler = ServeScheduler(
+        engine, SchedulerConfig(max_queue=max(requests, 8)),
+        registry=registry,
+    ).start()
+    server = ServeServer(scheduler, registry, port=0)
+    try:
+        summary = loadgen.run_load(
+            server.url, rate=rate, n_requests=requests, duration=None,
+            prompt_lens=list(prompt_lens), max_new=max_new, vocab=vocab,
+            seed=seed, api_keys=["bench"], temperature=0.0,
+            burst=0, cancel_one=False, timeout=600.0, poisson=False,
+        )
+    finally:
+        record = scheduler.close()
+        server.close()
+    total = float(record.get("wall_s") or 0.0)
+    bad = record.get("badput_s") or {}
+    dev = jax.devices()[0]
+    return {
+        "devices": f"1x {dev.device_kind}",
+        "model": f"d{d_model}/L{n_layers}/H{n_heads} vocab {vocab} {dtype}",
+        "offered_rps": summary["offered_rps"],
+        "sustained_rps": summary["achieved_rps"],
+        "requests_completed": summary["by_status"].get("completed", 0),
+        "requests_total": summary["requests"],
+        "tokens_per_s": summary["tokens_per_s"],
+        "ttft_p50_s": summary["ttft_p50_s"],
+        "ttft_p99_s": summary["ttft_p99_s"],
+        "intertoken_p50_s": summary["intertoken_p50_s"],
+        "intertoken_p99_s": summary["intertoken_p99_s"],
+        "engine": {
+            "max_batch": max_batch, "block_size": block_size,
+            "num_blocks": num_blocks, "prefill_chunk": prefill_chunk,
+            "warmup_programs": n_compiled,
+        },
+        "serve_goodput_ratio": record.get("goodput_ratio"),
+        "serve_breakdown_share": {
+            c: round(v / total, 4) for c, v in bad.items() if total > 0
+        },
+        "note": (
+            "open-loop load (tools/loadgen.py) against the in-process "
+            "serve/ stack over real HTTP+SSE; sustained_rps counts "
+            "COMPLETED requests over the whole window, TTFT includes "
+            "queue wait (docs/SERVING.md)"
+        ),
+    }

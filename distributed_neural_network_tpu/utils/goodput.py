@@ -37,6 +37,15 @@ bucket; `CAUSES` is the schema):
                         computed as total - attributed, never recorded
                         directly.
 
+**Serving taxonomy** (schema v2): the inference service
+(`serve/scheduler.py`) runs the same ledger machinery over its own
+closed cause set - ``queue_wait``, ``prefill``, ``decode`` (goodput),
+``batch_formation_idle``, ``kv_alloc_stall``, ``idle_other`` - selected
+with ``GoodputLedger(taxonomy="serve")``. Records carry a ``taxonomy``
+field; v1 records (training, no field) still parse, and every reader
+(`render_record`, `diff_records`, `check_record`, `tools/goodput.py`)
+resolves causes through `record_taxonomy`.
+
 **Conservation.** Intervals are attributed ONCE: overlapping recordings
 are resolved by a priority sweep (instrumented intervals beat the
 watchdog's coarse stall window, which beats nothing), the residual is
@@ -70,14 +79,17 @@ import threading
 import time
 
 # bump when the run-record schema changes shape; readers accept same-or-
-# older versions and refuse newer ones with a clear message
-RECORD_VERSION = 1
+# older versions and refuse newer ones with a clear message.
+# v1: training taxonomy only. v2: adds the `taxonomy` field ("train" |
+# "serve") and the serving cause set; v1 records (no taxonomy field)
+# still parse and render as training records.
+RECORD_VERSION = 2
 
 # env var naming the per-worker run-record path; the elastic supervisor
 # (train/supervisor.py) exports it next to the heartbeat/flight files
 RUN_RECORD_ENV = "DNN_TPU_RUN_RECORD"
 
-# the closed taxonomy, in report order. steady_step is goodput;
+# the closed TRAINING taxonomy, in report order. steady_step is goodput;
 # idle_other is the computed residual (never recorded directly).
 GOODPUT_CAUSE = "steady_step"
 IDLE_CAUSE = "idle_other"
@@ -95,6 +107,26 @@ CAUSES = (
 )
 BADPUT_CAUSES = tuple(c for c in CAUSES if c != GOODPUT_CAUSE)
 
+# the closed SERVING taxonomy (serve/scheduler.py's ledger): decode -
+# tokens reaching users - is the goodput bucket; prefill is real work
+# but not yet user-visible tokens, queue_wait is time requests sat
+# admitted-but-unserved while the engine had no free capacity,
+# batch_formation_idle is scheduler overhead between having runnable
+# work and dispatching the step, kv_alloc_stall is progress blocked on
+# KV-block exhaustion.
+SERVE_GOODPUT_CAUSE = "decode"
+SERVE_CAUSES = (
+    "queue_wait",
+    "prefill",
+    SERVE_GOODPUT_CAUSE,
+    "batch_formation_idle",
+    "kv_alloc_stall",
+    IDLE_CAUSE,
+)
+SERVE_BADPUT_CAUSES = tuple(
+    c for c in SERVE_CAUSES if c != SERVE_GOODPUT_CAUSE
+)
+
 # overlap-resolution priority (lower wins): precisely instrumented
 # intervals (step walls, checkpoint saves, reshard spans, data waits)
 # always beat the watchdog's coarse stall window, which covers the idle
@@ -109,6 +141,36 @@ _PRIORITY["restart_gap"] = 1
 _FILL_CAUSES = {"_steady_fill": GOODPUT_CAUSE, "_init_fill": "init"}
 _PRIORITY["_steady_fill"] = 2
 _PRIORITY["_init_fill"] = 3
+
+# serving overlap resolution: the engine's precisely fenced compute
+# spans (prefill/decode/kv_alloc_stall/batch_formation_idle) always win;
+# queue_wait is recorded per request over its whole admitted-but-queued
+# window and may overlap the engine serving OTHER requests, so it only
+# claims otherwise-idle seconds (the capacity-pressure signal).
+_SERVE_PRIORITY = {c: 0 for c in SERVE_CAUSES}
+_SERVE_PRIORITY["queue_wait"] = 1
+
+# taxonomy registry: name -> (causes, goodput cause, priority map,
+# fill-cause map). `GoodputLedger(taxonomy=...)` and every record
+# reader resolve through this table.
+TAXONOMIES = {
+    "train": (CAUSES, GOODPUT_CAUSE, _PRIORITY, _FILL_CAUSES),
+    "serve": (SERVE_CAUSES, SERVE_GOODPUT_CAUSE, _SERVE_PRIORITY, {}),
+}
+
+
+def record_taxonomy(rec: dict) -> tuple:
+    """``(causes, goodput_cause)`` for a record: v2 records carry a
+    ``taxonomy`` field, v1 records are training records. Unknown
+    taxonomy names (a future build's record that still validated as
+    version <= RECORD_VERSION) fall back to the record's own badput
+    keys so rendering never drops a bucket."""
+    name = rec.get("taxonomy") or "train"
+    if name in TAXONOMIES:
+        causes, goodput, _, _ = TAXONOMIES[name]
+        return causes, goodput
+    bad = tuple((rec.get("badput_s") or {}).keys())
+    return ("goodput",) + bad, "goodput"
 
 
 class _Interval:
@@ -156,7 +218,8 @@ _NULL_SPAN = _NullSpan()
 
 
 def attribute_intervals(
-    intervals, start: float, end: float, *, priority=None
+    intervals, start: float, end: float, *, priority=None,
+    causes=CAUSES, fills=None,
 ) -> dict:
     """Sweep-line attribution: partition ``[start, end]`` over the
     recorded intervals so every second is counted exactly once.
@@ -173,7 +236,8 @@ def attribute_intervals(
     import heapq
 
     prio = priority if priority is not None else _PRIORITY
-    out = {c: 0.0 for c in CAUSES}
+    fill_map = fills if fills is not None else _FILL_CAUSES
+    out = {c: 0.0 for c in causes}
     if end <= start:
         return out
     ivs = sorted(
@@ -208,7 +272,7 @@ def attribute_intervals(
             out[IDLE_CAUSE] += seg_end - t
         t = seg_end
     # fold internal fill causes into their public buckets
-    for fill, public in _FILL_CAUSES.items():
+    for fill, public in fill_map.items():
         if fill in out:
             out[public] += out.pop(fill)
     return out
@@ -235,9 +299,28 @@ class GoodputLedger:
       watchdog's stall episodes).
     - ``mark_recompute(n)``       - the next ``n`` step spans are
       rollback recompute, not goodput (`train/guard.py rollback`).
+
+    ``taxonomy`` selects the cause set: ``"train"`` (the default - the
+    original closed training taxonomy) or ``"serve"`` (the serving
+    ledger: queue_wait / prefill / decode / batch_formation_idle /
+    kv_alloc_stall, `serve/scheduler.py`). A serving ledger records via
+    ``interval``/``add``/``add_ending_now`` + ``note_steps``; the
+    training-specific feeds (``step_span``, ``fill_ending_now``,
+    ``mark_recompute``) reject the serve taxonomy loudly.
     """
 
-    def __init__(self, *, clock=time.monotonic):
+    def __init__(self, *, clock=time.monotonic, taxonomy: str = "train"):
+        if taxonomy not in TAXONOMIES:
+            raise ValueError(
+                f"unknown ledger taxonomy {taxonomy!r} "
+                f"(known: {', '.join(sorted(TAXONOMIES))})"
+            )
+        self.taxonomy = taxonomy
+        (self._causes, self._goodput_cause, self._priority,
+         self._fills) = TAXONOMIES[taxonomy]
+        self._badput_causes = tuple(
+            c for c in self._causes if c != self._goodput_cause
+        )
         self._clock = clock
         self._lock = threading.Lock()
         self.enabled = False
@@ -279,7 +362,12 @@ class GoodputLedger:
         with self._lock:
             self.enabled = True
             self._t_start = self._clock()
-            self._t_init_open = self._t_start
+            # "init" and its synthesized fill exist only in the training
+            # taxonomy; a serving ledger's pre-first-request prefix is
+            # plain idle_other
+            self._t_init_open = (
+                self._t_start if self.taxonomy == "train" else None
+            )
             self.started_unix = time.time()
             if rank is not None:
                 self.rank = int(rank)
@@ -337,19 +425,28 @@ class GoodputLedger:
     def _now(self) -> float:
         return self._clock()
 
+    def _check_cause(self, cause: str) -> None:
+        if cause not in self._causes or cause == IDLE_CAUSE:
+            raise ValueError(
+                f"unknown {self.taxonomy} goodput cause {cause!r} "
+                f"(closed taxonomy: "
+                f"{', '.join(c for c in self._causes if c != IDLE_CAUSE)}; "
+                f"{IDLE_CAUSE} is the computed residual)"
+            )
+
     def interval(self, cause: str, **_meta):
         """``with ledger.interval("checkpoint_save"): ...`` - no-op when
         disarmed."""
         if not self.enabled:
             return _NULL_SPAN
-        _check_cause(cause)
+        self._check_cause(cause)
         return _LedgerSpan(self, cause)
 
     def add(self, cause: str, t0: float, t1: float) -> None:
         """Record one closed interval on the ledger's own clock."""
         if not self.enabled or t1 <= t0:
             return
-        _check_cause(cause)
+        self._check_cause(cause)
         with self._lock:
             self._intervals.append(_Interval(t0, t1, cause))
 
@@ -377,11 +474,12 @@ class GoodputLedger:
         just to time it would change the run)."""
         if not self.enabled or dur_s <= 0:
             return
-        fill = {v: k for k, v in _FILL_CAUSES.items()}.get(cause)
+        fill = {v: k for k, v in self._fills.items()}.get(cause)
         if fill is None:
             raise ValueError(
-                f"no fill bucket for cause {cause!r} "
-                f"(fills: {sorted(_FILL_CAUSES.values())})"
+                f"no fill bucket for cause {cause!r} in the "
+                f"{self.taxonomy} taxonomy "
+                f"(fills: {sorted(self._fills.values())})"
             )
         now = self._now()
         with self._lock:
@@ -420,6 +518,12 @@ class GoodputLedger:
         """
         if not self.enabled:
             return
+        if self.taxonomy != "train":
+            raise ValueError(
+                "step_span is the training ledger's feed; a "
+                f"{self.taxonomy!r} ledger records via interval()/add() "
+                "+ note_steps()"
+            )
         now = self._now()
         t0 = now - max(float(dur_s), 0.0)
         with self._lock:
@@ -443,14 +547,29 @@ class GoodputLedger:
                 self.tokens += float(tokens)
             self.steps += 1
             self._intervals.append(_Interval(t0, now, cause))
-        if self._registry is not None and (
-            now - self._last_publish >= self.publish_interval_s
-        ):
+        self.maybe_publish(at=now)
+        self.maybe_write(at=now)
+
+    def maybe_publish(self, *, at: float | None = None,
+                      force: bool = False) -> None:
+        """Refresh the registry export at the bounded cadence - called
+        from `step_span` on the training path and from the serve loop
+        (`serve/scheduler.py`), whose feed is `add`/`interval` and so
+        never passes through `step_span`."""
+        if self._registry is None or not self.enabled:
+            return
+        now = self._now() if at is None else at
+        if force or now - self._last_publish >= self.publish_interval_s:
             self._last_publish = now
             self._publish_breakdown(self.breakdown(at=now))
-        if self.path is not None and (
-            now - self._last_write >= self.write_interval_s
-        ):
+
+    def maybe_write(self, *, at: float | None = None) -> None:
+        """Write-through at the bounded cadence (same split as
+        `maybe_publish`)."""
+        if self.path is None or not self.enabled:
+            return
+        now = self._now() if at is None else at
+        if now - self._last_write >= self.write_interval_s:
             self._last_write = now
             self.write_record(final=False)
 
@@ -460,7 +579,7 @@ class GoodputLedger:
         """``{cause: seconds}`` over the full taxonomy up to ``at`` (now
         by default); values sum to total wall-clock by construction."""
         if self._t_start is None:
-            return {c: 0.0 for c in CAUSES}
+            return {c: 0.0 for c in self._causes}
         end = self._now() if at is None else at
         with self._lock:
             intervals = list(self._intervals)
@@ -476,7 +595,10 @@ class GoodputLedger:
                     intervals.append(
                         _Interval(self._t_init_open, stop, "_init_fill")
                     )
-        return attribute_intervals(intervals, self._t_start, end)
+        return attribute_intervals(
+            intervals, self._t_start, end, priority=self._priority,
+            causes=self._causes, fills=self._fills,
+        )
 
     def wall_s(self, at: float | None = None) -> float:
         if self._t_start is None:
@@ -486,8 +608,8 @@ class GoodputLedger:
     def _publish_breakdown(self, buckets: dict) -> None:
         total = sum(buckets.values())
         if total > 0:
-            self._m_ratio.set(buckets[GOODPUT_CAUSE] / total)
-        for cause in BADPUT_CAUSES:
+            self._m_ratio.set(buckets[self._goodput_cause] / total)
+        for cause in self._badput_causes:
             if buckets[cause] > 0:
                 # set_max: totals only accumulate, so a re-publish (or a
                 # sweep re-resolution shaving an overlap) never regresses
@@ -543,7 +665,7 @@ class GoodputLedger:
             ivs = list(self._intervals)
         durs: dict = {}
         for iv in ivs:
-            if iv.cause in _FILL_CAUSES:
+            if iv.cause in self._fills:
                 continue
             durs.setdefault(iv.cause, []).append(iv.t1 - iv.t0)
         return {c: _dist_summary(d) for c, d in sorted(durs.items())}
@@ -551,7 +673,8 @@ class GoodputLedger:
     def _record(self, buckets: dict, total: float, *, final: bool) -> dict:
         return {
             "version": RECORD_VERSION,
-            "kind": "rank",
+            "kind": "rank" if self.taxonomy == "train" else self.taxonomy,
+            "taxonomy": self.taxonomy,
             "final": final,
             "rank": self.rank,
             "generation": self.generation,
@@ -566,12 +689,12 @@ class GoodputLedger:
             "goodput_steps": self.goodput_steps,
             "tokens": self.tokens,
             "wall_s": round(total, 6),
-            "goodput_s": round(buckets[GOODPUT_CAUSE], 6),
+            "goodput_s": round(buckets[self._goodput_cause], 6),
             "goodput_ratio": round(
-                buckets[GOODPUT_CAUSE] / total, 6
+                buckets[self._goodput_cause] / total, 6
             ) if total > 0 else None,
             "badput_s": {
-                c: round(buckets[c], 6) for c in BADPUT_CAUSES
+                c: round(buckets[c], 6) for c in self._badput_causes
             },
             # per-cause event-duration stats (additive, version-1
             # compatible): the distribution inputs for the fleet twin
@@ -1014,16 +1137,20 @@ def breakdown_from_trace(doc: dict) -> dict:
 
 def record_causes(rec: dict) -> dict:
     """Full ``{cause: seconds}`` view of a record (goodput + badput,
-    unknown forward-compat causes preserved)."""
-    out = {c: 0.0 for c in CAUSES}
-    out[GOODPUT_CAUSE] = float(rec.get("goodput_s") or 0.0)
+    unknown forward-compat causes preserved), keyed by the record's own
+    taxonomy (`record_taxonomy`)."""
+    causes, goodput = record_taxonomy(rec)
+    out = {c: 0.0 for c in causes}
+    out[goodput] = float(rec.get("goodput_s") or 0.0)
     for c, v in (rec.get("badput_s") or {}).items():
         out[c] = out.get(c, 0.0) + float(v)
     return out
 
 
 def render_record(rec: dict, *, title: str | None = None) -> str:
-    """Human-readable breakdown table of one record (rank/fleet/trace)."""
+    """Human-readable breakdown table of one record (rank/fleet/serve/
+    trace)."""
+    tax_causes, goodput_cause = record_taxonomy(rec)
     causes = record_causes(rec)
     total = float(rec.get("wall_s") or sum(causes.values()) or 0.0)
     lines = []
@@ -1042,15 +1169,15 @@ def render_record(rec: dict, *, title: str | None = None) -> str:
         meta.append("PARTIAL (write-through; the run did not finalize)")
     lines.append("  " + ", ".join(meta))
     lines.append(f"  {'cause':<20} {'seconds':>12} {'share':>8}")
-    order = [c for c in CAUSES if c in causes] + sorted(
-        c for c in causes if c not in CAUSES
+    order = [c for c in tax_causes if c in causes] + sorted(
+        c for c in causes if c not in tax_causes
     )
     for c in order:
         v = causes[c]
-        if v <= 0 and c not in (GOODPUT_CAUSE, IDLE_CAUSE):
+        if v <= 0 and c not in (goodput_cause, IDLE_CAUSE):
             continue
         share = v / total if total > 0 else 0.0
-        tag = "  <- goodput" if c == GOODPUT_CAUSE else ""
+        tag = "  <- goodput" if c == goodput_cause else ""
         lines.append(f"  {c:<20} {v:>12.3f} {share:>7.2%}{tag}")
     return "\n".join(lines)
 
@@ -1058,6 +1185,7 @@ def render_record(rec: dict, *, title: str | None = None) -> str:
 def diff_records(a: dict, b: dict, name_a: str = "A",
                  name_b: str = "B") -> str:
     """Side-by-side share comparison of two records."""
+    tax_causes, _ = record_taxonomy(a)
     ca, cb = record_causes(a), record_causes(b)
     ta = float(a.get("wall_s") or sum(ca.values()) or 0.0)
     tb = float(b.get("wall_s") or sum(cb.values()) or 0.0)
@@ -1068,8 +1196,8 @@ def diff_records(a: dict, b: dict, name_a: str = "A",
         f"{_fmt_ratio(b.get('goodput_ratio'))}",
         f"  {'cause':<20} {name_a:>12} {name_b:>12} {'d-share':>9}",
     ]
-    order = [c for c in CAUSES if c in ca or c in cb] + sorted(
-        set(list(ca) + list(cb)) - set(CAUSES)
+    order = [c for c in tax_causes if c in ca or c in cb] + sorted(
+        set(list(ca) + list(cb)) - set(tax_causes)
     )
     for c in order:
         va, vb = ca.get(c, 0.0), cb.get(c, 0.0)
@@ -1109,8 +1237,20 @@ def check_record(
 
     Tolerances resolve CLI > baseline-embedded ``check_tolerances``
     block > defaults - so the committed baseline carries its own
-    contract, shardlint-manifest style.
+    contract, shardlint-manifest style. Records are compared within one
+    taxonomy; gating a serving record against a training baseline (or
+    vice versa) is a usage error, named.
     """
+    tax_cur = current.get("taxonomy") or "train"
+    tax_base = baseline.get("taxonomy") or "train"
+    if tax_cur != tax_base:
+        raise ValueError(
+            f"taxonomy mismatch: current record is {tax_cur!r}, baseline "
+            f"is {tax_base!r} - gate serving records against a serving "
+            "baseline (tools/goodput.py --baseline ...)"
+        )
+    causes, goodput_cause = record_taxonomy(current)
+    badput_causes = tuple(c for c in causes if c != goodput_cause)
     embedded = baseline.get("check_tolerances") or {}
     if ratio_tol is None:
         ratio_tol = float(embedded.get("goodput_ratio", DEFAULT_RATIO_TOL))
@@ -1119,10 +1259,10 @@ def check_record(
     tols = dict(embedded.get("causes") or {})
     tols.update(cause_tols or {})
     for c in tols:
-        if c not in BADPUT_CAUSES:
+        if c not in badput_causes:
             raise ValueError(
                 f"unknown badput cause {c!r} in tolerances "
-                f"(known: {', '.join(BADPUT_CAUSES)})"
+                f"(known: {', '.join(badput_causes)})"
             )
     problems = []
     r_cur = current.get("goodput_ratio")
@@ -1142,7 +1282,7 @@ def check_record(
     t_cur = float(current.get("wall_s") or 0.0)
     t_base = float(baseline.get("wall_s") or 0.0)
     for c in sorted(set(list(cc) + list(cb))):
-        if c == GOODPUT_CAUSE:
+        if c == goodput_cause:
             continue
         s_cur = cc.get(c, 0.0) / t_cur if t_cur > 0 else 0.0
         s_base = cb.get(c, 0.0) / t_base if t_base > 0 else 0.0
@@ -1157,15 +1297,6 @@ def check_record(
 
 
 # ----------------------------------------------------------------- helpers
-
-
-def _check_cause(cause: str) -> None:
-    if cause not in CAUSES or cause == IDLE_CAUSE:
-        raise ValueError(
-            f"unknown goodput cause {cause!r} (closed taxonomy: "
-            f"{', '.join(c for c in CAUSES if c != IDLE_CAUSE)}; "
-            f"{IDLE_CAUSE} is the computed residual)"
-        )
 
 
 def _hostname() -> str:

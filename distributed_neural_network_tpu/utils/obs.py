@@ -857,7 +857,36 @@ def _split_label_pairs(s: str):
 
 class _ObsHandler(http.server.BaseHTTPRequestHandler):
     # the registry rides on the server instance (set by ObsServer)
+
+    def _dispatch_route(self, method: str) -> bool:
+        """Pluggable route table (``ObsServer(routes=...)``): the
+        serving layer (`serve/http.py`) mounts its endpoints - incl.
+        long-lived SSE streams - on the same server as /metrics and
+        /healthz. A route handler owns the whole response; a client
+        disconnect mid-stream must be handled inside it (the serving
+        handler turns it into a request cancel)."""
+        routes = getattr(self.server, "routes", None)
+        if not routes:
+            return False
+        fn = routes.get((method, self.path.split("?", 1)[0]))
+        if fn is None:
+            return False
+        fn(self)
+        return True
+
+    def do_POST(self):  # noqa: N802 - BaseHTTPRequestHandler API
+        if self._dispatch_route("POST"):
+            return
+        body = b"not found\n"
+        self.send_response(404)
+        self.send_header("Content-Type", "text/plain")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
+        if self._dispatch_route("GET"):
+            return
         reg = self.server.registry  # type: ignore[attr-defined]
         parts = self.path.split("?", 1)
         path = parts[0]
@@ -934,6 +963,13 @@ class _ObsHandler(http.server.BaseHTTPRequestHandler):
         pass
 
 
+class _ObsHTTPServer(http.server.ThreadingHTTPServer):
+    # socketserver's default listen backlog is 5; a serving burst (the
+    # 429 overflow probe fires dozens of connections at once) would get
+    # kernel connection resets before admission control ever saw them
+    request_queue_size = 128
+
+
 class ObsServer:
     """Background-thread HTTP server for one training process.
 
@@ -952,17 +988,18 @@ class ObsServer:
         host: str = "127.0.0.1",
         stall_after_s: float = 300.0,
         profiler=None,
+        routes: dict | None = None,
     ):
         self.registry = registry
-        self._httpd = http.server.ThreadingHTTPServer(
-            (host, port), _ObsHandler
-        )
+        self._httpd = _ObsHTTPServer((host, port), _ObsHandler)
         self._httpd.daemon_threads = True
         self._httpd.registry = registry  # type: ignore[attr-defined]
         self._httpd.stall_after_s = stall_after_s  # type: ignore
         # /profile target (train/monitor.py ProfileController; None =
         # the endpoint answers 501 with the wiring hint)
         self._httpd.profiler = profiler  # type: ignore[attr-defined]
+        # extra {(method, path): fn(handler)} routes (serve/http.py)
+        self._httpd.routes = dict(routes or {})  # type: ignore
         self.host = host
         self.port = int(self._httpd.server_address[1])
         self.url = f"http://{host}:{self.port}"

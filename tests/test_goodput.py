@@ -789,3 +789,158 @@ def test_cli_renders_directory_and_exports_distributions(tmp_path):
     assert r.returncode == 2
     assert _run_tool("--distributions",
                      str(tmp_path / "missing")).returncode == 2
+
+
+# ------------------------------------------- serving taxonomy (schema v2)
+
+
+def serve_ledger():
+    clk = [0.0]
+    led = GoodputLedger(clock=lambda: clk[0], taxonomy="serve")
+    return led, clk
+
+
+def test_serve_ledger_conserves_over_serve_taxonomy():
+    led, clk = serve_ledger()
+    led.start()
+    clk[0] = 1.0
+    led.add("prefill", 0.2, 0.8)
+    led.add("decode", 0.8, 1.0)
+    clk[0] = 2.0
+    led.add("kv_alloc_stall", 1.0, 1.4)
+    led.add("batch_formation_idle", 1.4, 1.5)
+    clk[0] = 3.0
+    rec = led.finalize()
+    assert rec["taxonomy"] == "serve" and rec["kind"] == "serve"
+    assert rec["version"] == gp.RECORD_VERSION
+    b = gp.record_causes(rec)
+    assert b["decode"] == pytest.approx(0.2)
+    assert b["prefill"] == pytest.approx(0.6)
+    assert b["kv_alloc_stall"] == pytest.approx(0.4)
+    assert b["batch_formation_idle"] == pytest.approx(0.1)
+    assert b["idle_other"] == pytest.approx(3.0 - 1.3)
+    assert sum(b.values()) == pytest.approx(rec["wall_s"])
+    # the train-only causes are NOT in a serve record
+    assert "init" not in rec["badput_s"]
+    assert "steady_step" not in b
+
+
+def test_serve_queue_wait_claims_only_idle_seconds():
+    """A request queued [0, 5] while the engine decoded [1, 3]: the
+    decode span wins its overlap; queue_wait gets only the idle rest."""
+    led, clk = serve_ledger()
+    led.start()
+    clk[0] = 5.0
+    led.add("queue_wait", 0.0, 5.0)
+    led.add("decode", 1.0, 3.0)
+    b = led.breakdown()
+    assert b["decode"] == pytest.approx(2.0)
+    assert b["queue_wait"] == pytest.approx(3.0)
+    assert sum(b.values()) == pytest.approx(5.0)
+
+
+def test_serve_ledger_rejects_train_causes_and_vice_versa():
+    led, _ = serve_ledger()
+    led.start()
+    with pytest.raises(ValueError, match="serve goodput cause"):
+        led.add("checkpoint_save", 0.0, 1.0)
+    with pytest.raises(ValueError, match="step_span"):
+        led.step_span(0, 1.0)
+    with pytest.raises(ValueError, match="no fill bucket"):
+        led.fill_ending_now("decode", 1.0)
+    train, _ = fake_ledger()
+    train.start()
+    with pytest.raises(ValueError, match="train goodput cause"):
+        train.add("kv_alloc_stall", 0.0, 1.0)
+    with pytest.raises(ValueError, match="unknown ledger taxonomy"):
+        GoodputLedger(taxonomy="nope")
+
+
+def test_v1_record_still_parses_and_renders_as_train():
+    """Forward compat across the v1 -> v2 bump: a v1 record (no
+    taxonomy field) validates, renders with the training causes, and
+    diffs/checks against other train records."""
+    old = {
+        "version": 1, "kind": "rank", "final": True,
+        "wall_s": 10.0, "goodput_s": 8.0, "goodput_ratio": 0.8,
+        "badput_s": {"compile": 1.0, "stall": 1.0},
+        "steps": 5,
+    }
+    rec = validate_record(old)
+    causes, goodput_cause = gp.record_taxonomy(rec)
+    assert goodput_cause == GOODPUT_CAUSE and causes == CAUSES
+    out = render_record(rec)
+    assert "steady_step" in out and "<- goodput" in out
+    assert check_record(rec, rec) == []
+    # and a v2 train record interoperates with it
+    led, clk = fake_ledger()
+    led.start()
+    clk[0] = 1.0
+    led.step_span(0, 1.0)
+    new = led.finalize()
+    assert new["version"] == 2 and new["taxonomy"] == "train"
+    assert "vs" in diff_records(new, rec)
+
+
+def test_newer_version_still_refused():
+    with pytest.raises(ValueError, match="newer"):
+        validate_record({"version": gp.RECORD_VERSION + 1,
+                         "wall_s": 1.0, "badput_s": {}})
+
+
+def test_check_record_taxonomy_mismatch_and_serve_gate():
+    led, clk = serve_ledger()
+    led.start()
+    clk[0] = 10.0
+    led.add("decode", 0.0, 8.0)
+    led.add("prefill", 8.0, 9.0)
+    rec = led.finalize()
+    train = {"version": 1, "wall_s": 10.0, "goodput_s": 8.0,
+             "goodput_ratio": 0.8, "badput_s": {}}
+    with pytest.raises(ValueError, match="taxonomy mismatch"):
+        check_record(rec, train)
+    # serve-vs-serve gating with serve-cause tolerances
+    assert check_record(rec, rec) == []
+    regressed = json.loads(json.dumps(rec))
+    regressed["badput_s"]["kv_alloc_stall"] = 6.0
+    regressed["wall_s"] = 16.0
+    problems = check_record(regressed, rec, share_tol=0.1)
+    assert problems and "kv_alloc_stall" in problems[0]
+    # serve-cause tolerance keys are accepted; train-cause keys are not
+    assert check_record(rec, rec, cause_tols={"kv_alloc_stall": 0.5}) == []
+    with pytest.raises(ValueError, match="unknown badput cause"):
+        check_record(rec, rec, cause_tols={"stall": 0.5})
+
+
+def test_serve_record_write_through_and_cli_render(tmp_path):
+    led, clk = serve_ledger()
+    led.start()
+    led.arm(str(tmp_path / "serve.json"))
+    clk[0] = 6.0
+    led.add("decode", 0.0, 3.0)
+    led.add("queue_wait", 0.0, 6.0)
+    led.note_steps(3, tokens=30.0)
+    led.finalize()
+    rec = read_record(str(tmp_path / "serve.json"))
+    assert rec["taxonomy"] == "serve"
+    assert rec["tokens"] == 30.0
+    r = subprocess.run(
+        [sys.executable, GOODPUT_TOOL, str(tmp_path / "serve.json")],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0
+    assert "queue_wait" in r.stdout and "decode" in r.stdout
+
+
+def test_serve_ledger_publishes_on_registry():
+    reg = MetricsRegistry()
+    led, clk = serve_ledger()
+    led.start()
+    led.publish(reg)
+    clk[0] = 4.0
+    led.add("decode", 0.0, 2.0)
+    led.add("kv_alloc_stall", 2.0, 3.0)
+    led.maybe_publish(force=True)
+    text = reg.render()
+    assert "goodput_ratio 0.5" in text
+    assert 'badput_seconds_total{cause="kv_alloc_stall"} 1' in text

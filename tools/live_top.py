@@ -18,7 +18,12 @@ server, and on dead runs' files). One compact ANSI frame per refresh:
     signal, shrink/grow/rendezvous restarts, and restart latency -
     plus the FLEET view (train/supervisor.py FleetFederation): one row
     per rank (step, step time, loss, up/DOWN), the attributed straggler
-    rank, a step-skew sparkline, and restart/postmortem counters.
+    rank, a step-skew sparkline, and restart/postmortem counters;
+  - when pointed at a serving endpoint (python -m
+    distributed_neural_network_tpu.serve): QPS (from completed-request
+    counter deltas), TTFT p50/p99 + sparkline, inter-token p99,
+    active/queued sequences, and KV-block utilization color-banded by
+    occupancy (green < 70% < yellow < 90% < red).
 
 Stdlib-only (no jax, no repo imports) so it runs anywhere - including a
 laptop pointed at a forwarded TPU host port.
@@ -180,6 +185,9 @@ class EndpointSource:
         self.timeout = timeout
         self.loss_history: list[float] = []
         self.skew_history: list[float] = []
+        self.qps_history: list[float] = []
+        self.ttft_history: list[float] = []
+        self._last_completed: tuple | None = None  # (t, count)
         self.error: str | None = None
 
     def _get(self, path: str) -> str | None:
@@ -221,9 +229,30 @@ class EndpointSource:
         if skew is not None and math.isfinite(skew):
             self.skew_history.append(skew)
             del self.skew_history[:-512]
+        # serving view histories (serve/scheduler.py series): QPS from
+        # completed-request counter deltas, TTFT p50 per sample
+        completed = labeled_value(
+            metrics, "serve_requests_total", status="completed"
+        )
+        if completed is not None:
+            now = time.time()
+            if self._last_completed is not None:
+                dt = now - self._last_completed[0]
+                if dt > 0:
+                    self.qps_history.append(
+                        max(0.0, (completed - self._last_completed[1]) / dt)
+                    )
+                    del self.qps_history[:-512]
+            self._last_completed = (now, completed)
+        ttft = hist_quantile(metrics, "serve_ttft_seconds", 0.50)
+        if ttft is not None and math.isfinite(ttft):
+            self.ttft_history.append(ttft)
+            del self.ttft_history[:-512]
         return {"metrics": metrics, "health": health,
                 "loss_history": list(self.loss_history),
                 "skew_history": list(self.skew_history),
+                "qps_history": list(self.qps_history),
+                "ttft_history": list(self.ttft_history),
                 "source": self.base}
 
 
@@ -533,6 +562,59 @@ def render(snap: dict, *, color: bool = True, width: int = 72) -> str:
             if straggler is not None and str(int(straggler)) == str(r):
                 row = c(YELLOW, row)
             lines.append(row)
+    # serving view (serve/scheduler.py): QPS, TTFT percentiles +
+    # sparkline, active/queued sequences, KV-block utilization
+    # color-banded by occupancy - present when the target is a
+    # `python -m distributed_neural_network_tpu.serve` endpoint
+    served = m.get("serve_requests_total") or {}
+    if served:
+        completed = labeled_value(
+            m, "serve_requests_total", 0, status="completed"
+        )
+        accepted = labeled_value(
+            m, "serve_requests_total", 0, status="accepted"
+        )
+        rejected = metric_sum(m, "serve_rejected_total")
+        qps_hist = snap.get("qps_history") or []
+        qps = qps_hist[-1] if qps_hist else None
+        line = (
+            "serving     "
+            + (f"{qps:.2f} req/s  " if qps is not None else "")
+            + f"completed {int(completed)}/{int(accepted)} accepted"
+            + (
+                c(YELLOW, f"  429s {int(rejected)}")
+                if rejected else "  429s 0"
+            )
+        )
+        lines.append(line)
+        ttft50 = hist_quantile(m, "serve_ttft_seconds", 0.50)
+        ttft99 = hist_quantile(m, "serve_ttft_seconds", 0.99)
+        it99 = hist_quantile(m, "serve_intertoken_seconds", 0.99)
+        ttft_s = (
+            f"ttft p50<={ttft50:.3g}s p99<={ttft99:.3g}s"
+            if ttft50 is not None else "ttft n/a"
+        )
+        spark = sparkline(snap.get("ttft_history") or [], 20)
+        lines.append(
+            "  " + ttft_s
+            + (f"  inter-token p99<={it99:.3g}s" if it99 is not None else "")
+            + (f"  {spark}" if spark else "")
+        )
+        active = metric_value(m, "serve_active_sequences", 0)
+        queued = metric_value(m, "serve_queue_depth", 0)
+        kv_used = metric_value(m, "serve_kv_blocks_in_use", 0)
+        kv_total = metric_value(m, "serve_kv_blocks_total", 0)
+        preempt = metric_value(m, "serve_preemptions_total", 0)
+        util = kv_used / kv_total if kv_total else 0.0
+        kv_col = GREEN if util < 0.7 else YELLOW if util < 0.9 else RED
+        kv_line = (
+            f"  active {int(active)}  queued {int(queued)}  "
+            + c(kv_col,
+                f"kv {int(kv_used)}/{int(kv_total)} blocks "
+                f"({100.0 * util:.0f}%)")
+            + (f"  preempted {int(preempt)}" if preempt else "")
+        )
+        lines.append(kv_line)
     phases = m.get("phase_seconds_total") or {}
     if phases:
         lines.append(

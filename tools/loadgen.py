@@ -1,0 +1,398 @@
+#!/usr/bin/env python
+"""Open-loop synthetic load generator for the serving stack
+(`distributed_neural_network_tpu/serve/`).
+
+OPEN loop: request arrival times are fixed by the offered rate alone -
+a slow server does not slow the generator down, so queueing delay shows
+up in the measured TTFT instead of being hidden by client backpressure
+(the standard serving-benchmark discipline; closed-loop generators
+underreport saturation).
+
+  # 5 req/s for 20 s, mixed prompt lengths, streamed
+  python tools/loadgen.py http://127.0.0.1:8000 --rate 5 --duration 20 \
+      --prompt-lens 8,32,128 --max-new 32
+
+  # fixed request count + a mid-flight client cancel + JSON summary
+  python tools/loadgen.py URL --rate 10 --requests 50 --cancel-one \
+      --out loadgen.json
+
+  # burst mode: N requests fired at once (the 429 overflow probe)
+  python tools/loadgen.py URL --burst 32 --requests 0 --expect-429
+
+  # verify every streamed completion against the offline
+  # models/transformer.py generate() oracle (the server's --seed /
+  # geometry flags repeated here rebuild the same model)
+  python tools/loadgen.py URL --rate 5 --requests 20 --check-oracle \
+      --seed 0 --vocab 256 --d-model 64 --n-heads 4 --n-layers 2 \
+      --d-ff 128
+
+Measures per request: TTFT (send -> first streamed token), inter-token
+gaps, completion status; reports offered/achieved req/s, p50/p99 TTFT,
+p50/p99 inter-token latency, token throughput, and counts by outcome.
+Exit codes: 0 ok; 1 a check failed (oracle mismatch, --expect-429
+unmet, or any transport error); 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import os
+import random
+import sys
+import threading
+import time
+import urllib.parse
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def percentile(xs, q: float):
+    """Nearest-rank percentile; None when empty."""
+    if not xs:
+        return None
+    s = sorted(xs)
+    import math
+
+    return s[max(0, math.ceil(q * len(s)) - 1)]
+
+
+def make_prompts(n: int, lens, vocab: int, seed: int):
+    """Deterministic mixed-length prompts (cycled lengths, seeded
+    tokens >= 2 - ids 0/1 are conventionally pad/eos-ish)."""
+    rng = random.Random(seed)
+    lo = min(2, vocab - 1)
+    out = []
+    for i in range(n):
+        ln = lens[i % len(lens)]
+        out.append([rng.randrange(lo, vocab) for _ in range(ln)])
+    return out
+
+
+class RequestResult:
+    __slots__ = ("idx", "status", "http_status", "tokens", "ttft_s",
+                 "gaps_s", "total_s", "error", "prompt", "cancelled_after")
+
+    def __init__(self, idx, prompt):
+        self.idx = idx
+        self.prompt = prompt
+        self.status = "pending"
+        self.http_status = None
+        self.tokens = []
+        self.ttft_s = None
+        self.gaps_s = []
+        self.total_s = None
+        self.error = None
+        self.cancelled_after = None
+
+
+def run_one(
+    base: str, res: RequestResult, *, max_new: int, api_key: str,
+    temperature: float, timeout: float, cancel_after: int | None = None,
+) -> None:
+    """One streamed request; fills ``res`` in place. ``cancel_after``
+    closes the connection after that many streamed tokens - the
+    mid-flight client-disconnect probe."""
+    u = urllib.parse.urlsplit(base)
+    t0 = time.monotonic()
+    conn = http.client.HTTPConnection(
+        u.hostname, u.port or 80, timeout=timeout
+    )
+    try:
+        conn.request(
+            "POST", "/v1/generate",
+            json.dumps({
+                "prompt": res.prompt, "max_new_tokens": max_new,
+                "temperature": temperature, "stream": True,
+            }),
+            {"Content-Type": "application/json", "X-API-Key": api_key},
+        )
+        r = conn.getresponse()
+        res.http_status = r.status
+        if r.status != 200:
+            res.status = (
+                "rejected_429" if r.status == 429 else f"http_{r.status}"
+            )
+            r.read()
+            return
+        t_prev = None
+        buf = b""
+        while True:
+            chunk = r.read(256)
+            if not chunk:
+                break
+            buf += chunk
+            while b"\n\n" in buf:
+                frame, buf = buf.split(b"\n\n", 1)
+                line = frame.decode("utf-8", "replace").strip()
+                if not line.startswith("data: "):
+                    continue
+                doc = json.loads(line[len("data: "):])
+                now = time.monotonic()
+                if "token" in doc:
+                    res.tokens.append(int(doc["token"]))
+                    if res.ttft_s is None:
+                        res.ttft_s = now - t0
+                    elif t_prev is not None:
+                        res.gaps_s.append(now - t_prev)
+                    t_prev = now
+                    if (cancel_after is not None
+                            and len(res.tokens) >= cancel_after):
+                        res.status = "client_cancelled"
+                        res.cancelled_after = len(res.tokens)
+                        res.total_s = now - t0
+                        conn.close()
+                        return
+                elif doc.get("done"):
+                    res.status = "completed"
+                    res.total_s = now - t0
+                    return
+                elif "error" in doc:
+                    res.status = "error"
+                    res.error = doc["error"]
+                    return
+        res.status = "error"
+        res.error = "stream ended without done frame"
+    except OSError as e:
+        res.status = "error"
+        res.error = f"{type(e).__name__}: {e}"
+    finally:
+        conn.close()
+
+
+def run_load(
+    base: str, *, rate: float, n_requests: int, duration: float | None,
+    prompt_lens, max_new: int, vocab: int, seed: int, api_keys,
+    temperature: float, burst: int, cancel_one: bool, timeout: float,
+    poisson: bool,
+) -> dict:
+    """Fire the schedule, join all clients, return the summary dict."""
+    if duration is not None:
+        n_requests = max(int(rate * duration), 1)
+    n_total = n_requests + burst
+    prompts = make_prompts(max(n_total, 1), prompt_lens, vocab, seed)
+    results = [RequestResult(i, prompts[i]) for i in range(n_total)]
+    cancel_idx = (
+        burst + n_requests // 2 if cancel_one and n_requests > 0
+        else (0 if cancel_one else None)
+    )
+    rng = random.Random(seed + 1)
+    threads = []
+    t_start = time.monotonic()
+
+    def fire(res, cancel_after):
+        th = threading.Thread(
+            target=run_one, args=(base, res),
+            kwargs=dict(
+                max_new=max_new,
+                api_key=api_keys[res.idx % len(api_keys)],
+                temperature=temperature, timeout=timeout,
+                cancel_after=cancel_after,
+            ),
+            daemon=True,
+        )
+        th.start()
+        threads.append(th)
+
+    # burst phase: all at once (the queue-overflow probe)
+    for i in range(burst):
+        fire(results[i], None)
+    # paced open-loop phase
+    t_next = time.monotonic()
+    for j in range(n_requests):
+        i = burst + j
+        if poisson:
+            t_next += rng.expovariate(rate) if rate > 0 else 0.0
+        else:
+            t_next += 1.0 / rate if rate > 0 else 0.0
+        delay = t_next - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        fire(results[i], 2 if i == cancel_idx else None)
+    for th in threads:
+        th.join(timeout=timeout + 60)
+    wall = time.monotonic() - t_start
+
+    by_status: dict = {}
+    for r in results:
+        by_status[r.status] = by_status.get(r.status, 0) + 1
+    ttfts = [r.ttft_s for r in results if r.ttft_s is not None]
+    gaps = [g for r in results for g in r.gaps_s]
+    completed = [r for r in results if r.status == "completed"]
+    toks = sum(len(r.tokens) for r in results)
+    return {
+        "offered_rps": round(rate, 4),
+        "achieved_rps": round(len(completed) / wall, 4) if wall > 0 else None,
+        "wall_s": round(wall, 3),
+        "requests": n_total,
+        "by_status": by_status,
+        "tokens_streamed": toks,
+        "tokens_per_s": round(toks / wall, 2) if wall > 0 else None,
+        "ttft_p50_s": percentile(ttfts, 0.50),
+        "ttft_p99_s": percentile(ttfts, 0.99),
+        "intertoken_p50_s": percentile(gaps, 0.50),
+        "intertoken_p99_s": percentile(gaps, 0.99),
+        "results": results,
+    }
+
+
+def check_oracle(summary: dict, args) -> list:
+    """Rebuild the server's seeded model offline and verify every
+    COMPLETED request's streamed tokens equal `generate()`'s (greedy).
+    Returns a list of problem strings."""
+    sys.path.insert(0, _REPO)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_neural_network_tpu.models import transformer as tfm
+
+    cfg = tfm.TransformerConfig(
+        vocab_size=args.vocab, d_model=args.d_model,
+        n_heads=args.n_heads, n_layers=args.n_layers, d_ff=args.d_ff,
+        dtype=jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32,
+    )
+    params = tfm.init_params(jax.random.key(args.seed), cfg)
+    problems = []
+    checked = 0
+    for r in summary["results"]:
+        if r.status == "completed":
+            want = np.asarray(tfm.generate(
+                params, jnp.asarray([r.prompt], jnp.int32), cfg,
+                max_new_tokens=args.max_new,
+            ))[0, len(r.prompt):]
+            if r.tokens != [int(x) for x in want]:
+                problems.append(
+                    f"request {r.idx}: streamed {r.tokens} != oracle "
+                    f"{[int(x) for x in want]}"
+                )
+            checked += 1
+        elif r.status == "client_cancelled":
+            # the cancelled prefix must still be oracle-exact
+            want = np.asarray(tfm.generate(
+                params, jnp.asarray([r.prompt], jnp.int32), cfg,
+                max_new_tokens=max(len(r.tokens), 1),
+            ))[0, len(r.prompt):][: len(r.tokens)]
+            if r.tokens != [int(x) for x in want]:
+                problems.append(
+                    f"request {r.idx} (cancelled): prefix {r.tokens} "
+                    f"!= oracle {[int(x) for x in want]}"
+                )
+            checked += 1
+    if checked == 0:
+        problems.append("oracle check had nothing to verify "
+                        "(no completed requests)")
+    else:
+        print(f"(oracle: {checked} completion(s) verified against "
+              "offline generate())", file=sys.stderr)
+    return problems
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("url", help="server base URL (http://host:port)")
+    p.add_argument("--rate", type=float, default=5.0,
+                   help="offered request rate (req/s, open loop)")
+    p.add_argument("--requests", type=int, default=20,
+                   help="paced request count (0 = burst only)")
+    p.add_argument("--duration", type=float, default=None,
+                   help="pace for this many seconds instead of a count")
+    p.add_argument("--poisson", action="store_true",
+                   help="Poisson arrivals (seeded) instead of uniform")
+    p.add_argument("--prompt-lens", default="4,8,16",
+                   help="comma list of prompt lengths, cycled")
+    p.add_argument("--max-new", type=int, default=16)
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--api-keys", default="tenant0,tenant1",
+                   help="comma list, assigned round-robin")
+    p.add_argument("--burst", type=int, default=0,
+                   help="requests fired all at once before pacing "
+                   "(the 429 overflow probe)")
+    p.add_argument("--cancel-one", action="store_true",
+                   help="client-close one mid-flight stream after 2 "
+                   "tokens (the disconnect-cancel probe)")
+    p.add_argument("--timeout", type=float, default=120.0)
+    p.add_argument("--expect-429", action="store_true",
+                   help="fail (exit 1) unless at least one request was "
+                   "rejected with 429")
+    p.add_argument("--check-oracle", action="store_true",
+                   help="verify streamed completions against offline "
+                   "generate() (rebuilds the server's seeded model "
+                   "from the flags below)")
+    p.add_argument("--out", default=None, help="write the JSON summary")
+    # model geometry for --check-oracle (must mirror the server's)
+    p.add_argument("--d-model", type=int, default=64)
+    p.add_argument("--n-heads", type=int, default=4)
+    p.add_argument("--n-layers", type=int, default=2)
+    p.add_argument("--d-ff", type=int, default=128)
+    p.add_argument("--vocab", type=int, default=256)
+    p.add_argument("--dtype", choices=("float32", "bfloat16"),
+                   default="float32")
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+
+    try:
+        lens = [int(x) for x in args.prompt_lens.split(",") if x.strip()]
+        assert lens and all(x > 0 for x in lens)
+    except (ValueError, AssertionError):
+        print(f"loadgen: bad --prompt-lens {args.prompt_lens!r}",
+              file=sys.stderr)
+        return 2
+    if args.requests <= 0 and args.burst <= 0 and not args.duration:
+        print("loadgen: nothing to send (requests, burst both 0)",
+              file=sys.stderr)
+        return 2
+    if args.check_oracle and args.temperature > 0:
+        print("loadgen: --check-oracle needs greedy decoding "
+              "(temperature 0)", file=sys.stderr)
+        return 2
+
+    summary = run_load(
+        args.url, rate=args.rate, n_requests=max(args.requests, 0),
+        duration=args.duration, prompt_lens=lens, max_new=args.max_new,
+        vocab=args.vocab, seed=args.seed,
+        api_keys=[k.strip() for k in args.api_keys.split(",") if k.strip()],
+        temperature=args.temperature, burst=max(args.burst, 0),
+        cancel_one=args.cancel_one, timeout=args.timeout,
+        poisson=args.poisson,
+    )
+
+    problems = []
+    errors = [r for r in summary["results"] if r.status == "error"]
+    for r in errors[:5]:
+        problems.append(f"request {r.idx} failed: {r.error}")
+    if args.expect_429 and not summary["by_status"].get("rejected_429"):
+        problems.append(
+            "--expect-429: no request was rejected with 429 "
+            f"(statuses: {summary['by_status']})"
+        )
+    if args.cancel_one and not summary["by_status"].get(
+        "client_cancelled"
+    ):
+        problems.append("--cancel-one: the cancel probe did not cancel "
+                        "(stream finished before 2 tokens?)")
+    if args.check_oracle:
+        problems.extend(check_oracle(summary, args))
+
+    doc = {k: v for k, v in summary.items() if k != "results"}
+    doc["ok"] = not problems
+    doc["problems"] = problems
+    print(json.dumps(doc, indent=1))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+    if problems:
+        print("LOADGEN FAILED:", file=sys.stderr)
+        for prob in problems:
+            print(f"  - {prob}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
