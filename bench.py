@@ -561,6 +561,31 @@ def _rows(epochs: int) -> list[dict]:
             "args": {"dtype": "bfloat16", "rate": 4.0, "requests": 24,
                      "max_new": 32},
         },
+        # the int8-KV serving row (ROADMAP item 3's serving half): same
+        # open-loop workload on the quantized pool, with the two
+        # honesty gates ASSERTED in the row - measured concurrent-
+        # sequence capacity >= 1.8x the bf16 pool at equal HBM budget
+        # (both pools' admitted-sequence counts recorded), and >= 99%
+        # per-token top-1 agreement vs the offline bf16 generate()
+        # oracle over every completed stream (docs/SERVING.md)
+        {
+            "id": "serve_d512_L8_int8kv_openloop",
+            "kind": "serving",
+            "est_s": 900,
+            "args": {"dtype": "bfloat16", "rate": 4.0, "requests": 24,
+                     "max_new": 32, "kv_dtype": "int8"},
+        },
+        # quantized-vs-bf16 training parity (the other honesty rail):
+        # same init + byte-identical batches, attention matmuls in
+        # int8/fp8 (ops/quant.py), final-loss delta + held-out logit
+        # MAE gated at the documented tolerances
+        # (docs/MEASUREMENT.md "Low-precision parity gates")
+        {
+            "id": "lm_quant_parity_cpu",
+            "kind": "quant_parity",
+            "env": {"JAX_PLATFORMS": "cpu"},
+            "args": {},
+        },
     ]
     return rows
 
@@ -652,6 +677,12 @@ def _run_worker(spec: dict) -> dict:
         )
 
         return measure_serving(**spec["args"])
+    if spec["kind"] == "quant_parity":
+        from distributed_neural_network_tpu.train.measure import (
+            measure_quant_parity,
+        )
+
+        return measure_quant_parity(**spec["args"])
     raise ValueError(f"unknown row kind {spec['kind']!r}")
 
 

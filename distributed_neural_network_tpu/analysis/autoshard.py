@@ -156,6 +156,10 @@ class SearchResult:
     ranked: list = field(default_factory=list)  # feasible, best first
     infeasible: list = field(default_factory=list)  # RankedPlan, reasoned
     hand_dims: dict | None = None
+    # param-footprint pricing the search scored under ("as-traced" or a
+    # DTYPE_BYTES name): recorded in the plan manifest - a plan searched
+    # at int8 pricing is not comparable to a bf16 one
+    precision: str = "as-traced"
 
     @property
     def chosen(self) -> RankedPlan | None:
@@ -229,6 +233,8 @@ def search_plans(
     result = SearchResult(
         config=config, family=family, devices=devices,
         optimizer=optimizer, hand_dims=hand_dims,
+        precision=(weights.param_precision if weights is not None
+                   and weights.param_precision else "as-traced"),
     )
     dims_list = (
         lm_mesh_candidates(devices) if family == "lm"
@@ -293,7 +299,8 @@ def search_config(
         hand = {"dp": bp["dp"], "pp": bp["pp"]}
         n = bp["dp"] * bp["pp"]
     return search_plans(
-        bp["family"], cfg=_trace_cfg(), devices=devices or n,
+        bp["family"], cfg=_trace_cfg(**bp.get("cfg_kwargs", {})),
+        devices=devices or n,
         batch=TRACE_BATCH, seq_len=TRACE_SEQ, optimizer=bp["optimizer"],
         kwargs=bp["kwargs"], optimizers=optimizers, weights=weights,
         config=name, hand_dims=hand if devices in (None, n) else None,
@@ -325,6 +332,7 @@ def build_plan_doc(result: SearchResult) -> dict:
         "devices": result.devices,
         "hand_dims": result.hand_dims,
         "matches_hand_config": result.matches_hand_config(),
+        "precision": result.precision,
         "chosen": {
             "plan": chosen.label,
             "dims": chosen.dims,
@@ -390,6 +398,14 @@ def diff_plans(expected: dict, result: SearchResult) -> list:
                 "--write-manifest (docs/STATIC_ANALYSIS.md)"
             ]
     msgs = []
+    if (expected.get("precision") or "as-traced") != result.precision:
+        return [
+            f"plan for {expected.get('config')!r} was searched under "
+            f"precision={expected.get('precision') or 'as-traced'!r} but "
+            f"this run priced {result.precision!r} - quantized and "
+            "full-precision footprints rank differently; regenerate or "
+            "drop --precision"
+        ]
     if expected.get("devices") != result.devices:
         return [
             f"device count changed: plan searched {expected.get('devices')}"

@@ -38,12 +38,12 @@ TRACE_SEQ = 16
 TRACE_BUCKET_MB = 0.002
 
 
-def _trace_cfg():
+def _trace_cfg(**cfg_kwargs):
     from ..models import transformer as tfm
 
     return tfm.TransformerConfig(
         vocab_size=TRACE_VOCAB, d_model=TRACE_D_MODEL, n_heads=TRACE_HEADS,
-        n_layers=TRACE_LAYERS, d_ff=TRACE_D_FF,
+        n_layers=TRACE_LAYERS, d_ff=TRACE_D_FF, **cfg_kwargs,
     )
 
 
@@ -64,17 +64,18 @@ def _require_devices(n: int):
 BLUEPRINTS: dict = {}
 
 
-def _lm(name, *, dp=4, sp=1, tp=1, optimizer="sgd", **kw):
+def _lm(name, *, dp=4, sp=1, tp=1, optimizer="sgd", cfg_kwargs=None, **kw):
     from ..train import lm as lmtrain
 
     BLUEPRINTS[name] = {
         "family": "lm", "dp": dp, "sp": sp, "tp": tp,
         "optimizer": optimizer, "kwargs": dict(kw),
+        "cfg_kwargs": dict(cfg_kwargs or {}),
     }
 
     def build():
         _require_devices(dp * sp * tp)
-        cfg = _trace_cfg()
+        cfg = _trace_cfg(**(cfg_kwargs or {}))
         mesh = lmtrain.create_lm_mesh(dp, sp, tp)
         with compat.trace_compat():
             return lmtrain.lm_step_program(
@@ -177,6 +178,17 @@ CANONICAL_CONFIGS = {
     "lm_zero_adam": _lm("lm_zero_adam", optimizer="zero-adam"),
     "lm_zero_adam_overlap": _lm(
         "lm_zero_adam_overlap", optimizer="zero-adam", **OVERLAP
+    ),
+    # the fp8/int8 fast path (ROADMAP item 3): the same dp step with
+    # quantized attention matmuls - the manifest pins the int8/fp8 value
+    # counts AND the wide-accumulate upcasts (fp8->f32 appears in the
+    # upcast table), so a silently-dropped low-precision path or a
+    # silently-dropped accumulation upcast both fail --check
+    "lm_quant_fp8": _lm(
+        "lm_quant_fp8", cfg_kwargs=dict(attn_quant="fp8")
+    ),
+    "lm_quant_int8": _lm(
+        "lm_quant_int8", cfg_kwargs=dict(attn_quant="int8")
     ),
     # pipeline: per-tick ppermute ring + the exit all_to_all
     "pp_gpipe": _pp("pp_gpipe"),

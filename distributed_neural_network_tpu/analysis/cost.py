@@ -49,6 +49,72 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+# ------------------------------------------------------- bytes per dtype
+#
+# The low-precision pricing table (ROADMAP item 3's closing clause): the
+# HBM-feasibility gate, `step_seconds`, and the serving KV-capacity math
+# all consult it, so autoshard can trade precision for parallelism (an
+# int8 plan that fits where a bf16 plan did not) and the serving stack
+# reports occupancy in the bytes it actually allocates. Quantized
+# formats carry per-block f32 scales - `quantized_bytes` charges them,
+# so a "free" 4x never appears in a feasibility decision.
+
+DTYPE_BYTES = {
+    "f32": 4, "float32": 4, "fp32": 4,
+    "bf16": 2, "bfloat16": 2, "f16": 2, "float16": 2,
+    "int8": 1, "fp8": 1, "fp8-e4m3": 1, "float8_e4m3fn": 1,
+}
+# formats that need a dequantization scale riding along
+QUANTIZED_DTYPES = ("int8", "fp8", "fp8-e4m3", "float8_e4m3fn")
+SCALE_BYTES = 4  # one f32 scale per quantization block
+
+
+def dtype_bytes(name: str) -> int:
+    try:
+        return DTYPE_BYTES[str(name)]
+    except KeyError:
+        raise ValueError(
+            f"unknown dtype name {name!r}; known: "
+            f"{', '.join(sorted(DTYPE_BYTES))}"
+        ) from None
+
+
+def quantized_bytes(n_elements: int, dtype: str, *,
+                    quant_block: int = 64) -> int:
+    """Storage bytes of ``n_elements`` in ``dtype`` INCLUDING the per-
+    block f32 scales quantized formats carry (one scale per
+    ``quant_block`` elements) - the honest footprint the HBM gate and
+    the KV-capacity math price."""
+    total = n_elements * dtype_bytes(dtype)
+    if str(dtype) in QUANTIZED_DTYPES:
+        total += -(-n_elements // max(quant_block, 1)) * SCALE_BYTES
+    return total
+
+
+def kv_block_bytes(n_layers: int, n_heads: int, head_dim: int,
+                   block_size: int, dtype: str = "bf16") -> int:
+    """Device bytes of ONE paged-KV block (serve/kv_cache.py): K + V
+    slabs for every layer, plus - for quantized dtypes - the
+    per-(block, head) f32 scale pair each layer stores. The serving
+    capacity multiplier is exactly bf16's figure over int8's."""
+    elems = 2 * n_layers * block_size * n_heads * head_dim  # K and V
+    total = elems * dtype_bytes(dtype)
+    if str(dtype) in QUANTIZED_DTYPES:
+        total += 2 * n_layers * n_heads * SCALE_BYTES
+    return total
+
+
+def kv_capacity_sequences(usable_blocks: int, block_size: int,
+                          seq_len: int) -> int:
+    """Concurrent sequences of ``seq_len`` tokens a pool of
+    ``usable_blocks`` holds - the *effective* capacity figure the
+    /metrics gauge and tools/live_top.py report instead of a raw block
+    count."""
+    if seq_len < 1:
+        raise ValueError(f"seq_len must be >= 1, got {seq_len}")
+    blocks_per_seq = -(-seq_len // block_size)
+    return usable_blocks // blocks_per_seq
+
 
 @dataclass(frozen=True)
 class CostWeights:
@@ -61,6 +127,16 @@ class CostWeights:
     donation_weight: float = 0.5  # per un-donated state byte
     leak_weight: float = 4.0  # per leaked (unsharded ZeRO carry) byte
     hbm_bytes: int = 16 * 2**30  # per-device budget (v5e-class default)
+    # price PARAM floating leaves as if stored in this dtype ("int8" /
+    # "fp8" / "bf16"; None = as traced): the quantized-footprint knob
+    # that lets the HBM-feasibility gate trade precision for parallelism
+    # - an int8 plan fits meshes a bf16 plan prunes (tools/autoshard.py
+    # --precision). Optimizer state is NEVER repriced (master weights /
+    # moments stay wide; quantizing them is a different algorithm, not
+    # a storage choice), and quantized formats are charged their
+    # per-block scale overhead (`quantized_bytes`).
+    param_precision: str | None = None
+    quant_block: int = 64  # elements per quantization scale
 
 
 # ring wire factor per logical payload byte, by op, for axis group size n
@@ -96,6 +172,7 @@ class CostBreakdown:
     scan_carry_bytes: int = 0
     peak_state_bytes: int = 0
     hbm_bytes: int = 0
+    param_precision: str = ""  # "" = as traced; else the priced dtype
     # term 3: donation
     state_bytes_total: int = 0
     undonated_state_bytes: int = 0
@@ -134,8 +211,9 @@ class CostBreakdown:
             )
         lines.append(
             f"  peak state B/device  {self.peak_state_bytes:>14,}  "
-            f"(params {self.param_bytes_per_device:,} + opt "
-            f"{self.opt_bytes_per_device:,} + carry "
+            f"(params {self.param_bytes_per_device:,}"
+            + (f" @{self.param_precision}" if self.param_precision else "")
+            + f" + opt {self.opt_bytes_per_device:,} + carry "
             f"{self.scan_carry_bytes:,}; budget {self.hbm_bytes:,})"
         )
         if self.undonated_state_bytes:
@@ -151,11 +229,19 @@ class CostBreakdown:
         return "\n".join(lines)
 
 
-def sharded_leaf_bytes(avals, specs, mesh_axes) -> int:
+def sharded_leaf_bytes(avals, specs, mesh_axes, *,
+                       precision: str | None = None,
+                       quant_block: int = 64) -> int:
     """Per-device bytes of an abstract state tree under a spec tree: each
     leaf's bytes divided by the product of its spec's axis sizes (the
-    spec may be a pytree prefix, shard_map's broadcast rule)."""
+    spec may be a pytree prefix, shard_map's broadcast rule).
+
+    ``precision`` reprices FLOATING leaves as if stored in that dtype
+    (per-block scale overhead included) - the quantized-footprint view
+    of the same tree; integer leaves (token buffers, indices) keep
+    their traced bytes."""
     import jax
+    import jax.numpy as jnp
     from jax.sharding import PartitionSpec
 
     def is_spec(s):
@@ -174,10 +260,18 @@ def sharded_leaf_bytes(avals, specs, mesh_axes) -> int:
         for leaf in jax.tree_util.tree_leaves(group):
             if not hasattr(leaf, "shape"):
                 continue
-            nbytes = int(
-                np.prod(leaf.shape, dtype=np.int64)
-            ) * np.dtype(leaf.dtype).itemsize
-            total += -(-nbytes // shards)  # ceil: padding is real memory
+            n = int(np.prod(leaf.shape, dtype=np.int64))
+            if precision is not None and jnp.issubdtype(
+                leaf.dtype, jnp.floating
+            ):
+                # ceil-shard the ELEMENTS, then price at the target
+                # dtype (+ scale overhead): padding is real memory
+                total += quantized_bytes(
+                    -(-n // shards), precision, quant_block=quant_block
+                )
+            else:
+                nbytes = n * np.dtype(leaf.dtype).itemsize
+                total += -(-nbytes // shards)
     return total
 
 
@@ -248,7 +342,9 @@ def score_program(program, facts, weights: CostWeights | None = None,
     w = weights or CostWeights()
     mesh_axes = {str(k): int(v) for k, v in program.mesh.shape.items()}
     bd = CostBreakdown(
-        plan=plan or program.name, mesh=mesh_axes, hbm_bytes=int(w.hbm_bytes)
+        plan=plan or program.name, mesh=mesh_axes,
+        hbm_bytes=int(w.hbm_bytes),
+        param_precision=w.param_precision or "",
     )
 
     # --- term 1: collectives -------------------------------------------
@@ -274,7 +370,8 @@ def score_program(program, facts, weights: CostWeights | None = None,
     specs = program.specs or {}
     if args and "params" in specs:
         bd.param_bytes_per_device = sharded_leaf_bytes(
-            args[0], specs["params"], mesh_axes
+            args[0], specs["params"], mesh_axes,
+            precision=w.param_precision, quant_block=w.quant_block,
         )
     if len(args) > 1 and "opt" in specs:
         bd.opt_bytes_per_device = sharded_leaf_bytes(

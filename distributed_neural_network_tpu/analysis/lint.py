@@ -17,6 +17,12 @@ hardware to surface (docs/STATIC_ANALYSIS.md):
 - **precision lint** - no f64 anywhere on the step (an accidental Python
   float promotion upcasts a whole tree); float upcasts (bf16->f32 etc.)
   are not errors but are pinned in the manifest, so growth fails --check.
+  Quantized dtypes (int8 / fp8) are legal ONLY where the program declares
+  them (``meta["quant"]``), and a declared-quantized step whose trace
+  shows none is equally an error (the quantized path silently fell
+  back). The fp8->f32 accumulate upcast of a quantized matmul is pinned
+  in the manifest like every other upcast, so a silently-dropped wide
+  accumulation fails ``--check``.
 """
 
 from __future__ import annotations
@@ -43,6 +49,7 @@ def lint_program(program, facts) -> list:
     findings += donation_audit(program, facts)
     findings += replication_leak_lint(program, facts)
     findings += precision_lint(program, facts)
+    findings += quantized_dtype_lint(program, facts)
     return sorted(findings, key=lambda f: (f.severity != "error", f.code))
 
 
@@ -213,6 +220,43 @@ def replication_leak_lint(program, facts) -> list:
 
 
 # --------------------------------------------------------- precision lint
+
+
+def quantized_dtype_lint(program, facts) -> list:
+    """int8/fp8 values are legal only in DECLARED quantized programs
+    (``meta["quant"]`` - lm_step_program sets it from
+    ``TransformerConfig.attn_quant``), and a declared program must
+    actually show them: both directions of drift - an accidental
+    low-precision cast sneaking into a full-precision step, and a
+    quantized config whose fast path silently fell back to bf16 -
+    fail statically."""
+    declared = (program.meta or {}).get("quant")
+    seen = getattr(facts, "quant_dtypes", None) or {}
+    if seen and not declared:
+        kinds = ", ".join(
+            f"{k} x{v}" for k, v in sorted(seen.items())
+        )
+        return [
+            Finding(
+                "error", "quant-undeclared",
+                f"{program.name}: quantized dtypes in the step ({kinds}) "
+                "but the program declares no quantization "
+                "(meta['quant']) - an accidental low-precision cast "
+                "loses mantissa silently; declare attn_quant or remove "
+                "the cast",
+            )
+        ]
+    if declared and not seen:
+        return [
+            Finding(
+                "error", "quant-missing",
+                f"{program.name}: declared quant={declared!r} but the "
+                "trace contains no int8/fp8 values - the quantized "
+                "path silently fell back to full precision (the fast "
+                "path is not running)",
+            )
+        ]
+    return []
 
 
 def precision_lint(program, facts) -> list:

@@ -63,6 +63,11 @@ def build_manifest(program, facts) -> dict:
         "upcasts": {
             k: dict(v) for k, v in sorted(facts.upcasts.items())
         },
+        "quant_dtypes": {
+            k: int(v) for k, v in sorted(
+                (getattr(facts, "quant_dtypes", None) or {}).items()
+            )
+        },
         "donation": {
             "argnums": list(program.donate),
             "n_donated": int(sum(donated)) if donated is not None else None,
@@ -148,6 +153,18 @@ def diff_manifests(expected: dict, actual: dict) -> list:
         msgs.append(
             f"dtype upcasts changed: manifest {expected.get('upcasts')} vs "
             f"traced {actual.get('upcasts')}"
+        )
+    # quantized-dtype pins (int8/fp8 value counts): a quantized config
+    # whose fast path falls back - or a full-precision config that grows
+    # a low-precision cast - diffs here (legacy manifests lack the key:
+    # missing compares as empty, so unquantized configs need no rewrite)
+    eq = expected.get("quant_dtypes") or {}
+    aq = actual.get("quant_dtypes") or {}
+    if eq != aq:
+        msgs.append(
+            f"quantized dtypes changed: manifest {eq or '{}'} vs traced "
+            f"{aq or '{}'} - the low-precision contract moved (lint "
+            "codes quant-undeclared / quant-missing)"
         )
     eb = expected.get("total_collective_bytes")
     ab = actual.get("total_collective_bytes")

@@ -74,6 +74,7 @@ class TraceFacts:
     collectives: list = field(default_factory=list)  # CollectiveSite, merged
     sites: list = field(default_factory=list)  # CollectiveSite, per call path
     upcasts: dict = field(default_factory=dict)  # "bf16->f32" -> {count, bytes}
+    quant_dtypes: dict = field(default_factory=dict)  # "int8"/"fp8" -> count
     f64_sites: int = 0
     scan_carry_max_bytes: int = 0
     reduce_scatter_carry_bytes: int | None = None  # ZeRO in-scan accumulator
@@ -150,6 +151,24 @@ def _is_float(dt) -> bool:
     return jnp.issubdtype(dt, jnp.floating)
 
 
+def _quant_dtype_name(dt) -> str | None:
+    """Canonical low-precision family of a value dtype, or None.
+
+    ``int8`` and the fp8 formats are the quantized-matmul storage
+    dtypes (ops/quant.py); their presence in a trace marks a quantized
+    step, which the precision lint requires to be DECLARED
+    (meta["quant"]). uint8 is deliberately not counted - byte-valued
+    DATA (token streams, image bytes) is not quantized compute."""
+    if dt is None:
+        return None
+    name = dt.name
+    if name == "int8":
+        return "int8"
+    if name.startswith("float8"):
+        return "fp8"
+    return None
+
+
 def collect_trace(closed_jaxpr) -> TraceFacts:
     """Walk a ClosedJaxpr (e.g. ``jax.make_jaxpr(step)(*abstract_args)``)
     and collect `TraceFacts`. Purely structural - nothing executes."""
@@ -204,6 +223,11 @@ def collect_trace(closed_jaxpr) -> TraceFacts:
                 dt = _np_dtype(getattr(aval, "dtype", None))
                 if dt is not None and dt == np.float64:
                     facts.f64_sites += mult
+                qname = _quant_dtype_name(dt)
+                if qname is not None:
+                    facts.quant_dtypes[qname] = (
+                        facts.quant_dtypes.get(qname, 0) + mult
+                    )
 
             if name == "scan":
                 body = eqn.params["jaxpr"].jaxpr

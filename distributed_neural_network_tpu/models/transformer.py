@@ -97,6 +97,14 @@ class TransformerConfig:
     # real-scale default) or "dense" ((T, E, C) one-hot einsums, the
     # small-shape oracle) - identical numerics (parallel/moe.py)
     moe_dispatch: str = "sort"
+    # low-precision attention forward ("" = off): "int8" / "fp8" run the
+    # QK^T and PV matmuls in the quantized dtype with per-token scales
+    # and wide accumulation (ops/quant.py; the Pallas quant kernel under
+    # attn_impl='flash' on TPU, the XLA reference elsewhere). Training
+    # backward stays full precision (straight-through); the bench parity
+    # gate bounds the loss/logit effect (docs/MEASUREMENT.md). Local
+    # attention only - a sequence axis (ring/ulysses/zigzag) rejects it.
+    attn_quant: str = ""
     # router z-loss weight RELATIVE to the load-balance aux: the training
     # loss adds aux_weight * (switch_aux + moe_z_weight * mean(lse^2)), so
     # the default 0.1 with lm_loss's aux_weight=0.01 gives the standard
@@ -239,13 +247,24 @@ def _sinusoid_pe(pos, d_model, dtype):
     return jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=-1).astype(dtype)
 
 
-def _attend(q, k, v, *, impl, seq_axis, s_local):
+def _attend(q, k, v, *, impl, seq_axis, s_local, quant: str = ""):
     if seq_axis is None:
         if impl == "flash":
             from ..ops.flash import flash_local_attention
 
-            return flash_local_attention(q, k, v, causal=True)
+            return flash_local_attention(q, k, v, causal=True,
+                                         quant=quant or None)
+        if quant:
+            from ..ops.quant import quantized_attention
+
+            return quantized_attention(q, k, v, causal=True, fmt=quant)
         return attention(q, k, v, causal=True)
+    if quant:
+        raise ValueError(
+            f"attn_quant={quant!r} is the local quantized path; a "
+            "sequence axis (ring/ulysses/zigzag) has no quantized "
+            "attention - drop the seq axis or attn_quant"
+        )
     if impl == "flash":
         raise ValueError(
             "attn impl 'flash' is the local kernel (no sequence axis); use "
@@ -344,7 +363,8 @@ def apply_hidden(
 
     def attend(q, k, v):
         return _attend(
-            q, k, v, impl=attn_impl, seq_axis=seq_axis, s_local=s_local
+            q, k, v, impl=attn_impl, seq_axis=seq_axis, s_local=s_local,
+            quant=cfg.attn_quant,
         )
 
     if cfg.remat_attn and not cfg.remat:

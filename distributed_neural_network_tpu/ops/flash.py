@@ -153,12 +153,39 @@ def _lib_flash(q, k, v, *, causal: bool):
 
 
 def flash_local_attention(q, k, v, *, causal: bool = True,
-                          impl: str | None = None):
+                          impl: str | None = None,
+                          quant: str | None = None):
     """q/k/v (B, S, H, D) -> (B, S, H, D); Pallas flash on TPU, plain
     attention elsewhere. Numerics match `attention` to blockwise-softmax
     reassociation tolerance. `impl`: "own" (default; shard_map-composable)
     or "lib" (library kernel, A/B baseline), overridable via
-    DNN_TPU_FLASH_IMPL."""
+    DNN_TPU_FLASH_IMPL.
+
+    ``quant`` ("int8" | "fp8") selects the low-precision forward
+    (`TransformerConfig.attn_quant` / ``--precision``): on TPU the own
+    kernel's quantized path (`ops/flash_pallas.py`); off-TPU the XLA
+    reference `ops/quant.py quantized_attention` - REAL int8/fp8 dots
+    either way, so CPU CI exercises the same quantized numerics the
+    chip runs. The library kernel has no quantized path (one more
+    reason the kernels are owned - module docstring)."""
+    if quant is not None:
+        from .quant import QUANT_FORMATS, quantized_attention
+
+        if quant not in QUANT_FORMATS:
+            raise ValueError(
+                f"unknown quant format {quant!r}; supported: "
+                f"{', '.join(QUANT_FORMATS)}"
+            )
+        if (impl or os.environ.get("DNN_TPU_FLASH_IMPL", "own")) == "lib":
+            raise ValueError(
+                "the library flash kernel has no quantized path; use "
+                "impl='own' (default) for attn quantization"
+            )
+        if not _on_tpu():
+            return quantized_attention(q, k, v, causal=causal, fmt=quant)
+        return flash_mha(q, k, v, causal=causal,
+                         blocks=tuned_blocks(q.shape[1], q.shape[-1]),
+                         quant=quant)
     if not _on_tpu():
         return attention(q, k, v, causal=causal)
     impl = impl or os.environ.get("DNN_TPU_FLASH_IMPL", "own")

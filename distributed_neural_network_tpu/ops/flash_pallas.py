@@ -78,9 +78,17 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..parallel.collectives import vma_union
+from .quant import QUANT_FORMATS, quantize
+
+# jax renamed TPUCompilerParams -> CompilerParams across generations;
+# alias so the kernels build (and the CPU interpret tests run) on both
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams"
+)
 
 _NEG_BIG = -1e30  # large-negative mask; avoids -inf NaN propagation
 _LANES = 128  # TPU lane width: per-row residuals are lane-replicated
+_QEPS = 1e-30  # scale floor for the in-kernel p quantization
 
 # (m,k)x(n,k)->(m,n), (m,k)x(k,n)->(m,n), (k,m)x(k,n)->(m,n)
 _NT = (((1,), (1,)), ((), ()))
@@ -230,13 +238,173 @@ def _fwd_call(q, k, v, *, blocks, scale, causal, interpret):
             pltpu.VMEM((bq, _LANES), jnp.float32),  # running denom l
             pltpu.VMEM((bq, d), jnp.float32),       # output accumulator
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
     )(q, k, v)
     # keep only lane 0 as the residual: between fwd and bwd the saved lse
     # is (bh, s), not 128x that (the broadcast back happens in _bwd_call)
+    return o, lse[..., 0]
+
+
+# ----------------------------------------------------- quantized forward
+#
+# The fp8/int8 fast path (ROADMAP item 3): q/k/v enter the kernel in the
+# quantized storage dtype with per-row (per-token) f32 scales riding the
+# same lane-replicated (bq, 128) layout as lse, so both MXU dots run in
+# low precision:
+#
+# - QK^T: q-hat @ k-hat-T accumulated wide (int8 -> int32, fp8 -> f32 via
+#   preferred_element_type - THE accumulate upcast the shardlint
+#   precision lint pins), dequantized by the rank-1 outer product of the
+#   row scales BEFORE the softmax max-subtraction, so the online-softmax
+#   recurrence (m/l/acc in f32 scratch) is unchanged and per-block scale
+#   differences flow through the alpha rescale exactly like score
+#   magnitude differences always did.
+# - PV: v's per-row scale cannot be factored out of the contraction
+#   (sum_j p_ij sv_j v-hat_jd), so it is FOLDED INTO P; the folded p is
+#   then quantized per query row with a dynamic in-kernel scale and the
+#   second dot runs low-precision too, its contribution dequantized by
+#   that one scalar per row.
+#
+# Backward stays the bf16 kernel pair on the ORIGINAL q/k/v residuals
+# (straight-through): training gets full-precision gradients at the
+# quantized forward's lse, and the end effect on loss/logits is bounded
+# by the bench parity gate (train/measure.py measure_quant_parity), not
+# assumed. On hardware, int8/fp8 blocks tile at (32, 128) - the resolved
+# block sizes (multiples of 128 at real sequence lengths) satisfy it;
+# interpret mode (CPU tests) has no tiling constraint.
+
+
+def _fwd_quant_kernel(q_ref, k_ref, v_ref, sq_ref, sk_ref, sv_ref,
+                      o_ref, lse_ref, m_sc, l_sc, acc_sc,
+                      *, bq, bk, scale, causal, fmt):
+    qi, kj = pl.program_id(1), pl.program_id(2)
+    n_k = pl.num_programs(2)
+    qmax = QUANT_FORMATS[fmt][1]
+
+    @pl.when(kj == 0)
+    def _init():
+        m_sc[...] = jnp.full(m_sc.shape, _NEG_BIG, m_sc.dtype)
+        l_sc[...] = jnp.zeros(l_sc.shape, l_sc.dtype)
+        acc_sc[...] = jnp.zeros(acc_sc.shape, acc_sc.dtype)
+
+    def _step():
+        q = q_ref[0]  # (bq, D) storage dtype (int8 / fp8)
+        k = k_ref[0]  # (bk, D)
+        if fmt == "int8":
+            s_acc = jax.lax.dot_general(
+                q, k, _NT, preferred_element_type=jnp.int32
+            ).astype(jnp.float32)
+        else:
+            s_acc = jax.lax.dot_general(
+                q, k, _NT, preferred_element_type=jnp.float32
+            )
+        sq = sq_ref[0][:, :1]                 # (bq, 1) f32 row scales
+        sk = sk_ref[0][:, :1].reshape(1, bk)  # (1, bk)
+        s = s_acc * sq * sk * scale
+        if causal:
+            s = _causal_mask(s, qi, bq, kj, bk)
+        m = m_sc[...][:, :1]
+        m_new = jnp.maximum(m, s.max(-1, keepdims=True))
+        p = jnp.exp(s - m_new)  # f32, feeds the l recurrence unchanged
+        alpha = jnp.exp(m - m_new)
+        l_new = l_sc[...][:, :1] * alpha + p.sum(-1, keepdims=True)
+        m_sc[...] = jnp.broadcast_to(m_new, m_sc.shape)
+        l_sc[...] = jnp.broadcast_to(l_new, l_sc.shape)
+        # fold v's per-row scale into p, quantize the folded p per query
+        # row, run the PV dot in low precision, dequantize by the row
+        # scalar - the per-block scales ride the same alpha rescale the
+        # f32 acc always used
+        sv = sv_ref[0][:, :1].reshape(1, bk)
+        p_f = p * sv
+        sp = jnp.maximum(
+            jnp.max(jnp.abs(p_f), axis=-1, keepdims=True), _QEPS
+        ) / qmax
+        p_q = p_f / sp
+        if fmt == "int8":
+            p_q = jnp.round(p_q)
+            pv = jax.lax.dot_general(
+                p_q.astype(jnp.int8), v_ref[0], _NN,
+                preferred_element_type=jnp.int32,
+            ).astype(jnp.float32)
+        else:
+            pv = jax.lax.dot_general(
+                p_q.astype(v_ref.dtype), v_ref[0], _NN,
+                preferred_element_type=jnp.float32,
+            )
+        acc_sc[...] = acc_sc[...] * alpha + pv * sp
+
+    if causal:
+        pl.when(_on_diag_or_below(qi, bq, kj, bk))(_step)
+    else:
+        _step()
+
+    @pl.when(kj == n_k - 1)
+    def _finish():
+        l = jnp.maximum(l_sc[...][:, :1], 1e-30)
+        o_ref[0] = (acc_sc[...] / l).astype(o_ref.dtype)
+        lse_ref[0] = jnp.broadcast_to(
+            m_sc[...][:, :1] + jnp.log(l), lse_ref.shape[1:]
+        )
+
+
+def _fwd_quant_call(q, k, v, *, blocks, scale, causal, interpret, fmt):
+    bh, s, d = q.shape
+    bq, bk = blocks.bq, blocks.bk
+    # per-row symmetric quantization in XLA (one fused pass per operand);
+    # scales enter lane-replicated like every per-row residual here
+    q_q, sq = quantize(q, fmt)
+    k_q, sk = quantize(k, fmt)
+    v_q, sv = quantize(v, fmt)
+    sq_l = jnp.broadcast_to(sq[..., None], (bh, s, _LANES))
+    sk_l = jnp.broadcast_to(sk[..., None], (bh, s, _LANES))
+    sv_l = jnp.broadcast_to(sv[..., None], (bh, s, _LANES))
+    kernel = functools.partial(
+        _fwd_quant_kernel, bq=bq, bk=bk, scale=scale, causal=causal,
+        fmt=fmt,
+    )
+
+    def k_index(b, i, j):
+        if causal:
+            j = jnp.minimum(j, ((i + 1) * bq - 1) // bk)
+        return (b, j, 0)
+
+    q_index = lambda b, i, j: (b, i, 0)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, s // bq, s // bk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), q_index, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), k_index, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), k_index, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, _LANES), q_index,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, _LANES), k_index,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, _LANES), k_index,
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), q_index, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, _LANES), q_index,
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            _struct((bh, s, d), q.dtype, q, k, v),
+            _struct((bh, s, _LANES), jnp.float32, q, k, v),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q_q, k_q, v_q, sq_l, sk_l, sv_l)
     return o, lse[..., 0]
 
 
@@ -322,7 +490,7 @@ def _bwd_call(q, k, v, o, lse, do, *, blocks, scale, causal, interpret):
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     delta_l = jnp.broadcast_to(delta[..., None], (bh, s, _LANES))
     lse_l = jnp.broadcast_to(lse[..., None], (bh, s, _LANES))
-    arb = pltpu.CompilerParams(
+    arb = _CompilerParams(
         dimension_semantics=("parallel", "parallel", "arbitrary"),
     )
 
@@ -406,20 +574,31 @@ def _bwd_call(q, k, v, o, lse, do, *, blocks, scale, causal, interpret):
 # ----------------------------------------------------- custom_vjp wiring
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, scale, blocks, interpret):
-    o, _ = _fwd_call(q, k, v, blocks=blocks, scale=scale, causal=causal,
+def _any_fwd_call(q, k, v, *, blocks, scale, causal, interpret, quant):
+    if quant:
+        return _fwd_quant_call(q, k, v, blocks=blocks, scale=scale,
+                               causal=causal, interpret=interpret,
+                               fmt=quant)
+    return _fwd_call(q, k, v, blocks=blocks, scale=scale, causal=causal,
                      interpret=interpret)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, blocks, interpret, quant):
+    o, _ = _any_fwd_call(q, k, v, blocks=blocks, scale=scale,
+                         causal=causal, interpret=interpret, quant=quant)
     return o
 
 
-def _flash_fwd(q, k, v, causal, scale, blocks, interpret):
-    o, lse = _fwd_call(q, k, v, blocks=blocks, scale=scale, causal=causal,
-                       interpret=interpret)
+def _flash_fwd(q, k, v, causal, scale, blocks, interpret, quant):
+    o, lse = _any_fwd_call(q, k, v, blocks=blocks, scale=scale,
+                           causal=causal, interpret=interpret, quant=quant)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd(causal, scale, blocks, interpret, res, g):
+def _flash_bwd(causal, scale, blocks, interpret, quant, res, g):
+    # quantized forwards backprop through the bf16 kernels on the
+    # ORIGINAL residuals (straight-through; see the quant section note)
     q, k, v, o, lse = res
     return _bwd_call(q, k, v, o, lse, g, blocks=blocks, scale=scale,
                      causal=causal, interpret=interpret)
@@ -429,7 +608,8 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_mha(q, k, v, *, causal: bool = True, scale=None,
-              blocks: FlashBlocks | None = None, interpret: bool = False):
+              blocks: FlashBlocks | None = None, interpret: bool = False,
+              quant: str | None = None):
     """Flash attention, (B, S, H, D) -> (B, S, H, D), trainable.
 
     Blockwise-softmax exact attention (up to reassociation): the (S, S)
@@ -439,11 +619,23 @@ def flash_mha(q, k, v, *, causal: bool = True, scale=None,
     and head axes are sharded; under a sequence axis use
     `parallel/ring.py`). `interpret=True` runs the Pallas interpreter
     (CPU tests); compiled Mosaic otherwise.
+
+    ``quant`` ("int8" | "fp8") switches the forward to the quantized
+    kernel: per-row symmetric scales, both MXU dots in the storage
+    dtype with wide accumulation, backward unchanged on the bf16
+    residuals. Numerics vs the bf16 kernel are bounded by the
+    `ops/quant.py` round-trip error (tested; gated end-to-end by the
+    bench parity row).
     """
+    if quant is not None and quant not in QUANT_FORMATS:
+        raise ValueError(
+            f"unknown quant format {quant!r}; supported: "
+            f"{', '.join(QUANT_FORMATS)} (or None for bf16/f32)"
+        )
     b, s, h, d = q.shape
     blocks = (blocks or FlashBlocks()).resolve(s)
     scale = (1.0 / math.sqrt(d)) if scale is None else float(scale)
     qf, kf, vf = (x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
                   for x in (q, k, v))
-    o = _flash(qf, kf, vf, causal, scale, blocks, interpret)
+    o = _flash(qf, kf, vf, causal, scale, blocks, interpret, quant)
     return o.reshape(b, h, s, d).transpose(0, 2, 1, 3)

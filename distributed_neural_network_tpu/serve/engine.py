@@ -52,7 +52,11 @@ from ..models.transformer import (
     _layer_norm,
     _sinusoid_pe,
 )
+from ..ops.decode_pallas import decode_cache_attention, decode_kernel_ok
 from .kv_cache import KVCacheConfig, OutOfBlocks, PagedKVCache
+
+_INT8_MAX = 127.0
+_SCALE_EPS = 1e-30
 
 
 @dataclass(frozen=True)
@@ -66,6 +70,32 @@ class EngineConfig:
     prefill_chunk: int = 1      # 1 = exact token-at-a-time prefill
     prefill_token_budget: int = 0   # 0 = one chunk call per tick
     eos_token: int | None = None    # retire on this token id
+    # "bf16" = pool in the model dtype; "int8" = quantized pool with
+    # per-(block, head) f32 scales - ~2x the concurrent-sequence
+    # capacity per HBM byte (the exact multiplier:
+    # analysis/cost.py kv_block_bytes), quantize-on-append +
+    # dequantize-in-step, accuracy gated vs the bf16 oracle
+    # (docs/SERVING.md "int8 KV cache")
+    kv_dtype: str = "bf16"
+    # per-step attention under the paged gather: "xla" = the einsum/
+    # softmax/einsum chain (PR 12 path), "pallas" = the tuned decode
+    # kernel (ops/decode_pallas.py) reading the gathered bucket with
+    # per-slot positions (int8 pools stream quantized with fused
+    # dequant), "auto" = pallas on TPU when the bucket's width admits a
+    # sublane-legal block, xla otherwise (off-TPU the kernel only runs
+    # interpreted - a test vehicle, not a fast path)
+    decode_impl: str = "auto"
+
+    def __post_init__(self):
+        if self.kv_dtype not in ("bf16", "int8"):
+            raise ValueError(
+                f"kv_dtype must be 'bf16' or 'int8', got {self.kv_dtype!r}"
+            )
+        if self.decode_impl not in ("auto", "xla", "pallas"):
+            raise ValueError(
+                f"decode_impl must be auto/xla/pallas, got "
+                f"{self.decode_impl!r}"
+            )
 
     def kv(self) -> KVCacheConfig:
         return KVCacheConfig(
@@ -140,8 +170,20 @@ class ServeEngine:
         dt = cfg.dtype
         L, H, Dh = cfg.n_layers, cfg.n_heads, cfg.head_dim
         slots = self.kv.cfg.pool_slots
-        self.k_pool = jnp.zeros((L, slots, H, Dh), dt)
-        self.v_pool = jnp.zeros((L, slots, H, Dh), dt)
+        self.quantized = ecfg.kv_dtype == "int8"
+        if self.quantized:
+            # int8 pool + per-(block, head) f32 scales: the one extra
+            # small array rides the SAME block-table addressing (scale
+            # of slot s = scales[table[s // bs]]), so every gather/
+            # scatter index the bf16 path computes is reused verbatim
+            self.k_pool = jnp.zeros((L, slots, H, Dh), jnp.int8)
+            self.v_pool = jnp.zeros((L, slots, H, Dh), jnp.int8)
+            self.k_scale = jnp.zeros((L, ecfg.num_blocks, H), jnp.float32)
+            self.v_scale = jnp.zeros((L, ecfg.num_blocks, H), jnp.float32)
+        else:
+            self.k_pool = jnp.zeros((L, slots, H, Dh), dt)
+            self.v_pool = jnp.zeros((L, slots, H, Dh), dt)
+            self.k_scale = self.v_scale = None
         self.lock = threading.Lock()
         self.active: list[Sequence] = []
         self._step_fns: dict = {}
@@ -181,7 +223,7 @@ class ServeEngine:
             for i, s in enumerate(self.active):
                 if s.seq_id == seq_id:
                     self.active.pop(i)
-                    self.kv.free(seq_id)
+                    self._free_seq(seq_id)
                     s.finished = True
                     return True
         return False
@@ -189,6 +231,75 @@ class ServeEngine:
     def has_work(self) -> bool:
         with self.lock:
             return bool(self.active)
+
+    # ------------------------------------------------- bytes + kv dtype
+
+    def kv_dtype_name(self) -> str:
+        """The /metrics ``serve_kv_dtype`` label value."""
+        if self.quantized:
+            return "int8"
+        return "bf16" if self.cfg.dtype == jnp.bfloat16 else "f32"
+
+    def kv_block_bytes(self) -> int:
+        """Device bytes of one paged block at this engine's kv dtype
+        (K + V + any per-(block, head) scales) - analysis/cost.py's
+        table, so the serving occupancy gauges and the autoshard HBM
+        gate can never disagree on a byte."""
+        from ..analysis.cost import kv_block_bytes
+
+        cfg = self.cfg
+        dtype = self.kv_dtype_name()
+        return kv_block_bytes(
+            cfg.n_layers, cfg.n_heads, cfg.head_dim,
+            self.ecfg.block_size, "f32" if dtype == "f32" else dtype,
+        )
+
+    def _free_seq(self, seq_id: int) -> int:
+        """Free a sequence's blocks; under int8 KV also zero the freed
+        blocks' scales - a reused block must start from scale 0 or the
+        previous owner's scale would leak into the new sequence's
+        quantization (breaking both accuracy and the deterministic
+        preemption replay)."""
+        if not self.quantized:
+            return self.kv.free(seq_id)
+        blocks = self.kv.seq_block_ids(seq_id)
+        n = self.kv.free(seq_id)
+        if blocks:
+            idx = jnp.asarray(blocks, jnp.int32)
+            self.k_scale = self.k_scale.at[:, idx, :].set(0.0)
+            self.v_scale = self.v_scale.at[:, idx, :].set(0.0)
+        return n
+
+    def _attn_route(self, W: int) -> str:
+        """Per-bucket attention impl under the paged gather: the tuned
+        decode kernel when routable, the XLA chain otherwise. The
+        kernel needs the bucket's gathered length W * block_size to
+        admit a sublane-legal k block (16-multiples for bf16, 32 for
+        int8 - ops/decode_pallas.py decode_kernel_ok)."""
+        impl = self.ecfg.decode_impl
+        if impl == "xla":
+            return "xla"
+        legal = decode_kernel_ok(
+            W * self.ecfg.block_size, quantized=self.quantized
+        )
+        if impl == "pallas":
+            if not legal:
+                raise ValueError(
+                    f"decode_impl 'pallas' requested but bucket width "
+                    f"{W} x block_size {self.ecfg.block_size} admits no "
+                    f"sublane-legal k block for "
+                    f"{'int8' if self.quantized else 'bf16'} - use a "
+                    "block_size multiple of "
+                    f"{32 if self.quantized else 16} or decode_impl "
+                    "'auto'"
+                )
+            return "pallas"
+        # auto: the kernel only pays on TPU (off-TPU it would run the
+        # Pallas interpreter - a test vehicle, not a fast path)
+        return (
+            "pallas"
+            if legal and jax.default_backend() == "tpu" else "xla"
+        )
 
     # ------------------------------------------------------ jitted steps
 
@@ -202,37 +313,125 @@ class ServeEngine:
         bs = kv.block_size
         S = W * bs
         neg = jnp.asarray(-1e30, jnp.float32)
+        quantized = self.quantized
+        attn_route = self._attn_route(W)
+        interpret = jax.default_backend() != "tpu"
 
-        def step(params, k_pool, v_pool, tok, pos, table, temps, keys):
-            # tok/pos (B,), table (B, W), temps (B,), keys (B, 2)
+        def xla_attend(q, ks, vs, live):
+            # the PR 12 chain, byte-identical for the bf16 pool
+            scores = jnp.einsum(
+                "bqhd,bhsd->bhqs", q, ks
+            ).astype(jnp.float32)
+            scores = scores / np.sqrt(Dh)
+            probs = jax.nn.softmax(
+                jnp.where(live, scores, neg), axis=-1
+            )
+            return jnp.einsum(
+                "bhqs,bhsd->bqhd", probs.astype(dt), vs
+            ).reshape(B, 1, H * Dh)
+
+        def step(params, k_pool, v_pool, k_scale, v_scale,
+                 tok, pos, table, temps, keys):
+            # tok/pos (B,), table (B, W), temps (B,), keys (B, 2);
+            # k_scale/v_scale (L, num_blocks, H) f32 (None-shaped dummies
+            # never reach here: the bf16 wrapper below drops them)
             x = params["embed"][tok].astype(dt)[:, None, :]
             x = x + _sinusoid_pe(pos, cfg.d_model, dt)[:, None, :]
-            flat = table[jnp.arange(B), pos // bs] * bs + pos % bs
+            blk = table[jnp.arange(B), pos // bs]
+            flat = blk * bs + pos % bs
             gather_idx = (
                 (table * bs)[:, :, None] + jnp.arange(bs)[None, None, :]
             ).reshape(B, S)
             live = (jnp.arange(S)[None, :] <= pos[:, None])[:, None, None, :]
+            rows = blk[:, None] * bs + jnp.arange(bs)[None, :]  # (B, bs)
+
+            def append_q8(pool, scales, val):
+                # quantize-on-append with a per-(block, head) running
+                # scale: a token whose amax outgrows the block's scale
+                # RE-QUANTIZES the block's existing slab under the new
+                # scale (one (B, bs) gather/scatter - the block is
+                # already hot), so every stored code is always ``value /
+                # scales[block]``. Scale growth is monotone per block
+                # and both it and the re-rounding depend only on this
+                # sequence's own writes - preemption replay is bitwise
+                # (tested).
+                a = jnp.max(jnp.abs(val.astype(jnp.float32)), -1)  # (B,H)
+                s_old = scales[blk]                                # (B,H)
+                s_new = jnp.maximum(s_old, a / _INT8_MAX)
+                ratio = jnp.where(
+                    s_new > 0.0,
+                    s_old / jnp.maximum(s_new, _SCALE_EPS), 1.0
+                )
+                slab = pool[rows].astype(jnp.float32)   # (B, bs, H, Dh)
+                slab = jnp.clip(
+                    jnp.round(slab * ratio[:, None, :, None]),
+                    -_INT8_MAX, _INT8_MAX,
+                ).astype(jnp.int8)
+                pool = pool.at[rows].set(slab)
+                q8 = jnp.clip(
+                    jnp.round(
+                        val.astype(jnp.float32)
+                        / jnp.maximum(s_new[..., None], _SCALE_EPS)
+                    ),
+                    -_INT8_MAX, _INT8_MAX,
+                ).astype(jnp.int8)
+                pool = pool.at[flat].set(q8)
+                scales = scales.at[blk].set(s_new)
+                return pool, scales
 
             def layer_step(x, lcaches):
-                lp, ck, cv = lcaches
+                if quantized:
+                    lp, ck, cv, ksc, vsc = lcaches
+                else:
+                    lp, ck, cv = lcaches
+                    ksc = vsc = None
                 h = _layer_norm(x, lp["ln1_scale"], lp["ln1_bias"]).astype(dt)
                 q = (h @ lp["wq"].astype(dt)).reshape(B, 1, H, Dh)
                 k = (h @ lp["wk"].astype(dt)).reshape(B, H, Dh)
                 v = (h @ lp["wv"].astype(dt)).reshape(B, H, Dh)
-                ck = ck.at[flat].set(k)
-                cv = cv.at[flat].set(v)
-                ks = ck[gather_idx].transpose(0, 2, 1, 3)  # (B, H, S, Dh)
-                vs = cv[gather_idx].transpose(0, 2, 1, 3)
-                scores = jnp.einsum(
-                    "bqhd,bhsd->bhqs", q, ks
-                ).astype(jnp.float32)
-                scores = scores / np.sqrt(Dh)
-                probs = jax.nn.softmax(
-                    jnp.where(live, scores, neg), axis=-1
-                )
-                o = jnp.einsum(
-                    "bhqs,bhsd->bqhd", probs.astype(dt), vs
-                ).reshape(B, 1, H * Dh)
+                if quantized:
+                    ck, ksc = append_q8(ck, ksc, k)
+                    cv, vsc = append_q8(cv, vsc, v)
+                    ks_q = ck[gather_idx]          # (B, S, H, Dh) int8
+                    vs_q = cv[gather_idx]
+                    # per-slot scale view: same block-table addressing,
+                    # one repeat per block (B, W, H) -> (B, S, H)
+                    k_slot = jnp.repeat(ksc[table], bs, axis=1)
+                    v_slot = jnp.repeat(vsc[table], bs, axis=1)
+                    if attn_route == "pallas":
+                        # the tuned decode kernel reads the int8 stream
+                        # directly - dequant fused in its k-block loop
+                        o = decode_cache_attention(
+                            q.reshape(B, H, Dh),
+                            ks_q.transpose(0, 2, 1, 3),
+                            vs_q.transpose(0, 2, 1, 3),
+                            pos,
+                            k_scale=k_slot.transpose(0, 2, 1),
+                            v_scale=v_slot.transpose(0, 2, 1),
+                            interpret=interpret,
+                        ).reshape(B, 1, H * Dh)
+                    else:
+                        ks = (
+                            ks_q.astype(jnp.float32) * k_slot[..., None]
+                        ).astype(dt).transpose(0, 2, 1, 3)
+                        vs = (
+                            vs_q.astype(jnp.float32) * v_slot[..., None]
+                        ).astype(dt).transpose(0, 2, 1, 3)
+                        o = xla_attend(q, ks, vs, live)
+                else:
+                    ck = ck.at[flat].set(k)
+                    cv = cv.at[flat].set(v)
+                    if attn_route == "pallas":
+                        o = decode_cache_attention(
+                            q.reshape(B, H, Dh),
+                            ck[gather_idx].transpose(0, 2, 1, 3),
+                            cv[gather_idx].transpose(0, 2, 1, 3),
+                            pos, interpret=interpret,
+                        ).reshape(B, 1, H * Dh)
+                    else:
+                        ks = ck[gather_idx].transpose(0, 2, 1, 3)
+                        vs = cv[gather_idx].transpose(0, 2, 1, 3)
+                        o = xla_attend(q, ks, vs, live)
                 x = x + o @ lp["wo"].astype(dt)
                 h2 = _layer_norm(
                     x, lp["ln2_scale"], lp["ln2_bias"]
@@ -241,12 +440,19 @@ class ServeEngine:
                     h2 @ lp["w1"].astype(dt) + lp["b1"].astype(dt)
                 )
                 x = x + h2 @ lp["w2"].astype(dt) + lp["b2"].astype(dt)
+                if quantized:
+                    return x, (ck, cv, ksc, vsc)
                 return x, (ck, cv)
 
-            x, (k_pool, v_pool) = jax.lax.scan(
-                layer_step, x, (params["layers"], k_pool, v_pool),
-                unroll=min(L, 8),
-            )
+            if quantized:
+                xs = (params["layers"], k_pool, v_pool, k_scale, v_scale)
+            else:
+                xs = (params["layers"], k_pool, v_pool)
+            x, out = jax.lax.scan(layer_step, x, xs, unroll=min(L, 8))
+            if quantized:
+                k_pool, v_pool, k_scale, v_scale = out
+            else:
+                k_pool, v_pool = out
             h = _layer_norm(
                 x, params["lnf_scale"], params["lnf_bias"]
             ).astype(dt)
@@ -260,9 +466,21 @@ class ServeEngine:
                 )
             )(keys, logits, temps)
             nxt = jnp.where(temps > 0.0, sampled, greedy).astype(jnp.int32)
-            return k_pool, v_pool, nxt, logits
+            return k_pool, v_pool, k_scale, v_scale, nxt, logits
 
-        fn = jax.jit(step)
+        if quantized:
+            fn = jax.jit(step)
+        else:
+            # bf16 keeps the PR 12 signature (no scale operands)
+            def step_bf16(params, k_pool, v_pool, tok, pos, table,
+                          temps, keys):
+                k_pool, v_pool, _, _, nxt, logits = step(
+                    params, k_pool, v_pool, None, None, tok, pos, table,
+                    temps, keys,
+                )
+                return k_pool, v_pool, nxt, logits
+
+            fn = jax.jit(step_bf16)
         self._step_fns[(B, W)] = fn
         return fn
 
@@ -276,8 +494,10 @@ class ServeEngine:
         bs = kv.block_size
         S = W * bs
         neg = jnp.asarray(-1e30, jnp.float32)
+        quantized = self.quantized
 
-        def prefill(params, k_pool, v_pool, toks, pos0, table, n_valid):
+        def prefill(params, k_pool, v_pool, k_scale, v_scale,
+                    toks, pos0, table, n_valid):
             # toks (C,), pos0 scalar, table (W,), n_valid scalar
             pv = pos0 + jnp.arange(C)
             valid = jnp.arange(C) < n_valid
@@ -285,6 +505,7 @@ class ServeEngine:
             x = x + _sinusoid_pe(pv, cfg.d_model, dt)[None]
             flat = table[pv // bs] * bs + pv % bs
             flat = jnp.where(valid, flat, 0)  # dead tail -> scratch
+            blkv = jnp.where(valid, table[pv // bs], 0)  # (C,) block ids
             gather_idx = (
                 (table * bs)[:, None] + jnp.arange(bs)[None, :]
             ).reshape(S)
@@ -293,16 +514,69 @@ class ServeEngine:
                 jnp.arange(S)[None, :] <= pv[:, None]
             )[None, None, :, :]  # (1, 1, C, S)
 
+            def append_q8(pool, scales, val):
+                # chunk form of the decode append: the chunk's per-block
+                # amax arrives by scatter-max (commutative ->
+                # deterministic under duplicate block ids), then the
+                # whole table span is re-quantized under the grown
+                # scales (it is being gathered for attention anyway)
+                # and the chunk written at its final scales
+                a = jnp.where(
+                    valid[:, None],
+                    jnp.max(jnp.abs(val.astype(jnp.float32)), -1),
+                    0.0,
+                )                                         # (C, H)
+                new_scales = scales.at[blkv].max(a / _INT8_MAX)
+                ratio = jnp.where(
+                    new_scales > 0.0,
+                    scales / jnp.maximum(new_scales, _SCALE_EPS), 1.0
+                )                                         # (nb, H)
+                ratio_slot = jnp.repeat(ratio[table], bs, axis=0)
+                slab = pool[gather_idx].astype(jnp.float32)  # (S, H, Dh)
+                slab = jnp.clip(
+                    jnp.round(slab * ratio_slot[..., None]),
+                    -_INT8_MAX, _INT8_MAX,
+                ).astype(jnp.int8)
+                pool = pool.at[gather_idx].set(slab)
+                s_tok = new_scales[blkv]                  # (C, H)
+                q8 = jnp.clip(
+                    jnp.round(
+                        val.astype(jnp.float32)
+                        / jnp.maximum(s_tok[..., None], _SCALE_EPS)
+                    ),
+                    -_INT8_MAX, _INT8_MAX,
+                ).astype(jnp.int8)
+                pool = pool.at[flat].set(q8)
+                return pool, new_scales
+
             def layer_step(x, lcaches):
-                lp, ck, cv = lcaches
+                if quantized:
+                    lp, ck, cv, ksc, vsc = lcaches
+                else:
+                    lp, ck, cv = lcaches
+                    ksc = vsc = None
                 h = _layer_norm(x, lp["ln1_scale"], lp["ln1_bias"]).astype(dt)
                 q = (h @ lp["wq"].astype(dt)).reshape(1, C, H, Dh)
                 k = (h @ lp["wk"].astype(dt)).reshape(C, H, Dh)
                 v = (h @ lp["wv"].astype(dt)).reshape(C, H, Dh)
-                ck = ck.at[flat].set(k)
-                cv = cv.at[flat].set(v)
-                ks = ck[gather_idx][None].transpose(0, 2, 1, 3)
-                vs = cv[gather_idx][None].transpose(0, 2, 1, 3)
+                if quantized:
+                    ck, ksc = append_q8(ck, ksc, k)
+                    cv, vsc = append_q8(cv, vsc, v)
+                    k_slot = jnp.repeat(ksc[table], bs, axis=0)  # (S, H)
+                    v_slot = jnp.repeat(vsc[table], bs, axis=0)
+                    ks = (
+                        ck[gather_idx].astype(jnp.float32)
+                        * k_slot[..., None]
+                    ).astype(dt)[None].transpose(0, 2, 1, 3)
+                    vs = (
+                        cv[gather_idx].astype(jnp.float32)
+                        * v_slot[..., None]
+                    ).astype(dt)[None].transpose(0, 2, 1, 3)
+                else:
+                    ck = ck.at[flat].set(k)
+                    cv = cv.at[flat].set(v)
+                    ks = ck[gather_idx][None].transpose(0, 2, 1, 3)
+                    vs = cv[gather_idx][None].transpose(0, 2, 1, 3)
                 scores = jnp.einsum(
                     "bqhd,bhsd->bhqs", q, ks
                 ).astype(jnp.float32)
@@ -321,19 +595,37 @@ class ServeEngine:
                     h2 @ lp["w1"].astype(dt) + lp["b1"].astype(dt)
                 )
                 x = x + h2 @ lp["w2"].astype(dt) + lp["b2"].astype(dt)
+                if quantized:
+                    return x, (ck, cv, ksc, vsc)
                 return x, (ck, cv)
 
-            x, (k_pool, v_pool) = jax.lax.scan(
-                layer_step, x, (params["layers"], k_pool, v_pool),
-                unroll=min(L, 8),
-            )
+            if quantized:
+                xs = (params["layers"], k_pool, v_pool, k_scale, v_scale)
+            else:
+                xs = (params["layers"], k_pool, v_pool)
+            x, out = jax.lax.scan(layer_step, x, xs, unroll=min(L, 8))
+            if quantized:
+                k_pool, v_pool, k_scale, v_scale = out
+            else:
+                k_pool, v_pool = out
             h = _layer_norm(
                 x, params["lnf_scale"], params["lnf_bias"]
             ).astype(dt)
             logits = (h[0] @ params["head"].astype(dt)).astype(jnp.float32)
-            return k_pool, v_pool, logits  # logits (C, vocab)
+            return k_pool, v_pool, k_scale, v_scale, logits  # (C, vocab)
 
-        fn = jax.jit(prefill)
+        if quantized:
+            fn = jax.jit(prefill)
+        else:
+            def prefill_bf16(params, k_pool, v_pool, toks, pos0, table,
+                             n_valid):
+                k_pool, v_pool, _, _, logits = prefill(
+                    params, k_pool, v_pool, None, None, toks, pos0,
+                    table, n_valid,
+                )
+                return k_pool, v_pool, logits
+
+            fn = jax.jit(prefill_bf16)
         self._prefill_fns[(C, W)] = fn
         return fn
 
@@ -361,13 +653,25 @@ class ServeEngine:
         for B in batches:
             for W in widths:
                 fn = self._decode_fn(B, W)
-                self.k_pool, self.v_pool, _, _ = fn(
+                args = (
                     self.params, self.k_pool, self.v_pool,
+                ) + ((self.k_scale, self.v_scale) if self.quantized
+                     else ()) + (
                     jnp.zeros((B,), jnp.int32), jnp.zeros((B,), jnp.int32),
                     jnp.zeros((B, W), jnp.int32),
                     jnp.zeros((B,), jnp.float32),
                     jnp.zeros((B, 2), jnp.uint32),
                 )
+                if self.quantized:
+                    (self.k_pool, self.v_pool, self.k_scale,
+                     self.v_scale, _, _) = fn(*args)
+                    # warmup writes land in the scratch block; its scale
+                    # is garbage by contract, but reset anyway so a
+                    # fresh engine stays bitwise clean
+                    self.k_scale = self.k_scale.at[:, 0, :].set(0.0)
+                    self.v_scale = self.v_scale.at[:, 0, :].set(0.0)
+                else:
+                    self.k_pool, self.v_pool, _, _ = fn(*args)
                 n += 1
         if self.ecfg.prefill_chunk > 1:
             chunks = []
@@ -380,11 +684,20 @@ class ServeEngine:
                     if C > W * bs:
                         continue
                     fn = self._prefill_fn(C, W)
-                    self.k_pool, self.v_pool, _ = fn(
+                    args = (
                         self.params, self.k_pool, self.v_pool,
+                    ) + ((self.k_scale, self.v_scale) if self.quantized
+                         else ()) + (
                         jnp.zeros((C,), jnp.int32), jnp.int32(0),
                         jnp.zeros((W,), jnp.int32), jnp.int32(0),
                     )
+                    if self.quantized:
+                        (self.k_pool, self.v_pool, self.k_scale,
+                         self.v_scale, _) = fn(*args)
+                        self.k_scale = self.k_scale.at[:, 0, :].set(0.0)
+                        self.v_scale = self.v_scale.at[:, 0, :].set(0.0)
+                    else:
+                        self.k_pool, self.v_pool, _ = fn(*args)
                     n += 1
         return n
 
@@ -416,7 +729,7 @@ class ServeEngine:
             with self.lock:
                 self.active = [s for s in self.active if not s.finished]
             for s in done:
-                self.kv.free(s.seq_id)
+                self._free_seq(s.seq_id)
         return done
 
     def _preempt_youngest(self, parked: list) -> None:
@@ -429,7 +742,7 @@ class ServeEngine:
             self.active = [
                 s for s in self.active if s.seq_id != victim.seq_id
             ]
-        self.kv.free(victim.seq_id)
+        self._free_seq(victim.seq_id)
         victim.pos = 0
         victim.preemptions += 1
         self.preempted.append(victim)
@@ -478,11 +791,20 @@ class ServeEngine:
                 toks[:n] = seq.prompt[seq.pos: seq.pos + n]
                 table = self.kv.table([seq.seq_id], W)[0]
                 fn = self._prefill_fn(C, W)
-                self.k_pool, self.v_pool, _ = fn(
-                    self.params, self.k_pool, self.v_pool,
+                tail = (
                     jnp.asarray(toks), jnp.int32(seq.pos),
                     jnp.asarray(table), jnp.int32(n),
                 )
+                if self.quantized:
+                    (self.k_pool, self.v_pool, self.k_scale,
+                     self.v_scale, _) = fn(
+                        self.params, self.k_pool, self.v_pool,
+                        self.k_scale, self.v_scale, *tail,
+                    )
+                else:
+                    self.k_pool, self.v_pool, _ = fn(
+                        self.params, self.k_pool, self.v_pool, *tail,
+                    )
                 seq.pos += n
                 budget -= n
                 self.prefill_tokens += n
@@ -534,11 +856,20 @@ class ServeEngine:
             [s.seq_id for s in batch] + [-1] * (B - len(batch)), W
         )
         fn = self._decode_fn(B, W)
-        self.k_pool, self.v_pool, nxt, _ = fn(
-            self.params, self.k_pool, self.v_pool,
+        tail = (
             jnp.asarray(tok), jnp.asarray(pos), jnp.asarray(table),
             jnp.asarray(temps), jnp.asarray(keys),
         )
+        if self.quantized:
+            (self.k_pool, self.v_pool, self.k_scale, self.v_scale,
+             nxt, _) = fn(
+                self.params, self.k_pool, self.v_pool,
+                self.k_scale, self.v_scale, *tail,
+            )
+        else:
+            self.k_pool, self.v_pool, nxt, _ = fn(
+                self.params, self.k_pool, self.v_pool, *tail,
+            )
         nxt = np.asarray(nxt)
         self.ticks += 1
         stats["batch"] = len(batch)

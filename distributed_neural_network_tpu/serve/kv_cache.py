@@ -31,6 +31,15 @@ their dead writes into it. The allocator therefore hands out ids
 Pure host bookkeeping + index math; the device pools live on
 `ServeEngine` (functionally updated by the jitted step). Stdlib+numpy
 only, importable without jax.
+
+Under ``EngineConfig.kv_dtype="int8"`` the device pools are stored
+QUANTIZED - int8 codes plus one f32 scale per (block, head) per layer -
+using the same block ids this allocator hands out (scale of slot ``s``
+= ``scales[table[s // block_size]]``), which roughly doubles how many
+concurrent sequences one HBM budget holds (`analysis/cost.py
+kv_block_bytes` prices it exactly; docs/SERVING.md "int8 KV cache").
+The allocator itself is dtype-blind; the engine zeroes a freed block's
+scales so reuse is history-free (deterministic preemption replay).
 """
 
 from __future__ import annotations

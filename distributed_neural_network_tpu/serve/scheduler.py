@@ -187,6 +187,7 @@ class ServeScheduler:
                     "block_size": engine.ecfg.block_size,
                     "max_seq_len": engine.ecfg.max_seq_len,
                     "prefill_chunk": engine.ecfg.prefill_chunk,
+                    "kv_dtype": engine.ecfg.kv_dtype,
                 },
                 "scheduler": {
                     "max_queue": self.cfg.max_queue,
@@ -218,6 +219,38 @@ class ServeScheduler:
             "serve_kv_blocks_total", "Paged-KV usable block count"
         )
         self._m_kv_total.set(engine.kv.cfg.usable_blocks)
+        # occupancy in the bytes the pool ACTUALLY allocates (int8 KV
+        # halves them; analysis/cost.py kv_block_bytes incl. scales) +
+        # the effective concurrent-sequence capacity at max_seq_len -
+        # the number an operator can compare across kv dtypes, unlike a
+        # raw block count whose byte value silently changed
+        from ..analysis.cost import kv_capacity_sequences
+
+        self._kv_block_bytes = engine.kv_block_bytes()
+        self._m_kv_dtype = r.gauge(
+            "serve_kv_dtype",
+            "KV-pool storage dtype (1 at the active label)",
+        )
+        self._m_kv_dtype.labels(dtype=engine.kv_dtype_name()).set(1)
+        self._m_kv_bytes_used = r.gauge(
+            "serve_kv_bytes_in_use",
+            "Allocated paged-KV bytes at the pool dtype (incl. scales)",
+        )
+        self._m_kv_bytes_total = r.gauge(
+            "serve_kv_bytes_total",
+            "Usable paged-KV pool bytes at the pool dtype (incl. scales)",
+        )
+        self._m_kv_bytes_total.set(
+            engine.kv.cfg.usable_blocks * self._kv_block_bytes
+        )
+        self._m_kv_capacity = r.gauge(
+            "serve_kv_capacity_sequences",
+            "Concurrent max_seq_len sequences the pool holds",
+        )
+        self._m_kv_capacity.set(kv_capacity_sequences(
+            engine.kv.cfg.usable_blocks, engine.ecfg.block_size,
+            engine.ecfg.max_seq_len,
+        ))
         self._m_ttft = r.histogram(
             "serve_ttft_seconds", "Time to first token",
             buckets=LATENCY_BUCKETS,
@@ -497,6 +530,9 @@ class ServeScheduler:
                 self.ledger.add("kv_alloc_stall", t0, t1)
             self._m_active.set(len(eng.active))
             self._m_kv_used.set(kv.blocks_in_use)
+            self._m_kv_bytes_used.set(
+                kv.blocks_in_use * self._kv_block_bytes
+            )
             self.ledger.maybe_publish()
             self.ledger.maybe_write()
             self.registry.beat(eng.ticks)

@@ -59,6 +59,17 @@ def add_common_flags(p: argparse.ArgumentParser, *, epochs: int, batch_size: int
         help="gradient-bucket payload cap in MiB for --grad-sync overlap",
     )
     p.add_argument(
+        "--precision",
+        choices=("bf16", "fp8", "int8", "int8-kv"),
+        default="bf16",
+        help="low-precision fast path selector (shared flag surface with "
+        "lm_train.py / the serve CLI). The CNN engine itself has no "
+        "quantized kernels - only 'bf16' (the full-precision contract) "
+        "runs here; 'fp8'/'int8' quantize the LM's attention matmuls "
+        "(lm_train.py --precision) and 'int8-kv' the serving KV cache "
+        "(python -m distributed_neural_network_tpu.serve --precision)",
+    )
+    p.add_argument(
         "--compilation-cache-dir",
         default=None,
         help="persistent XLA compilation cache directory "
@@ -378,6 +389,17 @@ def run_training(args, regime: str, *, log=print) -> Engine:
     G_LEDGER.start()
     if getattr(args, "run_record", None):
         G_LEDGER.arm(args.run_record)
+
+    precision = getattr(args, "precision", "bf16")
+    if precision != "bf16":
+        raise SystemExit(
+            f"--precision {precision}: the CNN engine has no quantized "
+            "kernels (its conv/dense matmuls are full precision); the "
+            "fp8/int8 fast path lives in the LM stack - lm_train.py "
+            "--precision fp8|int8 for training, python -m "
+            "distributed_neural_network_tpu.serve --precision int8-kv "
+            "for the serving KV cache (docs/MEASUREMENT.md)"
+        )
 
     honor_platform_env()
     from ..parallel.distributed import initialize as distributed_initialize

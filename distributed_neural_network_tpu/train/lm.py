@@ -721,6 +721,12 @@ def lm_step_program(
             "sync_axes": list(sync_axes),
             "batch": batch,
             "seq_len": seq_len,
+            # declares the low-precision contract to the shardlint
+            # quantized-dtype lint: int8/fp8 values are legal in a trace
+            # ONLY where this is set, and a declared-quantized step whose
+            # trace shows none fails (the quantized path silently fell
+            # back) - analysis/lint.py quantized_dtype_lint
+            "quant": cfg.attn_quant or None,
         },
     )
 

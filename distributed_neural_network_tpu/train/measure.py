@@ -1518,6 +1518,9 @@ def measure_serving(
     max_seq_len: int = 256,
     prefill_chunk: int = 16,
     seed: int = 0,
+    kv_dtype: str = "bf16",
+    min_capacity_ratio: float = 1.8,
+    min_top1_agreement: float = 0.99,
 ) -> dict:
     """The serving row: sustained requests/s + TTFT / inter-token
     latency under the open-loop load generator (tools/loadgen.py)
@@ -1530,11 +1533,24 @@ def measure_serving(
     prefill, queue_wait, batch_formation_idle, kv_alloc_stall) rides
     along, so the row says not just how fast but WHERE the wall-clock
     went (docs/SERVING.md).
+
+    ``kv_dtype="int8"`` runs the same workload on the quantized KV pool
+    and GATES the two claims that make quantization honest
+    (docs/MEASUREMENT.md "Low-precision parity gates"):
+
+    - capacity: the concurrent-sequence capacity of an int8 pool sized
+      to the SAME HBM byte budget as the bf16 pool, MEASURED by
+      admitting max-length sequences into both allocators until
+      OutOfBlocks, must be >= ``min_capacity_ratio`` x bf16's;
+    - accuracy: per-token top-1 agreement of every completed stream vs
+      the offline bf16 ``generate()`` oracle must be >=
+      ``min_top1_agreement``.
     """
     import sys as _sys
 
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
     from ..models.transformer import TransformerConfig, init_params
     from ..serve import (
@@ -1564,7 +1580,7 @@ def measure_serving(
     engine = ServeEngine(params, cfg, EngineConfig(
         max_batch=max_batch, num_blocks=num_blocks,
         block_size=block_size, max_seq_len=max_seq_len,
-        prefill_chunk=prefill_chunk,
+        prefill_chunk=prefill_chunk, kv_dtype=kv_dtype,
     ))
     # pre-compile the bucket grid: a bench row measures serving, not
     # first-request XLA compiles (production pays these at deploy time)
@@ -1588,9 +1604,76 @@ def measure_serving(
     total = float(record.get("wall_s") or 0.0)
     bad = record.get("badput_s") or {}
     dev = jax.devices()[0]
+
+    quant = {}
+    if kv_dtype == "int8":
+        # --- capacity gate: equal-HBM-budget pools, MEASURED by
+        # admitting max-length sequences into the real allocator
+        from ..analysis.cost import kv_block_bytes
+
+        bf16_name = "bf16" if dtype == "bfloat16" else "f32"
+        bb_bf16 = kv_block_bytes(
+            n_layers, n_heads, cfg.head_dim, block_size, bf16_name
+        )
+        bb_int8 = kv_block_bytes(
+            n_layers, n_heads, cfg.head_dim, block_size, "int8"
+        )
+        budget = (num_blocks - 1) * bb_bf16  # the bf16 pool's bytes
+        int8_blocks = budget // bb_int8 + 1  # + scratch
+        cap_bf16 = measure_kv_capacity(
+            num_blocks, block_size, max_seq_len
+        )
+        cap_int8 = measure_kv_capacity(
+            int8_blocks, block_size, max_seq_len
+        )
+        ratio = cap_int8 / max(cap_bf16, 1)
+        # --- accuracy gate: every completed stream vs the offline bf16
+        # oracle (the seeded-model contract), per-token top-1 agreement
+        from ..models.transformer import generate
+
+        agree = tot_toks = 0
+        for r in summary["results"]:
+            if r.status != "completed" or not r.tokens:
+                continue
+            oracle = np.asarray(generate(
+                params, jnp.asarray([r.prompt], jnp.int32), cfg,
+                max_new_tokens=len(r.tokens),
+            ))[0, len(r.prompt):]
+            agree += int(sum(
+                int(a) == int(b) for a, b in zip(r.tokens, oracle)
+            ))
+            tot_toks += len(r.tokens)
+        agreement = agree / max(tot_toks, 1)
+        quant = {
+            "kv_capacity": {
+                "hbm_budget_bytes": int(budget),
+                "bf16": {"blocks": num_blocks - 1,
+                         "bytes_per_block": bb_bf16,
+                         "max_seq_sequences": cap_bf16},
+                "int8": {"blocks": int(int8_blocks - 1),
+                         "bytes_per_block": bb_int8,
+                         "max_seq_sequences": cap_int8},
+                "measured_capacity_ratio": round(ratio, 4),
+            },
+            "oracle_top1_agreement": round(agreement, 6),
+            "oracle_tokens_compared": tot_toks,
+        }
+        assert ratio >= min_capacity_ratio, (
+            f"int8-KV capacity gate: measured concurrent-sequence "
+            f"capacity ratio {ratio:.3f} < {min_capacity_ratio} at equal "
+            f"HBM budget ({cap_int8} vs {cap_bf16} max-len sequences)"
+        )
+        assert agreement >= min_top1_agreement, (
+            f"int8-KV accuracy gate: per-token top-1 agreement "
+            f"{agreement:.4f} < {min_top1_agreement} vs the bf16 oracle "
+            f"over {tot_toks} tokens"
+        )
+
     return {
         "devices": f"1x {dev.device_kind}",
         "model": f"d{d_model}/L{n_layers}/H{n_heads} vocab {vocab} {dtype}",
+        "kv_dtype": kv_dtype,
+        **quant,
         "offered_rps": summary["offered_rps"],
         "sustained_rps": summary["achieved_rps"],
         "requests_completed": summary["by_status"].get("completed", 0),
@@ -1614,5 +1697,165 @@ def measure_serving(
             "serve/ stack over real HTTP+SSE; sustained_rps counts "
             "COMPLETED requests over the whole window, TTFT includes "
             "queue wait (docs/SERVING.md)"
+        ),
+    }
+
+
+def measure_kv_capacity(num_blocks: int, block_size: int,
+                        max_seq_len: int) -> int:
+    """MEASURED concurrent-sequence capacity of a paged-KV pool: admit
+    max-length sequences into the real allocator (serve/kv_cache.py)
+    until `OutOfBlocks`. The capacity half of the int8-KV gate runs on
+    this, not on arithmetic - if the allocator's scratch-block reserve,
+    ceil-div block math, or scale bookkeeping changed, the measured
+    ratio moves with it."""
+    from ..serve.kv_cache import KVCacheConfig, OutOfBlocks, PagedKVCache
+
+    pool = PagedKVCache(KVCacheConfig(
+        num_blocks=int(num_blocks), block_size=int(block_size),
+        max_seq_len=int(max_seq_len),
+    ))
+    n = 0
+    while True:
+        try:
+            pool.ensure_range(n, max_seq_len - 1)
+        except OutOfBlocks:
+            return n
+        n += 1
+
+
+# documented accuracy contract of the quantized training forward
+# (docs/MEASUREMENT.md "Low-precision parity gates"): per-row symmetric
+# int8 carries ~2^-7 relative error per operand, fp8-e4m3 ~2^-3; the
+# bounds below are the end-to-end budget those translate to at the
+# parity row's shapes, with headroom against seed/backend jitter. A
+# kernel change that breaks numerics blows through them by orders of
+# magnitude - a softmax-scale bug shows up as MAE ~ O(1), not O(0.1).
+QUANT_PARITY_TOLERANCES = {
+    #        (final-loss delta, logit MAE)
+    "int8": (0.05, 0.05),
+    "fp8": (0.10, 0.25),
+}
+
+
+def measure_quant_parity(
+    *,
+    d_model: int = 64,
+    n_layers: int = 2,
+    n_heads: int = 4,
+    d_ff: int = 128,
+    vocab: int = 64,
+    seq_len: int = 32,
+    batch: int = 8,
+    steps: int = 40,
+    lr: float = 0.05,
+    seed: int = 0,
+    formats: tuple = ("int8", "fp8"),
+    tolerances: dict | None = None,
+) -> dict:
+    """The training parity row: quantized-vs-bf16 loss/logit drift,
+    GATED (ROADMAP item 3's honesty rail).
+
+    Trains the same tiny LM three times from identical init/data -
+    full precision, ``attn_quant="int8"``, ``attn_quant="fp8"``
+    (ops/quant.py: real low-precision QK^T/PV dots, straight-through
+    backward) - and asserts the documented tolerances on
+
+    - ``loss_delta``: |final quantized loss - final full-precision loss|
+      (did quantization change what was learned), and
+    - ``logit_mae``: mean |logit difference| on a held-out batch at the
+      final parameters (how far individual predictions moved).
+
+    Single-device on purpose: the quantized forward is sharding-
+    agnostic (per-token scales are local math), so parity here is
+    parity everywhere the spec lint lets it run; single-device also
+    keeps the gate executable on any jax generation the serving CI
+    runs (the mesh step needs modern shard_map).
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..models import transformer as tfm
+
+    tol = dict(QUANT_PARITY_TOLERANCES)
+    tol.update(tolerances or {})
+
+    def build(fmt: str):
+        return tfm.TransformerConfig(
+            vocab_size=vocab, d_model=d_model, n_heads=n_heads,
+            n_layers=n_layers, d_ff=d_ff, attn_quant=fmt,
+        )
+
+    # fixed synthetic next-token workload: every variant sees byte-
+    # identical batches (seeded PRNG, regenerated per variant)
+    def batches(n):
+        key = jax.random.key(seed + 1)
+        for _ in range(n):
+            key, k = jax.random.split(key)
+            yield jax.random.randint(k, (batch, seq_len), 0, vocab)
+
+    def train(fmt: str):
+        cfg = build(fmt)
+        params = tfm.init_params(jax.random.key(seed), cfg)
+
+        def loss_fn(p, toks):
+            logits, _ = tfm.apply_with_aux(p, toks, cfg)
+            tgt = toks[:, 1:]
+            lp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
+            nll = -jnp.take_along_axis(
+                lp, tgt[..., None], axis=-1
+            )[..., 0]
+            return nll.mean()
+
+        @jax.jit
+        def step(p, toks):
+            loss, g = jax.value_and_grad(loss_fn)(p, toks)
+            p = jax.tree.map(lambda w, gw: w - lr * gw, p, g)
+            return p, loss
+
+        loss = None
+        for toks in batches(steps):
+            params, loss = step(params, toks)
+        eval_toks = jax.random.randint(
+            jax.random.key(seed + 2), (batch, seq_len), 0, vocab
+        )
+        logits, _ = tfm.apply_with_aux(params, eval_toks, cfg)
+        return float(loss), np.asarray(logits, np.float32)
+
+    base_loss, base_logits = train("")
+    rows = {}
+    for fmt in formats:
+        q_loss, q_logits = train(fmt)
+        loss_delta = abs(q_loss - base_loss)
+        logit_mae = float(np.mean(np.abs(q_logits - base_logits)))
+        d_tol, m_tol = tol[fmt]
+        rows[fmt] = {
+            "final_loss": round(q_loss, 6),
+            "loss_delta": round(loss_delta, 6),
+            "loss_delta_tol": d_tol,
+            "logit_mae": round(logit_mae, 6),
+            "logit_mae_tol": m_tol,
+        }
+        assert loss_delta <= d_tol, (
+            f"quant parity gate [{fmt}]: final-loss delta "
+            f"{loss_delta:.4f} > {d_tol} vs full precision "
+            f"(base {base_loss:.4f}, quantized {q_loss:.4f})"
+        )
+        assert logit_mae <= m_tol, (
+            f"quant parity gate [{fmt}]: logit MAE {logit_mae:.4f} > "
+            f"{m_tol} vs full precision on the held-out batch"
+        )
+    dev = jax.devices()[0]
+    return {
+        "devices": f"1x {dev.device_kind}",
+        "model": f"d{d_model}/L{n_layers}/H{n_heads} vocab {vocab}",
+        "steps": steps,
+        "baseline_final_loss": round(base_loss, 6),
+        "formats": rows,
+        "note": (
+            "same init + byte-identical batches per variant; quantized "
+            "attention forward (ops/quant.py), straight-through "
+            "backward; gates assert the documented tolerances "
+            "(docs/MEASUREMENT.md)"
         ),
     }

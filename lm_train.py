@@ -94,6 +94,19 @@ def main() -> int:
     p.add_argument("--n-layers", type=int, default=4)
     p.add_argument("--d-ff", type=int, default=512)
     p.add_argument("--dtype", choices=("float32", "bfloat16"), default="float32")
+    p.add_argument(
+        "--precision", choices=("bf16", "fp8", "int8", "int8-kv"),
+        default="bf16",
+        help="low-precision fast path (ops/quant.py): 'fp8'/'int8' run "
+        "the attention QK^T/PV matmuls quantized with per-token scales "
+        "and wide accumulation (forward only - backward stays full "
+        "precision; the bench parity row gates the loss/logit drift, "
+        "docs/MEASUREMENT.md); 'bf16' (default) is the unquantized "
+        "path ('bf16' names the ACCUMULATION contract, not --dtype). "
+        "'int8-kv' is the serving-side KV-cache quantization - use "
+        "python -m distributed_neural_network_tpu.serve --precision "
+        "int8-kv",
+    )
     p.add_argument("--loss-chunks", type=int, default=0,
                    help="compute the CE loss in this many sequence chunks "
                    "so full (B, S, vocab) logits never materialize "
@@ -404,6 +417,25 @@ def main() -> int:
             "with --dp/--tp (own vma-typed Pallas kernels, round 4); a "
             "sequence axis needs --attn ring/ulysses/zigzag"
         )
+    if args.precision == "int8-kv":
+        p.error(
+            "--precision int8-kv quantizes the SERVING KV cache (paged "
+            "pool + per-block scales); it is a flag of python -m "
+            "distributed_neural_network_tpu.serve. Training's quantized "
+            "paths are --precision fp8|int8"
+        )
+    if args.precision != "bf16" and args.sp > 1:
+        p.error(
+            f"--precision {args.precision} quantizes the LOCAL attention "
+            "matmuls; a sequence axis (ring/ulysses/zigzag) has no "
+            "quantized path - drop --sp or --precision"
+        )
+    if args.precision != "bf16" and args.pp > 1:
+        p.error(
+            f"--precision {args.precision} is wired through the "
+            "dp x sp x tp mesh step; the pipeline path does not thread "
+            "attn_quant - drop --pp or --precision"
+        )
     if args.grad_sync == "overlap" and args.experts and args.dp > 1:
         p.error(
             "--grad-sync overlap psums gradient buckets over the data "
@@ -522,6 +554,7 @@ def main() -> int:
         remat_attn=args.remat_attn,
         remat_policy=args.remat_policy,
         n_experts=args.experts,
+        attn_quant="" if args.precision == "bf16" else args.precision,
     )
     if args.n_heads % max(args.tp, 1):
         raise SystemExit(f"--n-heads {args.n_heads} must divide by --tp {args.tp}")
@@ -999,7 +1032,8 @@ def main() -> int:
     print(
         f"(LM {tfm.param_count(params):,} params, mesh {mesh_desc}, "
         f"attn={args.attn if args.sp > 1 or args.attn == 'flash' else 'full'}, "
-        f"experts={args.experts or 'dense'}, optimizer={args.optimizer})"
+        + (f"precision={args.precision}, " if args.precision != "bf16" else "")
+        + f"experts={args.experts or 'dense'}, optimizer={args.optimizer})"
     )
 
     first_loss = None

@@ -86,6 +86,16 @@ def main(argv=None) -> int:
         "(default 16)",
     )
     ap.add_argument(
+        "--precision", default=None, metavar="DTYPE",
+        choices=("bf16", "int8", "fp8"),
+        help="price the PARAM footprint as if stored in this dtype "
+        "(per-block scales charged; optimizer state stays wide) - the "
+        "quantized-footprint view of the HBM gate, so the search can "
+        "trade precision for parallelism (analysis/cost.py "
+        "DTYPE_BYTES). Recorded in written plan manifests; --check "
+        "refuses to compare across precisions",
+    )
+    ap.add_argument(
         "--write-manifest", action="store_true",
         help="pin each search's winning plan as analysis/plans/<name>.json",
     )
@@ -135,8 +145,13 @@ def main(argv=None) -> int:
         "write" if args.write_manifest else "check" if args.check else "rank"
     )
     weights = None
-    if args.hbm_gb is not None:
-        weights = CostWeights(hbm_bytes=int(args.hbm_gb * 2**30))
+    if args.hbm_gb is not None or args.precision is not None:
+        kw = {}
+        if args.hbm_gb is not None:
+            kw["hbm_bytes"] = int(args.hbm_gb * 2**30)
+        if args.precision is not None:
+            kw["param_precision"] = args.precision
+        weights = CostWeights(**kw)
     optimizers = (
         tuple(o for o in args.optimizers.split(",") if o)
         if args.optimizers else None

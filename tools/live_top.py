@@ -607,11 +607,29 @@ def render(snap: dict, *, color: bool = True, width: int = 72) -> str:
         preempt = metric_value(m, "serve_preemptions_total", 0)
         util = kv_used / kv_total if kv_total else 0.0
         kv_col = GREEN if util < 0.7 else YELLOW if util < 0.9 else RED
+        # quantized-byte accounting (serve/scheduler.py): occupancy in
+        # the bytes the pool dtype actually allocates + the effective
+        # concurrent-sequence capacity, so an int8-KV server reads as
+        # the capacity it really has rather than a raw block count
+        kv_dtype = next(
+            (dict(k).get("dtype") for k, v in
+             (m.get("serve_kv_dtype") or {}).items() if v), None
+        )
+        bytes_used = metric_value(m, "serve_kv_bytes_in_use", 0)
+        bytes_total = metric_value(m, "serve_kv_bytes_total", 0)
+        capacity = metric_value(m, "serve_kv_capacity_sequences", None)
+        byte_s = (
+            f" {fmt_bytes(bytes_used)}/{fmt_bytes(bytes_total)}"
+            + (f" {kv_dtype}" if kv_dtype else "")
+            if bytes_total else ""
+        )
         kv_line = (
             f"  active {int(active)}  queued {int(queued)}  "
             + c(kv_col,
                 f"kv {int(kv_used)}/{int(kv_total)} blocks "
-                f"({100.0 * util:.0f}%)")
+                f"({100.0 * util:.0f}%){byte_s}")
+            + (f"  cap {int(capacity)} seqs"
+               if capacity is not None else "")
             + (f"  preempted {int(preempt)}" if preempt else "")
         )
         lines.append(kv_line)
