@@ -17,18 +17,30 @@ Layers, bottom up:
   token-bucket fairness, the serve loop, and the serving goodput ledger
   (queue_wait / prefill / decode / batch_formation_idle /
   kv_alloc_stall - `utils/goodput.py` taxonomy "serve").
+- `reqtrace.py`  - per-request lifecycle tracing: every request
+  event-sourced through a closed cause taxonomy (queue_wait /
+  admission / prefill / decode / kv_alloc_stall / preempted_wait /
+  stream_write) with span conservation asserted; exported via
+  `GET /v1/requests`, Chrome trace lanes, and
+  `tools/request_trace.py` (tail attribution + SLO gates).
 - `http.py`      - the HTTP face: `POST /v1/generate` with
   server-sent-event token streaming on the ObsServer route surface
-  (`/metrics` + `/healthz` come with it), and the `python -m
+  (`/metrics` + `/healthz` come with it), `GET /v1/status` /
+  `GET /v1/requests`, and the `python -m
   distributed_neural_network_tpu.serve` CLI.
 
 docs/SERVING.md covers architecture, batching semantics, the KV-block
-math, the ledger taxonomy, and the load-generator workflow
-(tools/loadgen.py).
+math, the ledger taxonomy, per-request tracing, and the load-generator
+workflow (tools/loadgen.py).
 """
 
 from .engine import EngineConfig, ServeEngine, Sequence  # noqa: F401
 from .kv_cache import KVCacheConfig, OutOfBlocks, PagedKVCache  # noqa: F401
+from .reqtrace import (  # noqa: F401
+    REQUEST_CAUSES,
+    RequestRecord,
+    RequestTraceRecorder,
+)
 from .scheduler import (  # noqa: F401
     AdmissionError,
     SchedulerConfig,

@@ -41,6 +41,7 @@ deterministic, and already-streamed tokens are not re-emitted.
 from __future__ import annotations
 
 import threading
+from collections import deque
 from dataclasses import dataclass, field
 
 import jax
@@ -192,7 +193,9 @@ class ServeEngine:
         self.decode_tokens = 0
         self.prefill_tokens = 0
         self.stall_events = 0
-        self.preempted: list[Sequence] = []  # drained by the scheduler
+        # drained from the FRONT by the scheduler (popleft), re-parked at
+        # the back on eviction - a deque so both ends are O(1)
+        self.preempted: deque[Sequence] = deque()
 
     # --------------------------------------------------------- lifecycle
 
@@ -732,11 +735,12 @@ class ServeEngine:
                 self._free_seq(s.seq_id)
         return done
 
-    def _preempt_youngest(self, parked: list) -> None:
+    def _preempt_youngest(self, parked: list) -> Sequence:
         """Nothing could run: evict the youngest parked sequence so the
         others' next allocation can succeed. Blocks freed, position
         reset; generated tokens are kept for replay dedup (greedy /
-        per-position keys make the regeneration identical)."""
+        per-position keys make the regeneration identical). Returns the
+        victim so the caller can record provenance."""
         victim = parked[-1]
         with self.lock:
             self.active = [
@@ -747,20 +751,38 @@ class ServeEngine:
         victim.preemptions += 1
         self.preempted.append(victim)
         self.stall_events += 1
+        return victim
 
     def step(self) -> dict:
         """One engine tick. Returns per-tick stats for the scheduler's
         ledger/metrics: ``{"decode_tokens", "prefill_tokens",
         "finished", "parked", "batch", "prefill_s", "decode_s"}``
         (span seconds measured by the caller via the returned work
-        counts - the engine itself is clock-free for testability)."""
+        counts - the engine itself is clock-free for testability).
+
+        For per-request attribution (serve/reqtrace.py) the dict also
+        carries ``per_seq`` - ``{seq_id: {"prefill", "decode",
+        "replayed", "parked"}}``, this tick's token counts and park flag
+        for every sequence the tick touched - and ``preempted``, the
+        provenance of evictions performed this tick (``seq_id``,
+        ``tokens_held`` for replay accounting, cumulative
+        ``preemptions``)."""
         ecfg = self.ecfg
         bs = self.kv.cfg.block_size
         with self.lock:
             todo = list(self.active)
         parked: list[Sequence] = []
         stats = {"decode_tokens": 0, "prefill_tokens": 0, "finished": 0,
-                 "parked": 0, "batch": 0}
+                 "parked": 0, "batch": 0, "per_seq": {}, "preempted": []}
+
+        def seqstat(s: Sequence) -> dict:
+            d = stats["per_seq"].get(s.seq_id)
+            if d is None:
+                d = stats["per_seq"][s.seq_id] = {
+                    "prefill": 0, "decode": 0, "replayed": 0,
+                    "parked": False,
+                }
+            return d
 
         # ---- chunked prefill phase (prefill_chunk > 1 only)
         if ecfg.prefill_chunk > 1:
@@ -782,6 +804,7 @@ class ServeEngine:
                     self.kv.ensure_range(seq.seq_id, seq.pos + n - 1)
                 except OutOfBlocks:
                     parked.append(seq)
+                    seqstat(seq)["parked"] = True
                     continue
                 C = _bucket(n)
                 W = _bucket(
@@ -809,6 +832,7 @@ class ServeEngine:
                 budget -= n
                 self.prefill_tokens += n
                 stats["prefill_tokens"] += n
+                seqstat(seq)["prefill"] += n
 
         # ---- decode batch: one token per remaining runnable sequence
         batch: list[Sequence] = []
@@ -823,6 +847,7 @@ class ServeEngine:
                 self.kv.ensure(seq.seq_id, seq.pos)
             except OutOfBlocks:
                 parked.append(seq)
+                seqstat(seq)["parked"] = True
                 continue
             batch.append(seq)
 
@@ -833,7 +858,12 @@ class ServeEngine:
             if parked:
                 # every active sequence is parked on blocks: preempt the
                 # youngest so the others' next allocation can succeed
-                self._preempt_youngest(parked)
+                victim = self._preempt_youngest(parked)
+                stats["preempted"].append({
+                    "seq_id": victim.seq_id,
+                    "tokens_held": len(victim.out),
+                    "preemptions": victim.preemptions,
+                })
             return stats
 
         B = _bucket(len(batch))
@@ -885,10 +915,14 @@ class ServeEngine:
                 j = consumed_at + 1 - s.prompt_len
                 if j == len(s.out):
                     self._emit(s, int(nxt[i]))
+                else:
+                    seqstat(s)["replayed"] += 1
                 self.decode_tokens += 1
                 stats["decode_tokens"] += 1
+                seqstat(s)["decode"] += 1
             else:
                 self.prefill_tokens += 1
                 stats["prefill_tokens"] += 1
+                seqstat(s)["prefill"] += 1
         stats["finished"] = len(self._retire_finished())
         return stats

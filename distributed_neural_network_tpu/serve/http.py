@@ -19,7 +19,13 @@ pluggable route table:
   to HTTP status: 429 (queue full / tenant over rate, with
   ``Retry-After``) and 400 (malformed / over-length), so standard
   client backoff just works.
-- ``GET /v1/status`` - one JSON snapshot (active/queued/KV occupancy).
+- ``GET /v1/status`` - one JSON snapshot (active/queued/KV occupancy,
+  in-flight request summaries).
+- ``GET /v1/requests`` - the per-request lifecycle records
+  (serve/reqtrace.py): in-flight summaries + the bounded ring of
+  finalized records. ``?full=1`` includes every ringed record's span
+  sequence (the `tools/request_trace.py` input); ``?id=N`` returns one
+  request's full detail (404 when it fell off the ring).
 
 ``"text"`` prompts are byte-tokenized (the `data/tokens.py` .txt
 convention; needs vocab >= 256); responses for text prompts include the
@@ -41,6 +47,7 @@ import json
 import signal
 import sys
 import threading
+from urllib.parse import parse_qs, urlsplit
 
 from ..utils.obs import MetricsRegistry, ObsServer
 from .engine import EngineConfig, ServeEngine
@@ -88,6 +95,7 @@ class ServeServer:
             routes={
                 ("POST", "/v1/generate"): self._generate,
                 ("GET", "/v1/status"): self._status,
+                ("GET", "/v1/requests"): self._requests,
             },
         )
         self.port = self.obs.port
@@ -113,7 +121,37 @@ class ServeServer:
             "engine_ticks": eng.ticks,
             "decode_tokens": eng.decode_tokens,
             "prefill_tokens": eng.prefill_tokens,
+            "requests": self.scheduler.reqtrace.in_flight(),
+            "requests_finalized":
+                self.scheduler.reqtrace.finalized_total,
         })
+
+    def _requests(self, handler) -> None:
+        # the route table keys on the query-stripped path; the raw
+        # request line still carries ?id= / ?full=
+        qs = parse_qs(urlsplit(handler.path).query)
+        rid = qs.get("id", [None])[0]
+        if rid is not None:
+            try:
+                rid = int(rid)
+            except ValueError:
+                _json_response(
+                    handler, 400, {"error": "id must be an integer"}
+                )
+                return
+            doc = self.scheduler.reqtrace.get(rid)
+            if doc is None:
+                _json_response(handler, 404, {
+                    "error": f"request {rid} not found "
+                    "(never seen, or evicted from the ring)",
+                })
+            else:
+                _json_response(handler, 200, {"request": doc})
+            return
+        full = qs.get("full", ["0"])[0] not in ("0", "", "false")
+        _json_response(
+            handler, 200, self.scheduler.reqtrace.snapshot(full=full)
+        )
 
     def _parse_request(self, handler):
         try:
@@ -157,6 +195,7 @@ class ServeServer:
             temperature=float(body.get("temperature", 0.0)),
             seed=int(body.get("seed", 0)),
             api_key=str(api_key),
+            stream_owner=True,  # this handler acks the stream tail
         )
         return req, bool(body.get("stream", True)), is_text
 
@@ -221,16 +260,24 @@ class ServeServer:
         except (BrokenPipeError, ConnectionResetError, OSError):
             # client went away mid-stream: free its slot + KV blocks
             self.scheduler.cancel(req)
+        finally:
+            # seals the trace record's stream_write span (no-op unless
+            # the request already reached a terminal status - a wedged
+            # stream stays with the loop's cancel/shutdown paths)
+            self.scheduler.finish_stream(req)
 
     def _block_response(self, handler, req, is_text) -> None:
         last_err = None
         for kind, payload in self._drain(req):
             if kind == "error":
                 last_err = payload
-        if last_err is not None and req.status != "done":
-            _json_response(handler, 500, {"error": last_err})
-            return
-        _json_response(handler, 200, self._summary_doc(req, is_text))
+        try:
+            if last_err is not None and req.status != "done":
+                _json_response(handler, 500, {"error": last_err})
+                return
+            _json_response(handler, 200, self._summary_doc(req, is_text))
+        finally:
+            self.scheduler.finish_stream(req)
 
 
 # ----------------------------------------------------------------- CLI
@@ -310,6 +357,14 @@ def main(argv=None) -> int:
     p.add_argument("--run-record", default=None,
                    help="write the serving goodput record here "
                    "(utils/goodput.py taxonomy 'serve')")
+    p.add_argument("--trace-out", default=None,
+                   help="export a Chrome trace of per-request lifecycle "
+                   "lanes (one slot lane per concurrent request, spans "
+                   "by cause + preempt instants) at shutdown - merges "
+                   "with training shards via tools/trace_merge.py")
+    p.add_argument("--request-ring", type=int, default=256,
+                   help="finalized per-request records kept for "
+                   "GET /v1/requests / tools/request_trace.py")
     p.add_argument("--warmup", action="store_true",
                    help="pre-compile the (batch, width) bucket grid "
                    "before binding the port (no first-request compile "
@@ -331,6 +386,16 @@ def main(argv=None) -> int:
         n = engine.warmup()
         print(f"(warmup: {n} bucket programs compiled)", flush=True)
     registry = MetricsRegistry()
+    tracer = None
+    if args.trace_out:
+        import socket
+
+        from ..utils.tracing import Tracer
+
+        tracer = Tracer().set_process(
+            hostname=socket.gethostname(),
+            label=f"serve:{args.port}",
+        )
     scheduler = ServeScheduler(
         engine,
         SchedulerConfig(
@@ -338,8 +403,10 @@ def main(argv=None) -> int:
             tenant_rate=args.tenant_rate,
             tenant_burst=args.tenant_burst,
             run_record=args.run_record,
+            request_ring=args.request_ring,
         ),
         registry=registry,
+        tracer=tracer,
     ).start()
     server = ServeServer(
         scheduler, registry, port=args.port, host=args.host
@@ -351,7 +418,8 @@ def main(argv=None) -> int:
         f"{engine.kv.cfg.usable_blocks} KV blocks x "
         f"{args.block_size} tokens [{engine.kv_dtype_name()}, "
         f"{engine.kv_block_bytes():,} B/block]; endpoints: "
-        "POST /v1/generate, GET /v1/status, /metrics, /healthz)",
+        "POST /v1/generate, GET /v1/status, GET /v1/requests, "
+        "/metrics, /healthz)",
         flush=True,
     )
 
@@ -366,6 +434,9 @@ def main(argv=None) -> int:
         pass
     record = scheduler.close()
     server.close()
+    if tracer is not None:
+        tracer.export(args.trace_out, goodput=record)
+        print(f"(request trace lanes -> {args.trace_out})", flush=True)
     print("SERVE_SUMMARY " + json.dumps({
         "requests_completed": int(
             registry.counter("serve_requests_total")

@@ -58,6 +58,7 @@ from dataclasses import dataclass, field
 from ..utils.goodput import GoodputLedger
 from ..utils.obs import NULL_REGISTRY
 from .engine import ServeEngine, Sequence
+from .reqtrace import RequestTraceRecorder
 
 # histogram buckets for TTFT / inter-token latency: 1 ms .. 60 s
 LATENCY_BUCKETS = (
@@ -97,6 +98,10 @@ class ServeRequest:
     tokens: list = field(default_factory=list)
     events: object = None       # queue.Queue, created by submit()
     cancelled: threading.Event = field(default_factory=threading.Event)
+    # True when a streaming channel (the HTTP layer) owns the tail of
+    # the request's lifecycle: the per-request trace record then stays
+    # open in ``stream_write`` until `finish_stream` acks the flush
+    stream_owner: bool = False
     _seq: object = None
     _t_arrival_ledger: float = 0.0
     _t_prev_token: float | None = None
@@ -127,6 +132,7 @@ class SchedulerConfig:
     block_headroom: int = 0      # extra free blocks required to admit
     idle_poll_s: float = 0.02    # loop wakeup when completely idle
     run_record: str | None = None  # serving goodput record path
+    request_ring: int = 256      # finalized per-request records kept
 
 
 class _TokenBucket:
@@ -160,11 +166,13 @@ class ServeScheduler:
         *,
         registry=NULL_REGISTRY,
         clock=time.monotonic,
+        tracer=None,
     ):
         self.engine = engine
         self.cfg = cfg or SchedulerConfig()
         self.registry = registry
         self._clock = clock
+        self.tracer = tracer
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         self._tenants: dict[str, deque] = {}
@@ -177,6 +185,12 @@ class ServeScheduler:
         self._thread: threading.Thread | None = None
         self.ledger = GoodputLedger(taxonomy="serve", clock=clock)
         self.ledger.start()
+        # per-request lifecycle records on the ledger's clock, so the
+        # two accountings reconcile (tools/request_trace.py --ledger)
+        self.reqtrace = RequestTraceRecorder(
+            ring=self.cfg.request_ring, clock=self.ledger.now,
+            tracer=tracer,
+        )
         if self.cfg.run_record:
             self.ledger.arm(self.cfg.run_record)
         self.ledger.describe(
@@ -305,6 +319,7 @@ class ServeScheduler:
                     )
             if not bucket.try_take():
                 self._m_rejected.labels(reason="rate_limited").inc()
+                self.reqtrace.note_rejected("rate_limited")
                 raise AdmissionError(
                     429, "rate_limited",
                     f"tenant {req.api_key!r} over "
@@ -314,6 +329,7 @@ class ServeScheduler:
         with self._work:
             if self._queued >= self.cfg.max_queue:
                 self._m_rejected.labels(reason="queue_full").inc()
+                self.reqtrace.note_rejected("queue_full")
                 raise AdmissionError(
                     429, "queue_full",
                     f"admission queue full ({self.cfg.max_queue})",
@@ -323,6 +339,10 @@ class ServeScheduler:
             req._t_arrival_ledger = self.ledger.now()
             req.events = queue_mod.Queue()
             req.status = "queued"
+            self.reqtrace.arrive(
+                req.req_id, req.api_key, len(req.prompt),
+                req.max_new_tokens,
+            )
             fifo = self._tenants.get(req.api_key)
             if fifo is None:
                 fifo = self._tenants[req.api_key] = deque()
@@ -340,6 +360,17 @@ class ServeScheduler:
         req.cancelled.set()
         with self._work:
             self._work.notify()
+
+    def finish_stream(self, req: ServeRequest) -> None:
+        """Streaming-channel ack (any thread): the owner finished
+        writing the request's tail, so its trace record's
+        ``stream_write`` span closes and the record seals. Only acts on
+        a request already at a terminal status - a mid-flight stream
+        error stays with the loop (cancel / shutdown paths)."""
+        if req.req_id and req.status in ("done", "cancelled", "error"):
+            self.reqtrace.finalize(
+                req.req_id, req.status  # idempotent vs the loop's seal
+            )
 
     # ------------------------------------------------------------- loop
 
@@ -374,6 +405,7 @@ class ServeScheduler:
                 req.status = "error"
                 if req.events is not None:
                     req.events.put(("error", "server shutting down"))
+        self.reqtrace.finalize_all()
         if finalize:
             return self.ledger.finalize()
         return None
@@ -395,9 +427,11 @@ class ServeScheduler:
             req.status = "cancelled"
             req.t_done = time.monotonic()
             self._m_requests.labels(status="cancelled").inc()
+            self.reqtrace.finalize(req.req_id, "cancelled")
             if req.events is not None:
                 req.events.put(("done", req.summary()))
             return
+        self.reqtrace.mark(req.req_id, "admission")
         seq = Sequence(
             seq_id=req.req_id,
             prompt=[int(t) for t in req.prompt],
@@ -411,6 +445,7 @@ class ServeScheduler:
         self.engine.add(seq)
         req.t_admitted = time.monotonic()
         req.status = "active"
+        self.reqtrace.mark(req.req_id, "prefill")
         # the request's whole queued window, attributed once the sweep
         # resolves overlaps (it only claims otherwise-idle seconds)
         self.ledger.add(
@@ -424,6 +459,7 @@ class ServeScheduler:
             return
         now = time.monotonic()
         req.tokens.append(int(tok))
+        self.reqtrace.note_token(seq.seq_id)
         if req.t_first_token is None:
             req.t_first_token = now
             self._m_ttft.observe(now - req.t_arrival)
@@ -437,6 +473,12 @@ class ServeScheduler:
             req.t_done = now
             self._m_requests.labels(status="completed").inc()
             self._by_seq.pop(seq.seq_id, None)
+            # the stream_write window opens BEFORE the done event is
+            # visible to the streaming thread; with no stream owner the
+            # record seals immediately (zero-length flush)
+            self.reqtrace.mark(seq.seq_id, "stream_write")
+            if not req.stream_owner:
+                self.reqtrace.finalize(seq.seq_id, "done")
             if req.events is not None:
                 req.events.put(("done", req.summary()))
 
@@ -448,13 +490,14 @@ class ServeScheduler:
                 req.status = "cancelled"
                 req.t_done = time.monotonic()
                 self._m_requests.labels(status="cancelled").inc()
+                self.reqtrace.finalize(sid, "cancelled")
                 if req.events is not None:
                     req.events.put(("done", req.summary()))
         # preempted sequences whose request was cancelled while parked
-        self.engine.preempted = [
+        self.engine.preempted = deque(
             s for s in self.engine.preempted
             if self._by_seq.get(s.seq_id) is not None
-        ]
+        )
 
     def _loop(self) -> None:
         eng = self.engine
@@ -475,8 +518,11 @@ class ServeScheduler:
                 s = eng.preempted[0]
                 if not kv.can_fit(s.prompt_len + 1):
                     break
-                eng.preempted.pop(0)
+                eng.preempted.popleft()
                 eng.add(s)
+                # replay starts at pos 0: back to prefill until the
+                # engine re-derives the held tokens
+                self.reqtrace.mark(s.seq_id, "prefill")
             # admit new requests round-robin while capacity lasts
             while len(eng.active) < eng.ecfg.max_batch:
                 with self._work:
@@ -507,6 +553,7 @@ class ServeScheduler:
             stats = eng.step()
             t1 = self.ledger.now()
             self._m_steps.inc()
+            self.reqtrace.observe_step(stats, t0, t1)
             if len(eng.preempted) > preempted_before:
                 self._m_preempt.inc(len(eng.preempted) - preempted_before)
             dec, pre = stats["decode_tokens"], stats["prefill_tokens"]

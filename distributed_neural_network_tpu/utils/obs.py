@@ -910,11 +910,19 @@ class _ObsHandler(http.server.BaseHTTPRequestHandler):
             self.send_response(200 if h["alive"] else 503)
             self.send_header("Content-Type", "application/json")
         elif path == "/":
-            body = (
-                b"distributed_neural_network_tpu run\n"
-                b"endpoints: /metrics (Prometheus), /healthz (JSON), "
-                b"/profile?steps=N (on-demand jax.profiler capture)\n"
+            text = (
+                "distributed_neural_network_tpu run\n"
+                "endpoints: /metrics (Prometheus), /healthz (JSON), "
+                "/profile?steps=N (on-demand jax.profiler capture)\n"
             )
+            # mounted route-table endpoints (the serving layer's /v1/*)
+            # listed dynamically so the index never goes stale
+            mounted = getattr(self.server, "routes", None) or {}
+            if mounted:
+                text += "routes: " + ", ".join(
+                    f"{m} {p}" for m, p in sorted(mounted)
+                ) + "\n"
+            body = text.encode()
             self.send_response(200)
             self.send_header("Content-Type", "text/plain")
         else:

@@ -149,17 +149,23 @@ class Tracer:
         # relaunches - pids change per (re)launch, ranks do not
         self.rank: int | None = None
         self.hostname: str | None = None
+        self.label: str | None = None
 
     def set_process(
-        self, *, rank: int | None = None, hostname: str | None = None
+        self, *, rank: int | None = None, hostname: str | None = None,
+        label: str | None = None,
     ) -> "Tracer":
         """Stamp this tracer's process identity. With a rank set, the
         exported Chrome document's ``process_name`` metadata becomes
         ``rank{N}`` (not the pid-keyed default) and ``otherData`` carries
         ``rank``/``hostname`` - the keys `tools/trace_merge.py` aligns
-        and labels shards by."""
+        and labels shards by. ``label`` overrides the process name for
+        non-rank processes (the serve stack exports ``serve:{port}``
+        lanes this way; the merge preserves such labels verbatim)."""
         self.rank = int(rank) if rank is not None else None
         self.hostname = hostname
+        if label is not None:
+            self.label = str(label)
         return self
 
     # ------------------------------------------------------------ recording
@@ -193,6 +199,33 @@ class Tracer:
             name, "C", (time.perf_counter_ns() - self._epoch_ns) / 1e3,
             track=track, args=dict(values),
         )
+
+    # Explicit-timestamp recording: callers that already measured an
+    # interval on this tracer's clock (``now_s()``) can land it after
+    # the fact - serve/reqtrace.py emits whole request lifecycles this
+    # way when a record finalizes.
+
+    def now_s(self) -> float:
+        """Seconds on this tracer's span clock (the ``ts`` basis)."""
+        return (time.perf_counter_ns() - self._epoch_ns) / 1e9
+
+    def complete(self, name: str, t0_s: float, t1_s: float, *,
+                 track: str | None = None, **args) -> None:
+        """Record an already-measured complete ("X") event with explicit
+        endpoints in ``now_s()`` seconds."""
+        if not self.enabled:
+            return
+        self._record(
+            name, "X", t0_s * 1e6, track=track,
+            dur_us=max(t1_s - t0_s, 0.0) * 1e6, args=args,
+        )
+
+    def instant_at(self, name: str, t_s: float, *,
+                   track: str | None = None, **args) -> None:
+        """A marker event (ph "i") at an explicit ``now_s()`` time."""
+        if not self.enabled:
+            return
+        self._record(name, "i", t_s * 1e6, track=track, args=args)
 
     # ------------------------------------------------------------ internals
 
@@ -238,9 +271,12 @@ class Tracer:
         --goodput` cross-checks its span-derived breakdown against it.
         """
         pid = os.getpid()
-        pname = (
-            f"rank{self.rank}" if self.rank is not None else "dnn-tpu-train"
-        )
+        if self.label is not None:
+            pname = self.label
+        elif self.rank is not None:
+            pname = f"rank{self.rank}"
+        else:
+            pname = "dnn-tpu-train"
         events = [
             {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
              "ts": 0, "args": {"name": pname}},

@@ -337,7 +337,7 @@ def test_int8_kv_preemption_replay_is_byte_identical(params, n_devices):
             s = eng.preempted[0]
             if not eng.kv.can_fit(s.prompt_len + 1):
                 break
-            eng.preempted.pop(0)
+            eng.preempted.popleft()
             eng.add(s)
     assert not eng.has_work()
     assert sum(s.preemptions for s in seqs) > 0, "no preemption induced"

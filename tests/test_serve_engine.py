@@ -122,7 +122,7 @@ def test_preemption_replays_exactly_and_never_restreams(params,
         ticks += 1
         eng.step()
         if eng.preempted and eng.kv.can_fit(4):
-            eng.add(eng.preempted.pop(0))
+            eng.add(eng.preempted.popleft())
     assert all(s.finished for s in seqs)
     assert sum(s.preemptions for s in seqs) > 0, "pool was never tight"
     assert eng.stall_events > 0

@@ -248,11 +248,24 @@ class EndpointSource:
         if ttft is not None and math.isfinite(ttft):
             self.ttft_history.append(ttft)
             del self.ttft_history[:-512]
+        # per-request lifecycle records (serve endpoints only); a miss
+        # must not clobber the good /metrics sample's error state
+        requests = None
+        if "serve_requests_total" in metrics:
+            err = self.error
+            body_rq = self._get("/v1/requests")
+            self.error = err
+            if body_rq:
+                try:
+                    requests = json.loads(body_rq)
+                except ValueError:
+                    pass
         return {"metrics": metrics, "health": health,
                 "loss_history": list(self.loss_history),
                 "skew_history": list(self.skew_history),
                 "qps_history": list(self.qps_history),
                 "ttft_history": list(self.ttft_history),
+                "requests": requests,
                 "source": self.base}
 
 
@@ -633,6 +646,30 @@ def render(snap: dict, *, color: bool = True, width: int = 72) -> str:
             + (f"  preempted {int(preempt)}" if preempt else "")
         )
         lines.append(kv_line)
+        # slowest in-flight requests (GET /v1/requests, serve/reqtrace):
+        # age + current state + dominant lifecycle cause per request -
+        # the tail drill-down an aggregate histogram cannot give
+        inflight = (snap.get("requests") or {}).get("in_flight") or []
+        if inflight:
+            rows = sorted(
+                inflight, key=lambda r: -(r.get("age_s") or 0.0)
+            )[:4]
+            lines.append("  slowest in-flight:")
+            for r in rows:
+                state = r.get("state", "?")
+                age = r.get("age_s")
+                pre = r.get("preemptions") or 0
+                row = (
+                    f"    #{r.get('req_id', '?')} "
+                    f"{r.get('tenant', '?')} {state}"
+                    + (f" age {age:.2f}s" if age is not None else "")
+                    + f" tok {r.get('tokens_emitted', 0)}"
+                    + (f" preempt x{pre}" if pre else "")
+                    + f" dominant {r.get('dominant_cause', '?')}"
+                )
+                if state in ("kv_alloc_stall", "preempted_wait"):
+                    row = c(RED, row)
+                lines.append(row)
     phases = m.get("phase_seconds_total") or {}
     if phases:
         lines.append(

@@ -72,7 +72,8 @@ def make_prompts(n: int, lens, vocab: int, seed: int):
 
 class RequestResult:
     __slots__ = ("idx", "status", "http_status", "tokens", "ttft_s",
-                 "gaps_s", "total_s", "error", "prompt", "cancelled_after")
+                 "gaps_s", "total_s", "error", "prompt", "cancelled_after",
+                 "req_id", "t_send_unix", "t_first_unix", "t_done_unix")
 
     def __init__(self, idx, prompt):
         self.idx = idx
@@ -85,6 +86,13 @@ class RequestResult:
         self.total_s = None
         self.error = None
         self.cancelled_after = None
+        # the client half of the client-vs-server latency join
+        # (tools/request_trace.py --client): the server's request id
+        # echoed in the done frame, plus wall-clock edges
+        self.req_id = None
+        self.t_send_unix = None
+        self.t_first_unix = None
+        self.t_done_unix = None
 
 
 def run_one(
@@ -96,6 +104,7 @@ def run_one(
     mid-flight client-disconnect probe."""
     u = urllib.parse.urlsplit(base)
     t0 = time.monotonic()
+    res.t_send_unix = time.time()
     conn = http.client.HTTPConnection(
         u.hostname, u.port or 80, timeout=timeout
     )
@@ -134,6 +143,7 @@ def run_one(
                     res.tokens.append(int(doc["token"]))
                     if res.ttft_s is None:
                         res.ttft_s = now - t0
+                        res.t_first_unix = time.time()
                     elif t_prev is not None:
                         res.gaps_s.append(now - t_prev)
                     t_prev = now
@@ -142,11 +152,15 @@ def run_one(
                         res.status = "client_cancelled"
                         res.cancelled_after = len(res.tokens)
                         res.total_s = now - t0
+                        res.t_done_unix = time.time()
                         conn.close()
                         return
                 elif doc.get("done"):
                     res.status = "completed"
                     res.total_s = now - t0
+                    res.t_done_unix = time.time()
+                    if isinstance(doc.get("req_id"), int):
+                        res.req_id = doc["req_id"]
                     return
                 elif "error" in doc:
                     res.status = "error"
@@ -324,6 +338,11 @@ def main(argv=None) -> int:
                    "generate() (rebuilds the server's seeded model "
                    "from the flags below)")
     p.add_argument("--out", default=None, help="write the JSON summary")
+    p.add_argument("--out-requests", default=None,
+                   help="write per-request JSONL (send / first-token / "
+                   "done wall clocks, client-measured TTFT/E2E, the "
+                   "server-echoed req_id) - the client half of "
+                   "tools/request_trace.py --client")
     # model geometry for --check-oracle (must mirror the server's)
     p.add_argument("--d-model", type=int, default=64)
     p.add_argument("--n-heads", type=int, default=4)
@@ -386,6 +405,27 @@ def main(argv=None) -> int:
         with open(args.out, "w") as f:
             json.dump(doc, f, indent=1)
             f.write("\n")
+    if args.out_requests:
+        with open(args.out_requests, "w") as f:
+            for r in summary["results"]:
+                f.write(json.dumps({
+                    "idx": r.idx,
+                    "req_id": r.req_id,
+                    "status": r.status,
+                    "http_status": r.http_status,
+                    "n_tokens": len(r.tokens),
+                    "ttft_s": (
+                        round(r.ttft_s, 6) if r.ttft_s is not None
+                        else None
+                    ),
+                    "e2e_s": (
+                        round(r.total_s, 6) if r.total_s is not None
+                        else None
+                    ),
+                    "t_send_unix": r.t_send_unix,
+                    "t_first_token_unix": r.t_first_unix,
+                    "t_done_unix": r.t_done_unix,
+                }) + "\n")
     if problems:
         print("LOADGEN FAILED:", file=sys.stderr)
         for prob in problems:

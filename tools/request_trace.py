@@ -1,0 +1,445 @@
+#!/usr/bin/env python
+"""request_trace: per-request tail attribution + SLO gates for the
+serving stack.
+
+Aggregate histograms (`/metrics`) can say p99 TTFT is 800 ms; they
+cannot say WHY. This tool ingests the per-request lifecycle records the
+server keeps (`serve/reqtrace.py`, exported at ``GET
+/v1/requests?full=1``) and answers the operator questions directly:
+
+- **Tail decomposition** - for TTFT and E2E at p50/p95/p99, the share
+  of the tail requests' wall-clock per cause: "p99 TTFT = 62%
+  queue_wait, 21% kv_alloc_stall, ...". TTFT attribution clips each
+  record's spans at its first-token time; E2E uses the whole lifetime.
+- **Slow-request exemplars** - the N slowest requests with their full
+  span sequences, so one bad request's story is readable end to end.
+- **SLO gates** - ``--slo ttft_p99=0.5,e2e_p95=2.0`` checks the
+  percentiles and exits shardlint-style: 0 all pass, 1 violations
+  (each printed with the dominant cause at that percentile), 2 usage.
+- **Client join** (``--client loadgen_requests.jsonl``) - joins
+  `tools/loadgen.py --out-requests` rows on the server-echoed
+  ``req_id`` and gates the client-observed vs server-attributed E2E
+  gap: the honesty rail that catches seconds the server's accounting
+  never saw (network, HTTP queueing outside the recorder).
+- **Ledger reconciliation** (``--ledger serve_record.json``) - the
+  per-request apportioned engine seconds (``engine_s``) summed across
+  records must match the serving goodput ledger's prefill / decode /
+  kv_alloc_stall buckets within ``max(--ledger-tol x bucket, 0.05 s)``
+  (causes that exist on only one side - e.g. the ledger's
+  batch_formation_idle, the records' queue_wait - are per-design
+  excluded; the records' own span conservation is asserted serverside
+  at finalize). Skipped with a warning when records were evicted from
+  the server's ring (partial sums cannot reconcile).
+
+Usage:
+  python tools/request_trace.py http://127.0.0.1:8000
+  python tools/request_trace.py requests.json --slo ttft_p99=0.5
+  python tools/request_trace.py requests.json \
+      --client loadgen_requests.jsonl --slo e2e_p95=2.0 \
+      --ledger serve_record.json
+
+SOURCE is a ``/v1/requests`` JSON dump (file) or a server base URL
+(fetched live with ``?full=1``). Stdlib-only - no jax, no repo imports.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+import urllib.request
+
+# presentation order (mirrors serve/reqtrace.py REQUEST_CAUSES)
+CAUSES = (
+    "queue_wait", "admission", "prefill", "decode",
+    "kv_alloc_stall", "preempted_wait", "stream_write",
+)
+LEDGER_CAUSES = ("prefill", "decode", "kv_alloc_stall")
+PERCENTILES = (0.50, 0.95, 0.99)
+SLO_KEYS = tuple(
+    f"{m}_p{int(q * 100)}" for m in ("ttft", "e2e") for q in PERCENTILES
+)
+
+
+def percentile(xs, q: float):
+    """Nearest-rank percentile; None when empty."""
+    if not xs:
+        return None
+    s = sorted(xs)
+    return s[max(0, math.ceil(q * len(s)) - 1)]
+
+
+def load_source(source: str) -> dict:
+    """A /v1/requests document from a file or a live server URL."""
+    if source.startswith(("http://", "https://")):
+        url = source.rstrip("/")
+        if "/v1/requests" not in url:
+            url += "/v1/requests"
+        if "?" not in url:
+            url += "?full=1"
+        with urllib.request.urlopen(url, timeout=30) as r:
+            return json.loads(r.read())
+    with open(source) as f:
+        return json.loads(f.read())
+
+
+def usable_records(doc: dict) -> list[dict]:
+    """Finalized records that carry span sequences (?full=1 dumps)."""
+    recent = doc.get("recent") or []
+    return [r for r in recent if isinstance(r.get("spans"), list)]
+
+
+def _metric_value(rec: dict, metric: str):
+    return rec.get("ttft_s") if metric == "ttft" else rec.get("e2e_s")
+
+
+def _clipped_causes(rec: dict, metric: str) -> dict:
+    """Per-cause seconds inside the metric's window: [arrival,
+    first_token] for ttft, the whole lifetime for e2e."""
+    if metric == "ttft":
+        hi = rec.get("t_first_token_rel")
+        if hi is None:
+            return {}
+    else:
+        hi = float("inf")
+    out: dict = {}
+    for cause, t0, t1 in rec.get("spans") or ():
+        lo, up = float(t0), min(float(t1), hi)
+        if up > lo:
+            out[cause] = out.get(cause, 0.0) + (up - lo)
+    return out
+
+
+def decompose(records: list[dict], metric: str, q: float):
+    """The tail at percentile q: value, size, per-cause shares."""
+    vals = [
+        (r, v) for r in records
+        if (v := _metric_value(r, metric)) is not None
+    ]
+    if not vals:
+        return None
+    pv = percentile([v for _, v in vals], q)
+    tail = [r for r, v in vals if v >= pv - 1e-12]
+    acc: dict = {}
+    for r in tail:
+        for cause, s in _clipped_causes(r, metric).items():
+            acc[cause] = acc.get(cause, 0.0) + s
+    total = sum(acc.values())
+    shares = (
+        {c: acc[c] / total for c in acc} if total > 0 else {}
+    )
+    dominant = max(shares, key=shares.get) if shares else None
+    return {
+        "value": pv, "n_tail": len(tail), "n": len(vals),
+        "shares": shares, "dominant": dominant,
+    }
+
+
+def _fmt_shares(shares: dict, limit: int = 4) -> str:
+    parts = sorted(shares.items(), key=lambda kv: -kv[1])
+    out = ", ".join(f"{s * 100:.0f}% {c}" for c, s in parts[:limit])
+    if len(parts) > limit:
+        out += ", ..."
+    return out
+
+
+def print_report(records: list[dict], doc: dict, n_exemplars: int) -> dict:
+    """The decomposition tables + exemplars; returns {slo_key: info}."""
+    counts = doc.get("counts") or {}
+    print(
+        f"Request-trace attribution: {len(records)} finalized record(s) "
+        f"with spans (server totals: {counts.get('finalized', '?')} "
+        f"finalized, {counts.get('in_flight', '?')} in flight, "
+        f"evicted {counts.get('evicted', 0)})"
+    )
+    gates: dict = {}
+    for metric, label in (("ttft", "TTFT"), ("e2e", "E2E")):
+        for q in PERCENTILES:
+            d = decompose(records, metric, q)
+            key = f"{metric}_p{int(q * 100)}"
+            gates[key] = d
+            if d is None:
+                print(f"{label:<5} p{int(q * 100):<3} n/a (no samples)")
+                continue
+            print(
+                f"{label:<5} p{int(q * 100):<3} {d['value']:8.4f}s "
+                f"({d['n_tail']}/{d['n']} in tail) = "
+                f"{_fmt_shares(d['shares'])}"
+            )
+    ranked = sorted(
+        (r for r in records if r.get("e2e_s") is not None),
+        key=lambda r: -r["e2e_s"],
+    )[:n_exemplars]
+    if ranked:
+        print(f"Slowest {len(ranked)} request(s) by E2E:")
+    for r in ranked:
+        ttft = r.get("ttft_s")
+        print(
+            f"  #{r.get('req_id')} tenant={r.get('tenant')} "
+            f"{r.get('state')} e2e={r['e2e_s']:.4f}s "
+            f"ttft={'n/a' if ttft is None else f'{ttft:.4f}s'} "
+            f"tokens={r.get('tokens_emitted')} "
+            f"preempts={r.get('preemptions', 0)}"
+        )
+        segs = [
+            f"{c} {t1 - t0:.4f}s" for c, t0, t1 in (r.get("spans") or ())
+        ]
+        shown = segs[:12]
+        tail_note = (
+            f" -> ... (+{len(segs) - 12} more)" if len(segs) > 12 else ""
+        )
+        print("      " + " -> ".join(shown) + tail_note)
+    return gates
+
+
+def parse_slo(spec: str) -> dict:
+    """``ttft_p99=0.5,e2e_p95=2.0`` -> {key: seconds}. ValueError on
+    unknown keys / bad numbers."""
+    out = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, _, val = part.partition("=")
+        key = key.strip()
+        if key not in SLO_KEYS:
+            raise ValueError(
+                f"unknown SLO key {key!r} (choose from {SLO_KEYS})"
+            )
+        try:
+            out[key] = float(val)
+        except ValueError:
+            raise ValueError(f"bad SLO threshold {val!r} for {key}")
+        if out[key] <= 0:
+            raise ValueError(f"SLO threshold for {key} must be > 0")
+    if not out:
+        raise ValueError("empty --slo spec")
+    return out
+
+
+def gate_slo(gates: dict, slo: dict) -> list[str]:
+    problems = []
+    for key, limit in sorted(slo.items()):
+        d = gates.get(key)
+        if d is None:
+            problems.append(f"{key}: no samples to evaluate the SLO")
+            continue
+        if d["value"] > limit:
+            dom = d["dominant"] or "unattributed"
+            problems.append(
+                f"{key}: {d['value']:.4f}s > SLO {limit:.4f}s - "
+                f"dominant cause {dom} "
+                f"({_fmt_shares(d['shares'])})"
+            )
+        else:
+            print(f"SLO ok: {key} {d['value']:.4f}s <= {limit:.4f}s")
+    return problems
+
+
+def load_client(path: str) -> list[dict]:
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                rows.append(json.loads(line))
+    return rows
+
+
+def gate_client(records: list[dict], rows: list[dict],
+                gap_tol: float, slack: float) -> list[str]:
+    """Join client rows on req_id; gate client-vs-server E2E gap."""
+    problems = []
+    by_id = {
+        r["req_id"]: r for r in records
+        if isinstance(r.get("req_id"), int)
+    }
+    completed = [
+        c for c in rows
+        if c.get("status") == "completed"
+        and isinstance(c.get("req_id"), int)
+        and c.get("e2e_s") is not None
+    ]
+    joined = []
+    for c in completed:
+        s = by_id.get(c["req_id"])
+        if s is not None and s.get("state") == "done" \
+                and s.get("e2e_s") is not None:
+            joined.append((c, s))
+    if completed and not joined:
+        return [
+            f"client join matched 0 of {len(completed)} completed "
+            "client rows (req_id echo broken, or the server ring "
+            "evicted them)"
+        ]
+    if not completed:
+        return ["client file has no completed rows with req_id to join"]
+    gaps = [c["e2e_s"] - s["e2e_s"] for c, s in joined]
+    p50 = percentile(gaps, 0.50)
+    p95 = percentile(gaps, 0.95)
+    worst_neg = min(gaps)
+    print(
+        f"Client join: {len(joined)}/{len(completed)} completed "
+        f"request(s) matched; client-vs-server E2E gap p50 "
+        f"{p50 * 1e3:.1f} ms, p95 {p95 * 1e3:.1f} ms, "
+        f"min {worst_neg * 1e3:.1f} ms"
+    )
+    if worst_neg < -slack:
+        problems.append(
+            f"client gap: server attributed {-worst_neg:.4f}s MORE than "
+            f"the client observed (> {slack:.3f}s slack) - the "
+            "accounting claims time that did not happen"
+        )
+    if p95 > gap_tol:
+        problems.append(
+            f"client gap: p95 {p95:.4f}s > tolerance {gap_tol:.4f}s - "
+            "the server's attribution misses too much client-visible "
+            "latency"
+        )
+    return problems
+
+
+def gate_ledger(records: list[dict], doc: dict, ledger_path: str,
+                rel_tol: float) -> list[str]:
+    """Sum per-record engine_s and reconcile vs the serve goodput
+    record's prefill/decode/kv_alloc_stall buckets."""
+    with open(ledger_path) as f:
+        rec = json.loads(f.read())
+    if rec.get("taxonomy") != "serve":
+        return [
+            f"--ledger: {ledger_path} has taxonomy "
+            f"{rec.get('taxonomy')!r}, need the serving record"
+        ]
+    evicted = (doc.get("counts") or {}).get("evicted", 0)
+    if evicted:
+        print(
+            f"WARNING: ledger reconciliation skipped - {evicted} "
+            "record(s) evicted from the server ring, per-request sums "
+            "are partial (raise --request-ring)"
+        )
+        return []
+    badput = rec.get("badput_s") or {}
+    ledger_vals = {
+        "decode": rec.get("goodput_s") or 0.0,
+        "prefill": badput.get("prefill") or 0.0,
+        "kv_alloc_stall": badput.get("kv_alloc_stall") or 0.0,
+    }
+    problems = []
+    for cause in LEDGER_CAUSES:
+        mine = sum(
+            (r.get("engine_s") or {}).get(cause, 0.0) for r in records
+        )
+        theirs = ledger_vals[cause]
+        tol = max(rel_tol * max(theirs, mine), 0.05)
+        line = (
+            f"ledger {cause}: requests {mine:.4f}s vs ledger "
+            f"{theirs:.4f}s (tol {tol:.4f}s)"
+        )
+        if abs(mine - theirs) > tol:
+            problems.append(line + " - RECONCILIATION FAILED")
+        else:
+            print(line + " - ok")
+    return problems
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument(
+        "source",
+        help="a /v1/requests?full=1 JSON dump, or the server base URL",
+    )
+    ap.add_argument(
+        "--slo", default=None,
+        help="comma list of gates, e.g. ttft_p99=0.5,e2e_p95=2.0 "
+        f"(keys: {', '.join(SLO_KEYS)})",
+    )
+    ap.add_argument(
+        "--client", default=None,
+        help="tools/loadgen.py --out-requests JSONL to join on req_id",
+    )
+    ap.add_argument(
+        "--client-gap-tol", type=float, default=0.75,
+        help="max allowed p95 client-vs-server E2E gap, seconds "
+        "(default 0.75)",
+    )
+    ap.add_argument(
+        "--client-slack", type=float, default=0.05,
+        help="allowed negative gap (server > client), seconds "
+        "(default 0.05)",
+    )
+    ap.add_argument(
+        "--ledger", default=None,
+        help="serving goodput record (--run-record output) to "
+        "reconcile per-request engine seconds against",
+    )
+    ap.add_argument(
+        "--ledger-tol", type=float, default=0.05,
+        help="relative reconciliation tolerance per cause; the gate is "
+        "max(tol x bucket, 0.05 s) (default 0.05)",
+    )
+    ap.add_argument(
+        "--exemplars", type=int, default=3,
+        help="slowest-request span sequences to print (default 3)",
+    )
+    args = ap.parse_args(argv)
+
+    slo = None
+    if args.slo:
+        try:
+            slo = parse_slo(args.slo)
+        except ValueError as e:
+            print(f"request_trace: {e}", file=sys.stderr)
+            return 2
+    try:
+        doc = load_source(args.source)
+    except (OSError, ValueError) as e:
+        print(f"request_trace: cannot load {args.source}: {e}",
+              file=sys.stderr)
+        return 2
+    records = usable_records(doc)
+    if not records:
+        print(
+            "request_trace: no finalized records with spans in the "
+            "source (fetch /v1/requests?full=1, and send traffic first)",
+            file=sys.stderr,
+        )
+        return 2
+
+    gates = print_report(records, doc, max(args.exemplars, 0))
+    problems = []
+    if slo:
+        problems += gate_slo(gates, slo)
+    if args.client:
+        try:
+            rows = load_client(args.client)
+        except (OSError, ValueError) as e:
+            print(f"request_trace: cannot load --client: {e}",
+                  file=sys.stderr)
+            return 2
+        problems += gate_client(
+            records, rows, args.client_gap_tol, args.client_slack
+        )
+    if args.ledger:
+        try:
+            problems += gate_ledger(
+                records, doc, args.ledger, args.ledger_tol
+            )
+        except (OSError, ValueError) as e:
+            print(f"request_trace: cannot load --ledger: {e}",
+                  file=sys.stderr)
+            return 2
+
+    if problems:
+        print("REQUEST_TRACE GATE FAILED:", file=sys.stderr)
+        for prob in problems:
+            print(f"  - {prob}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -120,7 +120,12 @@ def merge_shards(
     step_spans: dict[int, dict[int, dict]] = defaultdict(dict)
     for (path, doc), r in zip(shards, ranks):
         off_us = offsets[r] * 1e6
-        hostname = (doc.get("otherData") or {}).get("hostname")
+        other = doc.get("otherData") or {}
+        hostname = other.get("hostname")
+        # a shard with no rank identity and a custom process label (a
+        # serving trace's "serve:8000" request lanes) keeps its label -
+        # rewriting it to rankN would mislabel a non-rank process
+        keep_label = not isinstance(other.get("rank"), int)
         stats = doc.get("stepStats")
         if isinstance(stats, dict) and stats:
             rank_stats[str(r)] = stats
@@ -132,7 +137,14 @@ def merge_shards(
                 if ev.get("name") == "process_name":
                     seen_pname = True
                     args = dict(ev.get("args") or {})
-                    args["name"] = f"rank{r}" + (
+                    orig = str(args.get("name", ""))
+                    if keep_label and orig and not re.fullmatch(
+                        r"rank\d+", orig
+                    ) and orig != "dnn-tpu-train":
+                        label = orig
+                    else:
+                        label = f"rank{r}"
+                    args["name"] = label + (
                         f" ({hostname})" if hostname else ""
                     )
                     out["args"] = args
