@@ -248,6 +248,18 @@ def _rows(epochs: int) -> list[dict]:
             "args": {"attn": "flash", "dtype": "bfloat16", "steps": 20},
         },
         {
+            # dynamics-observatory overhead A/B at the flagship shape:
+            # plain step vs --dynamics (per-layer norm bundle in-jit +
+            # one-step-lagged DynamicsSink decode, train/dynamics.py).
+            # Asserts within_budget (<1% steady-step overhead) and
+            # final_loss_bitwise_equal (the bundle is an extra output;
+            # the update math is untouched), like the guard row above
+            "id": "lm_dynamics_overhead_d512_L8_seq2048_bf16",
+            "kind": "dynamics_overhead",
+            "est_s": 600,
+            "args": {"attn": "flash", "dtype": "bfloat16", "steps": 20},
+        },
+        {
             # live-observability overhead A/B at the flagship shape: no
             # monitoring vs the full --metrics-port stack (registry +
             # /metrics server + watchdog threads + per-step publishes,
@@ -617,6 +629,12 @@ def _run_worker(spec: dict) -> dict:
         )
 
         return measure_guard_overhead(**spec["args"])
+    if spec["kind"] == "dynamics_overhead":
+        from distributed_neural_network_tpu.train.measure import (
+            measure_dynamics_overhead,
+        )
+
+        return measure_dynamics_overhead(**spec["args"])
     if spec["kind"] == "watchdog_overhead":
         from distributed_neural_network_tpu.train.measure import (
             measure_watchdog_overhead,

@@ -99,6 +99,46 @@ def global_norm(grads, *, specs=None, axes=()):
     return jnp.sqrt(total)
 
 
+def per_leaf_sq_norms(tree, *, specs=None, axes=()):
+    """Per-leaf squared L2 norms of a pytree, sharding-aware.
+
+    Returns a tree congruent to `tree` whose leaves are f32 scalars: the
+    GLOBAL squared norm of each leaf. Same reduction logic as
+    `global_norm` (each leaf's local squared sum is psummed over exactly
+    the mesh axes its spec shards it on; replicated leaves - whose value
+    typed autodiff already psummed - contribute their local copy once),
+    but WITHOUT collapsing across leaves: the per-layer resolution is the
+    point (train/dynamics.py buckets these by the `/`-joined tree paths
+    parallel/rules.py `named_leaves` yields). Summing the returned leaves
+    and sqrt-ing reproduces `global_norm` up to float reassociation.
+    """
+    leaves, treedef = jax.tree.flatten(tree)
+    if specs is None or not axes:
+        sq_leaves = [
+            jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves
+        ]
+        return jax.tree.unflatten(treedef, sq_leaves)
+    spec_leaves = jax.tree.leaves(
+        specs, is_leaf=lambda s: isinstance(s, jax.sharding.PartitionSpec)
+    )
+    assert len(spec_leaves) == len(leaves), (len(spec_leaves), len(leaves))
+    axes = set(axes)
+    sq_leaves = []
+    for g, spec in zip(leaves, spec_leaves):
+        sq = jnp.sum(jnp.square(g.astype(jnp.float32)))
+        shard_axes = tuple(
+            a
+            for entry in spec
+            if entry is not None
+            for a in ((entry,) if isinstance(entry, str) else tuple(entry))
+            if a in axes
+        )
+        if shard_axes:
+            sq = jax.lax.psum(sq, shard_axes)
+        sq_leaves.append(sq)
+    return jax.tree.unflatten(treedef, sq_leaves)
+
+
 def clip_by_global_norm(grads, max_norm: float, *, specs=None, axes=()):
     """Scale `grads` so the global norm is at most `max_norm`.
 
@@ -157,7 +197,7 @@ def tree_where(ok, new_tree, old_tree):
     )
 
 
-def accumulate_fwd_bwd(fwd_bwd_one, accum_steps: int):
+def accumulate_fwd_bwd(fwd_bwd_one, accum_steps: int, *, sq_norm_fn=None):
     """Wrap a per-micro-batch (params, tokens, targets) -> (loss, grads)
     into a k-step gradient-accumulation scan over B/k-row slices.
 
@@ -169,8 +209,26 @@ def accumulate_fwd_bwd(fwd_bwd_one, accum_steps: int):
     which mesh axes autodiff varies over. Call inside shard_map; the
     averaged (loss, grads) match one k-times-larger batch up to float
     reassociation.
+
+    sq_norm_fn (optional, requires accum_steps >= 2): a grads -> f32
+    scalar squared-norm reducer. When set, the wrapped fwd_bwd returns a
+    THIRD output: the mean over microbatches of sq_norm_fn applied to
+    each PER-MICROBATCH gradient - i.e. E[|g_small|^2] at batch B/k, the
+    small-batch half of the gradient-noise-scale estimator
+    (train/dynamics.py gns_estimate; the accumulated |g_big|^2 comes from
+    the averaged grads the caller already has). Inside the scan the
+    per-microbatch grads are the fully synced gradients (typed autodiff
+    psums after each backward on the end schedule), so the reducer sees
+    global norms. The default (sq_norm_fn=None) path is byte-identical
+    to before.
     """
     if accum_steps == 1:
+        if sq_norm_fn is not None:
+            raise ValueError(
+                "sq_norm_fn needs accum_steps >= 2: at k=1 the micro- and "
+                "accumulated gradients coincide and the noise-scale "
+                "estimator's denominator vanishes"
+            )
         return fwd_bwd_one
 
     def fwd_bwd(params, tokens, targets):
@@ -183,21 +241,44 @@ def accumulate_fwd_bwd(fwd_bwd_one, accum_steps: int):
         mb = b_local // accum_steps
         tok_k = tokens.reshape(accum_steps, mb, -1)
         tgt_k = targets.reshape(accum_steps, mb, -1)
-        first = fwd_bwd_one(params, tok_k[0], tgt_k[0])
+        loss0, g0 = fwd_bwd_one(params, tok_k[0], tgt_k[0])
+        if sq_norm_fn is None:
+            first = (loss0, g0)
 
-        def body(carry, tt):
-            loss_acc, grads_acc = carry
+            def body(carry, tt):
+                loss_acc, grads_acc = carry
+                loss, grads = fwd_bwd_one(params, *tt)
+                return (
+                    loss_acc + loss,
+                    jax.tree.map(jnp.add, grads_acc, grads),
+                ), None
+
+            (loss_sum, grads_sum), _ = jax.lax.scan(
+                body, first, (tok_k[1:], tgt_k[1:])
+            )
+            k = jnp.float32(accum_steps)
+            return loss_sum / k, jax.tree.map(lambda g: g / k, grads_sum)
+
+        first = (loss0, g0, sq_norm_fn(g0))
+
+        def body_sq(carry, tt):
+            loss_acc, grads_acc, sq_acc = carry
             loss, grads = fwd_bwd_one(params, *tt)
             return (
                 loss_acc + loss,
                 jax.tree.map(jnp.add, grads_acc, grads),
+                sq_acc + sq_norm_fn(grads),
             ), None
 
-        (loss_sum, grads_sum), _ = jax.lax.scan(
-            body, first, (tok_k[1:], tgt_k[1:])
+        (loss_sum, grads_sum, sq_sum), _ = jax.lax.scan(
+            body_sq, first, (tok_k[1:], tgt_k[1:])
         )
         k = jnp.float32(accum_steps)
-        return loss_sum / k, jax.tree.map(lambda g: g / k, grads_sum)
+        return (
+            loss_sum / k,
+            jax.tree.map(lambda g: g / k, grads_sum),
+            sq_sum / k,
+        )
 
     return fwd_bwd
 

@@ -111,11 +111,19 @@ class StepFaultPlan:
       `spike_scale` inside the step - the EMA spike detector's in-band
       trigger. The gradients are left untouched (the simulated failure is
       a diverging loss signal, not a corrupted backward).
+    nan_layer: restrict the NaN injection to gradient leaves whose
+      `/`-joined tree path (parallel/rules.py named_leaves - the same
+      paths the dynamics provenance reports) re.search-matches this
+      pattern. The end-to-end provenance test: inject at a chosen layer,
+      assert the guard names exactly that layer. None (default) NaNs the
+      whole tree. The filter is trace-time static - un-matched leaves
+      compile to the untouched gradient.
     """
 
     nan_grads_at: tuple = ()
     spike_loss_at: tuple = ()
     spike_scale: float = 100.0
+    nan_layer: str | None = None
 
     def __post_init__(self):
         object.__setattr__(
@@ -145,9 +153,36 @@ def inject_step_faults(step_i, loss, grads, plan: StepFaultPlan):
     """
     if plan.nan_grads_at:
         bad = _at(step_i, plan.nan_grads_at)
-        grads = jax.tree.map(
-            lambda g: jnp.where(bad, jnp.asarray(jnp.nan, g.dtype), g), grads
-        )
+        if plan.nan_layer is None:
+            grads = jax.tree.map(
+                lambda g: jnp.where(bad, jnp.asarray(jnp.nan, g.dtype), g),
+                grads,
+            )
+        else:
+            # static per-leaf filter by the named_leaves path (the paths
+            # the provenance reports): only matching leaves get the
+            # traced where; the rest compile to the untouched gradient
+            import re
+
+            from .rules import named_leaves
+
+            pat = re.compile(plan.nan_layer)
+            flat = named_leaves(grads)
+            if not any(pat.search(path) for path, _ in flat):
+                raise ValueError(
+                    f"nan_layer pattern {plan.nan_layer!r} matches no "
+                    f"gradient leaf path (have: "
+                    f"{[p for p, _ in flat]})"
+                )
+            leaves = [
+                jnp.where(bad, jnp.asarray(jnp.nan, g.dtype), g)
+                if pat.search(path)
+                else g
+                for path, g in flat
+            ]
+            grads = jax.tree.unflatten(
+                jax.tree.structure(grads), leaves
+            )
     if plan.spike_loss_at:
         spike = _at(step_i, plan.spike_loss_at)
         loss = jnp.where(spike, loss * plan.spike_scale, loss)

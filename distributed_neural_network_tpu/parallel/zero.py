@@ -274,6 +274,8 @@ def make_zero_split_step(
     with_health: bool = False,
     skip_nonfinite: bool = False,
     fault_plan=None,
+    dynamics: bool = False,
+    gns: bool = False,
 ):
     """Shared two-shard_map ZeRO-1 step orchestration.
 
@@ -302,6 +304,14 @@ def make_zero_split_step(
     clipping (with clip_fn the norm runs inside the optimizer shard_map
     regardless; the health norm is the same value computed where the
     bundle needs it). `fault_plan` forces the step-index signature.
+
+    dynamics (train/dynamics.py): appends the dynamics bundle as the
+    step's LAST output, computed at the jit level where the gradients
+    are full replicated arrays - plain per-leaf squared norms, no
+    collectives. `gns=True` declares that `fwd_bwd` carries the
+    accumulation scan's third output (the mean per-microbatch squared
+    grad norm, ops/schedule.py accumulate_fwd_bwd sq_norm_fn) and
+    threads it into the bundle.
     """
     import jax.numpy as _jnp
     from jax.sharding import PartitionSpec as _P
@@ -310,7 +320,7 @@ def make_zero_split_step(
         fwd_bwd,
         mesh=mesh,
         in_specs=(specs, data_spec, data_spec),
-        out_specs=(_P(), specs),
+        out_specs=(_P(), specs) + ((_P(),) if gns else ()),
         check_vma=check_vma,
     )
 
@@ -345,11 +355,24 @@ def make_zero_split_step(
     want_health = with_health or skip_nonfinite
 
     def zero_step(params, mom, tokens, targets, step_i=None):
-        loss, grads = grad_fn(params, tokens, targets)
+        msq_small = None
+        if gns:
+            loss, grads, msq_small = grad_fn(params, tokens, targets)
+        else:
+            loss, grads = grad_fn(params, tokens, targets)
         if fault_plan is not None:
             from .fault import inject_step_faults
 
             loss, grads = inject_step_faults(step_i, loss, grads, fault_plan)
+        dyn = None
+        if dynamics:
+            # jit level: grads are full replicated arrays (zero forbids
+            # tp/ep), so the per-leaf norms need no specs/collectives
+            from ..train.dynamics import dynamics_bundle
+
+            dyn = dynamics_bundle(grads, params)
+            if gns:
+                dyn["msq_small"] = msq_small
         health = None
         if want_health:
             from ..ops.schedule import global_norm, health_bundle
@@ -359,15 +382,28 @@ def make_zero_split_step(
             lr_schedule(step_i)
         )
         new_p, new_m = opt_fn(params, mom, grads, lr_t)
-        if want_health:
-            if skip_nonfinite:
-                from ..ops.schedule import tree_where
+        if want_health and skip_nonfinite:
+            from ..ops.schedule import tree_where
 
-                ok = health["all_finite"]
-                new_p = tree_where(ok, new_p, params)
-                new_m = tree_where(ok, new_m, mom)
-            return new_p, new_m, loss, health
-        return new_p, new_m, loss
+            ok = health["all_finite"]
+            new_p = tree_where(ok, new_p, params)
+            new_m = tree_where(ok, new_m, mom)
+        if dynamics:
+            from ..ops.schedule import per_leaf_sq_norms
+
+            upd = jax.tree.map(
+                lambda n, p: n.astype(_jnp.float32)
+                - p.astype(_jnp.float32),
+                new_p,
+                params,
+            )
+            dyn["upd_sq"] = per_leaf_sq_norms(upd)
+        out = (new_p, new_m, loss)
+        if want_health:
+            out = out + (health,)
+        if dynamics:
+            out = out + (dyn,)
+        return out
 
     has_step = lr_schedule is not None or fault_plan is not None
     if has_step:

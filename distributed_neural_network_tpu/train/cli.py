@@ -162,6 +162,18 @@ def add_common_flags(p: argparse.ArgumentParser, *, epochs: int, batch_size: int
         "with --failure-duration > 0 (straggler sleeps can only interleave "
         "between epochs) or --input-mode stream",
     )
+    # training-dynamics observatory (train/dynamics.py,
+    # docs/OBSERVABILITY.md "Training dynamics")
+    p.add_argument(
+        "--dynamics",
+        action="store_true",
+        help="measure replica-divergence at each parameter-averaging "
+        "sync (max/mean per-layer parameter distance across workers, "
+        "in-jit, just before the average collapses it): live "
+        "dynamics_replica_div_* gauges, dynamics/* metrics series, and "
+        "a 'dynamics' trace track; disables --fused (the divergence "
+        "rides the per-epoch sync dispatch)",
+    )
     # self-healing guard layer (train/guard.py, docs/ROBUSTNESS.md)
     p.add_argument(
         "--guard",
@@ -328,6 +340,7 @@ def config_from_args(args, regime: str) -> TrainConfig:
         stream_prefetch=getattr(args, "stream_prefetch", 2),
         grad_sync=getattr(args, "grad_sync", "end"),
         bucket_mb=getattr(args, "bucket_mb", 4.0),
+        dynamics=getattr(args, "dynamics", False),
     )
 
 

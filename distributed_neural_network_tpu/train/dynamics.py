@@ -1,0 +1,463 @@
+"""Training-dynamics observatory: model-health telemetry from inside jit.
+
+Every observability layer so far watches the SYSTEM - wall-clock, bytes,
+goodput, latency - while the model is a black box. This module closes
+that gap with four signals computed INSIDE the compiled step (one extra
+pytree output of f32 scalars, mesh-reduced, zero host sync beyond the
+existing one-step-lagged stats fetch the guard already pays):
+
+- per-layer gradient-norm / param-norm / update-to-weight-ratio,
+  bucketed by the same ``/``-joined tree paths shardlint and the
+  partition-rules table use (parallel/rules.py ``named_leaves``);
+- a gradient-noise-scale estimator (McCandlish et al., arXiv 1812.06162)
+  from the per-microbatch vs accumulated grad norms the accumulation
+  scan in ops/schedule.py already materializes, with a derived
+  critical-batch-size readout;
+- non-finite PROVENANCE: when the guard's all-finite flag trips, the
+  first layer whose gradients went non-finite, by name (in-jit per-leaf
+  isfinite reduction - surfaced through guard anomalies, the flight
+  recorder, and the supervisor's postmortem.json);
+- replica-divergence (train/engine.py): max/mean per-layer parameter
+  distance across workers, measured just before each parameter-averaging
+  sync - the convergence-vs-communication number the source paper's
+  setup could never show.
+
+GNS formula (k = accum_steps, B_small = B/k per-microbatch tokens,
+B_big = B accumulated tokens, msq_small = E[|g_small|^2] over the k
+microbatches, sq_big = |g_big|^2 of the averaged gradient):
+
+    |G|^2_true = (B_big * sq_big - B_small * msq_small) / (B_big - B_small)
+    S_noise    = (msq_small - sq_big) / (1/B_small - 1/B_big)
+    B_crit     = S_noise / |G|^2_true
+
+Both expectations come from the SAME step, so the estimate is noisy per
+step and meant to be smoothed downstream (tools/dynamics.py renders the
+running view). Everything host-side here is one-step lagged, mirroring
+train/guard.py's HealthPipe: push step i, decode step i-1 - the device
+never idles on telemetry.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+
+# -- in-jit builders (call inside shard_map / jit) -----------------------
+
+
+def dynamics_bundle(grads, params, new_params=None, *, specs=None, axes=()):
+    """The in-jit dynamics pytree: per-leaf squared norms + provenance.
+
+    All leaves are replicated f32 scalars (per_leaf_sq_norms psums each
+    leaf's squared sum over exactly the mesh axes its spec shards it on),
+    so the bundle leaves shard_map under plain ``P()`` out-specs. Call
+    with the PRE-CLIP gradients (the noise-scale estimator compares them
+    against the unclipped per-microbatch norms) and, when the
+    update-to-weight ratio is wanted, the params before and after the
+    optimizer update. ``first_bad`` is the index (in jax.tree.leaves
+    order == named_leaves order) of the first gradient leaf whose squared
+    norm went non-finite, or -1 - squares and sums propagate NaN/Inf, so
+    one scalar per leaf is a complete isfinite reduction.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.schedule import per_leaf_sq_norms
+
+    grad_sq = per_leaf_sq_norms(grads, specs=specs, axes=axes)
+    param_sq = per_leaf_sq_norms(params, specs=specs, axes=axes)
+    bad = ~jnp.isfinite(jnp.stack(jax.tree.leaves(grad_sq)))
+    first_bad = jnp.where(
+        jnp.any(bad), jnp.argmax(bad), jnp.int32(-1)
+    ).astype(jnp.int32)
+    bundle = {
+        "grad_sq": grad_sq,
+        "param_sq": param_sq,
+        "first_bad": first_bad,
+    }
+    if new_params is not None:
+        upd = jax.tree.map(
+            lambda n, p: n.astype(jnp.float32) - p.astype(jnp.float32),
+            new_params,
+            params,
+        )
+        bundle["upd_sq"] = per_leaf_sq_norms(upd, specs=specs, axes=axes)
+    return bundle
+
+
+def dynamics_out_specs(specs, *, with_upd: bool = True,
+                       with_gns: bool = False):
+    """out_specs pytree matching ``dynamics_bundle``'s structure.
+
+    Every bundle leaf is a replicated scalar, so every spec is ``P()`` -
+    but shard_map needs the PYTREE SHAPE to match, hence the map over the
+    param spec tree (``specs`` may be None for unsharded callers such as
+    the ZeRO jit-level path, where a plain dict of P() scalars suffices
+    is not needed at all).
+    """
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    scalar_tree = jax.tree.map(
+        lambda _: P(),
+        specs,
+        is_leaf=lambda s: isinstance(s, jax.sharding.PartitionSpec),
+    )
+    out = {
+        "grad_sq": scalar_tree,
+        "param_sq": scalar_tree,
+        "first_bad": P(),
+    }
+    if with_upd:
+        out["upd_sq"] = scalar_tree
+    if with_gns:
+        out["msq_small"] = P()
+    return out
+
+
+def replica_divergence(params, axis_name):
+    """Per-leaf parameter distance across an averaging group, in-jit.
+
+    For each leaf, every worker computes its distance to the group mean
+    ``d_w = |p_w - pmean(p)|_2`` and the group reduces it both ways:
+    returns ``(div_mean, div_max)`` - two trees congruent to ``params``
+    of replicated f32 scalars. Call inside the sync shard_map BEFORE the
+    averaging collapses the spread (train/engine.py); a healthy
+    local-SGD/post-local regime shows divergence growing between syncs
+    and snapping to ~0 after each one, and the max/mean ratio names
+    stragglers drifting from the pack.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    leaves, treedef = jax.tree.flatten(params)
+    means, maxes = [], []
+    for p in leaves:
+        p32 = p.astype(jnp.float32)
+        mean = jax.lax.pmean(p32, axis_name)
+        d = jnp.sqrt(jnp.sum(jnp.square(p32 - mean)))
+        means.append(jax.lax.pmean(d, axis_name))
+        maxes.append(jax.lax.pmax(d, axis_name))
+    return jax.tree.unflatten(treedef, means), jax.tree.unflatten(
+        treedef, maxes
+    )
+
+
+# -- host-side math ------------------------------------------------------
+
+
+def gns_estimate(msq_small, sq_big, *, b_small: float, b_big: float):
+    """Gradient-noise-scale readout from one step's two norm estimates.
+
+    Pure float math (host-side, after the device fetch). Returns a dict
+    {grad_sq_true, noise_scale, crit_batch_size, b_small, b_big} or None
+    when the estimate is degenerate: non-finite inputs, b_big <= b_small
+    (no accumulation -> the unbiased difference estimator's denominator
+    vanishes), or a non-positive |G|^2_true (sampling noise near
+    convergence can drive the difference negative - a smoothed consumer
+    should skip such steps, not clamp them).
+    """
+    if not (
+        isinstance(msq_small, (int, float))
+        and isinstance(sq_big, (int, float))
+        and math.isfinite(msq_small)
+        and math.isfinite(sq_big)
+    ):
+        return None
+    if b_big <= b_small or b_small <= 0:
+        return None
+    grad_sq_true = (b_big * sq_big - b_small * msq_small) / (
+        b_big - b_small
+    )
+    noise = (msq_small - sq_big) / (1.0 / b_small - 1.0 / b_big)
+    if not (math.isfinite(grad_sq_true) and grad_sq_true > 0.0):
+        return None
+    return {
+        "grad_sq_true": grad_sq_true,
+        "noise_scale": noise,
+        "crit_batch_size": noise / grad_sq_true,
+        "b_small": b_small,
+        "b_big": b_big,
+    }
+
+
+def first_bad_layer(paths, first_bad) -> str | None:
+    """Map the in-jit ``first_bad`` leaf index back to its layer path."""
+    i = int(first_bad)
+    if 0 <= i < len(paths):
+        return paths[i]
+    return None
+
+
+def _finite_or_none(v):
+    f = float(v)
+    return f if math.isfinite(f) else None
+
+
+def decode_bundle(paths, bundle, *, eps: float = 1e-12):
+    """Host-side decode of a fetched bundle into one JSONL-able row.
+
+    ``paths`` is the static ``named_leaves`` path list (computed once at
+    wiring time from the abstract params - jax.tree.leaves order, the
+    same order ``first_bad`` indexes). Non-finite values serialize as
+    null (the utils/metrics.py convention: strict parsers never see a
+    bare NaN token) with the provenance carried in ``bad_layer``.
+    update-to-weight ratio = |delta| / (|w| + eps), the classic
+    learning-dynamics health number (~1e-3 is the folk-healthy band).
+    """
+    import jax
+
+    grad_sq = [float(x) for x in jax.tree.leaves(bundle["grad_sq"])]
+    param_sq = [float(x) for x in jax.tree.leaves(bundle["param_sq"])]
+    upd_sq = (
+        [float(x) for x in jax.tree.leaves(bundle["upd_sq"])]
+        if "upd_sq" in bundle
+        else None
+    )
+    assert len(grad_sq) == len(paths), (len(grad_sq), len(paths))
+    layers = {}
+    for i, path in enumerate(paths):
+        g = math.sqrt(grad_sq[i]) if grad_sq[i] >= 0 else float("nan")
+        p = math.sqrt(param_sq[i]) if param_sq[i] >= 0 else float("nan")
+        entry = {
+            "grad_norm": _finite_or_none(g),
+            "param_norm": _finite_or_none(p),
+        }
+        if upd_sq is not None:
+            u = math.sqrt(upd_sq[i]) if upd_sq[i] >= 0 else float("nan")
+            entry["upd_ratio"] = _finite_or_none(u / (p + eps))
+        layers[path] = entry
+    total_sq = math.fsum(grad_sq)
+    row = {
+        "grad_norm": _finite_or_none(
+            math.sqrt(total_sq) if total_sq >= 0 else float("nan")
+        ),
+        "param_norm": _finite_or_none(
+            math.sqrt(s) if (s := math.fsum(param_sq)) >= 0 else float("nan")
+        ),
+        "layers": layers,
+        "bad_layer": first_bad_layer(paths, bundle["first_bad"]),
+    }
+    ratios = [
+        v["upd_ratio"]
+        for v in layers.values()
+        if v.get("upd_ratio") is not None
+    ]
+    row["upd_ratio_max"] = max(ratios) if ratios else None
+    grad_norms = [
+        v["grad_norm"] for v in layers.values()
+        if v["grad_norm"] is not None
+    ]
+    row["layer_grad_norm_max"] = max(grad_norms) if grad_norms else None
+    if "msq_small" in bundle:
+        row["msq_small"] = _finite_or_none(float(bundle["msq_small"]))
+        row["sq_big"] = _finite_or_none(total_sq)
+    return row
+
+
+# -- the host sink (one-step lagged, HealthPipe cadence) -----------------
+
+
+class DynamicsSink:
+    """Streams decoded dynamics rows to JSONL + gauges + trace counters.
+
+    One-step-lagged like train/guard.py's HealthPipe: ``push(i, bundle)``
+    decodes step i-1's stashed bundle (whose transfer overlapped step
+    i's device work) and stashes i. The loop MUST push the sink before
+    the health pipe so that when the guard judges step i-1 the
+    provenance for it (``bad_layer(i-1)``) is already decoded. ``clear``
+    drops the pending stash on rollback (its step never retired);
+    ``flush`` drains the last stash at loop exit.
+    """
+
+    def __init__(
+        self,
+        paths,
+        *,
+        jsonl_path=None,
+        registry=None,
+        tracer=None,
+        b_small=None,
+        b_big=None,
+        keep_provenance: int = 64,
+    ):
+        from ..utils.obs import NULL_REGISTRY
+        from ..utils.tracing import NULL_TRACER
+
+        self.paths = list(paths)
+        self.registry = registry if registry is not None else NULL_REGISTRY
+        self.tracer = tracer if tracer is not None else NULL_TRACER
+        self.b_small = b_small
+        self.b_big = b_big
+        self._pending = None
+        self._bad = {}  # step -> layer path (bounded ring)
+        self._keep = int(keep_provenance)
+        self.rows_written = 0
+        self._f = None
+        if jsonl_path:
+            os.makedirs(os.path.dirname(jsonl_path) or ".", exist_ok=True)
+            self._f = open(jsonl_path, "a", buffering=1)
+        r = self.registry
+        self._g_grad = r.gauge(
+            "dynamics_grad_norm", "global gradient L2 norm (pre-clip)"
+        )
+        self._g_param = r.gauge(
+            "dynamics_param_norm", "global parameter L2 norm"
+        )
+        self._g_upd = r.gauge(
+            "dynamics_upd_ratio_max",
+            "max per-layer update-to-weight ratio",
+        )
+        self._g_layer_grad = r.gauge(
+            "dynamics_layer_grad_norm", "per-layer gradient L2 norm"
+        )
+        self._g_layer_upd = r.gauge(
+            "dynamics_layer_upd_ratio",
+            "per-layer update-to-weight ratio",
+        )
+        self._g_gns = r.gauge(
+            "dynamics_gns_noise_scale",
+            "gradient noise scale (McCandlish simple estimator)",
+        )
+        self._g_crit = r.gauge(
+            "dynamics_crit_batch_size",
+            "critical batch size derived from the noise scale",
+        )
+        self._c_nonfinite = r.counter(
+            "dynamics_nonfinite_rows_total",
+            "dynamics rows with a non-finite gradient leaf",
+        )
+
+    def push(self, step: int, bundle) -> None:
+        prev, self._pending = self._pending, (int(step), bundle)
+        if prev is not None:
+            self._drain(*prev)
+
+    def flush(self) -> None:
+        prev, self._pending = self._pending, None
+        if prev is not None:
+            self._drain(*prev)
+
+    def clear(self) -> None:
+        """Rollback: the stashed step never retired - drop it."""
+        self._pending = None
+
+    def bad_layer(self, step: int):
+        """Provenance lookup for the guard: first non-finite layer of
+        ``step``, or None (finite, not yet decoded, or evicted)."""
+        return self._bad.get(int(step))
+
+    def close(self) -> None:
+        self.flush()
+        if self._f is not None and not self._f.closed:
+            self._f.close()
+
+    # internal ----------------------------------------------------------
+
+    def _drain(self, step: int, bundle) -> None:
+        import jax
+
+        row = decode_bundle(self.paths, jax.device_get(bundle))
+        row["step"] = step
+        row["t"] = time.time()
+        if row.get("bad_layer") is not None:
+            self._bad[step] = row["bad_layer"]
+            while len(self._bad) > self._keep:
+                self._bad.pop(next(iter(self._bad)))
+            self._c_nonfinite.inc()
+        gns = None
+        if (
+            row.get("msq_small") is not None
+            and row.get("sq_big") is not None
+            and self.b_small
+            and self.b_big
+        ):
+            gns = gns_estimate(
+                row["msq_small"],
+                row["sq_big"],
+                b_small=self.b_small,
+                b_big=self.b_big,
+            )
+            # batch sizes ride every row (not just the gns dict): the
+            # per-step estimate is often degenerate/None, but
+            # tools/dynamics.py re-estimates from run-averaged norms and
+            # needs the B's even when no single step yielded an estimate
+            row["b_small"] = self.b_small
+            row["b_big"] = self.b_big
+        row["gns"] = gns
+        self._publish(step, row)
+        self.rows_written += 1
+        if self._f is not None:
+            # allow_nan=False backstop: decode_bundle already nulled
+            # every non-finite float, so a bare NaN reaching json.dumps
+            # is a bug worth crashing on (utils/metrics.py convention)
+            self._f.write(json.dumps(row, allow_nan=False) + "\n")
+
+    def _publish(self, step: int, row) -> None:
+        if row["grad_norm"] is not None:
+            self._g_grad.set(row["grad_norm"])
+        if row["param_norm"] is not None:
+            self._g_param.set(row["param_norm"])
+        if row["upd_ratio_max"] is not None:
+            self._g_upd.set(row["upd_ratio_max"])
+        grad_track, upd_track = {}, {}
+        for path, entry in row["layers"].items():
+            if entry["grad_norm"] is not None:
+                self._g_layer_grad.labels(layer=path).set(
+                    entry["grad_norm"]
+                )
+                grad_track[path] = entry["grad_norm"]
+            u = entry.get("upd_ratio")
+            if u is not None:
+                self._g_layer_upd.labels(layer=path).set(u)
+                upd_track[path] = u
+        gns = row.get("gns")
+        if gns is not None:
+            self._g_gns.set(gns["noise_scale"])
+            self._g_crit.set(gns["crit_batch_size"])
+        if grad_track:
+            self.tracer.counter(
+                "dynamics grad_norm", grad_track, track="dynamics"
+            )
+        if upd_track:
+            self.tracer.counter(
+                "dynamics upd_ratio", upd_track, track="dynamics"
+            )
+        if gns is not None:
+            self.tracer.counter(
+                "dynamics gns",
+                {
+                    "noise_scale": gns["noise_scale"],
+                    "crit_batch_size": gns["crit_batch_size"],
+                },
+                track="dynamics",
+            )
+
+
+def decode_divergence(paths, div_mean, div_max):
+    """Host-side decode of the replica-divergence trees into one row:
+    {"layers": {path: {"mean", "max"}}, "div_mean", "div_max"} with the
+    global numbers aggregated across layers (max of maxes; L2-combined
+    means, so the global mean matches a whole-tree distance)."""
+    import jax
+
+    means = [float(x) for x in jax.tree.leaves(div_mean)]
+    maxes = [float(x) for x in jax.tree.leaves(div_max)]
+    assert len(means) == len(paths), (len(means), len(paths))
+    layers = {
+        p: {"mean": _finite_or_none(m), "max": _finite_or_none(x)}
+        for p, m, x in zip(paths, means, maxes)
+    }
+    finite_means = [m for m in means if math.isfinite(m)]
+    finite_maxes = [x for x in maxes if math.isfinite(x)]
+    return {
+        "layers": layers,
+        "div_mean": _finite_or_none(
+            math.sqrt(math.fsum(m * m for m in finite_means))
+        )
+        if finite_means
+        else None,
+        "div_max": max(finite_maxes) if finite_maxes else None,
+    }

@@ -103,6 +103,13 @@ class TrainConfig:
     # compute). Identical values either way; no effect in "epoch" mode.
     grad_sync: str = "end"
     bucket_mb: float = 4.0
+    # training-dynamics observatory (train/dynamics.py): measure per-layer
+    # replica divergence (each worker's parameter distance to the group
+    # mean, pmean/pmax-reduced) inside the sync dispatch, just BEFORE the
+    # averaging collapses the spread - the convergence-vs-communication
+    # number the paper's regimes differ on. Default-off keeps the sync
+    # program (and its shardlint manifest) byte-identical.
+    dynamics: bool = False
 
     def __post_init__(self):
         if self.regime not in REGIMES:
@@ -467,9 +474,20 @@ class Engine:
             )
         )
 
+        dyn = c.dynamics
+
         def sync_shard(params_stacked, live, loss_sums, n_batches):
             params_local = jax.tree.map(lambda x: x[0], params_stacked)
             w = live[0]
+            if dyn:
+                # measured BEFORE the average collapses the spread, over
+                # ALL workers (a dead/straggling replica's drift from the
+                # pack is exactly what the max should expose)
+                from .dynamics import replica_divergence
+
+                div_mean, div_max = replica_divergence(
+                    params_local, DATA_AXIS
+                )
             avg = masked_pmean_tree(params_local, w, DATA_AXIS)
             # all-dead epochs degrade to a plain mean (masked_pmean_tree
             # semantics) - count every device's loss too, so the reported
@@ -479,17 +497,40 @@ class Engine:
             train_loss = weighted_mean_scalar(
                 loss_sums[0] * w, n_batches[0] * w, DATA_AXIS
             )
+            if dyn:
+                return avg, train_loss, div_mean, div_max
             return avg, train_loss
 
+        scalar_specs = jax.tree.map(lambda _: P(), self.params)
+        sync_out = (P(), P()) + (
+            (scalar_specs, scalar_specs) if dyn else ()
+        )
         self._sync_fn = jax.jit(
             compat.shard_map(
                 sync_shard,
                 mesh=mesh,
                 in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
-                out_specs=(P(), P()),
+                out_specs=sync_out,
             ),
             donate_argnums=(0,),
         )
+        if dyn:
+            from ..parallel.rules import named_leaves
+
+            self.dyn_paths = [p for p, _ in named_leaves(self.params)]
+            self._m_div_mean = self.registry.gauge(
+                "dynamics_replica_div_mean",
+                "mean worker parameter distance to the group mean at sync",
+            )
+            self._m_div_max = self.registry.gauge(
+                "dynamics_replica_div_max",
+                "max worker parameter distance to the group mean at sync",
+            )
+            self._m_div_layer = self.registry.gauge(
+                "dynamics_layer_replica_div",
+                "per-layer max worker distance to the group mean at sync",
+            )
+        self.last_divergence = None
 
         if self.test_images is not None:
             eval_bs = c.eval_batch_size or c.batch_size
@@ -540,7 +581,7 @@ class Engine:
             },
             "sync": {
                 "in": (P(DATA_AXIS),) * 4,
-                "out": (P(), P()),
+                "out": sync_out,
                 "donate": (0,),
             },
             "eval": {
@@ -924,6 +965,34 @@ class Engine:
         )
         return params_stacked, loss_sums, n_batches
 
+    def _publish_divergence(self, epoch: int, div_mean, div_max) -> None:
+        """Decode + publish one replica-divergence sample (sync phase).
+
+        Host cost is one small fetch of per-leaf scalars per sync - the
+        sync result is fetched for train_loss anyway. Surfaces: gauges
+        (dynamics_replica_div_mean/max + per-layer), a counter track on
+        the dynamics trace lane, and `last_divergence` for the run()-level
+        JSONL series sink.
+        """
+        from .dynamics import decode_divergence
+
+        row = decode_divergence(self.dyn_paths, div_mean, div_max)
+        row["epoch"] = epoch
+        self.last_divergence = row
+        if row["div_mean"] is not None:
+            self._m_div_mean.set(row["div_mean"])
+        if row["div_max"] is not None:
+            self._m_div_max.set(row["div_max"])
+        track = {}
+        for path, entry in row["layers"].items():
+            if entry["max"] is not None:
+                self._m_div_layer.labels(layer=path).set(entry["max"])
+                track[path] = entry["max"]
+        if track:
+            self.tracer.counter(
+                "replica divergence", track, track=TR.DYNAMICS
+            )
+
     def run_epoch(
         self, epoch: int, *, timers: T.PhaseTimers | None = None, do_eval: bool = True
     ) -> EpochMetrics:
@@ -971,10 +1040,13 @@ class Engine:
         with tracer.span(TR.SYNC, track="sync", step=epoch):
             with timers.phase(T.COMMUNICATION) as t:
                 mask_dev = distribute_host_data(mask_host, self.mesh, P(DATA_AXIS))
-                self.params, train_loss = self._sync_fn(
+                sync_out = self._sync_fn(
                     params_stacked, mask_dev, loss_sums, n_batches
                 )
+                self.params, train_loss = sync_out[0], sync_out[1]
                 t.value = (self.params, train_loss)
+        if self.config.dynamics:
+            self._publish_divergence(epoch, sync_out[2], sync_out[3])
         # goodput: train + sync together are the epoch's training
         # progress (the reference's two progress phases); eval and
         # host bookkeeping below fall to idle_other honestly
@@ -1071,6 +1143,12 @@ class Engine:
                 "dispatch; --guard uses the per-epoch path)"
             )
             fused = False
+        if fused and self.config.dynamics:
+            log(
+                "(fused mode runs sync inside one dispatch; --dynamics "
+                "replica-divergence uses the per-epoch path)"
+            )
+            fused = False
         if fused:
             return self._run_fused(
                 timers=timers,
@@ -1130,6 +1208,12 @@ class Engine:
             log(f"Global Average Training Loss: {m.train_loss}")
             if run is not None:
                 run.append("train/loss", m.train_loss)
+                d = self.last_divergence
+                if d is not None and d.get("epoch") == epoch:
+                    if d["div_mean"] is not None:
+                        run.append("dynamics/replica_div_mean", d["div_mean"])
+                    if d["div_max"] is not None:
+                        run.append("dynamics/replica_div_max", d["div_max"])
             if m.val_acc is not None:
                 log(f"Validation loss of updated master model:  {m.val_loss}")
                 log(f"Validation Accuracy: {m.val_acc:.2f} %")

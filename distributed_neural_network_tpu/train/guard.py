@@ -175,10 +175,18 @@ class TrainingGuard:
         step_stats=None,
         registry=None,
         log=print,
+        provenance=None,
     ):
         self.cfg = config if config is not None else GuardConfig()
         self.tracer = tracer
         self.step_stats = step_stats
+        # non-finite provenance (train/dynamics.py DynamicsSink.bad_layer):
+        # a `step -> layer-path-or-None` lookup naming the first layer
+        # whose gradients went non-finite at that step. Consulted only on
+        # the nonfinite anomaly path; the layer lands in the verdict
+        # reason, the guard trace instant, and the flight event (whence
+        # the supervisor's postmortem.json picks it up).
+        self.provenance = provenance
         # live-metrics registry (utils/obs.py; None/NULL_REGISTRY = off):
         # anomaly/rollback counters surface on /metrics while the run is
         # alive, not only in the post-hoc trace/StepStats
@@ -197,6 +205,15 @@ class TrainingGuard:
             "guard_lr_scale", "Cumulative guard LR-backoff factor"
         )
         self._lr_scale_gauge.set(1.0)
+        # headroom BEFORE a trip: the z-score of every healthy observation
+        # against the EMA baseline (0 while the detector warms up), next
+        # to the --guard-spike-zscore threshold it is judged against
+        self._zscore_gauge = registry.gauge(
+            "guard_spike_zscore",
+            "Last observed loss z-score vs the spike detector's EMA "
+            "(0 during warmup)",
+        )
+        self._zscore_gauge.set(0.0)
         self.log = log
         self.detector = SpikeDetector(
             decay=self.cfg.ema_decay, warmup=self.cfg.warmup_steps
@@ -276,13 +293,19 @@ class TrainingGuard:
             finite = finite and bool(all_finite)
 
         if not finite:
-            return self._anomaly(
-                step, "nonfinite",
-                f"non-finite step (loss={loss}, grad_norm={grad_norm}, "
-                f"all_finite={all_finite})",
-                None,
+            layer = (
+                self.provenance(step) if self.provenance is not None
+                else None
             )
+            reason = (
+                f"non-finite step (loss={loss}, grad_norm={grad_norm}, "
+                f"all_finite={all_finite})"
+            )
+            if layer is not None:
+                reason += f"; first non-finite grads in layer {layer!r}"
+            return self._anomaly(step, "nonfinite", reason, None, layer=layer)
         z = self.detector.check(loss)
+        self._zscore_gauge.set(z if z is not None else 0.0)
         if z is not None and z > self.cfg.spike_zscore:
             return self._anomaly(
                 step, "spikes",
@@ -297,7 +320,7 @@ class TrainingGuard:
             self.retries_used = 0  # incident closed: refill the budget
         return Verdict(action="ok", step=step)
 
-    def _anomaly(self, step, kind, reason, zscore) -> Verdict:
+    def _anomaly(self, step, kind, reason, zscore, *, layer=None) -> Verdict:
         self.counters[kind] += 1
         self._anomaly_counter.labels(kind=kind).inc()
         self._healthy_streak = 0
@@ -314,16 +337,17 @@ class TrainingGuard:
             self.counters["skipped"] += 1
         elif action == "warn":
             self.counters["warnings"] += 1
+        extra = {} if layer is None else {"layer": layer}
         if self.tracer is not None:
             self.tracer.instant(
                 "guard", track="guard", step=int(step), action=action,
-                kind=kind, zscore=zscore,
+                kind=kind, zscore=zscore, **extra,
             )
         from ..utils.obs import flight_event
 
         flight_event(
             "guard_anomaly", step=int(step), action=action, anomaly=kind,
-            zscore=zscore,
+            zscore=zscore, **extra,
         )
         if self.step_stats is not None:
             self.step_stats.count_anomaly(kind)

@@ -335,6 +335,7 @@ def make_lm_train_step(
     skip_nonfinite: bool = False,
     fault_plan=None,
     rules=None,
+    dynamics: bool = False,
 ):
     """Compiled (params, mom, tokens, targets) -> (params, mom, loss).
 
@@ -403,6 +404,13 @@ def make_lm_train_step(
       ``--sharding rules:<file>`` path). Every param leaf must match;
       zero optimizers additionally require the matched specs to be
       fully replicated.
+    - dynamics: the step additionally returns a training-dynamics bundle
+      as its LAST output (train/dynamics.py dynamics_bundle): per-leaf
+      squared grad/param/update norms (mesh-reduced f32 scalars), the
+      first-non-finite-leaf index for provenance, and - when
+      grad_sync='end' with accum_steps >= 2 - the mean per-microbatch
+      squared grad norm feeding the gradient-noise-scale estimator.
+      Default-off leaves the compiled program unchanged.
     """
     sp, tp, ep, sync_axes, specs, mom_spec, data_spec = lm_wiring(
         cfg, mesh, optimizer, rules=rules
@@ -495,19 +503,59 @@ def make_lm_train_step(
             )
             return inner(params, tokens, targets)
     else:
-        fwd_bwd = accumulate_fwd_bwd(fwd_bwd_one, accum_steps)
+        all_axes_early = tuple(mesh.axis_names)
+        # GNS needs the per-microbatch grad norms the end-schedule scan
+        # already synchronizes (typed autodiff psums after each backward);
+        # the overlap schedule's in-scan grads are local pre-reduction
+        # partials, so the estimator stays off there
+        want_gns = dynamics and accum_steps >= 2
 
+        sq_norm_fn = None
+        if want_gns:
+            from ..ops.schedule import per_leaf_sq_norms
+
+            def sq_norm_fn(g):
+                return sum(
+                    jax.tree.leaves(
+                        per_leaf_sq_norms(
+                            g, specs=specs, axes=all_axes_early
+                        )
+                    )
+                )
+
+        fwd_bwd = accumulate_fwd_bwd(
+            fwd_bwd_one, accum_steps, sq_norm_fn=sq_norm_fn
+        )
+
+    want_gns = (
+        dynamics and grad_sync == "end" and accum_steps >= 2
+    )
     if fault_plan is not None and not fault_plan:
         fault_plan = None  # empty plan compiles nothing
     want_health = with_health or skip_nonfinite
     all_axes = tuple(mesh.axis_names)
 
     def step(params, mom, tokens, targets, step_i=None):
-        loss, grads = fwd_bwd(params, tokens, targets)
+        msq_small = None
+        if want_gns:
+            loss, grads, msq_small = fwd_bwd(params, tokens, targets)
+        else:
+            loss, grads = fwd_bwd(params, tokens, targets)
         if fault_plan is not None:
             from ..parallel.fault import inject_step_faults
 
             loss, grads = inject_step_faults(step_i, loss, grads, fault_plan)
+        dyn = None
+        if dynamics:
+            # pre-clip gradients: the noise-scale estimator compares
+            # against the (unclipped) per-microbatch norms, and the
+            # provenance scalars must see the anomaly clipping rescales
+            from .dynamics import dynamics_bundle
+
+            dyn = dynamics_bundle(grads, params, specs=specs, axes=all_axes)
+            if want_gns:
+                dyn["msq_small"] = msq_small
+            params_before = params
         norm = None
         if clip_norm > 0.0:
             from ..ops.schedule import clip_by_global_norm
@@ -557,9 +605,23 @@ def make_lm_train_step(
             from ..ops.schedule import apply_decoupled_weight_decay
 
             params = apply_decoupled_weight_decay(params, lr_t, weight_decay)
+        if dynamics:
+            from ..ops.schedule import per_leaf_sq_norms
+
+            upd = jax.tree.map(
+                lambda n, p: n.astype(jnp.float32) - p.astype(jnp.float32),
+                params,
+                params_before,
+            )
+            dyn["upd_sq"] = per_leaf_sq_norms(
+                upd, specs=specs, axes=all_axes
+            )
+        out = (params, mom, loss)
         if want_health:
-            return params, mom, loss, health
-        return params, mom, loss
+            out = out + (health,)
+        if dynamics:
+            out = out + (dyn,)
+        return out
 
     # attn='flash' composes with dp x tp meshes since round 4: the own
     # Pallas kernels (ops/flash_pallas.py) stamp vma-typed outputs, so the
@@ -611,9 +673,16 @@ def make_lm_train_step(
             lr_schedule=lr_schedule, clip_fn=clip_fn, axis_name=DATA_AXIS,
             check_vma=check_vma, with_health=with_health,
             skip_nonfinite=skip_nonfinite, fault_plan=fault_plan,
+            dynamics=dynamics, gns=want_gns,
         )
 
     out_specs = (specs, mom_spec, P()) + ((P(),) if want_health else ())
+    if dynamics:
+        from .dynamics import dynamics_out_specs
+
+        out_specs = out_specs + (
+            dynamics_out_specs(specs, with_upd=True, with_gns=want_gns),
+        )
     if has_step:
         return jax.jit(
             compat.shard_map(

@@ -950,6 +950,100 @@ def measure_guard_overhead(
     }
 
 
+def measure_dynamics_overhead(
+    *,
+    d_model: int = 512,
+    n_layers: int = 8,
+    n_heads: int = 8,
+    d_ff: int = 2048,
+    vocab: int = 32768,
+    seq_len: int = 2048,
+    batch: int = 16,
+    steps: int = 20,
+    warmup: int = 2,
+    attn: str = "flash",
+    dtype: str = "bfloat16",
+    budget_pct: float = 1.0,
+) -> dict:
+    """Dynamics-observatory A/B: the identical LM config with
+    ``--dynamics`` off vs on (per-layer norm bundle compiled into the
+    step + the one-step-lagged DynamicsSink decode, train/dynamics.py).
+
+    Two claims, both asserted into the returned row:
+    - ``within_budget``: the steady-state step-time overhead is under
+      `budget_pct` (default 1%) - the per-leaf squared-norm reductions
+      are O(params) elementwise flops over tensors the backward already
+      produced (vs the O(params * seq * batch) matmuls of the step), and
+      the sink's decode rides the same lagged fetch cadence as the
+      guard, never fencing the dispatch pipeline.
+    - ``final_loss_bitwise_equal``: dynamics is observation-only - the
+      bundle is an extra OUTPUT of the step, the update math is
+      untouched, so the final loss is BIT-IDENTICAL to the plain run's.
+    """
+    import jax.numpy as jnp
+
+    from ..models import transformer as tfm
+    from ..parallel.rules import named_leaves
+    from . import lm as lmtrain
+    from .dynamics import DynamicsSink
+
+    cfg = tfm.TransformerConfig(
+        vocab_size=vocab, d_model=d_model, n_heads=n_heads,
+        n_layers=n_layers, d_ff=d_ff,
+        dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32,
+    )
+    mesh = lmtrain.create_lm_mesh(1, 1, 1)
+    params0 = tfm.init_params(jax.random.key(0), cfg)
+    tokens, targets = lmtrain.make_copy_task(
+        jax.random.key(1), batch=batch, seq_len=seq_len, vocab=vocab
+    )
+    from ..utils.timers import fence_rtt, hard_block
+
+    def run(dyn_on: bool):
+        params, _ = lmtrain.shard_params(params0, cfg, mesh)
+        mom = lmtrain.init_lm_momentum(params, mesh)
+        step = lmtrain.make_lm_train_step(
+            cfg, mesh, lr=0.01, attn_impl=attn, dynamics=dyn_on,
+        )
+        sink = None
+        if dyn_on:
+            sink = DynamicsSink([p for p, _ in named_leaves(params)])
+        loss = None
+        for i in range(max(warmup, 1)):
+            out = step(params, mom, tokens, targets)
+            params, mom, loss = out[0], out[1], out[2]
+        hard_block(loss)
+        rtt = fence_rtt(loss)
+        t0 = time.perf_counter()
+        for i in range(steps):
+            out = step(params, mom, tokens, targets)
+            params, mom, loss = out[0], out[1], out[2]
+            if sink is not None:
+                sink.push(i, out[3])
+        if sink is not None:
+            sink.flush()
+        hard_block(loss)
+        dt = max(time.perf_counter() - t0 - rtt, 1e-9)
+        return dt, float(loss)
+
+    base_dt, base_loss = run(False)
+    dyn_dt, dyn_loss = run(True)
+    overhead_pct = (dyn_dt / base_dt - 1.0) * 100.0
+    tok = batch * seq_len * steps
+    return {
+        "d_model": d_model, "n_layers": n_layers, "seq_len": seq_len,
+        "batch": batch, "steps": steps, "dtype": dtype, "attn": attn,
+        "device_kind": jax.devices()[0].device_kind,
+        "base_tokens_per_s": round(tok / base_dt),
+        "dynamics_tokens_per_s": round(tok / dyn_dt),
+        "overhead_pct": round(overhead_pct, 3),
+        "budget_pct": budget_pct,
+        "within_budget": overhead_pct < budget_pct,
+        "final_loss": dyn_loss,
+        "final_loss_bitwise_equal": base_loss == dyn_loss,
+    }
+
+
 def measure_watchdog_overhead(
     *,
     d_model: int = 512,

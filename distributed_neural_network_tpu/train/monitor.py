@@ -254,6 +254,22 @@ class Watchdog:
             self.cfg.max_stall_s,
         )
 
+    def _model_health(self) -> dict:
+        """Last model-health gauges (train/dynamics.py DynamicsSink), for
+        the stall flight event: a hang's postmortem should show whether
+        the model was already sick (exploding grads, spiking loss) when
+        the heartbeat stopped. Empty when the run has no --dynamics."""
+        out = {}
+        for key, name in (
+            ("last_grad_norm", "dynamics_grad_norm"),
+            ("last_upd_ratio_max", "dynamics_upd_ratio_max"),
+            ("last_loss_zscore", "guard_spike_zscore"),
+        ):
+            g = self.registry.get(name)
+            if g is not None:
+                out[key] = round(g.value, 6)
+        return out
+
     def check_once(self) -> dict:
         """One poll of all three detectors (the thread body; callable
         directly from tests). Returns {stall, storm, ckpt_stale} bools of
@@ -289,6 +305,7 @@ class Watchdog:
                         "watchdog_stall", step=step,
                         heartbeat_age_s=round(age, 3),
                         threshold_s=round(thr, 3),
+                        **self._model_health(),
                     )
                     self.log(
                         f"(watchdog: STALL - no step heartbeat for "

@@ -32,7 +32,20 @@ load-bearing). This module is that layer:
   a bounded ring of structured anomaly/lifecycle events with an atomic
   write-through dump that survives SIGKILL, bundled per rank into the
   supervisor's ``postmortem.json`` (docs/OBSERVABILITY.md "Fleet
-  observability").
+  observability"). Guard anomalies carry the training-dynamics
+  provenance (`layer=` - the first layer whose gradients went
+  non-finite, train/dynamics.py), and watchdog stall events carry the
+  last model-health gauges, so a postmortem answers "was the model sick
+  when it died" without the JSONL stream.
+
+The training-dynamics observatory (train/dynamics.py) publishes its
+model-health gauges here too: ``dynamics_grad_norm`` /
+``dynamics_param_norm`` / ``dynamics_upd_ratio_max``, per-layer
+``dynamics_layer_{grad_norm,upd_ratio}{layer=...}``, the noise-scale
+pair ``dynamics_gns_noise_scale`` / ``dynamics_crit_batch_size``, the
+engine's ``dynamics_replica_div_{mean,max}``, and the guard's
+``guard_spike_zscore`` headroom gauge (docs/OBSERVABILITY.md "Training
+dynamics").
 
 Stdlib-only (no jax import), so the registry and server work on any host
 - including the dashboard/test side (`tools/live_top.py`).

@@ -59,6 +59,10 @@ STRAGGLER = "straggler"
 # elastic resume/shrink events (train/elastic.py): the reshard span wraps
 # one whole checkpoint->new-mesh redistribution on the "elastic" track
 RESHARD = "reshard"
+# model-health counter tracks (train/dynamics.py DynamicsSink: per-layer
+# grad norms, update-to-weight ratios, gradient-noise scale) and the
+# engine's replica-divergence samples before each averaging sync
+DYNAMICS = "dynamics"
 
 
 class _NullSpan:

@@ -208,6 +208,22 @@ def main() -> int:
                    "step time, tokens/s, device memory, collective bytes, "
                    "MFU from cost_analysis with analytic fallback), print "
                    "the summary, and emit step/* series to --metrics-jsonl")
+    p.add_argument("--dynamics", action="store_true",
+                   help="training-dynamics telemetry (train/dynamics.py, "
+                   "docs/OBSERVABILITY.md): the compiled step emits one "
+                   "extra mesh-reduced bundle - per-layer grad/param/"
+                   "update-to-weight norms, the gradient-noise scale "
+                   "(with --accum-steps >= 2 and --grad-sync end), and "
+                   "the first non-finite layer index for provenance - "
+                   "decoded one step behind like the guard's health "
+                   "bundle; streams to --dynamics-jsonl, dynamics_* "
+                   "gauges, and the 'dynamics' trace track. Mesh path "
+                   "only (not --pp)")
+    p.add_argument("--dynamics-jsonl", default=None, metavar="DYN.jsonl",
+                   help="append the per-step dynamics rows here (one JSON "
+                   "object per step: global + per-layer norms, GNS "
+                   "readout, bad_layer); render/diff/gate with "
+                   "tools/dynamics.py")
     p.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
                    help="serve live Prometheus metrics on http://127.0.0.1"
                    ":PORT/metrics plus a /healthz JSON liveness/readiness "
@@ -286,6 +302,13 @@ def main() -> int:
                    help="fault injection (parallel/fault.py): NaN the "
                    "gradient tree at step N inside the compiled step "
                    "(repeatable); exercises the guard's in-jit skip path")
+    p.add_argument("--chaos-nan-layer", default=None, metavar="REGEX",
+                   help="restrict --chaos-nan-step to gradient leaves whose "
+                   "/-joined tree path matches this regex (parallel/"
+                   "fault.py nan_layer; e.g. 'blocks/3/.*'): with "
+                   "--dynamics the non-finite provenance must name one of "
+                   "the matched layers in the guard anomaly, flight "
+                   "recorder, and postmortem")
     p.add_argument("--chaos-spike-step", type=int, action="append",
                    default=None, metavar="N",
                    help="fault injection: multiply the OBSERVED loss at "
@@ -462,6 +485,16 @@ def main() -> int:
                 f"{args.chaos_stall_seconds}")
     if args.chaos_stall_rank is not None and not args.chaos_stall_step:
         p.error("--chaos-stall-rank restricts --chaos-stall-step, which "
+                "was not given")
+    if args.chaos_nan_layer is not None and not args.chaos_nan_step:
+        p.error("--chaos-nan-layer restricts --chaos-nan-step, which "
+                "was not given")
+    if args.dynamics and args.pp > 1:
+        p.error("--dynamics is wired through the dp x sp x tp mesh step's "
+                "telemetry bundle (train/lm.py make_lm_train_step); the "
+                "pipeline path has no dynamics output - drop --pp")
+    if args.dynamics_jsonl and not args.dynamics:
+        p.error("--dynamics-jsonl is the sink for --dynamics, which "
                 "was not given")
     if args.elastic and not args.resume and args.chaos_shrink_at_step is None:
         p.error("--elastic configures how --resume (or a SHRINK "
@@ -693,7 +726,8 @@ def main() -> int:
             )
 
             fault_plan = StepFaultPlan(
-                nan_grads_at=tuple(args.chaos_nan_step)
+                nan_grads_at=tuple(args.chaos_nan_step),
+                nan_layer=args.chaos_nan_layer,
             )
 
         def build_step(lr_scale: float = 1.0):
@@ -718,6 +752,7 @@ def main() -> int:
                 skip_nonfinite=args.guard == "skip",
                 fault_plan=fault_plan,
                 rules=shard_rules,
+                dynamics=args.dynamics,
             )
 
         step = build_step()
@@ -1187,6 +1222,29 @@ def main() -> int:
             preempt=preempt,
             tracer=tracer,
         )
+    # training-dynamics observatory (train/dynamics.py): the sink decodes
+    # the step's extra telemetry bundle one step behind (same cadence as
+    # the guard's HealthPipe) and doubles as the guard's non-finite
+    # provenance source, so it is built BEFORE the guard
+    dsink = None
+    if args.dynamics:
+        from distributed_neural_network_tpu.parallel.rules import (
+            named_leaves,
+        )
+        from distributed_neural_network_tpu.train.dynamics import (
+            DynamicsSink,
+        )
+
+        want_gns = args.grad_sync == "end" and args.accum_steps >= 2
+        dsink = DynamicsSink(
+            [p_ for p_, _ in named_leaves(params)],
+            jsonl_path=args.dynamics_jsonl,
+            registry=registry, tracer=tracer,
+            # GNS batch sizes in tokens: per-microbatch vs accumulated
+            b_small=(args.batch_size * args.seq_len / args.accum_steps
+                     if want_gns else None),
+            b_big=(args.batch_size * args.seq_len if want_gns else None),
+        )
     guard = hpipe = None
     if guard_on:
         guard = G.TrainingGuard(
@@ -1197,6 +1255,7 @@ def main() -> int:
                 max_retries=args.max_retries,
             ),
             tracer=tracer, step_stats=stats, registry=registry,
+            provenance=dsink.bad_layer if dsink is not None else None,
         )
         hpipe = G.HealthPipe(
             guard, perturb=monkey.perturb if monkey is not None else None
@@ -1274,6 +1333,8 @@ def main() -> int:
         print(f"(guard: resuming from step {snap_step} at "
               f"lr_scale={guard.lr_scale:g} [one recompile])")
         hpipe.clear()
+        if dsink is not None:
+            dsink.clear()  # the stashed step's update never retired
         i = snap_step
         return True
 
@@ -1323,15 +1384,31 @@ def main() -> int:
             guard.drop_snapshot()
         if hpipe is not None:
             hpipe.clear()
+        if dsink is not None:
+            dsink.clear()
+            # the shrink re-sliced accumulation: the GNS per-microbatch
+            # token count follows (the rebuilt step stops emitting
+            # msq_small entirely if accum collapsed to 1)
+            if dsink.b_small is not None and args.accum_steps >= 2:
+                dsink.b_small = (
+                    args.batch_size * args.seq_len / args.accum_steps
+                )
         print(
             f"(elastic: continuing at step {at_step + 1} on mesh "
             f"{mesh_desc}, accum_steps={args.accum_steps})"
         )
 
+    # the dynamics bundle rides LAST in the step output: after the health
+    # bundle when the guard is on (train/lm.py make_lm_train_step)
+    dyn_idx = 4 if guard_on else 3
     while i < end_step:
         if guard is not None and (i - step0) % args.snapshot_every == 0:
             # settle the in-flight observation BEFORE snapshotting, so the
             # rolling snapshot only ever captures guard-verified state
+            # (dynamics first: the guard's provenance lookup for the
+            # settled step reads the sink's decoded row)
+            if dsink is not None:
+                dsink.flush()
             if handle_verdict(hpipe.flush()):
                 continue
             guard.maybe_snapshot(
@@ -1349,6 +1426,11 @@ def main() -> int:
         else:
             out = step(params, mom, tokens, targets)
         params, mom, loss = out[0], out[1], out[2]
+        if dsink is not None:
+            # BEFORE the health pipe: both are one-step lagged, so when
+            # the guard judges step i-1 below, the sink must already have
+            # decoded i-1's bundle for the bad_layer provenance lookup
+            dsink.push(i, out[dyn_idx])
         if hpipe is not None and handle_verdict(hpipe.push(i, out[3])):
             continue
         if ema_fn is not None:
@@ -1437,11 +1519,17 @@ def main() -> int:
         )
     if preempt is not None:
         preempt.uninstall()
+    if dsink is not None:
+        # settle before the health pipe's final flush (provenance for the
+        # last judged step), then close the JSONL stream
+        dsink.flush()
     if hpipe is not None:
         # settle the last step's observation (counters/trace completeness;
         # a final-step rollback has nothing left to re-run, and the abort
         # policy still raises from here)
         hpipe.flush()
+    if dsink is not None:
+        dsink.close()
     if ck is not None:
         if not preempted:
             ck.save(last_step, {"params": params, "mom": mom},
@@ -1554,6 +1642,10 @@ def main() -> int:
         "guard_summary": guard.summary() if guard is not None else None,
         "dtype": args.dtype, "pp_bubble_frac": bubble,
         "grad_sync": args.grad_sync, "accum_steps": args.accum_steps,
+        "dynamics": (
+            {"rows": dsink.rows_written, "jsonl": args.dynamics_jsonl}
+            if dsink is not None else None
+        ),
         "data_source": stream.source if stream is not None else "copy-task",
         "eval": last_eval,
         "first_loss": first_loss, "final_loss": float(loss),

@@ -1,0 +1,593 @@
+"""Training-dynamics observatory tests (train/dynamics.py,
+docs/OBSERVABILITY.md "Training dynamics").
+
+Three layers, mirroring test_guard.py:
+- host-side math and the sink (gns_estimate closed-form pin,
+  decode_bundle, DynamicsSink lag/provenance/gauges/JSONL,
+  decode_divergence, the stdlib tools/dynamics.py CLI) -
+  version-portable, no mesh needed;
+- in-jit halves under plain jit / vmap (per_leaf_sq_norms vs
+  global_norm, dynamics_bundle first_bad provenance, the
+  accumulate_fwd_bwd sq_norm_fn third output, StepFaultPlan nan_layer
+  targeting, replica_divergence under a vmapped axis);
+- the LM mesh path (make_lm_train_step dynamics=True: default-off
+  bitwise parity, bundle decode, GNS + nan_layer provenance end to
+  end) - needs jax.shard_map with vma typing, skipped on older jax
+  like the other mesh-parity suites.
+
+The injector tests carry the `chaos` marker, same as test_guard.py.
+"""
+
+import json
+import math
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_neural_network_tpu.ops.schedule import (
+    accumulate_fwd_bwd,
+    global_norm,
+    per_leaf_sq_norms,
+)
+from distributed_neural_network_tpu.parallel import fault as F
+from distributed_neural_network_tpu.parallel.rules import named_leaves
+from distributed_neural_network_tpu.train import dynamics as D
+from distributed_neural_network_tpu.train import guard as G
+from distributed_neural_network_tpu.utils import obs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+requires_shard_map = pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="needs jax.shard_map with vma-typed autodiff",
+)
+
+
+def _tree(seed=0):
+    """A small two-level param-like tree with known named_leaves paths."""
+    k = jax.random.split(jax.random.key(seed), 3)
+    return {
+        "emb": jax.random.normal(k[0], (4, 3), jnp.float32),
+        "blocks": {
+            "wq": jax.random.normal(k[1], (3, 3), jnp.float32),
+            "wo": jax.random.normal(k[2], (3,), jnp.float32),
+        },
+    }
+
+
+def _paths(tree):
+    return [p for p, _ in named_leaves(tree)]
+
+
+# --------------------------------------------------------- host-side math
+
+
+def test_gns_estimate_closed_form():
+    """Pin the estimator against the synthetic case where the answer is
+    known: build msq_small/sq_big FROM a chosen true |G|^2 and noise S
+    via E[|g_B|^2] = |G|^2 + S/B, then the McCandlish difference
+    estimator must recover (|G|^2, S, S/|G|^2) exactly."""
+    g2, s = 4.0, 100.0
+    b_small, b_big = 512.0, 4096.0
+    msq_small = g2 + s / b_small
+    sq_big = g2 + s / b_big
+    est = D.gns_estimate(msq_small, sq_big, b_small=b_small, b_big=b_big)
+    assert est is not None
+    assert est["grad_sq_true"] == pytest.approx(g2, rel=1e-9)
+    assert est["noise_scale"] == pytest.approx(s, rel=1e-9)
+    assert est["crit_batch_size"] == pytest.approx(s / g2, rel=1e-9)
+    assert est["b_small"] == b_small and est["b_big"] == b_big
+
+
+def test_gns_estimate_degenerate_cases():
+    ok = dict(b_small=512.0, b_big=4096.0)
+    assert D.gns_estimate(1.0, 0.9, b_small=512.0, b_big=512.0) is None
+    assert D.gns_estimate(1.0, 0.9, b_small=0.0, b_big=512.0) is None
+    assert D.gns_estimate(float("nan"), 0.9, **ok) is None
+    assert D.gns_estimate(1.0, float("inf"), **ok) is None
+    assert D.gns_estimate(None, 0.9, **ok) is None
+    # near convergence sampling noise can drive |G|^2_true <= 0: the
+    # estimator must return None (skip), never a clamped value
+    assert D.gns_estimate(10.0, 0.0, **ok) is None
+
+
+def test_first_bad_layer_mapping():
+    paths = ["a", "b/c", "b/d"]
+    assert D.first_bad_layer(paths, np.int32(1)) == "b/c"
+    assert D.first_bad_layer(paths, np.int32(-1)) is None
+    assert D.first_bad_layer(paths, np.int32(3)) is None
+
+
+def test_decode_bundle_row_math_and_nan_null():
+    paths = ["emb", "head"]
+    bundle = {
+        "grad_sq": [np.float32(4.0), np.float32(float("nan"))],
+        "param_sq": [np.float32(9.0), np.float32(16.0)],
+        "upd_sq": [np.float32(0.09), np.float32(1.0)],
+        "first_bad": np.int32(1),
+    }
+    row = D.decode_bundle(paths, bundle)
+    assert row["layers"]["emb"]["grad_norm"] == pytest.approx(2.0)
+    assert row["layers"]["emb"]["param_norm"] == pytest.approx(3.0)
+    # upd_ratio = |delta| / (|w| + eps) = 0.3 / 3
+    assert row["layers"]["emb"]["upd_ratio"] == pytest.approx(0.1)
+    # the NaN leaf serializes as null, with provenance in bad_layer
+    assert row["layers"]["head"]["grad_norm"] is None
+    assert row["bad_layer"] == "head"
+    assert row["grad_norm"] is None  # NaN poisons the global sum
+    assert row["param_norm"] == pytest.approx(5.0)
+    assert row["upd_ratio_max"] == pytest.approx(max(0.1, 1.0 / 4.0))
+    assert row["layer_grad_norm_max"] == pytest.approx(2.0)
+    # the whole row must be strict-JSON clean (allow_nan=False contract)
+    json.dumps(row, allow_nan=False)
+
+
+def test_decode_divergence_aggregates():
+    paths = ["a", "b"]
+    row = D.decode_divergence(
+        paths, [np.float32(3.0), np.float32(4.0)],
+        [np.float32(5.0), np.float32(7.0)],
+    )
+    assert row["layers"]["a"] == {"mean": 3.0, "max": 5.0}
+    # global mean combines in L2 so it matches a whole-tree distance
+    assert row["div_mean"] == pytest.approx(5.0)
+    assert row["div_max"] == pytest.approx(7.0)
+    bad = D.decode_divergence(
+        paths, [np.float32(float("nan"))] * 2, [np.float32(float("inf"))] * 2
+    )
+    assert bad["layers"]["a"]["mean"] is None
+    assert bad["div_mean"] is None and bad["div_max"] is None
+
+
+# ------------------------------------------------------- DynamicsSink
+
+
+def _bundle(tree, *, grad_scale=1.0, bad_leaf=None, msq_small=None):
+    """Host-built bundle congruent to `tree` (no mesh needed)."""
+    leaves = [
+        float(np.sum(np.square(np.asarray(x, np.float64))))
+        for x in jax.tree.leaves(tree)
+    ]
+    grad_sq = [g * grad_scale for g in leaves]
+    first_bad = -1
+    if bad_leaf is not None:
+        grad_sq[bad_leaf] = float("nan")
+        first_bad = bad_leaf
+    tdef = jax.tree.structure(tree)
+    out = {
+        "grad_sq": jax.tree.unflatten(
+            tdef, [np.float32(g) for g in grad_sq]
+        ),
+        "param_sq": jax.tree.unflatten(
+            tdef, [np.float32(p) for p in leaves]
+        ),
+        "upd_sq": jax.tree.unflatten(
+            tdef, [np.float32(p * 1e-6) for p in leaves]
+        ),
+        "first_bad": np.int32(first_bad),
+    }
+    if msq_small is not None:
+        out["msq_small"] = np.float32(msq_small)
+    return out
+
+
+def test_dynamics_sink_one_step_lag_jsonl_and_gauges(tmp_path):
+    tree = _tree()
+    paths = _paths(tree)
+    reg = obs.MetricsRegistry()
+    out = str(tmp_path / "dyn.jsonl")
+    sink = D.DynamicsSink(paths, jsonl_path=out, registry=reg)
+    sink.push(0, _bundle(tree))
+    assert sink.rows_written == 0  # one-step lag: 0 is stashed
+    sink.push(1, _bundle(tree, bad_leaf=1))
+    assert sink.rows_written == 1  # step 0 drained
+    sink.flush()
+    assert sink.rows_written == 2
+    sink.close()
+
+    rows = [json.loads(l) for l in open(out)]
+    assert [r["step"] for r in rows] == [0, 1]
+    assert rows[0]["bad_layer"] is None
+    assert rows[1]["bad_layer"] == paths[1]
+    # provenance lookup used by the guard (step keyed)
+    assert sink.bad_layer(0) is None
+    assert sink.bad_layer(1) == paths[1]
+    # gauges: global + per-layer label + non-finite counter
+    assert reg.gauge("dynamics_grad_norm").value > 0
+    assert reg.gauge("dynamics_param_norm").value > 0
+    assert reg.gauge("dynamics_upd_ratio_max").value > 0
+    assert (
+        reg.gauge("dynamics_layer_grad_norm").labels(layer=paths[0]).value
+        > 0
+    )
+    assert reg.counter("dynamics_nonfinite_rows_total").value == 1
+
+
+def test_dynamics_sink_clear_drops_pending_on_rollback():
+    tree = _tree()
+    sink = D.DynamicsSink(_paths(tree))
+    sink.push(5, _bundle(tree, bad_leaf=0))
+    sink.clear()  # rollback: step 5 never retired
+    sink.flush()
+    assert sink.rows_written == 0
+    assert sink.bad_layer(5) is None
+
+
+def test_dynamics_sink_gns_and_batch_stamp(tmp_path):
+    tree = _tree()
+    g2, s = 4.0, 100.0
+    b_small, b_big = 512.0, 4096.0
+    out = str(tmp_path / "dyn.jsonl")
+    sink = D.DynamicsSink(
+        _paths(tree), jsonl_path=out, registry=obs.MetricsRegistry(),
+        b_small=b_small, b_big=b_big,
+    )
+    # scale grads so sq_big = g2 + s/b_big exactly, then hand the sink
+    # the matching msq_small: the decoded row must carry the closed-form
+    # estimate and the batch sizes
+    base = math.fsum(
+        float(np.sum(np.square(np.asarray(x, np.float64))))
+        for x in jax.tree.leaves(tree)
+    )
+    scale = (g2 + s / b_big) / base
+    sq_big = g2 + s / b_big
+    sink.push(0, _bundle(tree, grad_scale=scale, msq_small=g2 + s / b_small))
+    sink.flush()
+    sink.close()
+    (row,) = [json.loads(l) for l in open(out)]
+    assert row["b_small"] == b_small and row["b_big"] == b_big
+    assert row["sq_big"] == pytest.approx(sq_big, rel=1e-5)
+    assert row["gns"] is not None
+    assert row["gns"]["noise_scale"] == pytest.approx(s, rel=1e-3)
+    assert row["gns"]["crit_batch_size"] == pytest.approx(s / g2, rel=1e-3)
+    # degenerate step (msq_small ~ sq_big from below): gns None but the
+    # B's still ride the row for the tool's run-averaged re-estimate
+    sink2 = D.DynamicsSink(
+        _paths(tree), b_small=b_small, b_big=b_big
+    )
+    sink2.push(0, _bundle(tree, grad_scale=scale, msq_small=0.0))
+    sink2.flush()
+
+
+# ------------------------------------------------- in-jit halves (plain)
+
+
+def test_per_leaf_sq_norms_sums_to_global_norm():
+    tree = _tree()
+    sq = jax.jit(per_leaf_sq_norms)(tree)
+    assert jax.tree.structure(sq) == jax.tree.structure(tree)
+    total = math.fsum(float(x) for x in jax.tree.leaves(sq))
+    ref = float(global_norm(tree))
+    assert math.sqrt(total) == pytest.approx(ref, rel=1e-6)
+
+
+def test_dynamics_bundle_first_bad_indexes_named_leaves():
+    params = _tree()
+    paths = _paths(params)
+
+    @jax.jit
+    def f(grads, params, new_params):
+        return D.dynamics_bundle(grads, params, new_params)
+
+    # finite grads: first_bad == -1, upd_sq present
+    grads = jax.tree.map(jnp.ones_like, params)
+    new_params = jax.tree.map(lambda p: p + 0.01, params)
+    b = f(grads, params, new_params)
+    assert int(b["first_bad"]) == -1
+    assert D.first_bad_layer(paths, b["first_bad"]) is None
+    assert jax.tree.structure(b["upd_sq"]) == jax.tree.structure(params)
+
+    # NaN exactly one leaf: first_bad names it, in jax.tree.leaves order
+    for i, want in enumerate(paths):
+        leaves = [jnp.ones_like(x) for x in jax.tree.leaves(params)]
+        leaves[i] = leaves[i].at[(0,) * leaves[i].ndim].set(jnp.nan)
+        bad_grads = jax.tree.unflatten(jax.tree.structure(params), leaves)
+        b = f(bad_grads, params, new_params)
+        assert int(b["first_bad"]) == i
+        assert D.first_bad_layer(paths, b["first_bad"]) == want
+
+
+def test_accumulate_fwd_bwd_sq_norm_fn_third_output():
+    """The GNS hook: with sq_norm_fn set the wrapped fwd_bwd returns the
+    mean over microbatches of the PER-MICROBATCH squared norm, while the
+    (loss, grads) pair stays bitwise-identical to the default path."""
+    params = {"w": jnp.float32(2.0)}
+
+    def fwd_bwd_one(params, tok, tgt):
+        # per-microbatch gradient = mean of the rows, loss = sum
+        g = jnp.mean(tok.astype(jnp.float32))
+        return jnp.sum(tok.astype(jnp.float32)), {"w": g * params["w"]}
+
+    k = 4
+    tok = jnp.arange(8, dtype=jnp.int32).reshape(8, 1)
+    tgt = tok
+    sq_fn = lambda g: jnp.sum(jnp.square(g["w"]))
+    plain = jax.jit(accumulate_fwd_bwd(fwd_bwd_one, k))
+    with_sq = jax.jit(accumulate_fwd_bwd(fwd_bwd_one, k, sq_norm_fn=sq_fn))
+    l1, g1 = plain(params, tok, tgt)
+    l2, g2, msq = with_sq(params, tok, tgt)
+    assert float(l1) == float(l2)
+    assert float(g1["w"]) == float(g2["w"])
+    # microbatch means of 8 rows split into 4: 0.5, 2.5, 4.5, 6.5
+    want = np.mean([(m * 2.0) ** 2 for m in (0.5, 2.5, 4.5, 6.5)])
+    assert float(msq) == pytest.approx(want, rel=1e-6)
+    # k=1 has no small-vs-big contrast: the hook must refuse
+    with pytest.raises(ValueError, match="accum_steps >= 2"):
+        accumulate_fwd_bwd(fwd_bwd_one, 1, sq_norm_fn=sq_fn)
+
+
+def test_replica_divergence_under_vmapped_axis():
+    """pmean/pmax drive the divergence; a vmapped named axis is the
+    portable stand-in for the engine's sync shard_map."""
+    p0 = {"w": jnp.array([1.0, 0.0]), "b": jnp.array([2.0])}
+    p1 = {"w": jnp.array([3.0, 0.0]), "b": jnp.array([2.0])}
+    stacked = jax.tree.map(lambda a, b: jnp.stack([a, b]), p0, p1)
+    div_mean, div_max = jax.vmap(
+        lambda p: D.replica_divergence(p, "workers"), axis_name="workers"
+    )(stacked)
+    # w differs by 2 -> each worker sits |1| from the mean; b is equal
+    np.testing.assert_allclose(np.asarray(div_mean["w"]), [1.0, 1.0])
+    np.testing.assert_allclose(np.asarray(div_max["w"]), [1.0, 1.0])
+    np.testing.assert_allclose(np.asarray(div_mean["b"]), [0.0, 0.0])
+    row = D.decode_divergence(
+        ["b", "w"],
+        [div_mean["b"][0], div_mean["w"][0]],
+        [div_max["b"][0], div_max["w"][0]],
+    )
+    assert row["div_max"] == pytest.approx(1.0)
+
+
+@pytest.mark.chaos
+def test_fault_nan_layer_targets_matching_leaves_only():
+    grads = _tree()
+    paths = _paths(grads)
+    target = paths[1]  # blocks/wo or blocks/wq depending on dict order
+    plan = F.StepFaultPlan(nan_grads_at=(3,), nan_layer=target)
+
+    @jax.jit
+    def run(step_i, loss, grads):
+        return F.inject_step_faults(step_i, loss, grads, plan)
+
+    loss, faulted = run(jnp.int32(3), jnp.float32(1.0), grads)
+    flat = dict(named_leaves(faulted))
+    for p in paths:
+        if p == target:
+            assert np.all(np.isnan(np.asarray(flat[p])))
+        else:
+            np.testing.assert_array_equal(
+                np.asarray(flat[p]), np.asarray(dict(named_leaves(grads))[p])
+            )
+    # un-listed step: bitwise untouched everywhere
+    _, clean = run(jnp.int32(2), jnp.float32(1.0), grads)
+    for a, b in zip(jax.tree.leaves(clean), jax.tree.leaves(grads)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.chaos
+def test_fault_nan_layer_unmatched_pattern_raises_with_paths():
+    grads = _tree()
+    plan = F.StepFaultPlan(nan_grads_at=(0,), nan_layer="no_such_layer")
+    with pytest.raises(ValueError, match="matches no"):
+        F.inject_step_faults(jnp.int32(0), jnp.float32(1.0), grads, plan)
+
+
+# -------------------------------------------- guard provenance + z-score
+
+
+@pytest.mark.chaos
+def test_guard_provenance_names_layer_in_reason_and_flight():
+    logs = []
+    prov = {5: "blocks/0/attn/wq"}
+    g = G.TrainingGuard(
+        G.GuardConfig(policy="warn"),
+        log=logs.append,
+        provenance=prov.get,
+    )
+    n_before = len(obs.FLIGHT.events())
+    v = g.observe(5, float("nan"), all_finite=False)
+    assert v.action == "warn"
+    assert any("blocks/0/attn/wq" in line for line in logs)
+    evs = obs.FLIGHT.events()[n_before:]
+    anomalies = [e for e in evs if e["kind"] == "guard_anomaly"]
+    assert anomalies and anomalies[-1]["layer"] == "blocks/0/attn/wq"
+    # a step with no provenance entry: reason stays layer-free
+    logs.clear()
+    g.observe(6, float("nan"), all_finite=False)
+    assert not any("layer" in line for line in logs)
+
+
+def test_guard_spike_zscore_gauge_tracks_observations():
+    reg = obs.MetricsRegistry()
+    g = G.TrainingGuard(
+        G.GuardConfig(policy="warn", warmup_steps=3, spike_zscore=1e9),
+        registry=reg, log=lambda *_: None,
+    )
+    gauge = reg.gauge("guard_spike_zscore")
+    assert gauge.value == 0.0
+    for i in range(3):  # warmup: detector returns None -> gauge stays 0
+        g.observe(i, 1.0)
+        assert gauge.value == 0.0
+    g.observe(3, 1.5)  # z-scored against the EMA, under the huge threshold
+    assert gauge.value > 0.0
+    g.observe(4, 1.0)
+    assert gauge.value != 0.0 or g.detector.check(1.0) == 0.0
+
+
+# ------------------------------------------------ tools/dynamics.py CLI
+
+
+def _write_dyn_jsonl(path, rows):
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+
+def _dyn_row(step, *, grad=1.0, bad=None, upd=0.001):
+    return {
+        "step": step,
+        "grad_norm": grad,
+        "param_norm": 10.0,
+        "upd_ratio_max": upd,
+        "layer_grad_norm_max": grad,
+        "layers": {"emb": {"grad_norm": grad, "param_norm": 10.0,
+                           "upd_ratio": upd}},
+        "bad_layer": bad,
+        "gns": None,
+    }
+
+
+def _run_tool(*args):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "dynamics.py"), *args],
+        capture_output=True, text=True,
+    )
+
+
+def test_dynamics_tool_render_and_check_rc0(tmp_path):
+    path = str(tmp_path / "dyn.jsonl")
+    _write_dyn_jsonl(path, [_dyn_row(i) for i in range(10)])
+    r = _run_tool(path)
+    assert r.returncode == 0, r.stderr
+    assert "grad_norm" in r.stdout
+    assert _run_tool("--check", path).returncode == 0
+
+
+def test_dynamics_tool_check_rc1_on_nonfinite_and_growth(tmp_path):
+    bad = str(tmp_path / "bad.jsonl")
+    _write_dyn_jsonl(
+        bad, [_dyn_row(0), _dyn_row(1, bad="emb"), _dyn_row(2)]
+    )
+    r = _run_tool("--check", bad)
+    assert r.returncode == 1
+    assert "non-finite" in (r.stdout + r.stderr)
+    grow = str(tmp_path / "grow.jsonl")
+    _write_dyn_jsonl(
+        grow,
+        [_dyn_row(i, grad=1.0) for i in range(10)]
+        + [_dyn_row(10 + i, grad=1000.0) for i in range(10)],
+    )
+    assert _run_tool("--check", grow).returncode == 1
+
+
+def test_dynamics_tool_diff_and_usage_rc2(tmp_path):
+    a = str(tmp_path / "a.jsonl")
+    b = str(tmp_path / "b.jsonl")
+    _write_dyn_jsonl(a, [_dyn_row(i) for i in range(4)])
+    _write_dyn_jsonl(b, [_dyn_row(i, grad=2.0) for i in range(4)])
+    assert _run_tool("--diff", a, b).returncode == 0
+    assert _run_tool(str(tmp_path / "missing.jsonl")).returncode == 2
+    empty = str(tmp_path / "empty.jsonl")
+    _write_dyn_jsonl(empty, [])
+    assert _run_tool(empty).returncode == 2
+
+
+def test_dynamics_tool_skips_malformed_lines(tmp_path):
+    path = str(tmp_path / "torn.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps(_dyn_row(0)) + "\n")
+        f.write('{"step": 1, "layers"\n')  # torn tail mid-write
+        f.write(json.dumps(_dyn_row(2)) + "\n")
+        f.write('{"step": "x", "layers": {}}\n')  # corrupted step
+    r = _run_tool(path)
+    assert r.returncode == 0
+    assert "steps" in r.stdout
+
+
+# ---------------------------------------------------- LM mesh path (gated)
+
+
+def _lm_setup(optimizer="sgd", **step_kw):
+    from distributed_neural_network_tpu.models import transformer as tfm
+    from distributed_neural_network_tpu.train import lm as lmtrain
+
+    cfg = tfm.TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64
+    )
+    mesh = lmtrain.create_lm_mesh(2, 1, 1)
+    params = tfm.init_params(jax.random.key(0), cfg)
+    params, _ = lmtrain.shard_params(params, cfg, mesh)
+    mom = lmtrain.init_lm_momentum(params, mesh, optimizer)
+    step = lmtrain.make_lm_train_step(
+        cfg, mesh, lr=0.1, optimizer=optimizer, **step_kw
+    )
+    tok, tgt = lmtrain.make_copy_task(
+        jax.random.key(1), batch=16, seq_len=16, vocab=64
+    )
+    return step, params, mom, tok, tgt
+
+
+@requires_shard_map
+def test_lm_dynamics_is_observation_only(n_devices):
+    """dynamics=True must not change the math: losses and params stay
+    bitwise identical to the default step, and the extra LAST output
+    decodes into finite per-layer norms under the params' paths."""
+    plain, p1, m1, tok, tgt = _lm_setup()
+    dyn_step, p2, m2, _, _ = _lm_setup(dynamics=True)
+    paths = _paths(p2)
+    for _ in range(3):
+        p1, m1, l1 = plain(p1, m1, tok, tgt)
+        p2, m2, l2, dyn = dyn_step(p2, m2, tok, tgt)
+        assert float(l1) == float(l2)
+        row = D.decode_bundle(paths, jax.device_get(dyn))
+        assert row["bad_layer"] is None
+        assert row["grad_norm"] is not None and row["grad_norm"] > 0
+        assert row["upd_ratio_max"] is not None
+        assert set(row["layers"]) == set(paths)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@requires_shard_map
+@pytest.mark.chaos
+def test_lm_dynamics_provenance_names_injected_layer(n_devices):
+    """Acceptance path: NaN injected into one chosen layer -> the decoded
+    bundle names exactly that layer, and a guard wired to the sink's
+    lookup carries it into the anomaly reason."""
+    step0, params, _, _, _ = _lm_setup(dynamics=True)
+    paths = _paths(params)
+    target = next(p for p in paths if "wq" in p)
+    plan = F.StepFaultPlan(nan_grads_at=(1,), nan_layer=target)
+    step, params, mom, tok, tgt = _lm_setup(
+        dynamics=True, with_health=True, skip_nonfinite=True,
+        fault_plan=plan,
+    )
+    sink = D.DynamicsSink(paths)
+    logs = []
+    guard = G.TrainingGuard(
+        G.GuardConfig(policy="warn"), log=logs.append,
+        provenance=sink.bad_layer,
+    )
+    for i in range(3):
+        params, mom, loss, h, dyn = step(
+            params, mom, tok, tgt, jnp.int32(i)
+        )
+        sink.push(i, dyn)
+    sink.flush()
+    assert sink.bad_layer(1) == target
+    assert sink.bad_layer(0) is None
+    guard.observe(1, 1.0, all_finite=False)
+    assert any(target in line for line in logs)
+
+
+@requires_shard_map
+def test_lm_dynamics_gns_bundle_with_accumulation(n_devices):
+    """grad_sync=end + accum_steps>=2 turns the GNS halves on: the bundle
+    carries msq_small and the decoded row yields a finite estimate
+    through the sink when the batch sizes are wired."""
+    step, params, mom, tok, tgt = _lm_setup(
+        dynamics=True, accum_steps=2, grad_sync="end"
+    )
+    paths = _paths(params)
+    b_big = float(tok.shape[0] * tok.shape[1])
+    sink = D.DynamicsSink(paths, b_small=b_big / 2, b_big=b_big)
+    for i in range(2):
+        params, mom, loss, dyn = step(params, mom, tok, tgt)
+        assert "msq_small" in dyn
+        sink.push(i, dyn)
+    sink.flush()
+    assert sink.rows_written == 2

@@ -210,6 +210,54 @@ def test_plot_metrics_reads_sanitized_sink_output(tmp_path):
     assert series["val/acc"][1] == [51.0]
 
 
+def test_plot_metrics_reads_dynamics_stream(tmp_path):
+    """A --dynamics-jsonl stream (train/dynamics.py rows with a `layers`
+    object) fans out as dynamics/* series alongside regular metric
+    events in the same plot."""
+    pm = _load_plot_metrics()
+    path = tmp_path / "dyn.jsonl"
+    path.write_text(
+        json.dumps({
+            "step": 0, "grad_norm": 2.0, "param_norm": 10.0,
+            "upd_ratio_max": 0.001, "layer_grad_norm_max": 1.5,
+            "layers": {"emb": {"grad_norm": 1.5}}, "bad_layer": None,
+            "gns": None,
+        }) + "\n"
+        + json.dumps({
+            "step": 1, "grad_norm": None, "param_norm": 10.0,
+            "upd_ratio_max": 0.002, "layer_grad_norm_max": 1.4,
+            "layers": {"emb": {"grad_norm": None}}, "bad_layer": "emb",
+            "gns": {"noise_scale": 80.0, "crit_batch_size": 20.0,
+                    "grad_sq_true": 4.0},
+        }) + "\n"
+        # corrupted step must not poison the dynamics x axis: skipped
+        + json.dumps({"step": "x", "grad_norm": 1.0, "layers": {}}) + "\n"
+        + json.dumps({"series": "train/loss", "step": 0, "value": 2.0})
+        + "\n"
+    )
+    series, _ = pm.load_series(str(path))
+    assert series["dynamics/grad_norm"] == ([0], [2.0])  # null dropped
+    assert series["dynamics/param_norm"] == ([0, 1], [10.0, 10.0])
+    assert series["dynamics/gns_noise_scale"] == ([1], [80.0])
+    assert series["dynamics/gns_crit_batch_size"] == ([1], [20.0])
+    assert series["train/loss"] == ([0], [2.0])
+
+
+def test_plot_metrics_non_numeric_step_falls_back_to_index(tmp_path):
+    """A corrupted step in a regular series event indexes by position
+    instead of poisoning the x axis (the pre-fix behavior plotted the
+    bad token verbatim)."""
+    pm = _load_plot_metrics()
+    path = tmp_path / "m.jsonl"
+    path.write_text(
+        '{"series": "train/loss", "step": 0, "value": 2.0}\n'
+        '{"series": "train/loss", "step": "oops", "value": 1.5}\n'
+        '{"series": "train/loss", "step": 2, "value": 1.0}\n'
+    )
+    series, _ = pm.load_series(str(path))
+    assert series["train/loss"] == ([0, 1, 2], [2.0, 1.5, 1.0])
+
+
 def test_step_stats_trace_embed_is_strict_json(tmp_path):
     """A StepStats carrying non-finite values must still export strictly."""
     tracer = tr.Tracer()

@@ -1,0 +1,384 @@
+#!/usr/bin/env python
+"""Render, diff, and gate training-dynamics JSONL streams (train/dynamics.py).
+
+`lm_train.py --dynamics --dynamics-jsonl dyn.jsonl` appends one row per
+step: global + per-layer gradient/parameter norms, update-to-weight
+ratios, the gradient-noise-scale inputs, and non-finite provenance
+(`bad_layer`). This tool is the operator/CI surface over that stream:
+
+  # render the run: norm trajectory, worst layers, smoothed GNS readout
+  python tools/dynamics.py dyn.jsonl
+
+  # side-by-side comparison of two runs (per-metric relative drift)
+  python tools/dynamics.py --diff before.jsonl after.jsonl
+
+  # CI health gate (shardlint-style exit codes: 0 = healthy, 1 = gate
+  # tripped, 2 = usage/input error). Without --baseline it gates run
+  # invariants: no non-finite rows, update-to-weight ratio under
+  # --max-upd-ratio, and final-vs-early grad-norm growth under
+  # --max-growth. With --baseline it additionally gates relative drift
+  # of the run summary (mean grad norm, mean update ratio, smoothed
+  # noise scale) within --gate-frac.
+  python tools/dynamics.py --check dyn.jsonl
+  python tools/dynamics.py --check dyn.jsonl --baseline main.jsonl \
+      [--gate-frac 0.5] [--max-upd-ratio 0.5] [--max-growth 10]
+
+Malformed lines (truncated tail of a killed run, junk) are skipped and
+counted, never fatal - but a stream with NO valid rows is an input
+error. Semantics: docs/OBSERVABILITY.md "Training dynamics".
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+
+
+def _is_num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def load_rows(path: str):
+    """Parse a dynamics JSONL stream -> (rows sorted by step, n_malformed).
+
+    A valid row is a JSON object with a numeric ``step`` and a ``layers``
+    object (the decode_bundle shape). Anything else on a line counts as
+    malformed and is skipped - a SIGKILLed run leaves a torn last line.
+    """
+    rows, malformed = [], 0
+    try:
+        f = open(path)
+    except OSError as e:
+        raise ValueError(f"{path}: {e}")
+    with f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                doc = json.loads(line)
+            except json.JSONDecodeError:
+                malformed += 1
+                continue
+            if (
+                not isinstance(doc, dict)
+                or not _is_num(doc.get("step"))
+                or not isinstance(doc.get("layers"), dict)
+            ):
+                malformed += 1
+                continue
+            rows.append(doc)
+    if not rows:
+        raise ValueError(
+            f"{path}: no dynamics rows"
+            + (f" ({malformed} malformed line(s))" if malformed else "")
+        )
+    rows.sort(key=lambda r: r["step"])
+    return rows, malformed
+
+
+def gns_estimate(msq_small, sq_big, *, b_small, b_big):
+    """Stdlib copy of train/dynamics.py gns_estimate (tools/ scripts do
+    not import the package: its __init__ pulls in jax). Same contract:
+    McCandlish simple estimator, None on degenerate inputs."""
+    if not (
+        _is_num(msq_small) and _is_num(sq_big)
+        and math.isfinite(msq_small) and math.isfinite(sq_big)
+    ):
+        return None
+    if not (_is_num(b_small) and _is_num(b_big)):
+        return None
+    if b_big <= b_small or b_small <= 0:
+        return None
+    grad_sq_true = (b_big * sq_big - b_small * msq_small) / (
+        b_big - b_small
+    )
+    noise = (msq_small - sq_big) / (1.0 / b_small - 1.0 / b_big)
+    if not (math.isfinite(grad_sq_true) and grad_sq_true > 0.0):
+        return None
+    return {
+        "grad_sq_true": grad_sq_true,
+        "noise_scale": noise,
+        "crit_batch_size": noise / grad_sq_true,
+    }
+
+
+def _series(rows, key):
+    return [r[key] for r in rows if _is_num(r.get(key))]
+
+
+def _mean(xs):
+    return sum(xs) / len(xs) if xs else None
+
+
+def summarize(rows) -> dict:
+    """Run-level summary of a dynamics stream (the render/diff/check
+    payload). The smoothed GNS re-estimates from run-averaged
+    msq_small/sq_big - far less noisy than any single step's readout."""
+    grad = _series(rows, "grad_norm")
+    upd = _series(rows, "upd_ratio_max")
+    bad = [
+        {"step": r["step"], "layer": r["bad_layer"]}
+        for r in rows
+        if r.get("bad_layer") is not None
+    ]
+    # early/late windows for the growth gate: first vs last 10% (>= 1 row)
+    w = max(1, len(grad) // 10)
+    early = _mean(grad[:w])
+    late = _mean(grad[-w:])
+    msq = _series(rows, "msq_small")
+    sqb = _series(rows, "sq_big")
+    b_small = next((r["b_small"] for r in rows if _is_num(r.get("b_small"))),
+                   None)
+    b_big = next((r["b_big"] for r in rows if _is_num(r.get("b_big"))), None)
+    gns = (
+        gns_estimate(_mean(msq), _mean(sqb), b_small=b_small, b_big=b_big)
+        if msq and sqb else None
+    )
+    # final per-layer view from the last row that carries layers
+    layers = {}
+    for r in rows:
+        for name, entry in r["layers"].items():
+            if isinstance(entry, dict):
+                layers[name] = entry  # last write wins (rows are sorted)
+    return {
+        "steps": len(rows),
+        "step_range": [rows[0]["step"], rows[-1]["step"]],
+        "grad_norm": {
+            "first": grad[0] if grad else None,
+            "last": grad[-1] if grad else None,
+            "mean": _mean(grad),
+            "max": max(grad) if grad else None,
+            "early": early,
+            "late": late,
+        },
+        "param_norm_last": (_series(rows, "param_norm") or [None])[-1],
+        "upd_ratio_max": {
+            "mean": _mean(upd),
+            "max": max(upd) if upd else None,
+        },
+        "nonfinite_rows": bad,
+        "gns": gns,
+        "layers": layers,
+    }
+
+
+def _fmt(v, nd=4):
+    if v is None:
+        return "-"
+    if isinstance(v, float) and (abs(v) >= 1e5 or (v != 0 and abs(v) < 1e-3)):
+        return f"{v:.3e}"
+    return f"{v:.{nd}f}" if isinstance(v, float) else str(v)
+
+
+def render(summary: dict, *, title: str, malformed: int = 0,
+           top: int = 5) -> str:
+    s = summary
+    lines = [title, "=" * len(title)]
+    lo, hi = s["step_range"]
+    lines.append(
+        f"steps             {s['steps']} (step {lo} .. {hi})"
+        + (f"   [{malformed} malformed line(s) skipped]" if malformed else "")
+    )
+    g = s["grad_norm"]
+    lines.append(
+        f"grad_norm         first {_fmt(g['first'])}  last {_fmt(g['last'])}"
+        f"  mean {_fmt(g['mean'])}  max {_fmt(g['max'])}"
+    )
+    lines.append(f"param_norm (last) {_fmt(s['param_norm_last'])}")
+    u = s["upd_ratio_max"]
+    lines.append(
+        f"upd_ratio_max     mean {_fmt(u['mean'])}  max {_fmt(u['max'])}"
+    )
+    if s["gns"] is not None:
+        lines.append(
+            f"GNS (smoothed)    noise_scale {_fmt(s['gns']['noise_scale'])}"
+            f"  crit_batch_size {_fmt(s['gns']['crit_batch_size'], 1)} tokens"
+        )
+    else:
+        lines.append("GNS (smoothed)    - (needs --accum-steps >= 2 with "
+                     "--grad-sync end)")
+    bad = s["nonfinite_rows"]
+    if bad:
+        lines.append(f"NON-FINITE        {len(bad)} row(s):")
+        for b in bad[:top]:
+            lines.append(f"  step {b['step']:>6}  first bad layer: "
+                         f"{b['layer']}")
+        if len(bad) > top:
+            lines.append(f"  ... and {len(bad) - top} more")
+    else:
+        lines.append("non-finite rows   0")
+    ranked = sorted(
+        (
+            (name, e)
+            for name, e in s["layers"].items()
+            if _is_num(e.get("grad_norm"))
+        ),
+        key=lambda kv: kv[1]["grad_norm"],
+        reverse=True,
+    )
+    if ranked:
+        lines.append(f"top {min(top, len(ranked))} layers by final "
+                     "grad_norm (upd_ratio alongside):")
+        width = max(len(name) for name, _ in ranked[:top])
+        for name, e in ranked[:top]:
+            lines.append(
+                f"  {name:<{width}}  grad {_fmt(e['grad_norm'])}"
+                f"  upd_ratio {_fmt(e.get('upd_ratio'))}"
+            )
+    return "\n".join(lines)
+
+
+_DIFF_KEYS = (
+    ("grad_norm mean", lambda s: s["grad_norm"]["mean"]),
+    ("grad_norm last", lambda s: s["grad_norm"]["last"]),
+    ("upd_ratio mean", lambda s: s["upd_ratio_max"]["mean"]),
+    ("noise_scale", lambda s: (s["gns"] or {}).get("noise_scale")),
+    ("crit_batch_size", lambda s: (s["gns"] or {}).get("crit_batch_size")),
+    ("nonfinite rows", lambda s: float(len(s["nonfinite_rows"]))),
+)
+
+
+def diff(a: dict, b: dict, name_a: str, name_b: str) -> str:
+    lines = [
+        f"{'metric':<18} {name_a[:20]:>20} {name_b[:20]:>20} {'drift':>9}",
+        "-" * 70,
+    ]
+    for label, get in _DIFF_KEYS:
+        va, vb = get(a), get(b)
+        drift = (
+            f"{(vb - va) / abs(va):+.1%}"
+            if _is_num(va) and _is_num(vb) and va
+            else "-"
+        )
+        lines.append(
+            f"{label:<18} {_fmt(va):>20} {_fmt(vb):>20} {drift:>9}"
+        )
+    return "\n".join(lines)
+
+
+def check(summary: dict, *, baseline: dict | None, gate_frac: float,
+          max_upd_ratio: float, max_growth: float) -> list:
+    """Gate a run summary; returns the list of problems (empty = pass)."""
+    problems = []
+    bad = summary["nonfinite_rows"]
+    if bad:
+        first = bad[0]
+        problems.append(
+            f"{len(bad)} non-finite row(s); first at step {first['step']} "
+            f"in layer {first['layer']!r}"
+        )
+    u = summary["upd_ratio_max"]["max"]
+    if _is_num(u) and u > max_upd_ratio:
+        problems.append(
+            f"upd_ratio_max {u:.4g} exceeds --max-upd-ratio "
+            f"{max_upd_ratio:g} (update >> weight: LR too hot or a "
+            "layer diverging)"
+        )
+    g = summary["grad_norm"]
+    if _is_num(g["early"]) and _is_num(g["late"]) and g["early"] > 0 \
+            and g["late"] > g["early"] * max_growth:
+        problems.append(
+            f"grad_norm grew {g['late'] / g['early']:.1f}x from the "
+            f"first to the last 10% of the run (--max-growth "
+            f"{max_growth:g}): diverging"
+        )
+    if baseline is not None:
+        for label, get in _DIFF_KEYS:
+            if label == "nonfinite rows":
+                continue
+            va, vb = get(baseline), get(summary)
+            if not (_is_num(va) and _is_num(vb)) or va == 0:
+                continue
+            drift = abs(vb - va) / abs(va)
+            if drift > gate_frac:
+                problems.append(
+                    f"{label} drifted {drift:.1%} vs baseline "
+                    f"({_fmt(va)} -> {_fmt(vb)}, --gate-frac "
+                    f"{gate_frac:g})"
+                )
+    return problems
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("stream", nargs="?", metavar="DYN.jsonl",
+                   help="dynamics JSONL stream to render")
+    p.add_argument("--diff", nargs=2, metavar=("A", "B"),
+                   help="compare two streams side by side")
+    p.add_argument("--check", metavar="DYN.jsonl",
+                   help="gate a stream; exit 1 when a health gate trips")
+    p.add_argument("--baseline", metavar="BASE.jsonl",
+                   help="--check: also gate relative drift of the run "
+                   "summary against this stream")
+    p.add_argument("--gate-frac", type=float, default=0.5,
+                   help="--check --baseline: max relative drift of any "
+                   "summary metric (default 0.5)")
+    p.add_argument("--max-upd-ratio", type=float, default=0.5,
+                   help="--check: max allowed per-layer update-to-weight "
+                   "ratio anywhere in the run (default 0.5; the healthy "
+                   "band is ~1e-3)")
+    p.add_argument("--max-growth", type=float, default=10.0,
+                   help="--check: max allowed late/early grad-norm "
+                   "growth factor (default 10)")
+    p.add_argument("--top", type=int, default=5,
+                   help="layers shown in the render ranking (default 5)")
+    args = p.parse_args(argv)
+
+    modes = sum(bool(x) for x in (args.stream, args.diff, args.check))
+    if modes != 1:
+        p.print_usage(sys.stderr)
+        print("dynamics: give exactly one of DYN.jsonl, --diff A B, or "
+              "--check DYN.jsonl", file=sys.stderr)
+        return 2
+
+    try:
+        if args.diff:
+            (ra, _), (rb, _) = (load_rows(x) for x in args.diff)
+            print(diff(summarize(ra), summarize(rb),
+                       os.path.basename(args.diff[0]),
+                       os.path.basename(args.diff[1])))
+            return 0
+        if args.check:
+            rows, malformed = load_rows(args.check)
+            summary = summarize(rows)
+            base = None
+            if args.baseline:
+                base_rows, _ = load_rows(args.baseline)
+                base = summarize(base_rows)
+            print(render(summary, title=f"Dynamics check: {args.check}",
+                         malformed=malformed, top=args.top))
+            problems = check(
+                summary, baseline=base, gate_frac=args.gate_frac,
+                max_upd_ratio=args.max_upd_ratio,
+                max_growth=args.max_growth,
+            )
+            if problems:
+                print(f"\nDYNAMICS CHECK FAILED ({len(problems)} "
+                      "problem(s)):")
+                for prob in problems:
+                    print(f"  - {prob}")
+                print("\nIf the drift is intended (new workload/LR), "
+                      "regenerate the baseline stream and commit it with "
+                      "the change that moved the dynamics.")
+                return 1
+            print("\ndynamics check OK")
+            return 0
+        rows, malformed = load_rows(args.stream)
+        print(render(summarize(rows),
+                     title=f"Training dynamics: {args.stream}",
+                     malformed=malformed, top=args.top))
+        return 0
+    except ValueError as e:
+        print(f"dynamics: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,6 +13,11 @@ server, and on dead runs' files). One compact ANSI frame per refresh:
     buckets), device memory, collective bytes;
   - guard anomaly / rollback counters and watchdog flags (stall,
     recompile storm, stale checkpoint) - red when non-zero;
+  - model health (a run started with --dynamics, train/dynamics.py):
+    gradient/param norms + sparkline, update-to-weight ratio, the
+    gradient-noise-scale readout, the guard's live loss z-score, the
+    hottest layer by gradient norm, non-finite row count (red), and
+    replica divergence at the last parameter sync;
   - when pointed at a tools/launch.py --metrics-port endpoint: the
     elastic supervisor's group size vs target, worker failures by
     signal, shrink/grow/rendezvous restarts, and restart latency -
@@ -184,6 +189,7 @@ class EndpointSource:
             self.base = self.base[: -len("/metrics")]
         self.timeout = timeout
         self.loss_history: list[float] = []
+        self.grad_history: list[float] = []
         self.skew_history: list[float] = []
         self.qps_history: list[float] = []
         self.ttft_history: list[float] = []
@@ -225,6 +231,11 @@ class EndpointSource:
             if not self.loss_history or self.loss_history[-1] != loss:
                 self.loss_history.append(loss)
                 del self.loss_history[:-512]
+        gn = metric_value(metrics, "dynamics_grad_norm")
+        if gn is not None and math.isfinite(gn):
+            if not self.grad_history or self.grad_history[-1] != gn:
+                self.grad_history.append(gn)
+                del self.grad_history[:-512]
         skew = metric_value(metrics, "fleet_last_step_skew_seconds")
         if skew is not None and math.isfinite(skew):
             self.skew_history.append(skew)
@@ -262,6 +273,7 @@ class EndpointSource:
                     pass
         return {"metrics": metrics, "health": health,
                 "loss_history": list(self.loss_history),
+                "grad_history": list(self.grad_history),
                 "skew_history": list(self.skew_history),
                 "qps_history": list(self.qps_history),
                 "ttft_history": list(self.ttft_history),
@@ -325,6 +337,14 @@ class JsonlSource:
                 (("device", "max"),):
                     self.series["step/mem_bytes_in_use_max"][-1]
             }
+        # the engine's replica-divergence series (train/engine.py run())
+        # surface as the same gauges the endpoint source would see
+        for s_key, gname in (
+            ("dynamics/replica_div_mean", "dynamics_replica_div_mean"),
+            ("dynamics/replica_div_max", "dynamics_replica_div_max"),
+        ):
+            if self.series.get(s_key):
+                metrics[gname] = {(): self.series[s_key][-1]}
         for s, vals in self.series.items():
             if s.startswith("step/anomaly_"):
                 metrics.setdefault("guard_anomalies_total", {})[
@@ -466,6 +486,53 @@ def render(snap: dict, *, color: bool = True, width: int = 72) -> str:
     if stall or storm or stale:
         dog = c(RED, dog)
     lines.append(dog)
+    # model health (train/dynamics.py; present when the run was started
+    # with --dynamics): the gauges the DynamicsSink / engine publish
+    gn = metric_value(m, "dynamics_grad_norm")
+    div_mean = metric_value(m, "dynamics_replica_div_mean")
+    if gn is not None or div_mean is not None:
+        parts = []
+        if gn is not None:
+            parts.append(f"|g| {gn:.4g}")
+        pn = metric_value(m, "dynamics_param_norm")
+        if pn is not None:
+            parts.append(f"|w| {pn:.4g}")
+        upd = metric_value(m, "dynamics_upd_ratio_max")
+        if upd is not None:
+            parts.append(f"upd/w max {upd:.3g}")
+        z = metric_value(m, "guard_spike_zscore")
+        if z is not None:
+            parts.append(f"loss z {z:+.2f}")
+        if parts:  # engine runs publish divergence only: no empty line
+            model_line = "model       " + "  ".join(parts)
+            nonfin = metric_value(m, "dynamics_nonfinite_rows_total", 0)
+            if nonfin:
+                model_line += c(RED, f"  NON-FINITE rows: {int(nonfin)}")
+            spark = sparkline(snap.get("grad_history") or [], 16)
+            if spark:
+                model_line += f"  {spark}"
+            lines.append(model_line)
+        gns_v = metric_value(m, "dynamics_gns_noise_scale")
+        if gns_v is not None:
+            crit = metric_value(m, "dynamics_crit_batch_size")
+            lines.append(
+                f"  gns noise_scale {gns_v:.4g}"
+                + (f"  crit batch {crit:,.0f} tokens"
+                   if crit is not None else "")
+            )
+        layer_fam = m.get("dynamics_layer_grad_norm") or {}
+        if layer_fam:
+            hot_key, hot_v = max(layer_fam.items(), key=lambda kv: kv[1])
+            lines.append(
+                f"  hottest layer {dict(hot_key).get('layer', '?')}  "
+                f"|g| {hot_v:.4g}"
+            )
+        if div_mean is not None:
+            div_max = metric_value(m, "dynamics_replica_div_max")
+            lines.append(
+                f"  replica divergence mean {div_mean:.4g}"
+                + (f"  max {div_max:.4g}" if div_max is not None else "")
+            )
     # goodput accounting (utils/goodput.py; published by a worker's own
     # ledger or the supervisor's fleet aggregation): what fraction of
     # wall-clock produced training progress, and where the rest went

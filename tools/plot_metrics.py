@@ -18,13 +18,26 @@ import sys
 from collections import defaultdict
 
 
+def _num(v) -> bool:
+    return (
+        isinstance(v, (int, float))
+        and not isinstance(v, bool)
+        and math.isfinite(v)
+    )
+
+
 def load_series(path: str):
     """Parse a metrics JSONL file into {series: (steps, values)}.
 
     Malformed lines (a run killed mid-write leaves a truncated tail; older
     files may carry bare NaN tokens) are skipped and counted to stderr
     instead of crashing the plot; non-numeric values (the null a
-    sanitized NaN/Inf serializes to, utils/metrics.py) are skipped too.
+    sanitized NaN/Inf serializes to, utils/metrics.py) are skipped too,
+    as are non-numeric steps (a corrupted row must not poison the x axis).
+
+    A --dynamics-jsonl stream (train/dynamics.py: one row per step with
+    a ``layers`` object) is recognized too: its global health numbers fan
+    out as dynamics/* series so the same tool plots both file kinds.
     """
     series = defaultdict(lambda: ([], []))
     params = None
@@ -45,16 +58,28 @@ def load_series(path: str):
             if ev.get("series") == "parameters":
                 params = ev.get("data")
                 continue
+            if isinstance(ev.get("layers"), dict) and _num(ev.get("step")):
+                for key in ("grad_norm", "param_norm", "upd_ratio_max",
+                            "layer_grad_norm_max"):
+                    if _num(ev.get(key)):
+                        xs, ys = series[f"dynamics/{key}"]
+                        xs.append(ev["step"])
+                        ys.append(ev[key])
+                gns = ev.get("gns")
+                if isinstance(gns, dict):
+                    for key in ("noise_scale", "crit_batch_size"):
+                        if _num(gns.get(key)):
+                            xs, ys = series[f"dynamics/gns_{key}"]
+                            xs.append(ev["step"])
+                            ys.append(gns[key])
+                continue
             if "value" in ev and isinstance(ev.get("series"), str):
                 v = ev["value"]
-                if (
-                    not isinstance(v, (int, float))
-                    or isinstance(v, bool)
-                    or not math.isfinite(v)
-                ):
+                if not _num(v):
                     continue  # null/NaN/invalid sample: not plottable
                 xs, ys = series[ev["series"]]
-                xs.append(ev.get("step", len(xs)))
+                step = ev.get("step")
+                xs.append(step if _num(step) else len(xs))
                 ys.append(v)
     if malformed:
         print(
@@ -83,25 +108,29 @@ def main() -> int:
 
     loss_keys = [k for k in series if k.endswith("loss")]
     acc_keys = [k for k in series if k.endswith("acc")]
-    n_axes = 1 + bool(acc_keys)
+    dyn_keys = [k for k in series if k.startswith("dynamics/")]
+    # one panel per populated group; norms/ratios span orders of
+    # magnitude, so the dynamics panel is log-scaled
+    panels = [(sorted(loss_keys), "loss", False)]
+    if acc_keys:
+        panels.append((sorted(acc_keys), "accuracy (%)", False))
+    if dyn_keys:
+        panels.append((sorted(dyn_keys), "norm / ratio", True))
+    n_axes = len(panels)
     fig, axes = plt.subplots(1, n_axes, figsize=(6 * n_axes, 4))
     axes = [axes] if n_axes == 1 else list(axes)
 
-    for k in sorted(loss_keys):
-        xs, ys = series[k]
-        axes[0].plot(xs, ys, marker=".", label=k)
-    axes[0].set_xlabel("step")
-    axes[0].set_ylabel("loss")
-    axes[0].legend()
-    axes[0].grid(True, alpha=0.3)
-    if acc_keys:
-        for k in sorted(acc_keys):
+    for ax, (keys, ylabel, log_y) in zip(axes, panels):
+        for k in keys:
             xs, ys = series[k]
-            axes[1].plot(xs, ys, marker=".", label=k)
-        axes[1].set_xlabel("step")
-        axes[1].set_ylabel("accuracy (%)")
-        axes[1].legend()
-        axes[1].grid(True, alpha=0.3)
+            ax.plot(xs, ys, marker=".", label=k)
+        ax.set_xlabel("step")
+        ax.set_ylabel(ylabel)
+        if keys:
+            ax.legend()
+        if log_y:
+            ax.set_yscale("log")
+        ax.grid(True, alpha=0.3)
     if params:
         fig.suptitle(
             ", ".join(f"{k}={v}" for k, v in list(params.items())[:6]),
