@@ -587,6 +587,21 @@ def _rows(epochs: int) -> list[dict]:
             "args": {"dtype": "bfloat16", "rate": 4.0, "requests": 24,
                      "max_new": 32, "kv_dtype": "int8"},
         },
+        # speculative decoding (--spec-decode 4): the early-exit
+        # drafter + one k+1-position verify per tick, with both gates
+        # ASSERTED in the row - emitted tokens per speculative
+        # slot-step > 1.5 (the one-token-per-slot ceiling is 1.0), and
+        # e2e tokens/s STRICTLY greater than the paired non-spec run
+        # the row measures first at the same offered load. Greedy
+        # streams stay token-exact vs generate(), so this row's
+        # speedup is oracle-gated, not approximate (docs/SERVING.md)
+        {
+            "id": "serve_d512_L8_spec_k4_openloop",
+            "kind": "serving",
+            "est_s": 1800,
+            "args": {"dtype": "bfloat16", "rate": 4.0, "requests": 24,
+                     "max_new": 32, "spec_decode": 4},
+        },
         # quantized-vs-bf16 training parity (the other honesty rail):
         # same init + byte-identical batches, attention matmuls in
         # int8/fp8 (ops/quant.py), final-loss delta + held-out logit

@@ -428,6 +428,37 @@ def apply(params, tokens, cfg: TransformerConfig, **kw):
     return apply_with_aux(params, tokens, cfg, **kw)[0]
 
 
+def early_exit_params(params, n_layers: int):
+    """The same param tree truncated to its FIRST ``n_layers`` blocks
+    (leading stacked-layer axis sliced; embed / final LN / head shared
+    with the full model). This IS the serving drafter's model
+    (docs/SERVING.md "Speculative decoding"): `ServeEngine` slices once
+    at init and runs k cheap greedy steps through it per speculative
+    round, so the draft distribution is pinned against
+    ``apply(early_exit_params(p, E), ...)`` - no second set of weights,
+    no train-time change."""
+    total = next(iter(jax.tree.leaves(params["layers"]))).shape[0]
+    if not 1 <= n_layers <= total:
+        raise ValueError(
+            f"early-exit depth must be in [1, {total}], got {n_layers}"
+        )
+    return {
+        **params,
+        "layers": jax.tree.map(lambda p: p[:n_layers], params["layers"]),
+    }
+
+
+def early_exit_logits(params, tokens, cfg: TransformerConfig,
+                      n_layers: int):
+    """Teacher-forced logits of the early-exit drafter: the first
+    ``n_layers`` blocks + the shared final LN/head, (B, S) -> (B, S,
+    vocab) f32. The offline oracle tests pin the engine's jitted
+    drafter against (greedy argmax over these logits == the drafted
+    tokens)."""
+    return apply(early_exit_params(params, n_layers), tokens, cfg,
+                 attn_impl="full")
+
+
 def param_count(params) -> int:
     return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
 

@@ -187,15 +187,51 @@ def _low_precision_dot(a_q, b_q, fmt: str, dn):
     )
 
 
-def quantized_matmul(a, b, fmt: str = "int8"):
+def prequantize_weight(w, fmt: str = "int8"):
+    """Quantize a ``(k, n)`` weight ONCE for reuse across many matmuls:
+    per-COLUMN symmetric codes stored transposed as ``(n, k)`` plus the
+    ``(n,)`` f32 scales - exactly the layout `quantized_matmul` builds
+    for its right operand on every call. Leading batch/layer axes pass
+    through (a stacked ``(L, k, n)`` weight yields ``(L, n, k)`` codes
+    + ``(L, n)`` scales - only the last two axes swap). Serving's
+    ``--precision int8-w`` quantizes each weight at engine init and
+    feeds the pair back via ``b=(w_q, w_scale)``, so the per-step cost
+    drops to quantizing the (tiny) activation rows."""
+    _check_fmt(fmt)
+    return quantize(jnp.swapaxes(w, -1, -2), fmt)
+
+
+def quantized_matmul(a, b, fmt: str = "int8", *,
+                     weight_only: bool = False):
     """``a (m, k) @ b (k, n)`` through per-row symmetric quantization of
     both operands (b quantized per COLUMN - its contraction axis is
-    rows), low-precision dot, f32 dequantized result. The XLA reference
-    for the Pallas quantized matmul paths, and a usable building block
-    on backends without them."""
+    rows), low-precision dot, f32 dequantized result. ``b`` may also be
+    a ``(b_q, b_scale)`` pair from `prequantize_weight` - same numerics,
+    weight-side quantization amortized to zero. The XLA reference for
+    the Pallas quantized matmul paths, and a usable building block on
+    backends without them.
+
+    ``weight_only=True`` is the W8A16 serving recipe: ONLY the weight
+    is quantized (codes read from int8 storage, dequantized by the
+    per-column scale inside the dot); the activation rows stay at full
+    precision. Decode matmuls are bandwidth-bound, so int8 storage
+    already buys the 2x HBM win, while skipping activation quantization
+    keeps per-token top-1 agreement at the >= 99% gate (the dual-int8
+    dot's activation rounding costs ~6% of argmaxes on these model
+    scales - fine for training parity tolerances, not for serving's
+    token-exactness bar)."""
     _check_fmt(fmt)
+    if isinstance(b, tuple):
+        b_q, sb = b                               # (n, k), (n,) stored
+    else:
+        b_q, sb = quantize(b.T, fmt)              # (n, k), (n,)
+    if weight_only:
+        acc = jax.lax.dot_general(
+            a.astype(jnp.float32), b_q.astype(jnp.float32),
+            (((1,), (1,)), ((), ())),
+        )                                         # (m, n) f32
+        return acc * sb[None, :]
     a_q, sa = quantize(a, fmt)                    # (m, k), (m,)
-    b_q, sb = quantize(b.T, fmt)                  # (n, k), (n,)
     acc = _low_precision_dot(
         a_q, b_q, fmt, (((1,), (1,)), ((), ()))
     )                                             # (m, n) f32

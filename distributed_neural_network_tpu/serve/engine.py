@@ -36,11 +36,36 @@ second). If nothing at all could run, the youngest parked sequence is
 preempted - blocks freed, position reset - and re-admitted later;
 greedy decoding (and the per-position sampling keys) make the replay
 deterministic, and already-streamed tokens are not re-emitted.
+
+**Speculative decoding** (``spec_decode = k > 0``): greedy slots break
+the one-token-per-tick ceiling. A cheap drafter - the SAME model
+early-exited after its first ``spec_draft_layers`` blocks
+(models/transformer.py early_exit_params: shared embed/final-LN/head,
+no second weight set) - proposes k tokens per slot in one jitted call
+that READS the paged pool but writes nothing (in-flight draft K/V live
+in a per-call buffer, so the pool - and under int8 its running scales -
+never sees a draft). One target-model VERIFY step then consumes
+``[t0, d1..dk]`` at positions ``pos..pos+k`` in a single call (a new
+per-(batch, k+1, table-width) jitted bucket family, pre-compiled by
+`warmup()`), writing all k+1 KV entries optimistically and returning
+the greedy prediction at every position. The host accepts the longest
+draft prefix that matches, emits ``a+1`` tokens (the all-rejected step
+emits exactly 1 - the same token plain decode would), and REWINDS the
+block-table write cursor past the rejected suffix
+(`kv_cache.py rewind` - the same bookkeeping preemption replay
+performs, so replay/cancel invariants carry over byte-identically and
+greedy streams stay token-exact vs the offline `generate()` oracle).
+Sampled slots (temperature > 0) take the plain decode path untouched -
+their per-(seed, position) keys never see speculation. Preemption
+replay feeds already-known tokens back as drafts (guaranteed
+acceptance under greedy determinism), so replay advances k+1 positions
+per tick instead of one.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from dataclasses import dataclass, field
 
@@ -54,10 +79,53 @@ from ..models.transformer import (
     _sinusoid_pe,
 )
 from ..ops.decode_pallas import decode_cache_attention, decode_kernel_ok
+from ..ops.quant import prequantize_weight, quantized_matmul
 from .kv_cache import KVCacheConfig, OutOfBlocks, PagedKVCache
 
 _INT8_MAX = 127.0
 _SCALE_EPS = 1e-30
+
+# the weight matrices --precision int8-w stores quantized (per-column
+# int8 codes + per-column f32 scales, ops/quant.py prequantize_weight);
+# embeddings (a lookup), layer norms and biases stay f32
+_QUANT_WEIGHT_KEYS = ("wq", "wk", "wv", "wo", "w1", "w2")
+
+
+def _prequantize_params(params):
+    """Quantize every transformer-block matmul weight of the (dense)
+    param tree once at engine init: each ``w`` becomes ``{"q": int8
+    (n, k), "s": f32 (n,)}`` - exactly the pair `ops/quant.py
+    quantized_matmul` consumes as a prequantized right operand. Stacked
+    layer weights keep their leading layer axis, so the jitted steps'
+    layer scan is unchanged. The head (logit) projection stays full
+    precision: it feeds the argmax directly, so quantizing it flips
+    top-1 tokens far more than any block weight, for a d_model x vocab
+    sliver of the weight bytes."""
+    layers = dict(params["layers"])
+    for key in _QUANT_WEIGHT_KEYS:
+        q, s = prequantize_weight(layers[key])
+        layers[key] = {"q": q, "s": s}
+    out = dict(params)
+    out["layers"] = layers
+    return out
+
+
+def _make_mm(weight_quantized: bool, dt):
+    """The one matmul the jitted steps route every weight through:
+    plain ``x @ w`` at the model dtype, or - under int8-w - the
+    low-precision dot against the prequantized codes (activation rows
+    quantized per call, int8 x int8 -> int32, f32 dequant)."""
+    if not weight_quantized:
+        def mm(x, w):
+            return x @ w.astype(dt)
+        return mm
+
+    def mm(x, w):
+        shp = x.shape
+        y = quantized_matmul(x.reshape(-1, shp[-1]), (w["q"], w["s"]),
+                             weight_only=True)
+        return y.astype(dt).reshape(*shp[:-1], y.shape[-1])
+    return mm
 
 
 @dataclass(frozen=True)
@@ -86,16 +154,47 @@ class EngineConfig:
     # sublane-legal block, xla otherwise (off-TPU the kernel only runs
     # interpreted - a test vehicle, not a fast path)
     decode_impl: str = "auto"
+    # speculative decoding: k > 0 lets each GREEDY slot emit up to k+1
+    # tokens per tick (draft k with the early-exit drafter, verify all
+    # of them in one multi-position target step, rewind the rejected
+    # suffix). 0 = off (every slot is one token per tick, the PR 12
+    # contract). docs/SERVING.md "Speculative decoding"
+    spec_decode: int = 0
+    # early-exit depth of the drafter (first E blocks of the same
+    # model); 0 = auto: max(1, n_layers // 8) - the measured
+    # sweet spot where draft agreement stays useful while the drafter's
+    # weight traffic stays a small fraction of the target step's
+    spec_draft_layers: int = 0
+    # "bf16" = params at the model dtype; "int8" = every matmul weight
+    # stored int8 + per-column f32 scales (ops/quant.py
+    # prequantize_weight), consumed by quantized_matmul in every jitted
+    # step - the --precision int8-w path, accuracy gated >= 99% top-1
+    # vs the bf16 oracle like int8-kv (composes with it)
+    weight_dtype: str = "bf16"
 
     def __post_init__(self):
         if self.kv_dtype not in ("bf16", "int8"):
             raise ValueError(
                 f"kv_dtype must be 'bf16' or 'int8', got {self.kv_dtype!r}"
             )
+        if self.weight_dtype not in ("bf16", "int8"):
+            raise ValueError(
+                f"weight_dtype must be 'bf16' or 'int8', got "
+                f"{self.weight_dtype!r}"
+            )
         if self.decode_impl not in ("auto", "xla", "pallas"):
             raise ValueError(
                 f"decode_impl must be auto/xla/pallas, got "
                 f"{self.decode_impl!r}"
+            )
+        if self.spec_decode < 0:
+            raise ValueError(
+                f"spec_decode must be >= 0, got {self.spec_decode}"
+            )
+        if self.spec_draft_layers < 0:
+            raise ValueError(
+                f"spec_draft_layers must be >= 0 (0 = auto), got "
+                f"{self.spec_draft_layers}"
             )
 
     def kv(self) -> KVCacheConfig:
@@ -167,7 +266,37 @@ class ServeEngine:
         self.cfg = cfg
         self.ecfg = ecfg
         self.kv = PagedKVCache(ecfg.kv())
+        self.weight_quantized = ecfg.weight_dtype == "int8"
+        if self.weight_quantized:
+            params = _prequantize_params(params)
         self.params = jax.device_put(params)
+        self.spec_k = ecfg.spec_decode
+        self.draft_layers = 0
+        self.draft_params = None
+        if self.spec_k:
+            self.draft_layers = (
+                ecfg.spec_draft_layers or max(1, cfg.n_layers // 8)
+            )
+            if self.draft_layers > cfg.n_layers:
+                raise ValueError(
+                    f"spec_draft_layers {self.draft_layers} > model "
+                    f"n_layers {cfg.n_layers}"
+                )
+            if self.spec_k + 1 >= ecfg.max_seq_len:
+                raise ValueError(
+                    f"spec_decode {self.spec_k} leaves no room under "
+                    f"max_seq_len {ecfg.max_seq_len}"
+                )
+            # the drafter IS the target model early-exited: slice the
+            # stacked layer axis once (embed / final LN / head shared) -
+            # works identically on prequantized int8-w trees
+            self.draft_params = {
+                **self.params,
+                "layers": jax.tree.map(
+                    lambda p: p[: self.draft_layers],
+                    self.params["layers"],
+                ),
+            }
         dt = cfg.dtype
         L, H, Dh = cfg.n_layers, cfg.n_heads, cfg.head_dim
         slots = self.kv.cfg.pool_slots
@@ -189,10 +318,17 @@ class ServeEngine:
         self.active: list[Sequence] = []
         self._step_fns: dict = {}
         self._prefill_fns: dict = {}
+        self._draft_fns: dict = {}
+        self._verify_fns: dict = {}
         self.ticks = 0
         self.decode_tokens = 0
         self.prefill_tokens = 0
         self.stall_events = 0
+        # cumulative speculative-decoding counters (the
+        # serve_spec_*_tokens_total metrics + /v1/status)
+        self.spec_proposed_tokens = 0
+        self.spec_accepted_tokens = 0
+        self.spec_steps = 0
         # drained from the FRONT by the scheduler (popleft), re-parked at
         # the back on eviction - a deque so both ends are O(1)
         self.preempted: deque[Sequence] = deque()
@@ -240,6 +376,12 @@ class ServeEngine:
     def kv_dtype_name(self) -> str:
         """The /metrics ``serve_kv_dtype`` label value."""
         if self.quantized:
+            return "int8"
+        return "bf16" if self.cfg.dtype == jnp.bfloat16 else "f32"
+
+    def weight_dtype_name(self) -> str:
+        """The /metrics ``serve_weight_dtype`` label value."""
+        if self.weight_quantized:
             return "int8"
         return "bf16" if self.cfg.dtype == jnp.bfloat16 else "f32"
 
@@ -319,6 +461,7 @@ class ServeEngine:
         quantized = self.quantized
         attn_route = self._attn_route(W)
         interpret = jax.default_backend() != "tpu"
+        mm = _make_mm(self.weight_quantized, dt)
 
         def xla_attend(q, ks, vs, live):
             # the PR 12 chain, byte-identical for the bf16 pool
@@ -389,9 +532,9 @@ class ServeEngine:
                     lp, ck, cv = lcaches
                     ksc = vsc = None
                 h = _layer_norm(x, lp["ln1_scale"], lp["ln1_bias"]).astype(dt)
-                q = (h @ lp["wq"].astype(dt)).reshape(B, 1, H, Dh)
-                k = (h @ lp["wk"].astype(dt)).reshape(B, H, Dh)
-                v = (h @ lp["wv"].astype(dt)).reshape(B, H, Dh)
+                q = mm(h, lp["wq"]).reshape(B, 1, H, Dh)
+                k = mm(h, lp["wk"]).reshape(B, H, Dh)
+                v = mm(h, lp["wv"]).reshape(B, H, Dh)
                 if quantized:
                     ck, ksc = append_q8(ck, ksc, k)
                     cv, vsc = append_q8(cv, vsc, v)
@@ -435,14 +578,12 @@ class ServeEngine:
                         ks = ck[gather_idx].transpose(0, 2, 1, 3)
                         vs = cv[gather_idx].transpose(0, 2, 1, 3)
                         o = xla_attend(q, ks, vs, live)
-                x = x + o @ lp["wo"].astype(dt)
+                x = x + mm(o, lp["wo"])
                 h2 = _layer_norm(
                     x, lp["ln2_scale"], lp["ln2_bias"]
                 ).astype(dt)
-                h2 = jax.nn.gelu(
-                    h2 @ lp["w1"].astype(dt) + lp["b1"].astype(dt)
-                )
-                x = x + h2 @ lp["w2"].astype(dt) + lp["b2"].astype(dt)
+                h2 = jax.nn.gelu(mm(h2, lp["w1"]) + lp["b1"].astype(dt))
+                x = x + mm(h2, lp["w2"]) + lp["b2"].astype(dt)
                 if quantized:
                     return x, (ck, cv, ksc, vsc)
                 return x, (ck, cv)
@@ -459,9 +600,7 @@ class ServeEngine:
             h = _layer_norm(
                 x, params["lnf_scale"], params["lnf_bias"]
             ).astype(dt)
-            logits = (h[:, 0] @ params["head"].astype(dt)).astype(
-                jnp.float32
-            )
+            logits = h[:, 0] @ params["head"].astype(dt).astype(jnp.float32)
             greedy = jnp.argmax(logits, axis=-1)
             sampled = jax.vmap(
                 lambda k_, lg, t: jax.random.categorical(
@@ -498,6 +637,7 @@ class ServeEngine:
         S = W * bs
         neg = jnp.asarray(-1e30, jnp.float32)
         quantized = self.quantized
+        mm = _make_mm(self.weight_quantized, dt)
 
         def prefill(params, k_pool, v_pool, k_scale, v_scale,
                     toks, pos0, table, n_valid):
@@ -559,9 +699,9 @@ class ServeEngine:
                     lp, ck, cv = lcaches
                     ksc = vsc = None
                 h = _layer_norm(x, lp["ln1_scale"], lp["ln1_bias"]).astype(dt)
-                q = (h @ lp["wq"].astype(dt)).reshape(1, C, H, Dh)
-                k = (h @ lp["wk"].astype(dt)).reshape(C, H, Dh)
-                v = (h @ lp["wv"].astype(dt)).reshape(C, H, Dh)
+                q = mm(h, lp["wq"]).reshape(1, C, H, Dh)
+                k = mm(h, lp["wk"]).reshape(C, H, Dh)
+                v = mm(h, lp["wv"]).reshape(C, H, Dh)
                 if quantized:
                     ck, ksc = append_q8(ck, ksc, k)
                     cv, vsc = append_q8(cv, vsc, v)
@@ -590,14 +730,12 @@ class ServeEngine:
                 o = jnp.einsum(
                     "bhqs,bhsd->bqhd", probs.astype(dt), vs
                 ).reshape(1, C, H * Dh)
-                x = x + o @ lp["wo"].astype(dt)
+                x = x + mm(o, lp["wo"])
                 h2 = _layer_norm(
                     x, lp["ln2_scale"], lp["ln2_bias"]
                 ).astype(dt)
-                h2 = jax.nn.gelu(
-                    h2 @ lp["w1"].astype(dt) + lp["b1"].astype(dt)
-                )
-                x = x + h2 @ lp["w2"].astype(dt) + lp["b2"].astype(dt)
+                h2 = jax.nn.gelu(mm(h2, lp["w1"]) + lp["b1"].astype(dt))
+                x = x + mm(h2, lp["w2"]) + lp["b2"].astype(dt)
                 if quantized:
                     return x, (ck, cv, ksc, vsc)
                 return x, (ck, cv)
@@ -614,7 +752,7 @@ class ServeEngine:
             h = _layer_norm(
                 x, params["lnf_scale"], params["lnf_bias"]
             ).astype(dt)
-            logits = (h[0] @ params["head"].astype(dt)).astype(jnp.float32)
+            logits = h[0] @ params["head"].astype(dt).astype(jnp.float32)
             return k_pool, v_pool, k_scale, v_scale, logits  # (C, vocab)
 
         if quantized:
@@ -630,6 +768,265 @@ class ServeEngine:
 
             fn = jax.jit(prefill_bf16)
         self._prefill_fns[(C, W)] = fn
+        return fn
+
+    def _draft_fn(self, B: int, W: int):
+        """k greedy early-exit steps in ONE jitted call: reads the paged
+        pool (history < pos), keeps the in-flight draft K/V in a local
+        per-call buffer, writes NOTHING back - the pool (and under int8
+        its running scales) never sees a draft, so rejected speculation
+        cannot pollute live state."""
+        fn = self._draft_fns.get((B, W))
+        if fn is not None:
+            return fn
+        cfg, kv = self.cfg, self.kv.cfg
+        dt = cfg.dtype
+        E, K = self.draft_layers, self.spec_k
+        H, Dh = cfg.n_heads, cfg.head_dim
+        bs = kv.block_size
+        S = W * bs
+        neg = jnp.asarray(-1e30, jnp.float32)
+        quantized = self.quantized
+        mm = _make_mm(self.weight_quantized, dt)
+
+        def draft(params, k_pool, v_pool, k_scale, v_scale,
+                  tok, pos, table):
+            # tok/pos (B,), table (B, W) -> (B, K) greedy draft tokens.
+            # Gather + (int8) dequantize the E layers of pool history
+            # ONCE - it is invariant across the K draft steps.
+            gather_idx = (
+                (table * bs)[:, :, None] + jnp.arange(bs)[None, None, :]
+            ).reshape(B, S)
+            hk = k_pool[:E][:, gather_idx]     # (E, B, S, H, Dh)
+            hv = v_pool[:E][:, gather_idx]
+            if quantized:
+                k_slot = jnp.repeat(
+                    k_scale[:E][:, table], bs, axis=2
+                )                               # (E, B, S, H)
+                v_slot = jnp.repeat(v_scale[:E][:, table], bs, axis=2)
+                hk = (hk.astype(jnp.float32) * k_slot[..., None]).astype(dt)
+                hv = (hv.astype(jnp.float32) * v_slot[..., None]).astype(dt)
+            hk = hk.transpose(0, 1, 3, 2, 4)   # (E, B, H, S, Dh)
+            hv = hv.transpose(0, 1, 3, 2, 4)
+            hist_live = (jnp.arange(S)[None, :] < pos[:, None])  # (B, S)
+            bufk = jnp.zeros((E, B, H, K, Dh), dt)
+            bufv = jnp.zeros((E, B, H, K, Dh), dt)
+            drafts = []
+            for i in range(K):
+                x = params["embed"][tok].astype(dt)[:, None, :]
+                x = x + _sinusoid_pe(pos + i, cfg.d_model, dt)[:, None, :]
+                loc = jnp.broadcast_to(
+                    (jnp.arange(K) <= i)[None, :], (B, K)
+                )
+                live = jnp.concatenate(
+                    [hist_live, loc], axis=1
+                )[:, None, None, :]             # (B, 1, 1, S + K)
+
+                def layer_step(x, lc, i=i):
+                    lp, lhk, lhv, bk, bv = lc
+                    h = _layer_norm(
+                        x, lp["ln1_scale"], lp["ln1_bias"]
+                    ).astype(dt)
+                    q = mm(h, lp["wq"]).reshape(B, 1, H, Dh)
+                    kk = mm(h, lp["wk"]).reshape(B, H, 1, Dh)
+                    vv = mm(h, lp["wv"]).reshape(B, H, 1, Dh)
+                    bk = jax.lax.dynamic_update_slice_in_dim(
+                        bk, kk, i, axis=2
+                    )
+                    bv = jax.lax.dynamic_update_slice_in_dim(
+                        bv, vv, i, axis=2
+                    )
+                    ks = jnp.concatenate([lhk, bk], axis=2)
+                    vs = jnp.concatenate([lhv, bv], axis=2)
+                    scores = jnp.einsum(
+                        "bqhd,bhsd->bhqs", q, ks
+                    ).astype(jnp.float32) / np.sqrt(Dh)
+                    probs = jax.nn.softmax(
+                        jnp.where(live, scores, neg), axis=-1
+                    )
+                    o = jnp.einsum(
+                        "bhqs,bhsd->bqhd", probs.astype(dt), vs
+                    ).reshape(B, 1, H * Dh)
+                    x = x + mm(o, lp["wo"])
+                    h2 = _layer_norm(
+                        x, lp["ln2_scale"], lp["ln2_bias"]
+                    ).astype(dt)
+                    h2 = jax.nn.gelu(
+                        mm(h2, lp["w1"]) + lp["b1"].astype(dt)
+                    )
+                    x = x + mm(h2, lp["w2"]) + lp["b2"].astype(dt)
+                    return x, (bk, bv)
+
+                x, (bufk, bufv) = jax.lax.scan(
+                    layer_step, x, (params["layers"], hk, hv, bufk, bufv),
+                    unroll=min(E, 8),
+                )
+                h = _layer_norm(
+                    x, params["lnf_scale"], params["lnf_bias"]
+                ).astype(dt)
+                logits = h[:, 0] @ params["head"].astype(dt).astype(jnp.float32)
+                tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                drafts.append(tok)
+            return jnp.stack(drafts, axis=1)    # (B, K)
+
+        if quantized:
+            fn = jax.jit(draft)
+        else:
+            def draft_bf16(params, k_pool, v_pool, tok, pos, table):
+                return draft(
+                    params, k_pool, v_pool, None, None, tok, pos, table
+                )
+
+            fn = jax.jit(draft_bf16)
+        self._draft_fns[(B, W)] = fn
+        return fn
+
+    def _verify_fn(self, B: int, W: int):
+        """One target-model step over K = spec_k + 1 positions per slot
+        (inputs ``[t0, d1..dk]`` at ``pos..pos+k``): write-then-gather
+        over the paged pool with the chunked-prefill causal mask
+        generalized to a batch axis, greedy prediction returned at
+        EVERY position - the host accepts the longest matching draft
+        prefix and rewinds the rest."""
+        fn = self._verify_fns.get((B, W))
+        if fn is not None:
+            return fn
+        cfg, kv = self.cfg, self.kv.cfg
+        dt = cfg.dtype
+        L, H, Dh = cfg.n_layers, cfg.n_heads, cfg.head_dim
+        K = self.spec_k + 1
+        bs = kv.block_size
+        S = W * bs
+        neg = jnp.asarray(-1e30, jnp.float32)
+        quantized = self.quantized
+        mm = _make_mm(self.weight_quantized, dt)
+
+        def verify(params, k_pool, v_pool, k_scale, v_scale,
+                   toks, pos0, table):
+            # toks (B, K), pos0 (B,), table (B, W)
+            pv = pos0[:, None] + jnp.arange(K)[None, :]      # (B, K)
+            x = params["embed"][toks].astype(dt)             # (B, K, d)
+            x = x + _sinusoid_pe(
+                pv.reshape(-1), cfg.d_model, dt
+            ).reshape(B, K, cfg.d_model)
+            blkv = jnp.take_along_axis(table, pv // bs, axis=1)  # (B, K)
+            flat = blkv * bs + pv % bs                           # (B, K)
+            gather_idx = (
+                (table * bs)[:, :, None] + jnp.arange(bs)[None, None, :]
+            ).reshape(B, S)
+            # query (b, i) attends pool slots <= pos0[b] + i (its own
+            # just-written position included - write-then-gather, the
+            # chunked-prefill pattern with a batch axis)
+            live = (
+                jnp.arange(S)[None, None, :] <= pv[:, :, None]
+            )[:, None]                                       # (B,1,K,S)
+
+            def append_q8(pool, scales, val):
+                # batch form of the chunked-prefill append: per-block
+                # amax by scatter-max (commutative -> deterministic
+                # under duplicate block ids), whole-table-span requant
+                # under the grown scales, then the K new tokens written
+                # at their final scales
+                a = jnp.max(jnp.abs(val.astype(jnp.float32)), -1)  # (B,K,H)
+                new_scales = scales.at[blkv].max(a / _INT8_MAX)
+                ratio = jnp.where(
+                    new_scales > 0.0,
+                    scales / jnp.maximum(new_scales, _SCALE_EPS), 1.0
+                )                                            # (nb, H)
+                ratio_slot = jnp.repeat(ratio[table], bs, axis=1)
+                slab = pool[gather_idx].astype(jnp.float32)  # (B,S,H,Dh)
+                slab = jnp.clip(
+                    jnp.round(slab * ratio_slot[..., None]),
+                    -_INT8_MAX, _INT8_MAX,
+                ).astype(jnp.int8)
+                pool = pool.at[gather_idx].set(slab)
+                s_tok = new_scales[blkv]                     # (B, K, H)
+                q8 = jnp.clip(
+                    jnp.round(
+                        val.astype(jnp.float32)
+                        / jnp.maximum(s_tok[..., None], _SCALE_EPS)
+                    ),
+                    -_INT8_MAX, _INT8_MAX,
+                ).astype(jnp.int8)
+                pool = pool.at[flat].set(q8)
+                return pool, new_scales
+
+            def layer_step(x, lcaches):
+                if quantized:
+                    lp, ck, cv, ksc, vsc = lcaches
+                else:
+                    lp, ck, cv = lcaches
+                    ksc = vsc = None
+                h = _layer_norm(
+                    x, lp["ln1_scale"], lp["ln1_bias"]
+                ).astype(dt)
+                q = mm(h, lp["wq"]).reshape(B, K, H, Dh)
+                k = mm(h, lp["wk"]).reshape(B, K, H, Dh)
+                v = mm(h, lp["wv"]).reshape(B, K, H, Dh)
+                if quantized:
+                    ck, ksc = append_q8(ck, ksc, k)
+                    cv, vsc = append_q8(cv, vsc, v)
+                    k_slot = jnp.repeat(ksc[table], bs, axis=1)  # (B,S,H)
+                    v_slot = jnp.repeat(vsc[table], bs, axis=1)
+                    ks = (
+                        ck[gather_idx].astype(jnp.float32)
+                        * k_slot[..., None]
+                    ).astype(dt).transpose(0, 2, 1, 3)
+                    vs = (
+                        cv[gather_idx].astype(jnp.float32)
+                        * v_slot[..., None]
+                    ).astype(dt).transpose(0, 2, 1, 3)
+                else:
+                    ck = ck.at[flat].set(k)
+                    cv = cv.at[flat].set(v)
+                    ks = ck[gather_idx].transpose(0, 2, 1, 3)
+                    vs = cv[gather_idx].transpose(0, 2, 1, 3)
+                scores = jnp.einsum(
+                    "bqhd,bhsd->bhqs", q, ks
+                ).astype(jnp.float32) / np.sqrt(Dh)
+                probs = jax.nn.softmax(
+                    jnp.where(live, scores, neg), axis=-1
+                )
+                o = jnp.einsum(
+                    "bhqs,bhsd->bqhd", probs.astype(dt), vs
+                ).reshape(B, K, H * Dh)
+                x = x + mm(o, lp["wo"])
+                h2 = _layer_norm(
+                    x, lp["ln2_scale"], lp["ln2_bias"]
+                ).astype(dt)
+                h2 = jax.nn.gelu(mm(h2, lp["w1"]) + lp["b1"].astype(dt))
+                x = x + mm(h2, lp["w2"]) + lp["b2"].astype(dt)
+                if quantized:
+                    return x, (ck, cv, ksc, vsc)
+                return x, (ck, cv)
+
+            if quantized:
+                xs = (params["layers"], k_pool, v_pool, k_scale, v_scale)
+            else:
+                xs = (params["layers"], k_pool, v_pool)
+            x, out = jax.lax.scan(layer_step, x, xs, unroll=min(L, 8))
+            if quantized:
+                k_pool, v_pool, k_scale, v_scale = out
+            else:
+                k_pool, v_pool = out
+            h = _layer_norm(
+                x, params["lnf_scale"], params["lnf_bias"]
+            ).astype(dt)
+            logits = h @ params["head"].astype(dt).astype(jnp.float32)  # (B,K,v)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return k_pool, v_pool, k_scale, v_scale, nxt
+
+        if quantized:
+            fn = jax.jit(verify)
+        else:
+            def verify_bf16(params, k_pool, v_pool, toks, pos0, table):
+                k_pool, v_pool, _, _, nxt = verify(
+                    params, k_pool, v_pool, None, None, toks, pos0, table
+                )
+                return k_pool, v_pool, nxt
+
+            fn = jax.jit(verify_bf16)
+        self._verify_fns[(B, W)] = fn
         return fn
 
     # ----------------------------------------------------------- warmup
@@ -702,6 +1099,41 @@ class ServeEngine:
                     else:
                         self.k_pool, self.v_pool, _ = fn(*args)
                     n += 1
+        if self.spec_k:
+            # the speculative bucket families: drafter + K-position
+            # verify per (batch, width). Dummy writes land in the
+            # scratch block (zero tables), like every other warmup call.
+            K = self.spec_k + 1
+            for B in batches:
+                for W in widths:
+                    dfn = self._draft_fn(B, W)
+                    dargs = (
+                        self.draft_params, self.k_pool, self.v_pool,
+                    ) + ((self.k_scale, self.v_scale) if self.quantized
+                         else ()) + (
+                        jnp.zeros((B,), jnp.int32),
+                        jnp.zeros((B,), jnp.int32),
+                        jnp.zeros((B, W), jnp.int32),
+                    )
+                    dfn(*dargs)  # read-only: no pool state to restore
+                    n += 1
+                    vfn = self._verify_fn(B, W)
+                    vargs = (
+                        self.params, self.k_pool, self.v_pool,
+                    ) + ((self.k_scale, self.v_scale) if self.quantized
+                         else ()) + (
+                        jnp.zeros((B, K), jnp.int32),
+                        jnp.zeros((B,), jnp.int32),
+                        jnp.zeros((B, W), jnp.int32),
+                    )
+                    if self.quantized:
+                        (self.k_pool, self.v_pool, self.k_scale,
+                         self.v_scale, _) = vfn(*vargs)
+                        self.k_scale = self.k_scale.at[:, 0, :].set(0.0)
+                        self.v_scale = self.v_scale.at[:, 0, :].set(0.0)
+                    else:
+                        self.k_pool, self.v_pool, _ = vfn(*vargs)
+                    n += 1
         return n
 
     # ------------------------------------------------------------ the tick
@@ -753,6 +1185,148 @@ class ServeEngine:
         self.stall_events += 1
         return victim
 
+    def _spec_eligible(self, s: Sequence) -> bool:
+        """Slots speculation applies to: GREEDY (sampled slots keep the
+        plain path so their per-(seed, position) keys never change),
+        past prefill (positions pos+1..pos+k must all be generation
+        positions, i.e. pos >= prompt_len - 1), and with room for k+1
+        optimistic writes under max_seq_len."""
+        return (
+            s.temperature == 0.0
+            and s.pos >= s.prompt_len - 1
+            and s.pos + self.spec_k + 1 <= self.ecfg.max_seq_len
+        )
+
+    def _rewind_seq(self, seq_id: int, n_tokens: int) -> None:
+        """Rewind the KV write cursor past a rejected speculative
+        suffix; freed blocks get their int8 scales zeroed (the same
+        history-free-reuse contract `_free_seq` keeps)."""
+        freed = self.kv.rewind(seq_id, n_tokens)
+        if freed and self.quantized:
+            idx = jnp.asarray(freed, jnp.int32)
+            self.k_scale = self.k_scale.at[:, idx, :].set(0.0)
+            self.v_scale = self.v_scale.at[:, idx, :].set(0.0)
+
+    def _spec_step(self, batch: list, stats: dict, seqstat) -> None:
+        """The speculative phase of one tick: draft k tokens per slot
+        (skipped for slots whose future is already known from
+        preemption replay - their own `out` tokens are the drafts,
+        guaranteed acceptance under greedy determinism), verify all
+        k+1 positions in one target step, accept the longest matching
+        prefix, emit, rewind the rest."""
+        k = self.spec_k
+        K = k + 1
+        bs = self.kv.cfg.block_size
+        n = len(batch)
+        W = _bucket(max((s.pos + k) // bs + 1 for s in batch))
+        drafts = np.zeros((n, k), np.int32)
+        need_draft = []
+        for idx, s in enumerate(batch):
+            j0 = s.pos + 1 - s.prompt_len
+            if 0 <= j0 and j0 + k <= len(s.out):
+                drafts[idx] = s.out[j0: j0 + k]   # replay: known future
+            else:
+                need_draft.append(idx)
+        draft_s = 0.0
+        if need_draft:
+            Bd = _bucket(len(need_draft))
+            if Bd > self.ecfg.max_batch:
+                Bd = self.ecfg.max_batch
+            dtok = np.zeros((Bd,), np.int32)
+            dpos = np.zeros((Bd,), np.int32)
+            for row, idx in enumerate(need_draft):
+                dtok[row] = batch[idx].next_input()
+                dpos[row] = batch[idx].pos
+            dtable = self.kv.table(
+                [batch[i].seq_id for i in need_draft]
+                + [-1] * (Bd - len(need_draft)), W,
+            )
+            fn = self._draft_fn(Bd, W)
+            t0 = time.perf_counter()
+            args = (
+                self.draft_params, self.k_pool, self.v_pool,
+            ) + ((self.k_scale, self.v_scale) if self.quantized
+                 else ()) + (
+                jnp.asarray(dtok), jnp.asarray(dpos), jnp.asarray(dtable),
+            )
+            out_d = np.asarray(fn(*args))  # asarray = device sync
+            draft_s = time.perf_counter() - t0
+            for row, idx in enumerate(need_draft):
+                drafts[idx] = out_d[row]
+
+        B = _bucket(n)
+        if B > self.ecfg.max_batch:
+            B = self.ecfg.max_batch
+        toks = np.zeros((B, K), np.int32)
+        pos0 = np.zeros((B,), np.int32)
+        for i, s in enumerate(batch):
+            toks[i, 0] = s.next_input()
+            toks[i, 1:] = drafts[i]
+            pos0[i] = s.pos
+        table = self.kv.table(
+            [s.seq_id for s in batch] + [-1] * (B - n), W
+        )
+        fn = self._verify_fn(B, W)
+        tail = (jnp.asarray(toks), jnp.asarray(pos0), jnp.asarray(table))
+        t0 = time.perf_counter()
+        if self.quantized:
+            (self.k_pool, self.v_pool, self.k_scale, self.v_scale,
+             nxt) = fn(
+                self.params, self.k_pool, self.v_pool,
+                self.k_scale, self.v_scale, *tail,
+            )
+        else:
+            self.k_pool, self.v_pool, nxt = fn(
+                self.params, self.k_pool, self.v_pool, *tail,
+            )
+        nxt = np.asarray(nxt)
+        verify_s = time.perf_counter() - t0
+
+        sp = stats["spec"] = {
+            "proposed": 0, "accepted": 0, "steps": 1,
+            "draft_s": draft_s, "verify_s": verify_s, "per_slot": [],
+        }
+        self.spec_steps += 1
+        for i, s in enumerate(batch):
+            tgt = nxt[i]          # greedy prediction at pos..pos+k
+            a = 0
+            while a < k and drafts[i, a] == tgt[a]:
+                a += 1
+            d = seqstat(s)
+            d["proposed"] += k
+            d["accepted"] += a
+            d["verify_s"] += verify_s / n
+            if i in need_draft:
+                d["draft_s"] += draft_s / len(need_draft)
+            sp["proposed"] += k
+            sp["accepted"] += a
+            sp["per_slot"].append(a)
+            self.spec_proposed_tokens += k
+            self.spec_accepted_tokens += a
+            # emit tgt[0..a] (a+1 tokens; the all-rejected step emits
+            # exactly 1 - the token plain decode would have) through the
+            # SAME per-consumed-position accounting as the plain path,
+            # so decode_ticks == tokens_emitted + replayed_ticks holds
+            # by construction
+            start = s.pos
+            for t in range(a + 1):
+                consumed_at = start + t
+                s.pos = consumed_at + 1
+                j = consumed_at + 1 - s.prompt_len
+                if j == len(s.out):
+                    self._emit(s, int(tgt[t]))
+                else:
+                    d["replayed"] += 1
+                self.decode_tokens += 1
+                stats["decode_tokens"] += 1
+                d["decode"] += 1
+                if s.finished:
+                    break
+            # the verify step wrote K entries optimistically; keep only
+            # the consumed prefix (retirement frees everything anyway)
+            if not s.finished:
+                self._rewind_seq(s.seq_id, s.pos)
+
     def step(self) -> dict:
         """One engine tick. Returns per-tick stats for the scheduler's
         ledger/metrics: ``{"decode_tokens", "prefill_tokens",
@@ -762,11 +1336,15 @@ class ServeEngine:
 
         For per-request attribution (serve/reqtrace.py) the dict also
         carries ``per_seq`` - ``{seq_id: {"prefill", "decode",
-        "replayed", "parked"}}``, this tick's token counts and park flag
+        "replayed", "parked", "proposed", "accepted", "draft_s",
+        "verify_s"}}``, this tick's token counts and park flag
         for every sequence the tick touched - and ``preempted``, the
         provenance of evictions performed this tick (``seq_id``,
         ``tokens_held`` for replay accounting, cumulative
-        ``preemptions``)."""
+        ``preemptions``). Ticks with a speculative phase additionally
+        carry ``spec`` - ``{"proposed", "accepted", "steps",
+        "draft_s", "verify_s", "per_slot"}`` (``per_slot`` = accepted
+        drafts per slot, the acceptance-histogram input)."""
         ecfg = self.ecfg
         bs = self.kv.cfg.block_size
         with self.lock:
@@ -781,6 +1359,9 @@ class ServeEngine:
                 d = stats["per_seq"][s.seq_id] = {
                     "prefill": 0, "decode": 0, "replayed": 0,
                     "parked": False,
+                    # speculative sub-attribution (zero when spec off)
+                    "proposed": 0, "accepted": 0,
+                    "draft_s": 0.0, "verify_s": 0.0,
                 }
             return d
 
@@ -834,8 +1415,10 @@ class ServeEngine:
                 stats["prefill_tokens"] += n
                 seqstat(seq)["prefill"] += n
 
-        # ---- decode batch: one token per remaining runnable sequence
+        # ---- decode batch: plain slots (one token each) + speculative
+        # slots (k drafts verified in one multi-position step)
         batch: list[Sequence] = []
+        spec_batch: list[Sequence] = []
         for seq in todo:
             if seq.finished or seq in parked:
                 continue
@@ -843,6 +1426,15 @@ class ServeEngine:
                 seq.pos < seq.prompt_len - 1
             ):
                 continue  # still mid-chunked-prefill; next tick
+            if self.spec_k and self._spec_eligible(seq):
+                try:
+                    self.kv.ensure_range(
+                        seq.seq_id, seq.pos + self.spec_k
+                    )
+                    spec_batch.append(seq)
+                    continue
+                except OutOfBlocks:
+                    pass  # degrade to the one-block plain path
             try:
                 self.kv.ensure(seq.seq_id, seq.pos)
             except OutOfBlocks:
@@ -854,7 +1446,7 @@ class ServeEngine:
         stats["parked"] = len(parked)
         if parked:
             self.stall_events += 1
-        if not batch:
+        if not batch and not spec_batch:
             if parked:
                 # every active sequence is parked on blocks: preempt the
                 # youngest so the others' next allocation can succeed
@@ -866,63 +1458,67 @@ class ServeEngine:
                 })
             return stats
 
-        B = _bucket(len(batch))
-        if B > ecfg.max_batch:
-            B = ecfg.max_batch
-            batch = batch[:B]
-        W = _bucket(max(
-            s.pos // bs + 1 for s in batch
-        ))
-        tok = np.zeros((B,), np.int32)
-        pos = np.zeros((B,), np.int32)
-        temps = np.zeros((B,), np.float32)
-        keys = np.zeros((B, 2), np.uint32)
-        for i, s in enumerate(batch):
-            tok[i] = s.next_input()
-            pos[i] = s.pos
-            temps[i] = s.temperature
-            keys[i] = self._sample_key(s)
-        table = self.kv.table(
-            [s.seq_id for s in batch] + [-1] * (B - len(batch)), W
-        )
-        fn = self._decode_fn(B, W)
-        tail = (
-            jnp.asarray(tok), jnp.asarray(pos), jnp.asarray(table),
-            jnp.asarray(temps), jnp.asarray(keys),
-        )
-        if self.quantized:
-            (self.k_pool, self.v_pool, self.k_scale, self.v_scale,
-             nxt, _) = fn(
-                self.params, self.k_pool, self.v_pool,
-                self.k_scale, self.v_scale, *tail,
+        if batch:
+            B = _bucket(len(batch))
+            if B > ecfg.max_batch:
+                B = ecfg.max_batch
+                batch = batch[:B]
+            W = _bucket(max(
+                s.pos // bs + 1 for s in batch
+            ))
+            tok = np.zeros((B,), np.int32)
+            pos = np.zeros((B,), np.int32)
+            temps = np.zeros((B,), np.float32)
+            keys = np.zeros((B, 2), np.uint32)
+            for i, s in enumerate(batch):
+                tok[i] = s.next_input()
+                pos[i] = s.pos
+                temps[i] = s.temperature
+                keys[i] = self._sample_key(s)
+            table = self.kv.table(
+                [s.seq_id for s in batch] + [-1] * (B - len(batch)), W
             )
-        else:
-            self.k_pool, self.v_pool, nxt, _ = fn(
-                self.params, self.k_pool, self.v_pool, *tail,
+            fn = self._decode_fn(B, W)
+            tail = (
+                jnp.asarray(tok), jnp.asarray(pos), jnp.asarray(table),
+                jnp.asarray(temps), jnp.asarray(keys),
             )
-        nxt = np.asarray(nxt)
-        self.ticks += 1
-        stats["batch"] = len(batch)
-        for i, s in enumerate(batch):
-            consumed_at = s.pos
-            s.pos += 1
-            if consumed_at >= s.prompt_len - 1:
-                # prediction for generated-token index j; after a
-                # preemption the replay re-derives tokens the sequence
-                # already holds (j < len(out)) - deterministic by
-                # construction (greedy, or the per-position sampling
-                # key), so they are dropped, not re-appended/re-streamed
-                j = consumed_at + 1 - s.prompt_len
-                if j == len(s.out):
-                    self._emit(s, int(nxt[i]))
-                else:
-                    seqstat(s)["replayed"] += 1
-                self.decode_tokens += 1
-                stats["decode_tokens"] += 1
-                seqstat(s)["decode"] += 1
+            if self.quantized:
+                (self.k_pool, self.v_pool, self.k_scale, self.v_scale,
+                 nxt, _) = fn(
+                    self.params, self.k_pool, self.v_pool,
+                    self.k_scale, self.v_scale, *tail,
+                )
             else:
-                self.prefill_tokens += 1
-                stats["prefill_tokens"] += 1
-                seqstat(s)["prefill"] += 1
+                self.k_pool, self.v_pool, nxt, _ = fn(
+                    self.params, self.k_pool, self.v_pool, *tail,
+                )
+            nxt = np.asarray(nxt)
+            for i, s in enumerate(batch):
+                consumed_at = s.pos
+                s.pos += 1
+                if consumed_at >= s.prompt_len - 1:
+                    # prediction for generated-token index j; after a
+                    # preemption the replay re-derives tokens the
+                    # sequence already holds (j < len(out)) -
+                    # deterministic by construction (greedy, or the
+                    # per-position sampling key), so they are dropped,
+                    # not re-appended/re-streamed
+                    j = consumed_at + 1 - s.prompt_len
+                    if j == len(s.out):
+                        self._emit(s, int(nxt[i]))
+                    else:
+                        seqstat(s)["replayed"] += 1
+                    self.decode_tokens += 1
+                    stats["decode_tokens"] += 1
+                    seqstat(s)["decode"] += 1
+                else:
+                    self.prefill_tokens += 1
+                    stats["prefill_tokens"] += 1
+                    seqstat(s)["prefill"] += 1
+        if spec_batch:
+            self._spec_step(spec_batch, stats, seqstat)
+        self.ticks += 1
+        stats["batch"] = len(batch) + len(spec_batch)
         stats["finished"] = len(self._retire_finished())
         return stats

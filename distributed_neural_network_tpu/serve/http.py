@@ -121,6 +121,17 @@ class ServeServer:
             "engine_ticks": eng.ticks,
             "decode_tokens": eng.decode_tokens,
             "prefill_tokens": eng.prefill_tokens,
+            "weight_dtype": eng.weight_dtype_name(),
+            "spec_decode": eng.spec_k,
+            "spec_draft_layers": eng.draft_layers if eng.spec_k else 0,
+            "spec_proposed_tokens": eng.spec_proposed_tokens,
+            "spec_accepted_tokens": eng.spec_accepted_tokens,
+            "spec_steps": eng.spec_steps,
+            "spec_acceptance_rate": (
+                round(eng.spec_accepted_tokens
+                      / eng.spec_proposed_tokens, 4)
+                if eng.spec_proposed_tokens else None
+            ),
             "requests": self.scheduler.reqtrace.in_flight(),
             "requests_finalized":
                 self.scheduler.reqtrace.finalized_total,
@@ -334,14 +345,30 @@ def main(argv=None) -> int:
     p.add_argument("--prefill-chunk", type=int, default=1,
                    help="prompt tokens per chunked-prefill call (1 = "
                    "exact token-at-a-time prefill)")
-    p.add_argument("--precision", choices=("bf16", "int8-kv"),
-                   default="bf16",
-                   help="'int8-kv' stores the paged KV pool quantized "
-                   "(int8 + per-(block, head) f32 scales): ~2x the "
-                   "concurrent-sequence capacity per HBM byte, "
-                   "per-token top-1 agreement vs the bf16 oracle gated "
-                   ">= 99%% in the bench/CI parity rows "
-                   "(docs/SERVING.md). 'bf16' = the unquantized pool")
+    p.add_argument("--precision", default="bf16",
+                   help="comma-separated set from {bf16, int8-kv, "
+                   "int8-w}. 'int8-kv' stores the paged KV pool "
+                   "quantized (int8 + per-(block, head) f32 scales): "
+                   "~2x the concurrent-sequence capacity per HBM byte; "
+                   "'int8-w' stores the weights quantized (int8 codes "
+                   "+ per-output-column f32 scales) and routes every "
+                   "weight matmul through the int8 dot path; they "
+                   "compose ('int8-kv,int8-w'). Per-token top-1 "
+                   "agreement vs the bf16 oracle gated >= 99%% in the "
+                   "bench/CI parity rows (docs/SERVING.md). "
+                   "'bf16' = neither quantization")
+    p.add_argument("--spec-decode", type=int, default=0, metavar="K",
+                   help="speculative decoding: an early-exit drafter "
+                   "(the first --spec-draft-layers layers of the same "
+                   "model) proposes K tokens per greedy slot each tick "
+                   "and ONE verify step checks all K+1 positions at "
+                   "once; rejected suffixes rewind the block-table "
+                   "write cursor. Greedy streams stay token-exact vs "
+                   "offline generate(). 0 = off")
+    p.add_argument("--spec-draft-layers", type=int, default=0,
+                   metavar="E",
+                   help="drafter depth (early-exit layer count); "
+                   "0 = auto (max(1, n_layers // 8))")
     p.add_argument("--decode-impl", choices=("auto", "xla", "pallas"),
                    default="auto",
                    help="attention under the paged gather: the tuned "
@@ -371,6 +398,12 @@ def main(argv=None) -> int:
                    "TTFT spike)")
     args = p.parse_args(argv)
 
+    precision = {s.strip() for s in args.precision.split(",") if s.strip()}
+    bad = precision - {"bf16", "int8-kv", "int8-w"}
+    if bad:
+        p.error(f"--precision: unknown mode(s) {sorted(bad)} "
+                "(choose from bf16, int8-kv, int8-w)")
+
     params, cfg = build_model(args)
     engine = ServeEngine(params, cfg, EngineConfig(
         max_batch=args.max_batch,
@@ -379,8 +412,11 @@ def main(argv=None) -> int:
         max_seq_len=args.max_seq_len,
         prefill_chunk=args.prefill_chunk,
         eos_token=args.eos_token,
-        kv_dtype="int8" if args.precision == "int8-kv" else "bf16",
+        kv_dtype="int8" if "int8-kv" in precision else "bf16",
+        weight_dtype="int8" if "int8-w" in precision else "bf16",
         decode_impl=args.decode_impl,
+        spec_decode=args.spec_decode,
+        spec_draft_layers=args.spec_draft_layers,
     ))
     if args.warmup:
         n = engine.warmup()
@@ -417,7 +453,11 @@ def main(argv=None) -> int:
         f"vocab {args.vocab} seed {args.seed}; "
         f"{engine.kv.cfg.usable_blocks} KV blocks x "
         f"{args.block_size} tokens [{engine.kv_dtype_name()}, "
-        f"{engine.kv_block_bytes():,} B/block]; endpoints: "
+        f"{engine.kv_block_bytes():,} B/block]; "
+        f"weights {engine.weight_dtype_name()}; "
+        + (f"spec-decode k={engine.spec_k} "
+           f"E={engine.draft_layers}; " if engine.spec_k else "")
+        + "endpoints: "
         "POST /v1/generate, GET /v1/status, GET /v1/requests, "
         "/metrics, /healthz)",
         flush=True,
@@ -444,6 +484,9 @@ def main(argv=None) -> int:
         ),
         "decode_tokens": engine.decode_tokens,
         "prefill_tokens": engine.prefill_tokens,
+        "spec_proposed_tokens": engine.spec_proposed_tokens,
+        "spec_accepted_tokens": engine.spec_accepted_tokens,
+        "spec_steps": engine.spec_steps,
         "goodput_ratio": record.get("goodput_ratio") if record else None,
         "run_record": args.run_record,
     }), flush=True)

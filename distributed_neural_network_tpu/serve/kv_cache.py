@@ -207,6 +207,48 @@ class PagedKVCache:
             if end_pos + 1 > self._seq_used.get(seq_id, 0):
                 self._seq_used[seq_id] = end_pos + 1
 
+    def rewind(self, seq_id: int, n_tokens: int) -> list[int]:
+        """Roll ``seq_id``'s write cursor back to ``n_tokens`` tokens
+        written, returning any trailing blocks past
+        ``blocks_for_tokens(n_tokens)`` to the pool (newest first, so
+        the LIFO free list reuses them immediately). Returns the freed
+        block ids so the engine can zero their int8 scales - the same
+        history-free-reuse contract `free` has.
+
+        This is the cursor-rewind speculative decoding relies on: a
+        verify step writes k+1 positions optimistically, then the host
+        rewinds past the rejected suffix. It is exactly the bookkeeping
+        preemption replay performs (free + re-ensure), just partial, so
+        replay determinism carries over unchanged. Growing the cursor is
+        not this primitive's job (``n_tokens`` above the current count
+        is a ValueError, not a silent alloc)."""
+        if n_tokens < 0:
+            raise ValueError(f"n_tokens must be >= 0, got {n_tokens}")
+        keep = self.cfg.blocks_for_tokens(n_tokens)
+        with self._lock:
+            blocks = self._seq_blocks.get(seq_id)
+            if blocks is None:
+                return []
+            used = self._seq_used.get(seq_id, 0)
+            if n_tokens > used:
+                raise ValueError(
+                    f"rewind({seq_id}, {n_tokens}) would grow the "
+                    f"cursor (currently {used} tokens written)"
+                )
+            freed = blocks[keep:]
+            del blocks[keep:]
+            # newest-written first onto the LIFO list (pop() reuses the
+            # cache-hot block next), mirroring free()'s ordering intent
+            self._free.extend(reversed(freed))
+            self.free_total += len(freed)
+            if n_tokens:
+                self._seq_used[seq_id] = n_tokens
+            else:
+                self._seq_used.pop(seq_id, None)
+                if not blocks:
+                    self._seq_blocks.pop(seq_id, None)
+            return freed
+
     def free(self, seq_id: int) -> int:
         """Return all of ``seq_id``'s blocks to the pool (retirement,
         cancel, preemption); returns how many were freed. Unknown ids

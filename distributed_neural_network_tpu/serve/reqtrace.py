@@ -12,7 +12,12 @@ taxonomy mirroring the serve goodput causes -
 - ``admission``       - wiring into the engine (sequence build + add);
 - ``prefill``         - consuming prompt tokens (incl. chunked prefill
                         and post-preemption replay);
-- ``decode``          - generating tokens (the goodput phase);
+- ``decode``          - generating tokens (the goodput phase). With
+                        speculative decoding on, ``draft_s``/``verify_s``
+                        sub-attribute the device seconds INSIDE this
+                        cause (counters on the record, not new taxonomy
+                        members) along with proposed/accepted token
+                        counts;
 - ``kv_alloc_stall``  - parked: block exhaustion blocked this sequence
                         this tick;
 - ``preempted_wait``  - evicted (blocks freed, pos reset), waiting for
@@ -101,6 +106,7 @@ class RequestRecord:
         "spans", "_open_cause", "_open_t0", "_last_t",
         "tokens_emitted", "decode_ticks", "prefill_tokens",
         "replayed_ticks", "preemptions", "episodes", "engine_s", "lane",
+        "draft_s", "verify_s", "proposed_tokens", "accepted_tokens",
     )
 
     def __init__(self, req_id, tenant, prompt_len, max_new_tokens, t, lane):
@@ -124,6 +130,13 @@ class RequestRecord:
         self.episodes: list[dict] = []
         self.engine_s = {c: 0.0 for c in ENGINE_CAUSES}
         self.lane = lane
+        # speculative-decoding sub-attribution: draft_s + verify_s live
+        # INSIDE the decode cause (they are device seconds of the decode
+        # spans, not new taxonomy members - conservation is untouched)
+        self.draft_s = 0.0
+        self.verify_s = 0.0
+        self.proposed_tokens = 0
+        self.accepted_tokens = 0
 
     # ------------------------------------------------------------- views
 
@@ -157,6 +170,13 @@ class RequestRecord:
         if self.t_terminal is None:
             return None
         return self.t_terminal - self.t_arrival
+
+    def acceptance_rate(self) -> float | None:
+        """accepted / proposed draft tokens; None if the request never
+        took a speculative step."""
+        if not self.proposed_tokens:
+            return None
+        return self.accepted_tokens / self.proposed_tokens
 
     def summary(self, now: float | None = None) -> dict:
         doc = {
@@ -202,6 +222,14 @@ class RequestRecord:
             },
             episodes=list(self.episodes),
         )
+        if self.proposed_tokens:
+            doc.update(
+                proposed_tokens=self.proposed_tokens,
+                accepted_tokens=self.accepted_tokens,
+                acceptance_rate=round(self.acceptance_rate(), 6),
+                draft_s=round(self.draft_s, 9),
+                verify_s=round(self.verify_s, 9),
+            )
         return doc
 
 
@@ -313,6 +341,10 @@ class RequestTraceRecorder:
                 rec.decode_ticks += d.get("decode", 0)
                 rec.prefill_tokens += d.get("prefill", 0)
                 rec.replayed_ticks += d.get("replayed", 0)
+                rec.proposed_tokens += d.get("proposed", 0)
+                rec.accepted_tokens += d.get("accepted", 0)
+                rec.draft_s += d.get("draft_s", 0.0)
+                rec.verify_s += d.get("verify_s", 0.0)
                 if span > 0:
                     if total_tokens > 0:
                         if d.get("prefill"):

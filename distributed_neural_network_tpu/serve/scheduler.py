@@ -202,6 +202,11 @@ class ServeScheduler:
                     "max_seq_len": engine.ecfg.max_seq_len,
                     "prefill_chunk": engine.ecfg.prefill_chunk,
                     "kv_dtype": engine.ecfg.kv_dtype,
+                    "weight_dtype": engine.ecfg.weight_dtype,
+                    "spec_decode": engine.ecfg.spec_decode,
+                    "spec_draft_layers": (
+                        engine.draft_layers if engine.spec_k else 0
+                    ),
                 },
                 "scheduler": {
                     "max_queue": self.cfg.max_queue,
@@ -278,6 +283,23 @@ class ServeScheduler:
         )
         self._m_steps = r.counter(
             "serve_engine_steps_total", "Engine decode steps executed"
+        )
+        # speculative decoding: proposed/accepted draft tokens plus a
+        # per-slot-step acceptance histogram (integer buckets 0..k -
+        # "how many of this step's k drafts survived verification")
+        self._m_spec_proposed = r.counter(
+            "serve_spec_proposed_tokens_total",
+            "Draft tokens proposed by the speculative drafter",
+        )
+        self._m_spec_accepted = r.counter(
+            "serve_spec_accepted_tokens_total",
+            "Draft tokens accepted by target-model verification",
+        )
+        spec_k = max(int(getattr(engine, "spec_k", 0)), 1)
+        self._m_spec_accept_hist = r.histogram(
+            "serve_spec_accepted_per_step",
+            "Accepted draft tokens per speculative slot-step",
+            buckets=tuple(float(i) for i in range(spec_k)),
         )
         if r is not NULL_REGISTRY:
             self.ledger.publish(r)
@@ -556,6 +578,14 @@ class ServeScheduler:
             self.reqtrace.observe_step(stats, t0, t1)
             if len(eng.preempted) > preempted_before:
                 self._m_preempt.inc(len(eng.preempted) - preempted_before)
+            spec = stats.get("spec")
+            if spec:
+                if spec["proposed"]:
+                    self._m_spec_proposed.inc(spec["proposed"])
+                if spec["accepted"]:
+                    self._m_spec_accepted.inc(spec["accepted"])
+                for a in spec.get("per_slot", ()):
+                    self._m_spec_accept_hist.observe(float(a))
             dec, pre = stats["decode_tokens"], stats["prefill_tokens"]
             span = t1 - t0
             if dec + pre > 0 and span > 0:

@@ -1613,8 +1613,12 @@ def measure_serving(
     prefill_chunk: int = 16,
     seed: int = 0,
     kv_dtype: str = "bf16",
+    weight_dtype: str = "bf16",
+    spec_decode: int = 0,
+    spec_draft_layers: int = 0,
     min_capacity_ratio: float = 1.8,
     min_top1_agreement: float = 0.99,
+    min_accepted_per_step: float = 1.5,
 ) -> dict:
     """The serving row: sustained requests/s + TTFT / inter-token
     latency under the open-loop load generator (tools/loadgen.py)
@@ -1639,6 +1643,23 @@ def measure_serving(
     - accuracy: per-token top-1 agreement of every completed stream vs
       the offline bf16 ``generate()`` oracle must be >=
       ``min_top1_agreement``.
+
+    ``weight_dtype="int8"`` serves with int8-quantized weights and
+    applies the same per-token top-1 agreement gate (the capacity claim
+    is the pool's, so only the accuracy half applies).
+
+    ``spec_decode=k`` runs speculative decoding (early-exit drafter +
+    one k+1-position verify per tick) and gates the two claims that
+    make the mode worth shipping:
+
+    - accepted tokens per speculative slot-step (the guaranteed token
+      plus accepted drafts) must be > ``min_accepted_per_step``;
+    - end-to-end tokens/s must be STRICTLY greater than a paired
+      non-spec run at the same offered load (measured here, same
+      engine geometry, spec off).
+
+    Greedy spec streams are token-exact vs ``generate()``, so the
+    oracle gate composes rather than weakening.
     """
     import sys as _sys
 
@@ -1671,35 +1692,115 @@ def measure_serving(
         dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32,
     )
     params = init_params(jax.random.key(seed), cfg)
-    engine = ServeEngine(params, cfg, EngineConfig(
-        max_batch=max_batch, num_blocks=num_blocks,
-        block_size=block_size, max_seq_len=max_seq_len,
-        prefill_chunk=prefill_chunk, kv_dtype=kv_dtype,
-    ))
-    # pre-compile the bucket grid: a bench row measures serving, not
-    # first-request XLA compiles (production pays these at deploy time)
-    n_compiled = engine.warmup()
-    registry = MetricsRegistry()
-    scheduler = ServeScheduler(
-        engine, SchedulerConfig(max_queue=max(requests, 8)),
-        registry=registry,
-    ).start()
-    server = ServeServer(scheduler, registry, port=0)
-    try:
-        summary = loadgen.run_load(
-            server.url, rate=rate, n_requests=requests, duration=None,
-            prompt_lens=list(prompt_lens), max_new=max_new, vocab=vocab,
-            seed=seed, api_keys=["bench"], temperature=0.0,
-            burst=0, cancel_one=False, timeout=600.0, poisson=False,
+
+    def _run(spec_k: int):
+        """One end-to-end serving run (engine -> scheduler -> HTTP ->
+        loadgen) at the shared geometry and offered load."""
+        eng = ServeEngine(params, cfg, EngineConfig(
+            max_batch=max_batch, num_blocks=num_blocks,
+            block_size=block_size, max_seq_len=max_seq_len,
+            prefill_chunk=prefill_chunk, kv_dtype=kv_dtype,
+            weight_dtype=weight_dtype, spec_decode=spec_k,
+            spec_draft_layers=spec_draft_layers,
+        ))
+        # pre-compile the bucket grid: a bench row measures serving,
+        # not first-request XLA compiles (production pays these at
+        # deploy time)
+        n = eng.warmup()
+        reg = MetricsRegistry()
+        sched = ServeScheduler(
+            eng, SchedulerConfig(max_queue=max(requests, 8)),
+            registry=reg,
+        ).start()
+        srv = ServeServer(sched, reg, port=0)
+        try:
+            summ = loadgen.run_load(
+                srv.url, rate=rate, n_requests=requests, duration=None,
+                prompt_lens=list(prompt_lens), max_new=max_new,
+                vocab=vocab, seed=seed, api_keys=["bench"],
+                temperature=0.0, burst=0, cancel_one=False,
+                timeout=600.0, poisson=False,
+            )
+        finally:
+            rec = sched.close()
+            srv.close()
+        return eng, summ, rec, n
+
+    spec = {}
+    if spec_decode:
+        # paired baseline first: the SAME workload at the same offered
+        # load, spec off - the throughput gate compares against it
+        _, base_summary, _, _ = _run(0)
+        spec["baseline_tokens_per_s"] = base_summary["tokens_per_s"]
+    engine, summary, record, n_compiled = _run(spec_decode)
+    if spec_decode:
+        slot_steps = max(
+            engine.spec_proposed_tokens // max(spec_decode, 1), 1
         )
-    finally:
-        record = scheduler.close()
-        server.close()
+        accepted_per_step = (
+            engine.spec_accepted_tokens + slot_steps
+        ) / slot_steps
+        spec.update({
+            "k": spec_decode,
+            "draft_layers": engine.draft_layers,
+            "proposed_tokens": engine.spec_proposed_tokens,
+            "accepted_tokens": engine.spec_accepted_tokens,
+            "acceptance_rate": round(
+                engine.spec_accepted_tokens
+                / max(engine.spec_proposed_tokens, 1), 4
+            ),
+            # emitted tokens per speculative slot-step: the guaranteed
+            # token + accepted drafts (1.0 == plain decode's ceiling)
+            "accepted_tokens_per_step": round(accepted_per_step, 4),
+            "tokens_per_s": summary["tokens_per_s"],
+        })
+        assert accepted_per_step > min_accepted_per_step, (
+            f"spec-decode acceptance gate: {accepted_per_step:.3f} "
+            f"emitted tokens per slot-step <= {min_accepted_per_step} "
+            f"(k={spec_decode}, acceptance "
+            f"{spec['acceptance_rate']:.1%}) - the drafter is not "
+            "beating the one-token-per-slot ceiling"
+        )
+        assert summary["tokens_per_s"] > spec["baseline_tokens_per_s"], (
+            f"spec-decode throughput gate: {summary['tokens_per_s']} "
+            f"tokens/s with k={spec_decode} is not strictly greater "
+            f"than the paired non-spec run's "
+            f"{spec['baseline_tokens_per_s']} at the same offered load"
+        )
     total = float(record.get("wall_s") or 0.0)
     bad = record.get("badput_s") or {}
     dev = jax.devices()[0]
 
     quant = {}
+    if kv_dtype == "int8" or weight_dtype == "int8":
+        # --- accuracy gate (int8 KV pool and/or int8 weights): every
+        # completed stream vs the offline full-precision oracle (the
+        # seeded-model contract), per-token top-1 agreement
+        from ..models.transformer import generate
+
+        agree = tot_toks = 0
+        for r in summary["results"]:
+            if r.status != "completed" or not r.tokens:
+                continue
+            oracle = np.asarray(generate(
+                params, jnp.asarray([r.prompt], jnp.int32), cfg,
+                max_new_tokens=len(r.tokens),
+            ))[0, len(r.prompt):]
+            agree += int(sum(
+                int(a) == int(b) for a, b in zip(r.tokens, oracle)
+            ))
+            tot_toks += len(r.tokens)
+        agreement = agree / max(tot_toks, 1)
+        quant = {
+            "oracle_top1_agreement": round(agreement, 6),
+            "oracle_tokens_compared": tot_toks,
+        }
+        assert agreement >= min_top1_agreement, (
+            f"low-precision accuracy gate (kv {kv_dtype}, weights "
+            f"{weight_dtype}): per-token top-1 agreement "
+            f"{agreement:.4f} < {min_top1_agreement} vs the "
+            f"full-precision oracle over {tot_toks} tokens"
+        )
     if kv_dtype == "int8":
         # --- capacity gate: equal-HBM-budget pools, MEASURED by
         # admitting max-length sequences into the real allocator
@@ -1721,52 +1822,28 @@ def measure_serving(
             int8_blocks, block_size, max_seq_len
         )
         ratio = cap_int8 / max(cap_bf16, 1)
-        # --- accuracy gate: every completed stream vs the offline bf16
-        # oracle (the seeded-model contract), per-token top-1 agreement
-        from ..models.transformer import generate
-
-        agree = tot_toks = 0
-        for r in summary["results"]:
-            if r.status != "completed" or not r.tokens:
-                continue
-            oracle = np.asarray(generate(
-                params, jnp.asarray([r.prompt], jnp.int32), cfg,
-                max_new_tokens=len(r.tokens),
-            ))[0, len(r.prompt):]
-            agree += int(sum(
-                int(a) == int(b) for a, b in zip(r.tokens, oracle)
-            ))
-            tot_toks += len(r.tokens)
-        agreement = agree / max(tot_toks, 1)
-        quant = {
-            "kv_capacity": {
-                "hbm_budget_bytes": int(budget),
-                "bf16": {"blocks": num_blocks - 1,
-                         "bytes_per_block": bb_bf16,
-                         "max_seq_sequences": cap_bf16},
-                "int8": {"blocks": int(int8_blocks - 1),
-                         "bytes_per_block": bb_int8,
-                         "max_seq_sequences": cap_int8},
-                "measured_capacity_ratio": round(ratio, 4),
-            },
-            "oracle_top1_agreement": round(agreement, 6),
-            "oracle_tokens_compared": tot_toks,
+        quant["kv_capacity"] = {
+            "hbm_budget_bytes": int(budget),
+            "bf16": {"blocks": num_blocks - 1,
+                     "bytes_per_block": bb_bf16,
+                     "max_seq_sequences": cap_bf16},
+            "int8": {"blocks": int(int8_blocks - 1),
+                     "bytes_per_block": bb_int8,
+                     "max_seq_sequences": cap_int8},
+            "measured_capacity_ratio": round(ratio, 4),
         }
         assert ratio >= min_capacity_ratio, (
             f"int8-KV capacity gate: measured concurrent-sequence "
             f"capacity ratio {ratio:.3f} < {min_capacity_ratio} at equal "
             f"HBM budget ({cap_int8} vs {cap_bf16} max-len sequences)"
         )
-        assert agreement >= min_top1_agreement, (
-            f"int8-KV accuracy gate: per-token top-1 agreement "
-            f"{agreement:.4f} < {min_top1_agreement} vs the bf16 oracle "
-            f"over {tot_toks} tokens"
-        )
 
     return {
         "devices": f"1x {dev.device_kind}",
         "model": f"d{d_model}/L{n_layers}/H{n_heads} vocab {vocab} {dtype}",
         "kv_dtype": kv_dtype,
+        "weight_dtype": weight_dtype,
+        **({"spec_decode": spec} if spec else {}),
         **quant,
         "offered_rps": summary["offered_rps"],
         "sustained_rps": summary["achieved_rps"],

@@ -1,0 +1,494 @@
+"""Speculative decoding inside the serving engine (serve/engine.py
+``spec_decode`` + serve/kv_cache.py ``rewind``).
+
+Bars (the accept/reject state machine and its invariants):
+- greedy spec streams are TOKEN-EXACT vs the offline `generate()`
+  oracle at every k, including k=1 (which must equal plain decode's
+  streams bitwise);
+- an all-rejected verify step still emits exactly one token - the same
+  token plain decode would have produced - so spec can degrade but
+  never stall or corrupt;
+- the cursor rewind is the same bookkeeping preemption replay performs:
+  preempt-then-replay under spec stays byte-identical and never
+  re-streams a token;
+- spec composes with chunked prefill and the int8 KV pool;
+- sampled slots never enter the speculative path, so their
+  per-(seed, position) keys produce the same stream with spec on or off;
+- int8 weight storage (weight_dtype="int8") serves, composes with
+  spec + int8-kv, and its top-1 agreement vs the bf16 oracle is bounded
+  (the >= 99% gate runs at bench geometry in train/measure.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_neural_network_tpu.models import transformer as tfm
+from distributed_neural_network_tpu.serve.engine import (
+    EngineConfig,
+    Sequence,
+    ServeEngine,
+)
+from distributed_neural_network_tpu.serve.kv_cache import (
+    KVCacheConfig,
+    PagedKVCache,
+)
+
+CFG = tfm.TransformerConfig(
+    vocab_size=32, d_model=32, n_heads=4, n_layers=2, d_ff=64
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return tfm.init_params(jax.random.key(0), CFG)
+
+
+def _prompt(key, n):
+    return list(
+        np.asarray(jax.random.randint(jax.random.key(key), (n,), 2, 32))
+    )
+
+
+def _oracle(params, prompt, n_new):
+    return [int(x) for x in np.asarray(tfm.generate(
+        params, jnp.asarray([prompt], jnp.int32), CFG,
+        max_new_tokens=n_new,
+    ))[0, len(prompt):]]
+
+
+def _drain(eng, max_ticks=1000):
+    t = 0
+    while eng.has_work() and t < max_ticks:
+        eng.step()
+        t += 1
+    assert not eng.has_work()
+
+
+def _engine(params, spec, **kw):
+    defaults = dict(
+        max_batch=4, num_blocks=64, block_size=16, max_seq_len=64,
+    )
+    defaults.update(kw)
+    return ServeEngine(
+        params, CFG, EngineConfig(spec_decode=spec, **defaults)
+    )
+
+
+# --------------------------------------------------------- allocator rewind
+
+
+def test_rewind_frees_trailing_blocks_lifo():
+    kv = PagedKVCache(KVCacheConfig(num_blocks=8, block_size=4,
+                                    max_seq_len=32))
+    kv.ensure_range(1, 11)  # 12 tokens -> 3 blocks
+    held = kv.seq_block_ids(1)
+    assert len(held) == 3
+    freed = kv.rewind(1, 5)  # 5 tokens -> keep 2 blocks
+    assert freed == held[2:]
+    assert kv.seq_block_ids(1) == held[:2]
+    # the freed (cache-hot) block is the next one handed out
+    kv.ensure(2, 0)
+    assert kv.seq_block_ids(2) == [held[2]]
+
+
+def test_rewind_refuses_to_grow_and_tolerates_unknown():
+    kv = PagedKVCache(KVCacheConfig(num_blocks=8, block_size=4,
+                                    max_seq_len=32))
+    kv.ensure_range(1, 3)
+    with pytest.raises(ValueError):
+        kv.rewind(1, 9)
+    assert kv.rewind(99, 0) == []  # unknown id: no-op like free()
+    # rewind to the same count frees nothing
+    assert kv.rewind(1, 4) == []
+    # rewind to zero releases everything, like free()
+    freed = kv.rewind(1, 0)
+    assert len(freed) == 1
+    assert kv.seq_block_ids(1) == []
+    assert kv.blocks_in_use == 0
+
+
+def test_rewind_matches_free_then_reensure_bookkeeping():
+    """rewind == the partial form of what preemption replay does
+    (free + re-ensure): after both, the allocator state is identical."""
+    a = PagedKVCache(KVCacheConfig(num_blocks=8, block_size=4,
+                                   max_seq_len=32))
+    b = PagedKVCache(KVCacheConfig(num_blocks=8, block_size=4,
+                                   max_seq_len=32))
+    a.ensure_range(1, 11)
+    b.ensure_range(1, 11)
+    a.rewind(1, 6)
+    b.free(1)
+    b.ensure_range(1, 5)
+    # LIFO reuse reorders which IDs come back after the full free; the
+    # capacity bookkeeping (live/free counts) is what replay
+    # correctness depends on, and it must be identical
+    assert len(a.seq_block_ids(1)) == len(b.seq_block_ids(1))
+    assert a.free_blocks == b.free_blocks
+    assert a.blocks_in_use == b.blocks_in_use
+
+
+# ------------------------------------------------------------ token parity
+
+
+def test_spec_streams_token_exact_vs_oracle(params, n_devices):
+    """Staggered joins + mixed prompt lengths under spec_decode=4:
+    every stream equals its offline single-sequence oracle."""
+    eng = _engine(params, spec=4)
+    prompts = [_prompt(k, n) for k, n in ((1, 5), (2, 9), (3, 3))]
+    seqs = [
+        Sequence(seq_id=i, prompt=p, max_new_tokens=12)
+        for i, p in enumerate(prompts)
+    ]
+    eng.add(seqs[0])
+    eng.step()
+    eng.add(seqs[1])
+    eng.step()
+    eng.add(seqs[2])
+    _drain(eng)
+    for p, s in zip(prompts, seqs):
+        assert s.out == _oracle(params, p, 12)
+    assert eng.spec_proposed_tokens > 0
+    assert eng.spec_accepted_tokens >= 0
+
+
+def test_spec_k1_matches_plain_decode_bitwise(params, n_devices):
+    """k=1 is the degenerate spec step: one draft, one verify. Its
+    streams must equal the plain engine's bitwise - and both equal the
+    oracle - while using strictly fewer ticks than plain whenever any
+    draft is accepted."""
+    prompts = [_prompt(k, n) for k, n in ((4, 6), (5, 10))]
+    outs = {}
+    for spec in (0, 1):
+        eng = _engine(params, spec=spec)
+        seqs = [
+            Sequence(seq_id=i, prompt=p, max_new_tokens=10)
+            for i, p in enumerate(prompts)
+        ]
+        for s in seqs:
+            eng.add(s)
+        _drain(eng)
+        outs[spec] = [s.out for s in seqs]
+    assert outs[0] == outs[1]
+    for p, o in zip(prompts, outs[1]):
+        assert o == _oracle(params, p, 10)
+
+
+def test_all_rejected_step_emits_exactly_one_token(params, n_devices):
+    """Force every draft wrong: each verify step must emit exactly one
+    token (the one plain decode would have), acceptance stays 0, and
+    the final stream still equals the oracle - correctness never
+    depends on draft quality."""
+    prompt = _prompt(6, 5)
+    n_new = 10
+    oracle = _oracle(params, prompt, n_new)
+    # token stream by generated index -> always-wrong draft per position
+    eng = _engine(params, spec=3)
+    pl = len(prompt)
+    stream = {pl - 1 + j: oracle[j] for j in range(n_new)}
+
+    def wrong_draft_fn(B, W):
+        def fake(params_, kp, vp, tok, pos, table):
+            pos = np.asarray(pos)
+            out = np.zeros((B, eng.spec_k), np.int32)
+            for i in range(B):
+                for t in range(eng.spec_k):
+                    # draft t is compared against the prediction at
+                    # consumed position pos + t
+                    true = stream.get(int(pos[i]) + t, 0)
+                    out[i, t] = (int(true) + 1) % CFG.vocab_size
+            return jnp.asarray(out)
+        return fake
+
+    eng._draft_fn = wrong_draft_fn
+    seq = Sequence(seq_id=0, prompt=prompt, max_new_tokens=n_new)
+    eng.add(seq)
+    ticks_with_spec = 0
+    while eng.has_work():
+        st = eng.step()
+        sp = st.get("spec")
+        if sp:
+            ticks_with_spec += 1
+            # all drafts rejected -> every slot emits exactly 1
+            assert sp["accepted"] == 0
+            assert all(a == 0 for a in sp["per_slot"])
+            assert st["decode_tokens"] == len(sp["per_slot"])
+    assert seq.out == oracle
+    assert ticks_with_spec > 0
+    assert eng.spec_accepted_tokens == 0
+
+
+def test_spec_perfect_drafts_accept_everything(params, n_devices):
+    """The dual pin: feed the TRUE next tokens as drafts - every step
+    must accept all k and emit k+1."""
+    prompt = _prompt(7, 4)
+    n_new = 9
+    oracle = _oracle(params, prompt, n_new)
+    eng = _engine(params, spec=2)
+    pl = len(prompt)
+    stream = {pl - 1 + j: oracle[j] for j in range(n_new)}
+
+    def perfect_draft_fn(B, W):
+        def fake(params_, kp, vp, tok, pos, table):
+            pos = np.asarray(pos)
+            out = np.zeros((B, eng.spec_k), np.int32)
+            for i in range(B):
+                for t in range(eng.spec_k):
+                    out[i, t] = stream.get(int(pos[i]) + t, 0)
+            return jnp.asarray(out)
+        return fake
+
+    eng._draft_fn = perfect_draft_fn
+    seq = Sequence(seq_id=0, prompt=prompt, max_new_tokens=n_new)
+    eng.add(seq)
+    while eng.has_work():
+        st = eng.step()
+        sp = st.get("spec")
+        if sp and not seq.finished:
+            assert sp["accepted"] == sp["proposed"]
+    assert seq.out == oracle
+
+
+# ------------------------------------------------- rewind == replay identity
+
+
+def test_preempt_replay_under_spec_is_byte_identical(params, n_devices):
+    """KV exhaustion with spec on: the preempted sequence replays
+    through the speculative path (known tokens become drafts) and both
+    streams stay token-exact with nothing re-streamed - the
+    cursor-rewind and the preemption-replay bookkeeping are the same
+    operation."""
+    eng = ServeEngine(params, CFG, EngineConfig(
+        max_batch=4, num_blocks=6, block_size=2, max_seq_len=16,
+        spec_decode=4,
+    ))
+    prompts = [_prompt(80 + i, 4) for i in range(3)]
+    streamed = {i: [] for i in range(3)}
+    seqs = []
+    for i, p in enumerate(prompts):
+        s = Sequence(i, p, 6,
+                     on_token=lambda sq, t, d: streamed[sq.seq_id].append(t))
+        seqs.append(s)
+        eng.add(s)
+    ticks = 0
+    while (eng.has_work() or eng.preempted) and ticks < 1000:
+        ticks += 1
+        eng.step()
+        if eng.preempted and eng.kv.can_fit(4):
+            eng.add(eng.preempted.popleft())
+    assert all(s.finished for s in seqs)
+    assert sum(s.preemptions for s in seqs) > 0, "pool was never tight"
+    for i, s in enumerate(seqs):
+        want = _oracle(params, s.prompt, 6)
+        assert s.out == want
+        assert streamed[i] == want  # no duplicates, no gaps
+    assert eng.kv.blocks_in_use == 0
+
+
+def test_replay_uses_known_tokens_as_drafts(params, n_devices):
+    """After a manual preempt+replay, ticks where the future is fully
+    known must accept every draft (greedy determinism makes the replay
+    a guaranteed-accept fast path)."""
+    eng = _engine(params, spec=3)
+    prompt = _prompt(10, 5)
+    seq = Sequence(seq_id=0, prompt=prompt, max_new_tokens=12)
+    eng.add(seq)
+    # prefill is plain ticks; run until a few tokens have been generated
+    for _ in range(20):
+        eng.step()
+        if len(seq.out) > 3:
+            break
+    assert len(seq.out) > 3
+    # preempt by hand: free blocks, reset pos (what _preempt_youngest does)
+    eng._free_seq(seq.seq_id)
+    seq.pos = 0
+    seq.preemptions += 1
+    replay_specs = []
+    while eng.has_work():
+        st = eng.step()
+        sp = st.get("spec")
+        if sp:
+            replay_specs.append(sp)
+    assert seq.out == _oracle(params, prompt, 12)
+    # at least one replay tick had its whole draft budget accepted
+    assert any(sp["accepted"] == sp["proposed"] for sp in replay_specs)
+
+
+# ----------------------------------------------------------- composition
+
+
+def test_spec_composes_with_chunked_prefill(params, n_devices):
+    eng = _engine(params, spec=4, prefill_chunk=4)
+    prompts = [_prompt(k, n) for k, n in ((11, 13), (12, 6))]
+    seqs = [
+        Sequence(seq_id=i, prompt=p, max_new_tokens=10)
+        for i, p in enumerate(prompts)
+    ]
+    for s in seqs:
+        eng.add(s)
+    _drain(eng)
+    for p, s in zip(prompts, seqs):
+        assert s.out == _oracle(params, p, 10)
+
+
+def test_spec_composes_with_int8_kv(params, n_devices):
+    """int8 pool + spec: statistically gated elsewhere (rejected verify
+    writes may grow block scales); here the composition must run,
+    retire cleanly, and emit full-length streams."""
+    eng = _engine(params, spec=4, kv_dtype="int8")
+    prompts = [_prompt(k, n) for k, n in ((13, 5), (14, 8))]
+    seqs = [
+        Sequence(seq_id=i, prompt=p, max_new_tokens=10)
+        for i, p in enumerate(prompts)
+    ]
+    for s in seqs:
+        eng.add(s)
+    _drain(eng)
+    for s in seqs:
+        assert len(s.out) == 10
+    assert eng.spec_steps > 0
+
+
+def test_spec_int8_kv_chunked_all_compose(params, n_devices):
+    eng = _engine(params, spec=2, kv_dtype="int8", prefill_chunk=4)
+    prompt = _prompt(15, 11)
+    seq = Sequence(seq_id=0, prompt=prompt, max_new_tokens=8)
+    eng.add(seq)
+    _drain(eng)
+    assert len(seq.out) == 8
+
+
+def test_sampled_slots_never_speculate_and_keys_unchanged(
+    params, n_devices
+):
+    """A temperature>0 slot rides the plain path (its per-(seed, pos)
+    keys untouched) while a greedy slot speculates beside it: the
+    sampled stream must be identical to what a no-spec engine
+    produces."""
+    prompt_s = _prompt(16, 6)
+    prompt_g = _prompt(17, 7)
+    outs = {}
+    for spec in (0, 4):
+        eng = _engine(params, spec=spec)
+        sampled = Sequence(seq_id=0, prompt=prompt_s, max_new_tokens=10,
+                           temperature=0.9, seed=123)
+        greedy = Sequence(seq_id=1, prompt=prompt_g, max_new_tokens=10)
+        eng.add(sampled)
+        eng.add(greedy)
+        _drain(eng)
+        outs[spec] = (list(sampled.out), list(greedy.out))
+        if spec:
+            # the greedy slot did speculate
+            assert eng.spec_proposed_tokens > 0
+    assert outs[0][0] == outs[4][0]  # sampled stream bitwise unchanged
+    assert outs[0][1] == outs[4][1] == _oracle(params, prompt_g, 10)
+
+
+def test_warmup_compiles_spec_buckets_and_leaves_state_clean(
+    params, n_devices
+):
+    eng = _engine(params, spec=4, num_blocks=8)
+    n_plain = ServeEngine(
+        params, CFG, EngineConfig(max_batch=4, num_blocks=8,
+                                  block_size=16, max_seq_len=64)
+    ).warmup()
+    n = eng.warmup()
+    assert n > n_plain  # the draft + verify families compiled too
+    prompt = _prompt(18, 5)
+    seq = Sequence(seq_id=0, prompt=prompt, max_new_tokens=10)
+    eng.add(seq)
+    _drain(eng)
+    assert seq.out == _oracle(params, prompt, 10)
+
+
+# ------------------------------------------------------------- int8 weights
+
+
+def test_int8_weights_serve_and_agree(params, n_devices):
+    """weight_dtype="int8": every matmul runs against prequantized
+    codes. At this tiny random-init geometry the agreement bound is
+    loose (the >= 99% gate runs at bench geometry); the stream must be
+    full-length and mostly agree with the bf16 oracle."""
+    eng = _engine(params, spec=0, weight_dtype="int8")
+    assert eng.weight_dtype_name() == "int8"
+    prompts = [_prompt(k, n) for k, n in ((19, 5), (20, 9))]
+    seqs = [
+        Sequence(seq_id=i, prompt=p, max_new_tokens=12)
+        for i, p in enumerate(prompts)
+    ]
+    for s in seqs:
+        eng.add(s)
+    _drain(eng)
+    agree = total = 0
+    for p, s in zip(prompts, seqs):
+        assert len(s.out) == 12
+        o = _oracle(params, p, 12)
+        agree += sum(int(a == b) for a, b in zip(o, s.out))
+        total += 12
+    assert agree / total > 0.5
+
+
+def test_int8_weights_compose_with_spec_and_int8_kv(params, n_devices):
+    eng = _engine(params, spec=4, weight_dtype="int8", kv_dtype="int8")
+    prompt = _prompt(21, 6)
+    seq = Sequence(seq_id=0, prompt=prompt, max_new_tokens=8)
+    eng.add(seq)
+    _drain(eng)
+    assert len(seq.out) == 8
+    assert eng.spec_steps > 0
+
+
+def test_engine_config_validation(params, n_devices):
+    with pytest.raises(ValueError):
+        EngineConfig(spec_decode=-1)
+    with pytest.raises(ValueError):
+        EngineConfig(weight_dtype="fp4")
+    with pytest.raises(ValueError):
+        EngineConfig(spec_draft_layers=-2)
+    with pytest.raises(ValueError):
+        # drafter deeper than the model
+        ServeEngine(params, CFG, EngineConfig(
+            spec_decode=2, spec_draft_layers=5
+        ))
+
+
+def test_early_exit_reference_pins_drafter(params, n_devices):
+    """The engine's jitted drafter == greedy argmax over the offline
+    early-exit logits (models/transformer.py early_exit_logits), one
+    position at a time."""
+    eng = _engine(params, spec=4, spec_draft_layers=1)
+    prompt = _prompt(22, 6)
+    seq = Sequence(seq_id=0, prompt=prompt, max_new_tokens=6)
+    eng.add(seq)
+    # run prefill up to the spec-eligible point with plain ticks
+    drafts_seen = []
+    orig = eng._draft_fn
+
+    def spy(B, W):
+        fn = orig(B, W)
+
+        def wrapped(*args):
+            out = fn(*args)
+            drafts_seen.append(
+                (np.asarray(args[-3]).copy(), np.asarray(args[-2]).copy(),
+                 np.asarray(out).copy())
+            )
+            return out
+        return wrapped
+
+    eng._draft_fn = spy
+    _drain(eng)
+    assert drafts_seen, "the drafter ran"
+    tok0, pos0, drafted = drafts_seen[0]
+    # offline: feed prompt + generated prefix, early-exit the first
+    # layer, and greedily roll the draft chain forward
+    consumed = (prompt + seq.out)[: int(pos0[0])]
+    chain = list(consumed) + [int(tok0[0])]
+    for t in range(eng.spec_k):
+        lg = tfm.early_exit_logits(
+            params, jnp.asarray([chain], jnp.int32), CFG, 1
+        )
+        nxt = int(jnp.argmax(lg[0, -1]))
+        assert nxt == int(drafted[0, t]), f"draft step {t} diverged"
+        chain.append(nxt)

@@ -713,6 +713,25 @@ def render(snap: dict, *, color: bool = True, width: int = 72) -> str:
             + (f"  preempted {int(preempt)}" if preempt else "")
         )
         lines.append(kv_line)
+        # speculative decoding (serve/engine.py --spec-decode):
+        # accepted/proposed draft tokens color-banded by acceptance rate
+        # - below 40% the drafter is wasting more verify work than the
+        # accepted tokens buy back
+        spec_prop = metric_value(m, "serve_spec_proposed_tokens_total", 0)
+        if spec_prop:
+            spec_acc = metric_value(
+                m, "serve_spec_accepted_tokens_total", 0
+            )
+            rate = spec_acc / spec_prop
+            rate_col = (
+                RED if rate < 0.4 else YELLOW if rate < 0.6 else GREEN
+            )
+            lines.append(
+                "  spec-decode "
+                + c(rate_col,
+                    f"accept {int(spec_acc)}/{int(spec_prop)} "
+                    f"({100.0 * rate:.0f}%)")
+            )
         # slowest in-flight requests (GET /v1/requests, serve/reqtrace):
         # age + current state + dominant lifecycle cause per request -
         # the tail drill-down an aggregate histogram cannot give

@@ -303,6 +303,31 @@ def check_oracle(summary: dict, args) -> list:
     return problems
 
 
+def fetch_spec_stats(base: str, timeout: float) -> dict | None:
+    """Best-effort GET /v1/status for the server's speculative-decoding
+    counters (proposed/accepted draft tokens + acceptance rate). None
+    when the server is unreachable or runs without --spec-decode."""
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+            base.rstrip("/") + "/v1/status", timeout=timeout
+        ) as r:
+            doc = json.loads(r.read())
+    except (OSError, ValueError):
+        return None
+    if not doc.get("spec_decode"):
+        return None
+    return {
+        "spec_decode": doc["spec_decode"],
+        "spec_draft_layers": doc.get("spec_draft_layers"),
+        "spec_proposed_tokens": doc.get("spec_proposed_tokens", 0),
+        "spec_accepted_tokens": doc.get("spec_accepted_tokens", 0),
+        "spec_steps": doc.get("spec_steps", 0),
+        "spec_acceptance_rate": doc.get("spec_acceptance_rate"),
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description=__doc__,
@@ -398,6 +423,9 @@ def main(argv=None) -> int:
         problems.extend(check_oracle(summary, args))
 
     doc = {k: v for k, v in summary.items() if k != "results"}
+    spec = fetch_spec_stats(args.url, min(args.timeout, 10.0))
+    if spec is not None:
+        doc["spec"] = spec
     doc["ok"] = not problems
     doc["problems"] = problems
     print(json.dumps(doc, indent=1))

@@ -153,6 +153,16 @@ def print_report(records: list[dict], doc: dict, n_exemplars: int) -> dict:
         f"finalized, {counts.get('in_flight', '?')} in flight, "
         f"evicted {counts.get('evicted', 0)})"
     )
+    prop = sum(r.get("proposed_tokens") or 0 for r in records)
+    if prop:
+        acc = sum(r.get("accepted_tokens") or 0 for r in records)
+        drf = sum(r.get("draft_s") or 0.0 for r in records)
+        ver = sum(r.get("verify_s") or 0.0 for r in records)
+        print(
+            f"Speculative decode: accepted {acc}/{prop} draft tokens "
+            f"({100.0 * acc / prop:.1f}%); draft {drf:.4f}s + verify "
+            f"{ver:.4f}s device time inside decode"
+        )
     gates: dict = {}
     for metric, label in (("ttft", "TTFT"), ("e2e", "E2E")):
         for q in PERCENTILES:
@@ -175,12 +185,21 @@ def print_report(records: list[dict], doc: dict, n_exemplars: int) -> dict:
         print(f"Slowest {len(ranked)} request(s) by E2E:")
     for r in ranked:
         ttft = r.get("ttft_s")
+        # speculative-decoding acceptance, when the server ran with it:
+        # accepted/proposed draft tokens inside this request's decode
+        prop = r.get("proposed_tokens") or 0
+        acc_note = (
+            f" accept={r.get('accepted_tokens', 0)}/{prop}"
+            f" ({100.0 * r.get('accepted_tokens', 0) / prop:.0f}%)"
+            if prop else ""
+        )
         print(
             f"  #{r.get('req_id')} tenant={r.get('tenant')} "
             f"{r.get('state')} e2e={r['e2e_s']:.4f}s "
             f"ttft={'n/a' if ttft is None else f'{ttft:.4f}s'} "
             f"tokens={r.get('tokens_emitted')} "
             f"preempts={r.get('preemptions', 0)}"
+            + acc_note
         )
         segs = [
             f"{c} {t1 - t0:.4f}s" for c, t0, t1 in (r.get("spans") or ())
