@@ -602,6 +602,23 @@ def _rows(epochs: int) -> list[dict]:
             "args": {"dtype": "bfloat16", "rate": 4.0, "requests": 24,
                      "max_new": 32, "spec_decode": 4},
         },
+        # the serving-fleet row (serve/fleet.py, docs/SERVING.md
+        # "Serving fleet"): 2 replicas behind the failover router,
+        # three legs with the gates ASSERTED in the row - healthy
+        # 2-replica sustained rps >= 0.9 x 2 x the single-replica
+        # baseline the row measures first, then a chaos leg that kills
+        # one replica under live streams and requires zero
+        # client-visible failures with every failed-over stream
+        # per-token identical to the offline generate() oracle
+        # (deterministic replay), plus goodput conservation asserted
+        # on the fleet-aggregated serve record
+        {
+            "id": "serve_fleet_2rep_failover_openloop",
+            "kind": "fleet_serving",
+            "est_s": 900,
+            "args": {"dtype": "bfloat16", "rate": 3.0, "requests": 12,
+                     "max_new": 24},
+        },
         # quantized-vs-bf16 training parity (the other honesty rail):
         # same init + byte-identical batches, attention matmuls in
         # int8/fp8 (ops/quant.py), final-loss delta + held-out logit
@@ -710,6 +727,12 @@ def _run_worker(spec: dict) -> dict:
         )
 
         return measure_serving(**spec["args"])
+    if spec["kind"] == "fleet_serving":
+        from distributed_neural_network_tpu.train.measure import (
+            measure_fleet_serving,
+        )
+
+        return measure_fleet_serving(**spec["args"])
     if spec["kind"] == "quant_parity":
         from distributed_neural_network_tpu.train.measure import (
             measure_quant_parity,

@@ -242,6 +242,72 @@ class Sequence:
         return self.prompt_len + self.max_new_tokens
 
 
+def export_descriptor(seq: Sequence) -> dict:
+    """A live sequence as a migration descriptor: everything a PEER
+    replica needs to re-derive the exact remaining stream by
+    deterministic re-prefill replay (serve/fleet.py drain/failover).
+
+    The contract is the same one preemption replay rests on: the seeded
+    model is identical on every replica, greedy decode is a pure
+    function of the token history, and sampled slots key on the
+    ABSOLUTE position (`_sample_key` folds ``seq.pos``) - so prefilling
+    ``prompt + already-emitted tokens`` on any replica reconstructs the
+    byte-identical KV state and the next sampling key, and the
+    continuation matches the stream a single never-failing replica
+    would have produced. ``emitted`` holds only tokens the client has
+    already seen (the dedup rule: they become prompt on resume, never
+    re-streamed)."""
+    emitted = [int(t) for t in seq.out[: seq.emitted]]
+    return {
+        "seq_id": int(seq.seq_id),
+        "prompt": [int(t) for t in seq.prompt],
+        "emitted": emitted,
+        "max_new_tokens": int(seq.max_new_tokens),
+        "remaining_tokens": int(seq.max_new_tokens) - len(emitted),
+        "temperature": float(seq.temperature),
+        "seed": int(seq.seed),
+        "preemptions": int(seq.preemptions),
+    }
+
+
+def resume_request(desc: dict) -> dict:
+    """The re-dispatch request body for a migrated descriptor: emitted
+    tokens are folded into the prompt (re-prefill replay) and the token
+    budget shrinks by the tokens already streamed. Raises ValueError
+    when nothing remains to generate (the caller should synthesize the
+    done frame itself - it already holds the full stream)."""
+    emitted = [int(t) for t in desc.get("emitted") or ()]
+    remaining = int(desc["max_new_tokens"]) - len(emitted)
+    if remaining < 1:
+        raise ValueError(
+            f"descriptor for seq {desc.get('seq_id')} has no tokens "
+            f"left to generate ({len(emitted)} already emitted)"
+        )
+    return {
+        "prompt": [int(t) for t in desc["prompt"]] + emitted,
+        "max_new_tokens": remaining,
+        "temperature": float(desc.get("temperature", 0.0)),
+        "seed": int(desc.get("seed", 0)),
+    }
+
+
+def resume_sequence(desc: dict, *, seq_id: int | None = None,
+                    on_token=None) -> Sequence:
+    """Import a migration descriptor as a fresh `Sequence` on this
+    engine (the direct, HTTP-less form of `resume_request`). The
+    emitted tokens ride as prompt, so the engine prefills them and the
+    first token it EMITS is the first one the client has not seen."""
+    body = resume_request(desc)
+    return Sequence(
+        seq_id=int(desc["seq_id"]) if seq_id is None else int(seq_id),
+        prompt=body["prompt"],
+        max_new_tokens=body["max_new_tokens"],
+        temperature=body["temperature"],
+        seed=body["seed"],
+        on_token=on_token,
+    )
+
+
 def _bucket(n: int, lo: int = 1) -> int:
     """Smallest power of two >= n (>= lo)."""
     b = lo

@@ -1,0 +1,967 @@
+"""Serving fleet: the failover router, drain orchestration, and the
+SLO-driven autoscaling decision function.
+
+The single-replica stack (serve/http.py) dies with its process; this
+module makes replica death a non-event for clients by composing four
+things the repo already has:
+
+- **Discovery the federation way** (train/supervisor.py): each replica
+  advertises its ``/metrics`` URL through its heartbeat file
+  (``role: "serve"``); the router watches a heartbeat directory, so
+  supervisor restarts (new PID, new ephemeral port) re-register
+  automatically and a stale heartbeat marks the replica DOWN.
+- **Least-loaded dispatch**: the router scrapes each replica's
+  ``serve_queue_depth`` / ``serve_active_sequences`` / KV occupancy and
+  routes ``POST /v1/generate`` to the least-loaded UP replica,
+  corrected by router-side in-flight counts between scrapes.
+- **Failover by deterministic replay** (the PR 12 seeded-replay
+  contract): generation is a pure function of (model seed, prompt,
+  request seed, temperature) and sampling keys are per absolute
+  position, so when a replica dies mid-stream the router re-dispatches
+  to a survivor with ``prompt' = prompt + already_streamed`` and
+  ``max_new' = max_new - n_streamed`` - the same dedup rule preemption
+  replay uses - and the client stream stays byte-identical to the
+  offline oracle. Bounded by ``max_retries`` episodes per request;
+  re-dispatch provenance rides the ``X-Router-Retries`` /
+  ``X-Router-Retry-Seconds`` headers into the replica's per-request
+  trace (serve/reqtrace.py ``router_retry``).
+- **Graceful drain**: ``POST /v1/drain {"replica": id}`` stops
+  admission on the target (scheduler 503s), migrates its live
+  sequences out as replay descriptors, and every router-proxied stream
+  self-heals through the same failover path when its ``migrated``
+  frame arrives - SIGTERM rolling restarts and scale-down both reuse
+  this.
+
+`autoscale_decision` is the pure policy the `tools/serve_fleet.py`
+operator loop runs: scale UP on queue_wait-dominant SLO violations (or
+raw queue pressure), explicitly do NOT scale on kv_alloc_stall-dominant
+violations (more replicas can't fix an undersized KV pool - the readout
+says "add KV capacity" instead), scale DOWN after sustained idleness.
+`slo_readout` produces the dominant-cause gates from fleet-merged
+``/v1/requests?full=1`` records (the PR 14 taxonomy);
+`aggregate_serve_records` folds per-replica serving goodput records
+into one fleet record with conservation asserted.
+
+Stdlib + utils/obs.py only - the router must not need jax.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import math
+import os
+import threading
+import time
+import urllib.request
+from dataclasses import dataclass, field
+from urllib.parse import urlsplit
+
+from ..utils.obs import ObsServer, parse_prom_samples
+from .http import STREAM_TIMEOUT_S, _json_response
+
+# mirrors tools/request_trace.py (stdlib tool, can't be imported here)
+PERCENTILES = (0.50, 0.95, 0.99)
+SLO_KEYS = tuple(
+    f"{m}_p{int(q * 100)}" for m in ("ttft", "e2e") for q in PERCENTILES
+)
+
+
+# ------------------------------------------------------------- replicas
+
+
+@dataclass
+class ReplicaState:
+    """One replica as the router sees it: identity, liveness, and the
+    scraped load signals dispatch keys on."""
+
+    replica_id: str
+    url: str                      # serving/metrics base URL
+    state: str = "down"           # "up" | "draining" | "down"
+    hb_path: str | None = None    # heartbeat file (None = static)
+    queue_depth: int = 0
+    active: int = 0
+    kv_blocks_in_use: int = 0
+    kv_blocks_total: int = 0
+    kv_util: float = 0.0
+    completed: int = 0
+    ttft_p99_s: float | None = None
+    dispatched: int = 0           # router dispatch episodes, lifetime
+    inflight: int = 0             # router-side open episodes
+    failures: int = 0             # up->down transitions observed
+    last_seen: float = 0.0        # last successful scrape (monotonic)
+
+    def load_key(self):
+        """Least-loaded sort key (queue first, then KV pressure)."""
+        return (
+            self.queue_depth + self.active + self.inflight,
+            self.kv_util,
+            self.replica_id,
+        )
+
+    def doc(self) -> dict:
+        return {
+            "replica": self.replica_id,
+            "url": self.url,
+            "state": self.state,
+            "queue_depth": self.queue_depth,
+            "active_sequences": self.active,
+            "kv_blocks_in_use": self.kv_blocks_in_use,
+            "kv_blocks_total": self.kv_blocks_total,
+            "kv_utilization": round(self.kv_util, 4),
+            "requests_completed": self.completed,
+            "ttft_p99_s": self.ttft_p99_s,
+            "dispatched": self.dispatched,
+            "inflight": self.inflight,
+            "failures": self.failures,
+        }
+
+
+@dataclass(frozen=True)
+class RouterConfig:
+    poll_s: float = 0.5           # discovery + scrape cadence
+    scrape_timeout_s: float = 2.0
+    hb_stale_s: float = 5.0       # heartbeat age -> DOWN
+    max_retries: int = 3          # failover episodes per request
+    connect_timeout_s: float = 5.0
+    drain_timeout_s: float = 60.0
+
+
+def _hist_quantile(bucket_samples: dict, q: float):
+    """Quantile from Prometheus cumulative ``_bucket`` samples
+    ({label_key_tuple: count}); None when empty."""
+    pts = []
+    for key, count in bucket_samples.items():
+        le = dict(key).get("le")
+        if le is None:
+            continue
+        try:
+            pts.append((float(le), count))
+        except ValueError:
+            continue
+    pts.sort()
+    if not pts or pts[-1][1] <= 0:
+        return None
+    total = pts[-1][1]
+    rank = q * total
+    for le, count in pts:
+        if count >= rank:
+            return None if math.isinf(le) else le
+    return None
+
+
+# --------------------------------------------------------------- router
+
+
+class FleetRouter:
+    """The fleet front door: same /v1/generate + /v1/status surface as
+    a single replica, plus /v1/fleet (per-replica detail) and
+    /v1/drain (graceful replica drain). `close()` stops the poll
+    thread and the HTTP server."""
+
+    def __init__(
+        self,
+        registry,
+        *,
+        watch_dir: str | None = None,
+        replicas=(),
+        cfg: RouterConfig | None = None,
+        port: int = 0,
+        host: str = "127.0.0.1",
+    ):
+        self.registry = registry
+        self.cfg = cfg or RouterConfig()
+        self.watch_dir = watch_dir
+        self._lock = threading.Lock()
+        self._replicas: dict[str, ReplicaState] = {}
+        for rid, url in replicas:
+            self._replicas[str(rid)] = ReplicaState(
+                replica_id=str(rid), url=str(url).rstrip("/")
+            )
+        self._target = len(self._replicas)
+        self._closed = threading.Event()
+        r = registry
+        self._m_requests = r.counter(
+            "fleet_router_requests_total",
+            "Router requests by terminal status (serve/fleet.py)",
+        )
+        self._m_retries = r.counter(
+            "fleet_router_retries_total",
+            "Failover re-dispatch episodes across all requests",
+        )
+        self._m_failures = r.counter(
+            "fleet_replica_failures_total",
+            "Replica up->down transitions the router observed",
+        )
+        self._m_dispatch = r.counter(
+            "fleet_dispatch_total", "Dispatch episodes by replica"
+        )
+        self._m_replicas = r.gauge(
+            "fleet_replicas", "Replica count by state"
+        )
+        self._m_target = r.gauge(
+            "fleet_target_replicas", "Autoscaler target replica count"
+        )
+        self._m_actual = r.gauge(
+            "fleet_actual_replicas", "UP (dispatchable) replica count"
+        )
+        self._m_r_queue = r.gauge(
+            "fleet_replica_queue_depth", "Scraped queue depth per replica"
+        )
+        self._m_r_active = r.gauge(
+            "fleet_replica_active_sequences",
+            "Scraped decode-batch size per replica",
+        )
+        self._m_r_kv = r.gauge(
+            "fleet_replica_kv_utilization",
+            "Scraped paged-KV occupancy per replica",
+        )
+        self._m_r_up = r.gauge(
+            "fleet_replica_up",
+            "1 up / 0.5 draining / 0 down, per replica",
+        )
+        self.obs = ObsServer(
+            registry,
+            port=port,
+            host=host,
+            routes={
+                ("POST", "/v1/generate"): self._generate,
+                ("GET", "/v1/status"): self._status,
+                ("GET", "/v1/fleet"): self._fleet,
+                ("POST", "/v1/drain"): self._drain,
+            },
+        )
+        self.port = self.obs.port
+        self.url = self.obs.url
+        self._poll_once()
+        self._thread = threading.Thread(
+            target=self._poll_loop, name="fleet-router-poll", daemon=True
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._closed.set()
+        self._thread.join(timeout=5)
+        self.obs.close()
+
+    # ------------------------------------------------- discovery + scrape
+
+    def set_target(self, n: int) -> None:
+        """Autoscaler's declared target size (display + /v1/fleet)."""
+        self._target = int(n)
+        self._m_target.set(self._target)
+
+    @property
+    def target(self) -> int:
+        return self._target
+
+    def replicas(self) -> list[ReplicaState]:
+        with self._lock:
+            return list(self._replicas.values())
+
+    def up_count(self) -> int:
+        with self._lock:
+            return sum(
+                1 for r in self._replicas.values() if r.state == "up"
+            )
+
+    def _poll_loop(self) -> None:
+        while not self._closed.wait(self.cfg.poll_s):
+            try:
+                self._poll_once()
+            except Exception:
+                pass  # discovery must never kill the router
+
+    def _discover(self) -> None:
+        """Fold heartbeat files (role == "serve") into the replica set.
+        A restarted replica rewrites its stable per-rank file with a
+        fresh PID + metrics URL, so re-registration is automatic."""
+        if not self.watch_dir or not os.path.isdir(self.watch_dir):
+            return
+        for name in sorted(os.listdir(self.watch_dir)):
+            if not name.endswith(".json"):
+                continue
+            path = os.path.join(self.watch_dir, name)
+            try:
+                with open(path) as f:
+                    doc = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if doc.get("role") != "serve" or not doc.get("metrics_url"):
+                continue
+            rank = doc.get("rank")
+            rid = f"rank{rank}" if rank is not None else name[:-5]
+            fresh = (time.time() - float(doc.get("t") or 0)
+                     ) <= self.cfg.hb_stale_s
+            with self._lock:
+                rep = self._replicas.get(rid)
+                if rep is None:
+                    rep = self._replicas[rid] = ReplicaState(
+                        replica_id=rid, url="", hb_path=path
+                    )
+                rep.url = str(doc["metrics_url"]).rstrip("/")
+                rep.hb_path = path
+                if not fresh:
+                    self._mark_down(rep)
+
+    def _mark_down(self, rep: ReplicaState) -> None:
+        """Caller holds the lock. Counts the up->down transition once."""
+        if rep.state != "down":
+            rep.failures += 1
+            self._m_failures.inc()
+        rep.state = "down"
+
+    def _scrape_one(self, rep: ReplicaState) -> None:
+        hb_fresh = True
+        if rep.hb_path is not None:
+            try:
+                with open(rep.hb_path) as f:
+                    doc = json.load(f)
+                hb_fresh = (time.time() - float(doc.get("t") or 0)
+                            ) <= self.cfg.hb_stale_s
+            except (OSError, ValueError):
+                hb_fresh = False
+        try:
+            with urllib.request.urlopen(
+                rep.url + "/metrics", timeout=self.cfg.scrape_timeout_s
+            ) as resp:
+                samples = parse_prom_samples(resp.read().decode())
+        except (OSError, ValueError):
+            with self._lock:
+                self._mark_down(rep)
+            return
+
+        def scalar(name, default=0.0):
+            return next(iter(samples.get(name, {}).values()), default)
+
+        with self._lock:
+            if not hb_fresh:
+                self._mark_down(rep)
+                return
+            rep.queue_depth = int(scalar("serve_queue_depth"))
+            rep.active = int(scalar("serve_active_sequences"))
+            rep.kv_blocks_in_use = int(scalar("serve_kv_blocks_in_use"))
+            rep.kv_blocks_total = int(scalar("serve_kv_blocks_total"))
+            rep.kv_util = (
+                rep.kv_blocks_in_use / rep.kv_blocks_total
+                if rep.kv_blocks_total else 0.0
+            )
+            rep.completed = int(
+                samples.get("serve_requests_total", {}).get(
+                    (("status", "completed"),), 0
+                )
+            )
+            rep.ttft_p99_s = _hist_quantile(
+                samples.get("serve_ttft_seconds_bucket", {}), 0.99
+            )
+            rep.state = (
+                "draining" if scalar("serve_draining") > 0 else "up"
+            )
+            rep.last_seen = time.monotonic()
+
+    def _poll_once(self) -> None:
+        self._discover()
+        for rep in self.replicas():
+            self._scrape_one(rep)
+        with self._lock:
+            counts = {"up": 0, "draining": 0, "down": 0}
+            for rep in self._replicas.values():
+                counts[rep.state] = counts.get(rep.state, 0) + 1
+                self._m_r_queue.labels(replica=rep.replica_id).set(
+                    rep.queue_depth
+                )
+                self._m_r_active.labels(replica=rep.replica_id).set(
+                    rep.active
+                )
+                self._m_r_kv.labels(replica=rep.replica_id).set(
+                    rep.kv_util
+                )
+                self._m_r_up.labels(replica=rep.replica_id).set(
+                    {"up": 1.0, "draining": 0.5}.get(rep.state, 0.0)
+                )
+            for state, n in counts.items():
+                self._m_replicas.labels(state=state).set(n)
+            self._m_actual.set(counts["up"])
+            self._m_target.set(self._target)
+
+    # ------------------------------------------------------------ dispatch
+
+    def pick_replica(self, exclude=()) -> ReplicaState | None:
+        """Least-loaded UP replica, preferring ones not in ``exclude``
+        (already failed for this request); falls back to an excluded-
+        but-up replica rather than failing a request that could run."""
+        with self._lock:
+            up = [
+                r for r in self._replicas.values() if r.state == "up"
+            ]
+            fresh = [r for r in up if r.replica_id not in exclude]
+            pool = fresh or up
+            if not pool:
+                return None
+            return min(pool, key=ReplicaState.load_key)
+
+    # -------------------------------------------------------------- routes
+
+    def _status(self, handler) -> None:
+        with self._lock:
+            reps = list(self._replicas.values())
+        _json_response(handler, 200, {
+            "fleet": True,
+            "replicas_up": sum(1 for r in reps if r.state == "up"),
+            "replicas_draining": sum(
+                1 for r in reps if r.state == "draining"
+            ),
+            "replicas_down": sum(1 for r in reps if r.state == "down"),
+            "target_replicas": self._target,
+            "active_sequences": sum(r.active for r in reps),
+            "queued": sum(r.queue_depth for r in reps),
+            "kv_blocks_in_use": sum(r.kv_blocks_in_use for r in reps),
+            "kv_blocks_total": sum(r.kv_blocks_total for r in reps),
+            "requests_completed": sum(r.completed for r in reps),
+        })
+
+    def _fleet(self, handler) -> None:
+        with self._lock:
+            reps = [r.doc() for r in self._replicas.values()]
+        reps.sort(key=lambda d: d["replica"])
+        _json_response(handler, 200, {
+            "replicas": reps,
+            "target_replicas": self._target,
+            "actual_replicas": sum(
+                1 for d in reps if d["state"] == "up"
+            ),
+            "router": {
+                "requests_completed": int(
+                    self._m_requests.labels(status="completed").value
+                ),
+                "retries_total": int(self._m_retries.value),
+                "replica_failures": int(self._m_failures.value),
+            },
+        })
+
+    def _drain(self, handler) -> None:
+        """Orchestrate a graceful replica drain: proxy /v1/drain to the
+        target (admission stops, live sequences emit migrate frames on
+        their router-proxied streams and fail over automatically)."""
+        try:
+            n = int(handler.headers.get("Content-Length") or 0)
+            body = json.loads(handler.rfile.read(n) or b"{}")
+            rid = str(body.get("replica") or "")
+        except (ValueError, UnicodeDecodeError):
+            _json_response(handler, 400, {"error": "invalid JSON body"})
+            return
+        with self._lock:
+            rep = self._replicas.get(rid)
+        if rep is None:
+            _json_response(handler, 404, {
+                "error": f"unknown replica {rid!r}",
+                "replicas": sorted(self._replicas),
+            })
+            return
+        try:
+            req = urllib.request.Request(
+                rep.url + "/v1/drain", data=b"{}", method="POST"
+            )
+            with urllib.request.urlopen(
+                req, timeout=self.cfg.drain_timeout_s
+            ) as resp:
+                doc = json.loads(resp.read())
+        except (OSError, ValueError) as e:
+            _json_response(handler, 502, {
+                "error": f"drain of {rid} failed: {e}",
+            })
+            return
+        with self._lock:
+            rep.state = "draining"
+        _json_response(handler, 200, doc)
+
+    def drain_replica(self, rid: str) -> dict:
+        """Programmatic drain (tools/serve_fleet.py scale-down path)."""
+        with self._lock:
+            rep = self._replicas.get(str(rid))
+        if rep is None:
+            raise KeyError(f"unknown replica {rid!r}")
+        req = urllib.request.Request(
+            rep.url + "/v1/drain", data=b"{}", method="POST"
+        )
+        with urllib.request.urlopen(
+            req, timeout=self.cfg.drain_timeout_s
+        ) as resp:
+            doc = json.loads(resp.read())
+        with self._lock:
+            rep.state = "draining"
+        return doc
+
+    # --------------------------------------------------- generate (proxy)
+
+    def _parse_client(self, handler):
+        try:
+            n = int(handler.headers.get("Content-Length") or 0)
+        except ValueError:
+            n = 0
+        try:
+            body = json.loads(handler.rfile.read(n) or b"{}")
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object")
+        except (ValueError, UnicodeDecodeError) as e:
+            raise ValueError(f"invalid JSON body: {e}")
+        prompt = body.get("prompt")
+        is_text = False
+        if prompt is None and isinstance(body.get("text"), str):
+            # byte-tokenize here so the replay prompt is always integer
+            # tokens (the replica enforces vocab >= 256 and 400s for us)
+            prompt = list(body["text"].encode())
+            is_text = True
+        if not isinstance(prompt, list) or not all(
+            isinstance(t, int) for t in prompt
+        ):
+            raise ValueError(
+                "body needs 'prompt': [int token ids] or 'text': str"
+            )
+        api_key = handler.headers.get("X-API-Key") or body.get("api_key")
+        return {
+            "prompt": [int(t) for t in prompt],
+            "max_new_tokens": int(body.get("max_new_tokens", 16)),
+            "temperature": float(body.get("temperature", 0.0)),
+            "seed": int(body.get("seed", 0)),
+            "api_key": api_key,
+            "stream": bool(body.get("stream", True)),
+            "is_text": is_text,
+        }
+
+    def _open_episode(self, rep: ReplicaState, spec: dict,
+                      streamed: list, retries: int, retry_s: float):
+        """One upstream dispatch: POST /v1/generate with the replay
+        body (original prompt + streamed tokens suppressed into the
+        prompt; the remaining budget as max_new_tokens)."""
+        body = {
+            "prompt": spec["prompt"] + streamed,
+            "max_new_tokens": spec["max_new_tokens"] - len(streamed),
+            "temperature": spec["temperature"],
+            "seed": spec["seed"],
+            "stream": True,
+        }
+        if spec["api_key"] is not None:
+            body["api_key"] = str(spec["api_key"])
+        u = urlsplit(rep.url)
+        conn = http.client.HTTPConnection(
+            u.hostname, u.port, timeout=STREAM_TIMEOUT_S
+        )
+        headers = {
+            "Content-Type": "application/json",
+            "X-Router-Retries": str(retries),
+            "X-Router-Retry-Seconds": f"{retry_s:.6f}",
+        }
+        if spec["api_key"] is not None:
+            headers["X-API-Key"] = str(spec["api_key"])
+        conn.request(
+            "POST", "/v1/generate", body=json.dumps(body).encode(),
+            headers=headers,
+        )
+        return conn, conn.getresponse()
+
+    def _send_frame(self, handler, frame: dict) -> None:
+        handler.wfile.write(f"data: {json.dumps(frame)}\n\n".encode())
+        handler.wfile.flush()
+
+    def _finish(self, handler, spec, frame, *, headers_sent) -> None:
+        """Deliver the rewritten done frame (stream) or the single JSON
+        body (non-stream)."""
+        if spec["stream"]:
+            if not headers_sent:
+                self._send_stream_headers(handler)
+            self._send_frame(handler, frame)
+        else:
+            _json_response(handler, 200, frame)
+
+    def _send_stream_headers(self, handler) -> None:
+        handler.send_response(200)
+        handler.send_header("Content-Type", "text/event-stream")
+        handler.send_header("Cache-Control", "no-store")
+        handler.send_header("Connection", "close")
+        handler.end_headers()
+
+    def _done_frame(self, spec, streamed, upstream_done, rep,
+                    retries, t_start) -> dict:
+        """The client-facing summary: tokens are the FULL accumulated
+        stream (failover suppressed duplicates upstream, so upstream's
+        summary only covers the final episode's suffix)."""
+        frame = dict(upstream_done or {})
+        frame.update({
+            "status": "done",
+            "done": True,
+            "prompt_len": len(spec["prompt"]),
+            "tokens": list(streamed),
+            "n_tokens": len(streamed),
+            "total_s": round(time.monotonic() - t_start, 6),
+            "replica": rep.replica_id if rep is not None else None,
+            "router_retries": retries,
+        })
+        if spec["is_text"]:
+            frame["text"] = bytes(
+                t for t in streamed if 0 <= t < 256
+            ).decode("utf-8", "replace")
+        return frame
+
+    def _generate(self, handler) -> None:
+        try:
+            spec = self._parse_client(handler)
+        except ValueError as e:
+            self._m_requests.labels(status="rejected").inc()
+            _json_response(handler, 400, {
+                "error": str(e), "reason": "bad_request",
+            })
+            return
+        streamed: list[int] = []
+        retries = 0          # completed failover episodes
+        retry_s = 0.0        # wall seconds burned in failed episodes
+        tried: set[str] = set()
+        headers_sent = False
+        t_start = time.monotonic()
+        last_reject = None   # (status, doc) from a 4xx/503 upstream
+        while True:
+            rep = self.pick_replica(exclude=tried)
+            if rep is None:
+                break
+            with self._lock:
+                rep.dispatched += 1
+                rep.inflight += 1
+            self._m_dispatch.labels(replica=rep.replica_id).inc()
+            t_ep = time.monotonic()
+            conn = None
+            failed = False
+            migrated_ep = False
+            upstream_done = None
+            last_reject = None
+            try:
+                conn, resp = self._open_episode(
+                    rep, spec, streamed, retries, retry_s
+                )
+                if resp.status == 400:
+                    # malformed for ANY replica: forward, don't retry
+                    doc = json.loads(resp.read() or b"{}")
+                    self._m_requests.labels(status="rejected").inc()
+                    if not headers_sent:
+                        _json_response(handler, 400, doc)
+                    return
+                if resp.status != 200:
+                    # 429 / 503 (draining): try the other replicas
+                    last_reject = (
+                        resp.status, json.loads(resp.read() or b"{}")
+                    )
+                    failed = True
+                else:
+                    for frame in self._read_frames(resp):
+                        if "token" in frame:
+                            streamed.append(int(frame["token"]))
+                            if spec["stream"]:
+                                if not headers_sent:
+                                    self._send_stream_headers(handler)
+                                    headers_sent = True
+                                self._send_frame(handler, frame)
+                        elif frame.get("done"):
+                            upstream_done = frame
+                            break
+                        elif frame.get("migrated") or "error" in frame:
+                            # drain migration or replica-side failure:
+                            # both re-dispatch with streamed suppressed
+                            failed = True
+                            migrated_ep = bool(frame.get("migrated"))
+                            break
+                    else:
+                        failed = True  # EOF without a terminal frame
+            except (OSError, http.client.HTTPException, ValueError):
+                failed = True
+            finally:
+                if conn is not None:
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                with self._lock:
+                    rep.inflight = max(rep.inflight - 1, 0)
+            if not failed:
+                frame = self._done_frame(
+                    spec, streamed, upstream_done, rep, retries, t_start
+                )
+                try:
+                    self._finish(
+                        handler, spec, frame, headers_sent=headers_sent
+                    )
+                    self._m_requests.labels(status="completed").inc()
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    self._m_requests.labels(status="client_gone").inc()
+                return
+            # episode failed -> bounded re-dispatch
+            retry_s += time.monotonic() - t_ep
+            tried.add(rep.replica_id)
+            if last_reject is None and not migrated_ep:
+                # a connection/stream failure (not a polite 429/503 or
+                # a drain migration): distrust the replica until the
+                # next scrape clears it
+                with self._lock:
+                    self._mark_down(rep)
+            if len(streamed) >= spec["max_new_tokens"]:
+                # died/migrated between the last token and the done
+                # frame: the stream is already complete - synthesize
+                frame = self._done_frame(
+                    spec, streamed, {}, rep, retries, t_start
+                )
+                try:
+                    self._finish(
+                        handler, spec, frame, headers_sent=headers_sent
+                    )
+                    self._m_requests.labels(status="completed").inc()
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    self._m_requests.labels(status="client_gone").inc()
+                return
+            if retries >= self.cfg.max_retries:
+                break
+            retries += 1
+            self._m_retries.inc()
+        # no replica completed the request
+        self._m_requests.labels(status="error").inc()
+        if headers_sent:
+            try:
+                self._send_frame(handler, {
+                    "error": "no replica could complete the request "
+                    f"(retries {retries}, streamed {len(streamed)})",
+                })
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                pass
+            return
+        if last_reject is not None:
+            status, doc = last_reject
+            extra = (("Retry-After", "1"),) if status == 429 else ()
+            _json_response(handler, status, doc, extra)
+            return
+        _json_response(handler, 503, {
+            "error": "no replicas available",
+            "reason": "no_replicas",
+        })
+
+    def _read_frames(self, resp):
+        """SSE frames from an upstream response (generator); raises
+        OSError family on transport failure, StopIteration semantics
+        on EOF."""
+        while True:
+            line = resp.readline()
+            if not line:
+                return
+            line = line.strip()
+            if not line.startswith(b"data: "):
+                continue
+            try:
+                yield json.loads(line[len(b"data: "):])
+            except ValueError:
+                return
+
+
+# -------------------------------------------- SLO readout + autoscaling
+
+
+def _percentile(xs, q: float):
+    if not xs:
+        return None
+    s = sorted(xs)
+    return s[max(0, math.ceil(q * len(s)) - 1)]
+
+
+def _clipped_causes(rec: dict, metric: str) -> dict:
+    if metric == "ttft":
+        hi = rec.get("t_first_token_rel")
+        if hi is None:
+            return {}
+    else:
+        hi = float("inf")
+    out: dict = {}
+    for cause, t0, t1 in rec.get("spans") or ():
+        lo, up = float(t0), min(float(t1), hi)
+        if up > lo:
+            out[cause] = out.get(cause, 0.0) + (up - lo)
+    return out
+
+
+def _decompose(records, metric: str, q: float):
+    vals = [
+        (r, v) for r in records
+        if (v := r.get("ttft_s" if metric == "ttft" else "e2e_s"))
+        is not None
+    ]
+    if not vals:
+        return None
+    pv = _percentile([v for _, v in vals], q)
+    tail = [r for r, v in vals if v >= pv - 1e-12]
+    acc: dict = {}
+    for r in tail:
+        for cause, s in _clipped_causes(r, metric).items():
+            acc[cause] = acc.get(cause, 0.0) + s
+    total = sum(acc.values())
+    shares = {c: acc[c] / total for c in acc} if total > 0 else {}
+    dominant = max(shares, key=shares.get) if shares else None
+    return {"value": pv, "shares": shares, "dominant": dominant}
+
+
+def slo_readout(records: list, slo: dict) -> dict:
+    """Dominant-cause SLO gates over fleet-merged per-request records
+    (the ``recent`` lists of each replica's ``/v1/requests?full=1``).
+    ``slo`` maps keys like ``ttft_p99`` to limit seconds; each gate in
+    the result carries value/limit/violated/dominant/shares - the
+    autoscaler's input (mirrors tools/request_trace.py decompose)."""
+    out = {}
+    for key, limit in slo.items():
+        if key not in SLO_KEYS:
+            raise ValueError(
+                f"unknown SLO key {key!r} (choose from {SLO_KEYS})"
+            )
+        metric, _, pq = key.partition("_p")
+        d = _decompose(records, metric, int(pq) / 100.0)
+        if d is None:
+            out[key] = {
+                "value": None, "limit": float(limit),
+                "violated": False, "dominant": None, "shares": {},
+            }
+            continue
+        out[key] = {
+            "value": d["value"],
+            "limit": float(limit),
+            "violated": d["value"] > float(limit),
+            "dominant": d["dominant"],
+            "shares": d["shares"],
+        }
+    return out
+
+
+def autoscale_decision(
+    *,
+    actual: int,
+    min_replicas: int,
+    max_replicas: int,
+    queue_depth: int = 0,
+    queue_high: int = 8,
+    gates: dict | None = None,
+    idle_s: float = 0.0,
+    scale_down_idle_s: float = 60.0,
+) -> dict:
+    """The pure autoscaling policy (tools/serve_fleet.py runs it on a
+    timer; tests pin it directly). Returns ``{"action": "scale_up" |
+    "scale_down" | "hold", "target": n, "reason": str}``.
+
+    The PR 14 dominant-cause taxonomy does the triage: a queue_wait-
+    dominant SLO violation means requests are waiting for a SLOT -
+    another replica fixes that; a kv_alloc_stall-dominant violation
+    means sequences stall on KV BLOCKS - another replica leaves the
+    per-replica pool just as undersized, so the decision is HOLD with
+    add-KV-capacity advice, never a futile scale-up."""
+    gates = gates or {}
+    violated = {
+        k: g for k, g in gates.items() if g.get("violated")
+    }
+    queue_dom = [
+        k for k, g in violated.items()
+        if g.get("dominant") == "queue_wait"
+    ]
+    kv_dom = [
+        k for k, g in violated.items()
+        if g.get("dominant") == "kv_alloc_stall"
+    ]
+    if queue_dom:
+        if actual < max_replicas:
+            return {
+                "action": "scale_up", "target": actual + 1,
+                "reason": "queue_wait-dominant SLO violation "
+                f"({', '.join(sorted(queue_dom))})",
+            }
+        return {
+            "action": "hold", "target": actual,
+            "reason": "queue_wait-dominant SLO violation but already "
+            f"at max_replicas={max_replicas}",
+        }
+    if kv_dom:
+        return {
+            "action": "hold", "target": actual,
+            "reason": "kv_alloc_stall-dominant SLO violation "
+            f"({', '.join(sorted(kv_dom))}): add KV capacity "
+            "(--num-blocks / int8-kv), replicas won't help",
+        }
+    if queue_depth >= queue_high:
+        if actual < max_replicas:
+            return {
+                "action": "scale_up", "target": actual + 1,
+                "reason": f"queue depth {queue_depth} >= {queue_high}",
+            }
+        return {
+            "action": "hold", "target": actual,
+            "reason": f"queue depth {queue_depth} but already at "
+            f"max_replicas={max_replicas}",
+        }
+    if idle_s >= scale_down_idle_s and actual > min_replicas:
+        return {
+            "action": "scale_down", "target": actual - 1,
+            "reason": f"idle {idle_s:.0f}s >= {scale_down_idle_s:.0f}s",
+        }
+    return {"action": "hold", "target": actual, "reason": "steady"}
+
+
+# ------------------------------------------------- fleet serve records
+
+
+def collect_records(replica_urls) -> list:
+    """Fleet-merged finalized per-request records: each replica's
+    ``/v1/requests?full=1`` ``recent`` list, concatenated (unreachable
+    replicas are skipped - dead replicas can't report)."""
+    out: list = []
+    for url in replica_urls:
+        try:
+            with urllib.request.urlopen(
+                str(url).rstrip("/") + "/v1/requests?full=1", timeout=10
+            ) as resp:
+                doc = json.loads(resp.read())
+        except (OSError, ValueError):
+            continue
+        out.extend(
+            r for r in (doc.get("recent") or [])
+            if isinstance(r.get("spans"), list)
+        )
+    return out
+
+
+def aggregate_serve_records(records: list) -> dict:
+    """Fold per-replica serving goodput records (`utils/goodput.py`
+    taxonomy "serve") into one fleet record. Conservation is asserted
+    per input AND on the aggregate: goodput + badput buckets must sum
+    to wall-clock within tolerance - the bench gate's honesty rail."""
+    if not records:
+        raise ValueError("no serve records to aggregate")
+    wall = good = 0.0
+    bad: dict = {}
+    for rec in records:
+        if rec.get("taxonomy") != "serve":
+            raise ValueError(
+                f"record taxonomy {rec.get('taxonomy')!r} != 'serve'"
+            )
+        w = float(rec.get("wall_s") or 0.0)
+        g = float(rec.get("goodput_s") or 0.0)
+        b = {
+            k: float(v) for k, v in (rec.get("badput_s") or {}).items()
+        }
+        attributed = g + sum(b.values())
+        if abs(attributed - w) > max(1e-3 * max(w, 1.0), 1e-6):
+            raise AssertionError(
+                "serve record conservation violated: "
+                f"{attributed:.6f}s attributed over {w:.6f}s wall "
+                f"(rank={rec.get('rank')}, pid={rec.get('pid')})"
+            )
+        wall += w
+        good += g
+        for k, v in b.items():
+            bad[k] = bad.get(k, 0.0) + v
+    return {
+        "taxonomy": "serve",
+        "kind": "fleet",
+        "replicas": len(records),
+        "wall_s": round(wall, 6),
+        "goodput_s": round(good, 6),
+        "goodput_ratio": round(good / wall, 6) if wall > 0 else None,
+        "badput_s": {k: round(v, 6) for k, v in sorted(bad.items())},
+    }

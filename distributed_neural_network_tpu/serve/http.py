@@ -44,6 +44,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import signal
 import sys
 import threading
@@ -85,9 +86,11 @@ class ServeServer:
         *,
         port: int = 0,
         host: str = "127.0.0.1",
+        replica_id: str | None = None,
     ):
         self.scheduler = scheduler
         self.registry = registry
+        self.replica_id = replica_id
         self.obs = ObsServer(
             registry,
             port=port,
@@ -96,6 +99,7 @@ class ServeServer:
                 ("POST", "/v1/generate"): self._generate,
                 ("GET", "/v1/status"): self._status,
                 ("GET", "/v1/requests"): self._requests,
+                ("POST", "/v1/drain"): self._drain_route,
             },
         )
         self.port = self.obs.port
@@ -110,6 +114,8 @@ class ServeServer:
         eng = self.scheduler.engine
         blk_bytes = eng.kv_block_bytes()
         _json_response(handler, 200, {
+            "replica": self.replica_id,
+            "draining": self.scheduler.draining,
             "active_sequences": len(eng.active),
             "queued": self.scheduler._queued,
             "kv_blocks_in_use": eng.kv.blocks_in_use,
@@ -164,6 +170,20 @@ class ServeServer:
             handler, 200, self.scheduler.reqtrace.snapshot(full=full)
         )
 
+    def _drain_route(self, handler) -> None:
+        """Graceful drain: stop admission, migrate live sequences out as
+        deterministic replay descriptors (engine.export_descriptor), and
+        report them so the fleet router can re-dispatch to peers. The
+        process itself is released by the caller (SIGTERM after drain -
+        the CLI exits 0)."""
+        out = self.scheduler.drain()
+        _json_response(handler, 200, {
+            "replica": self.replica_id,
+            "draining": True,
+            "completed": bool(out.get("completed")),
+            "migrated": out.get("migrated", []),
+        })
+
     def _parse_request(self, handler):
         try:
             n = int(handler.headers.get("Content-Length") or 0)
@@ -200,12 +220,22 @@ class ServeServer:
             or body.get("api_key")
             or "anonymous"
         )
+        # fleet-router failover provenance (serve/fleet.py re-dispatch)
+        try:
+            retries = int(handler.headers.get("X-Router-Retries") or 0)
+            retry_s = float(
+                handler.headers.get("X-Router-Retry-Seconds") or 0.0
+            )
+        except ValueError:
+            retries, retry_s = 0, 0.0
         req = ServeRequest(
             prompt=prompt,
             max_new_tokens=int(body.get("max_new_tokens", 16)),
             temperature=float(body.get("temperature", 0.0)),
             seed=int(body.get("seed", 0)),
             api_key=str(api_key),
+            router_retries=retries,
+            router_retry_s=retry_s,
             stream_owner=True,  # this handler acks the stream tail
         )
         return req, bool(body.get("stream", True)), is_text
@@ -238,11 +268,13 @@ class ServeServer:
                 yield "error", "stream timeout"
                 return
             yield kind, payload
-            if kind in ("done", "error"):
+            if kind in ("done", "error", "migrate"):
                 return
 
     def _summary_doc(self, req, is_text) -> dict:
         doc = req.summary()
+        if self.replica_id is not None:
+            doc["replica"] = self.replica_id
         if is_text:
             doc["text"] = bytes(
                 t for t in req.tokens if 0 <= t < 256
@@ -262,6 +294,15 @@ class ServeServer:
                 elif kind == "done":
                     frame = dict(self._summary_doc(req, is_text))
                     frame["done"] = True
+                elif kind == "migrate":
+                    # drain migration: the fleet router re-dispatches
+                    # with already-streamed tokens as prompt suffix
+                    frame = {
+                        "migrated": True,
+                        "req_id": req.req_id,
+                        "n_tokens": len(req.tokens),
+                        "replica": self.replica_id,
+                    }
                 else:
                     frame = {"error": payload}
                 handler.wfile.write(
@@ -381,9 +422,13 @@ def main(argv=None) -> int:
     p.add_argument("--tenant-rate", type=float, default=0.0,
                    help="per-API-key token-bucket rate (req/s; 0 = off)")
     p.add_argument("--tenant-burst", type=int, default=8)
-    p.add_argument("--run-record", default=None,
+    p.add_argument("--run-record",
+                   default=os.environ.get("DNN_TPU_RUN_RECORD"),
                    help="write the serving goodput record here "
-                   "(utils/goodput.py taxonomy 'serve')")
+                   "(utils/goodput.py taxonomy 'serve'; default "
+                   "$DNN_TPU_RUN_RECORD - the fleet supervisor sets it "
+                   "so serve/fleet.py aggregate_serve_records can fold "
+                   "per-replica records into the fleet view)")
     p.add_argument("--trace-out", default=None,
                    help="export a Chrome trace of per-request lifecycle "
                    "lanes (one slot lane per concurrent request, spans "
@@ -396,6 +441,15 @@ def main(argv=None) -> int:
                    help="pre-compile the (batch, width) bucket grid "
                    "before binding the port (no first-request compile "
                    "TTFT spike)")
+    p.add_argument("--replica-id",
+                   default=os.environ.get("DNN_TPU_REPLICA_ID"),
+                   help="fleet replica identity (stamped on summaries "
+                   "and /v1/status; default $DNN_TPU_REPLICA_ID)")
+    p.add_argument("--heartbeat-file",
+                   default=os.environ.get("DNN_TPU_HEARTBEAT_FILE"),
+                   help="write a liveness heartbeat JSON here "
+                   "(advertises the /metrics URL for serve/fleet.py "
+                   "router discovery; default $DNN_TPU_HEARTBEAT_FILE)")
     args = p.parse_args(argv)
 
     precision = {s.strip() for s in args.precision.split(",") if s.strip()}
@@ -445,8 +499,17 @@ def main(argv=None) -> int:
         tracer=tracer,
     ).start()
     server = ServeServer(
-        scheduler, registry, port=args.port, host=args.host
+        scheduler, registry, port=args.port, host=args.host,
+        replica_id=args.replica_id,
     )
+    heartbeat = None
+    if args.heartbeat_file:
+        from ..utils.obs import HeartbeatFileWriter
+
+        heartbeat = HeartbeatFileWriter(
+            registry, args.heartbeat_file,
+            metrics_url=server.url, role="serve",
+        )
     print(
         f"serving on {server.url} "
         f"(model d{args.d_model}/L{args.n_layers}/H{args.n_heads} "
@@ -472,6 +535,8 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGINT, _stop)
     while not stop.wait(0.2):
         pass
+    if heartbeat is not None:
+        heartbeat.close()
     record = scheduler.close()
     server.close()
     if tracer is not None:

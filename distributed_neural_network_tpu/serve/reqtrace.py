@@ -89,7 +89,10 @@ REQUEST_CAUSES = (
 # buckets (the apportioned engine seconds; see module docstring)
 ENGINE_CAUSES = ("prefill", "decode", "kv_alloc_stall")
 
-TERMINAL_STATES = ("done", "cancelled", "error")
+# "migrated" = drained off this replica mid-flight (serve/fleet.py):
+# terminal HERE - the request's remaining lifetime continues as a fresh
+# record on the peer replica the router re-dispatched it to
+TERMINAL_STATES = ("done", "cancelled", "error", "migrated")
 
 
 def _tolerance(total: float) -> float:
@@ -107,6 +110,7 @@ class RequestRecord:
         "tokens_emitted", "decode_ticks", "prefill_tokens",
         "replayed_ticks", "preemptions", "episodes", "engine_s", "lane",
         "draft_s", "verify_s", "proposed_tokens", "accepted_tokens",
+        "router_retries", "router_retry_s",
     )
 
     def __init__(self, req_id, tenant, prompt_len, max_new_tokens, t, lane):
@@ -137,6 +141,14 @@ class RequestRecord:
         self.verify_s = 0.0
         self.proposed_tokens = 0
         self.accepted_tokens = 0
+        # router failover provenance (serve/fleet.py): how many times
+        # the fleet router re-dispatched this request before it reached
+        # this replica, and the seconds those episodes cost the client.
+        # Record-level counters like the preemption ``episodes`` - NOT
+        # spans, so per-request conservation (this replica's own
+        # arrival -> terminal partition) is untouched
+        self.router_retries = 0
+        self.router_retry_s = 0.0
 
     # ------------------------------------------------------------- views
 
@@ -230,6 +242,11 @@ class RequestRecord:
                 draft_s=round(self.draft_s, 9),
                 verify_s=round(self.verify_s, 9),
             )
+        if self.router_retries:
+            doc["router_retry"] = {
+                "episodes": self.router_retries,
+                "seconds": round(self.router_retry_s, 9),
+            }
         return doc
 
 
@@ -287,6 +304,21 @@ class RequestTraceRecorder:
         """An admission rejection (429) - counted, no lifecycle."""
         with self._lock:
             self._rejected[reason] = self._rejected.get(reason, 0) + 1
+
+    def note_router_retry(self, req_id: int, episodes: int,
+                          seconds: float) -> None:
+        """Failover provenance from the fleet router (X-Router-Retries
+        headers): this request was re-dispatched ``episodes`` times
+        before arriving here, losing ``seconds`` of client-visible
+        time on dead/drained replicas. Carried as record-level
+        counters (like preemption episodes), never as spans - the
+        lost seconds happened BEFORE this replica's arrival clock
+        started, so span conservation stays exact."""
+        with self._lock:
+            rec = self._open.get(req_id)
+            if rec is not None:
+                rec.router_retries = max(int(episodes), 0)
+                rec.router_retry_s = max(float(seconds), 0.0)
 
     def mark(self, req_id: int, cause: str) -> None:
         """Transition a request to ``cause`` now: closes the open span,

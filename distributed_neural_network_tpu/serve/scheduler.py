@@ -57,7 +57,7 @@ from dataclasses import dataclass, field
 
 from ..utils.goodput import GoodputLedger
 from ..utils.obs import NULL_REGISTRY
-from .engine import ServeEngine, Sequence
+from .engine import ServeEngine, Sequence, export_descriptor
 from .reqtrace import RequestTraceRecorder
 
 # histogram buckets for TTFT / inter-token latency: 1 ms .. 60 s
@@ -89,6 +89,11 @@ class ServeRequest:
     api_key: str = "anonymous"
     temperature: float = 0.0
     seed: int = 0
+    # fleet-router failover provenance (X-Router-Retries headers):
+    # re-dispatch episode count + client-visible seconds lost before
+    # this replica saw the request (serve/reqtrace.py router_retry)
+    router_retries: int = 0
+    router_retry_s: float = 0.0
     req_id: int = 0
     t_arrival: float = 0.0
     t_admitted: float | None = None
@@ -183,6 +188,12 @@ class ServeScheduler:
         self._ids = itertools.count(1)
         self._running = False
         self._thread: threading.Thread | None = None
+        # graceful drain (serve/fleet.py): once set, admission 503s and
+        # the loop migrates every live sequence out as deterministic
+        # replay descriptors (engine.export_descriptor)
+        self._draining = False
+        self._drained = threading.Event()
+        self._drain_out: list = []
         self.ledger = GoodputLedger(taxonomy="serve", clock=clock)
         self.ledger.start()
         # per-request lifecycle records on the ledger's clock, so the
@@ -228,6 +239,9 @@ class ServeScheduler:
             "serve_tokens_total", "Tokens processed, by kind"
         )
         self._m_queue = r.gauge("serve_queue_depth", "Queued requests")
+        self._m_draining = r.gauge(
+            "serve_draining", "1 while the replica is draining"
+        )
         self._m_active = r.gauge(
             "serve_active_sequences", "Sequences in the decode batch"
         )
@@ -308,8 +322,15 @@ class ServeScheduler:
 
     def submit(self, req: ServeRequest) -> ServeRequest:
         """Admit a request to the queue (any thread). Raises
-        `AdmissionError` (429/400); on success the request will stream
-        through ``req.events``."""
+        `AdmissionError` (429/400/503); on success the request will
+        stream through ``req.events``."""
+        if self._draining:
+            self._m_rejected.labels(reason="draining").inc()
+            self.reqtrace.note_rejected("draining")
+            raise AdmissionError(
+                503, "draining",
+                "replica is draining; retry on another replica",
+            )
         ecfg = self.engine.ecfg
         if not req.prompt:
             raise AdmissionError(400, "empty_prompt", "empty prompt")
@@ -365,6 +386,10 @@ class ServeScheduler:
                 req.req_id, req.api_key, len(req.prompt),
                 req.max_new_tokens,
             )
+            if req.router_retries:
+                self.reqtrace.note_router_retry(
+                    req.req_id, req.router_retries, req.router_retry_s
+                )
             fifo = self._tenants.get(req.api_key)
             if fifo is None:
                 fifo = self._tenants[req.api_key] = deque()
@@ -389,7 +414,9 @@ class ServeScheduler:
         ``stream_write`` span closes and the record seals. Only acts on
         a request already at a terminal status - a mid-flight stream
         error stays with the loop (cancel / shutdown paths)."""
-        if req.req_id and req.status in ("done", "cancelled", "error"):
+        if req.req_id and req.status in (
+            "done", "cancelled", "error", "migrated"
+        ):
             self.reqtrace.finalize(
                 req.req_id, req.status  # idempotent vs the loop's seal
             )
@@ -423,7 +450,7 @@ class ServeScheduler:
             self._queued = 0
             self._m_queue.set(0)
         for req in pending + list(self._by_seq.values()):
-            if req.status not in ("done", "cancelled", "error"):
+            if req.status not in ("done", "cancelled", "error", "migrated"):
                 req.status = "error"
                 if req.events is not None:
                     req.events.put(("error", "server shutting down"))
@@ -431,6 +458,99 @@ class ServeScheduler:
         if finalize:
             return self.ledger.finalize()
         return None
+
+    # ------------------------------------------------------------ drain
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drain(self, timeout: float = 30.0) -> dict:
+        """Stop admission and migrate every live sequence out as a
+        deterministic replay descriptor (any thread). Returns
+        ``{"draining", "completed", "migrated"}`` where ``migrated`` is
+        the descriptor list a peer replica (or the fleet router) can
+        resubmit via `engine.resume_request` for a byte-identical
+        continuation. Idempotent; an empty replica completes
+        immediately."""
+        with self._work:
+            first = not self._draining
+            self._draining = True
+            self._m_draining.set(1)
+            self._work.notify()
+        if self._thread is None:
+            # no loop thread (tests / synchronous drivers): sweep inline
+            self._drain_sweep()
+        ok = self._drained.wait(timeout=timeout)
+        with self._work:
+            descs = list(self._drain_out)
+            if first:
+                self._drain_out = []
+        return {"draining": True, "completed": ok, "migrated": descs}
+
+    def _migrate_one(self, req: ServeRequest) -> None:
+        """Seal one request as migrated and emit its replay descriptor
+        (loop thread). Queued requests (no engine sequence yet) migrate
+        with an empty emitted list — a plain re-dispatch."""
+        if req._seq is not None:
+            desc = export_descriptor(req._seq)
+        else:
+            desc = {
+                "seq_id": int(req.req_id),
+                "prompt": [int(t) for t in req.prompt],
+                "emitted": [],
+                "max_new_tokens": int(req.max_new_tokens),
+                "remaining_tokens": int(req.max_new_tokens),
+                "temperature": float(req.temperature),
+                "seed": int(req.seed),
+                "preemptions": 0,
+            }
+        desc["api_key"] = req.api_key
+        req.status = "migrated"
+        req.t_done = time.monotonic()
+        self._m_requests.labels(status="migrated").inc()
+        self.reqtrace.finalize(req.req_id, "migrated")
+        if req.events is not None:
+            req.events.put(("migrate", desc))
+        self._drain_out.append(desc)
+
+    def _drain_sweep(self) -> None:
+        """Evict every live request as a migration descriptor (loop
+        thread, or inline when the loop never started). Cancels are
+        enacted FIRST so a client cancel racing the drain wins — its
+        request ends cancelled, not migrated."""
+        self._enact_cancels()
+        # active (running AND parked-on-kv) sequences: both live in
+        # engine.active; cancel() frees their blocks
+        for sid, req in list(self._by_seq.items()):
+            self.engine.cancel(sid)
+            self._by_seq.pop(sid, None)
+            self._migrate_one(req)
+        # preempted sequences' requests were in _by_seq too (their
+        # blocks are already freed); clear the replay deque
+        self.engine.preempted.clear()
+        with self._work:
+            pending = [r for f in self._tenants.values() for r in f]
+            for f in self._tenants.values():
+                f.clear()
+            self._queued = 0
+            self._m_queue.set(0)
+        for req in pending:
+            if req.cancelled.is_set():
+                req.status = "cancelled"
+                req.t_done = time.monotonic()
+                self._m_requests.labels(status="cancelled").inc()
+                self.reqtrace.finalize(req.req_id, "cancelled")
+                if req.events is not None:
+                    req.events.put(("done", req.summary()))
+            else:
+                self._migrate_one(req)
+        self._m_active.set(len(self.engine.active))
+        self._m_kv_used.set(self.engine.kv.blocks_in_use)
+        self._m_kv_bytes_used.set(
+            self.engine.kv.blocks_in_use * self._kv_block_bytes
+        )
+        self._drained.set()
 
     def _next_request(self):
         """Round-robin over tenant FIFOs (caller holds the lock)."""
@@ -526,6 +646,11 @@ class ServeScheduler:
         kv = eng.kv
         cfg = self.cfg
         while self._running:
+            if self._draining:
+                self._drain_sweep()
+                with self._work:
+                    self._work.wait(timeout=cfg.idle_poll_s)
+                continue
             with self._work:
                 have_queued = self._queued > 0
             if not have_queued and not eng.has_work() and not eng.preempted:

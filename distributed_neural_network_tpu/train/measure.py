@@ -1872,6 +1872,243 @@ def measure_serving(
     }
 
 
+def measure_fleet_serving(
+    *,
+    d_model: int = 256,
+    n_layers: int = 4,
+    n_heads: int = 8,
+    d_ff: int = 1024,
+    vocab: int = 256,
+    dtype: str = "bfloat16",
+    rate: float = 3.0,
+    requests: int = 12,
+    prompt_lens=(16, 64),
+    max_new: int = 24,
+    max_batch: int = 8,
+    num_blocks: int = 129,
+    block_size: int = 16,
+    max_seq_len: int = 256,
+    prefill_chunk: int = 16,
+    seed: int = 0,
+    kill_after_s: float = 1.5,
+    min_scaling_ratio: float = 0.9,
+) -> dict:
+    """The serving-fleet row (serve/fleet.py, docs/SERVING.md "Serving
+    fleet"): two replicas behind the failover router, three legs, all
+    gates ASSERTED in the row.
+
+    1. single-replica baseline at offered rate r (the denominator);
+    2. healthy 2-replica fleet at 2r: sustained rps must be >=
+       ``min_scaling_ratio`` x 2 x the single-replica sustained rps -
+       the router's least-loaded dispatch must actually deliver the
+       second replica's capacity, not just its existence;
+    3. chaos failover at 2r: one replica is killed abruptly mid-run
+       (scheduler torn down under live streams, then the listener -
+       in-flight SSE streams break, new dispatches get connection
+       refused). Every request must still COMPLETE, at least one must
+       arrive via failover re-dispatch, and every retried stream must
+       be per-token identical to the offline ``generate()`` oracle -
+       the deterministic-replay contract, measured, not assumed.
+
+    Per-replica serving goodput records from both fleet legs fold
+    through `serve.fleet.aggregate_serve_records`, which asserts
+    goodput + badput == wall conservation per replica AND on the
+    aggregate (including the killed replica's partial record)."""
+    import sys as _sys
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..models.transformer import (
+        TransformerConfig,
+        generate,
+        init_params,
+    )
+    from ..serve import (
+        EngineConfig,
+        SchedulerConfig,
+        ServeEngine,
+        ServeScheduler,
+    )
+    from ..serve.fleet import FleetRouter, aggregate_serve_records
+    from ..serve.http import ServeServer
+    from ..utils.obs import MetricsRegistry
+
+    tools_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)
+        ))), "tools",
+    )
+    if tools_dir not in _sys.path:
+        _sys.path.insert(0, tools_dir)
+    import loadgen
+
+    cfg = TransformerConfig(
+        vocab_size=vocab, d_model=d_model, n_heads=n_heads,
+        n_layers=n_layers, d_ff=d_ff,
+        dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32,
+    )
+    params = init_params(jax.random.key(seed), cfg)
+
+    def _replica(rid: str):
+        eng = ServeEngine(params, cfg, EngineConfig(
+            max_batch=max_batch, num_blocks=num_blocks,
+            block_size=block_size, max_seq_len=max_seq_len,
+            prefill_chunk=prefill_chunk,
+        ))
+        eng.warmup()
+        reg = MetricsRegistry()
+        sched = ServeScheduler(
+            eng, SchedulerConfig(max_queue=max(4 * requests, 8)),
+            registry=reg,
+        ).start()
+        srv = ServeServer(sched, reg, port=0, replica_id=rid)
+        return sched, srv
+
+    def _load(url: str, offered: float, n: int):
+        return loadgen.run_load(
+            url, rate=offered, n_requests=n, duration=None,
+            prompt_lens=list(prompt_lens), max_new=max_new,
+            vocab=vocab, seed=seed, api_keys=["bench"],
+            temperature=0.0, burst=0, cancel_one=False,
+            timeout=600.0, poisson=False,
+        )
+
+    # --- leg 1: single-replica baseline at offered rate r
+    sched, srv = _replica("solo")
+    try:
+        base = _load(srv.url, rate, requests)
+    finally:
+        sched.close()
+        srv.close()
+    single_rps = base["achieved_rps"]
+
+    def _fleet_leg(chaos: bool):
+        s0, v0 = _replica("rank0")
+        s1, v1 = _replica("rank1")
+        reg = MetricsRegistry()
+        router = FleetRouter(reg, replicas=[
+            ("rank0", v0.url), ("rank1", v1.url),
+        ])
+        recs: dict = {}
+        killer = None
+        if chaos:
+            def _kill():
+                # abrupt replica death under live streams: in-flight
+                # requests get torn down (SSE error frames / broken
+                # pipes), then the listener goes away so re-dispatch
+                # sees connection refused - the router must fail both
+                # over to rank1 with streams intact
+                recs["rank0"] = s0.close()
+                v0.close()
+
+            killer = threading.Timer(kill_after_s, _kill)
+            killer.start()
+        try:
+            summ = _load(router.url, 2 * rate, 2 * requests)
+        finally:
+            if killer is not None:
+                killer.join()
+            if "rank0" not in recs:
+                recs["rank0"] = s0.close()
+                v0.close()
+            recs["rank1"] = s1.close()
+            v1.close()
+            failures = int(
+                reg.counter("fleet_replica_failures_total").value
+            )
+            router.close()
+        return summ, [recs["rank0"], recs["rank1"]], failures
+
+    # --- leg 2: healthy 2-replica fleet at 2r - the scaling gate
+    healthy, healthy_recs, _ = _fleet_leg(chaos=False)
+    fleet_rps = healthy["achieved_rps"]
+    assert fleet_rps >= min_scaling_ratio * 2.0 * single_rps, (
+        f"fleet scaling gate: 2-replica sustained {fleet_rps:.3f} rps "
+        f"< {min_scaling_ratio} x 2 x single-replica "
+        f"{single_rps:.3f} rps - the router is not delivering the "
+        "second replica's capacity"
+    )
+    healthy_agg = aggregate_serve_records(healthy_recs)
+
+    # --- leg 3: chaos failover at 2r - the robustness gates
+    chaos, chaos_recs, failures = _fleet_leg(chaos=True)
+    completed = chaos["by_status"].get("completed", 0)
+    assert completed == chaos["requests"], (
+        f"fleet failover gate: {completed}/{chaos['requests']} "
+        "requests completed - a replica SIGKILL must be invisible to "
+        f"clients (statuses: {chaos['by_status']})"
+    )
+    assert chaos["requests_retried"] >= 1, (
+        "fleet failover gate: killing a replica mid-run produced zero "
+        "failover re-dispatches - the chaos leg did not exercise the "
+        "failover path"
+    )
+    assert failures >= 1, (
+        "fleet failover gate: router observed no replica failure "
+        "(fleet_replica_failures_total == 0) after the kill"
+    )
+    # deterministic-replay oracle: every RETRIED stream (prompt replayed
+    # with streamed tokens suppressed on a survivor) must match the
+    # offline greedy oracle token for token
+    checked = mismatched = 0
+    for r in chaos["results"]:
+        if r.status != "completed" or not r.router_retries:
+            continue
+        oracle = np.asarray(generate(
+            params, jnp.asarray([r.prompt], jnp.int32), cfg,
+            max_new_tokens=len(r.tokens),
+        ))[0, len(r.prompt):]
+        checked += 1
+        if list(map(int, r.tokens)) != [int(t) for t in oracle]:
+            mismatched += 1
+    assert checked >= 1 and mismatched == 0, (
+        f"fleet failover oracle gate: {mismatched}/{checked} retried "
+        "streams diverged from the offline generate() oracle - "
+        "deterministic replay is broken"
+    )
+    chaos_agg = aggregate_serve_records(chaos_recs)
+
+    dev = jax.devices()[0]
+    return {
+        "devices": f"1x {dev.device_kind}",
+        "model": f"d{d_model}/L{n_layers}/H{n_heads} vocab {vocab} {dtype}",
+        "replicas": 2,
+        "single_replica_sustained_rps": single_rps,
+        "offered_rps": healthy["offered_rps"],
+        "sustained_rps": fleet_rps,
+        "scaling_ratio_vs_2x_single": round(
+            fleet_rps / max(2.0 * single_rps, 1e-9), 4
+        ),
+        "ttft_p50_s": healthy["ttft_p50_s"],
+        "ttft_p99_s": healthy["ttft_p99_s"],
+        "by_replica": healthy.get("by_replica"),
+        "failover": {
+            "kill_after_s": kill_after_s,
+            "requests_completed": completed,
+            "requests_total": chaos["requests"],
+            "requests_retried": chaos["requests_retried"],
+            "retry_episodes": chaos["router_retry_episodes"],
+            "replica_failures_observed": failures,
+            "oracle_checked_streams": checked,
+            "oracle_mismatched_streams": mismatched,
+            "sustained_rps": chaos["achieved_rps"],
+        },
+        "fleet_goodput_ratio": healthy_agg["goodput_ratio"],
+        "fleet_goodput_ratio_under_failure": chaos_agg["goodput_ratio"],
+        "note": (
+            "2 in-process replicas behind serve/fleet.py FleetRouter "
+            "over real HTTP+SSE; scaling gate >= "
+            f"{min_scaling_ratio} x 2 x single-replica sustained rps, "
+            "chaos leg kills a replica under live streams and gates "
+            "zero client-visible failures + per-token oracle equality "
+            "of every failed-over stream (docs/SERVING.md)"
+        ),
+    }
+
+
 def measure_kv_capacity(num_blocks: int, block_size: int,
                         max_seq_len: int) -> int:
     """MEASURED concurrent-sequence capacity of a paged-KV pool: admit

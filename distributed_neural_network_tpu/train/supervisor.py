@@ -1292,6 +1292,345 @@ class Supervisor:
         }))
 
 
+class ReplicaSupervisor:
+    """Fleet operator for SERVE replicas (serve/fleet.py): the
+    training `Supervisor`'s failure handling - restart budget,
+    per-rank exponential backoff, worker_failures_total by signal,
+    postmortem.json bundles - without the gang semantics. Serve
+    replicas are independent processes (no JAX coordinator, no
+    rendezvous, a death never restarts the survivors), so the unit of
+    restart is ONE rank, and `scale_to()` grows/retires individual
+    ranks on the autoscaler's orders.
+
+    ``command`` is the replica argv; ``{rank}`` substitutes per
+    worker. Each rank gets a STABLE heartbeat path
+    (``run_dir/hb/rank{N}.json``) so the fleet router's discovery
+    survives restarts: the relaunched process rewrites the same file
+    with its fresh PID + metrics URL. A replica exiting for ANY reason
+    the supervisor didn't order (including rc 0) is a failure -
+    serving processes have no "done".
+
+    Drive it with `tick()` from the operator loop
+    (tools/serve_fleet.py); `stop()` SIGTERMs everyone (the drain-on-
+    SIGTERM path in the serve CLI) and SIGKILLs past the grace window.
+    """
+
+    def __init__(
+        self,
+        command: list,
+        policy: SupervisorPolicy,
+        *,
+        run_dir: str,
+        base_env: dict | None = None,
+        registry=None,
+        log=print,
+    ):
+        self.command = [str(c) for c in command]
+        self.policy = policy
+        self.run_dir = os.path.abspath(run_dir)
+        self.base_env = dict(
+            base_env if base_env is not None else os.environ
+        )
+        self.log = log
+        if registry is None:
+            from ..utils.obs import MetricsRegistry
+
+            registry = MetricsRegistry()
+        self.registry = registry
+        self._m_size = registry.gauge(
+            "supervisor_group_size", "Live replica count"
+        )
+        self._m_target = registry.gauge(
+            "supervisor_target_size", "Target replica count"
+        )
+        self._m_budget = registry.gauge(
+            "supervisor_restart_budget_remaining",
+            "Failure restarts left before dead ranks stay down",
+        )
+        self._m_failures = registry.counter(
+            "worker_failures_total",
+            "Replica deaths observed, by signal/exit label",
+        )
+        self._m_restarts = registry.counter(
+            "elastic_restarts_total",
+            "Replica spawns by direction (grow/shrink/restart)",
+        )
+        self._m_postmortems = registry.counter(
+            "supervisor_postmortems_total",
+            "Postmortem bundles written on replica crashes",
+        )
+        self.postmortem_path = os.path.join(
+            self.run_dir, "postmortem.json"
+        )
+        self.postmortems_written = 0
+        self.workers: dict[int, _Worker] = {}
+        self.target = policy.nprocs
+        self.restarts_used = 0
+        self.failures: list[dict] = []
+        self._attempts: dict[int, int] = {}   # per-rank failure count
+        self._spawn_seq: dict[int, int] = {}  # per-rank launch count
+        self._pending: dict[int, float] = {}  # rank -> respawn due time
+        swept = self._sweep_stale()
+        if swept:
+            self.log(
+                f"(replica-supervisor: swept {swept} stale state "
+                f"file(s) from reused {self.run_dir})"
+            )
+        for sub in ("hb", "logs", "flight", "records"):
+            os.makedirs(os.path.join(self.run_dir, sub), exist_ok=True)
+        self._m_target.set(self.target)
+        self._m_budget.set(policy.max_restarts)
+
+    @property
+    def hb_dir(self) -> str:
+        """The router's ``watch_dir`` (heartbeat-file discovery)."""
+        return os.path.join(self.run_dir, "hb")
+
+    def _sweep_stale(self) -> int:
+        swept = 0
+        for sub in ("hb", "flight", "records"):
+            d = os.path.join(self.run_dir, sub)
+            try:
+                names = os.listdir(d)
+            except OSError:
+                continue
+            for name in names:
+                if name.endswith(".json") or ".json.tmp" in name:
+                    try:
+                        os.unlink(os.path.join(d, name))
+                        swept += 1
+                    except OSError:
+                        pass
+        try:
+            os.unlink(self.postmortem_path)
+            swept += 1
+        except OSError:
+            pass
+        return swept
+
+    # ------------------------------------------------------------- spawn
+
+    def _argv(self, rank: int) -> list:
+        return [a.replace("{rank}", str(rank)) for a in self.command]
+
+    def _spawn_rank(self, rank: int) -> None:
+        seq = self._spawn_seq.get(rank, 0)
+        self._spawn_seq[rank] = seq + 1
+        hb_path = os.path.join(self.hb_dir, f"rank{rank}.json")
+        log_path = os.path.join(
+            self.run_dir, "logs", f"rank{rank}_launch{seq}.log"
+        )
+        flight_path = os.path.join(
+            self.run_dir, "flight", f"rank{rank}.json"
+        )
+        record_path = os.path.join(
+            self.run_dir, "records", f"rank{rank}.json"
+        )
+        env = dict(self.base_env)
+        env[HEARTBEAT_ENV] = hb_path
+        env[FLIGHT_ENV] = flight_path
+        env[RUN_RECORD_ENV] = record_path
+        env["DNN_TPU_SUPERVISOR"] = "1"
+        env["DNN_TPU_REPLICA_ID"] = f"rank{rank}"
+        env["JAX_PROCESS_ID"] = str(rank)
+        log_file = open(log_path, "w")
+        proc = subprocess.Popen(
+            self._argv(rank), env=env,
+            stdout=log_file, stderr=subprocess.STDOUT,
+        )
+        self.workers[rank] = _Worker(
+            rank, proc, hb_path, log_path, log_file, flight_path
+        )
+        self._m_size.set(len(self.workers))
+        self.log(
+            f"(replica-supervisor: rank{rank} launch {seq} -> "
+            f"pid {proc.pid}, log {log_path})"
+        )
+
+    def start(self) -> "ReplicaSupervisor":
+        for rank in range(self.target):
+            if rank not in self.workers:
+                self._spawn_rank(rank)
+        return self
+
+    # ----------------------------------------------------------- monitor
+
+    def tick(self) -> None:
+        """One non-blocking poll: detect deaths, write postmortems,
+        schedule backed-off restarts, fire due respawns. The operator
+        loop calls this every poll interval."""
+        now = time.monotonic()
+        for rank, w in list(self.workers.items()):
+            rc = w.poll()
+            if rc is None:
+                continue
+            # any exit the supervisor didn't order is a failure -
+            # planned retirements leave self.workers BEFORE the kill
+            label = signal_label(rc)
+            self._m_failures.labels(signal=label).inc()
+            self.failures.append({
+                "rank": rank, "returncode": rc, "cause": label,
+                "unix": time.time(),
+            })
+            self._write_postmortem(
+                w, reason=f"replica rank{rank} died ({label})"
+            )
+            del self.workers[rank]
+            self._m_size.set(len(self.workers))
+            if rank >= self.target:
+                continue
+            if self.restarts_used >= self.policy.max_restarts:
+                self.log(
+                    f"(replica-supervisor: rank{rank} died ({label}) "
+                    f"with the restart budget exhausted "
+                    f"({self.policy.max_restarts}); leaving it down)"
+                )
+                continue
+            self.restarts_used += 1
+            self._m_budget.set(
+                self.policy.max_restarts - self.restarts_used
+            )
+            attempt = self._attempts.get(rank, 0) + 1
+            self._attempts[rank] = attempt
+            delay = self.policy.backoff_for(attempt)
+            self._pending[rank] = now + delay
+            self.log(
+                f"(replica-supervisor: rank{rank} died ({label}); "
+                f"restart {self.restarts_used}/"
+                f"{self.policy.max_restarts} in {delay:g}s)"
+            )
+        for rank, due in list(self._pending.items()):
+            if rank >= self.target:
+                del self._pending[rank]
+                continue
+            if now >= due and rank not in self.workers:
+                del self._pending[rank]
+                self._spawn_rank(rank)
+                self._m_restarts.labels(direction="restart").inc()
+
+    # ------------------------------------------------------------- scale
+
+    def scale_to(self, n: int, *, drain=None) -> None:
+        """Grow or shrink to ``n`` replicas. Shrink retires the
+        highest ranks: ``drain("rankN")`` (the router's graceful-drain
+        orchestration, migrating live sequences to survivors) runs
+        best-effort first, then SIGTERM -> grace -> SIGKILL. A retired
+        rank's heartbeat file is removed so discovery forgets it."""
+        n = max(int(n), 0)
+        old, self.target = self.target, n
+        self._m_target.set(n)
+        if n > old:
+            for rank in range(old, n):
+                if rank not in self.workers:
+                    self._pending.pop(rank, None)
+                    self._spawn_rank(rank)
+                    self._m_restarts.labels(direction="grow").inc()
+            return
+        for rank in range(n, old):
+            self._pending.pop(rank, None)
+            w = self.workers.pop(rank, None)
+            self._m_size.set(len(self.workers))
+            if w is None:
+                continue
+            if drain is not None:
+                try:
+                    drain(f"rank{rank}")
+                except Exception as e:
+                    self.log(
+                        f"(replica-supervisor: drain of rank{rank} "
+                        f"failed ({e}); retiring anyway)"
+                    )
+            self._retire(w)
+            self._m_restarts.labels(direction="shrink").inc()
+
+    def _retire(self, w: _Worker) -> None:
+        w.kill(signal.SIGTERM)
+        deadline = time.monotonic() + self.policy.grace_s
+        while time.monotonic() < deadline and w.alive():
+            time.sleep(0.05)
+        if w.alive():
+            self.log(
+                f"(replica-supervisor: rank{w.rank} ignored SIGTERM "
+                f"for {self.policy.grace_s:g}s; SIGKILL)"
+            )
+            w.kill(signal.SIGKILL)
+        try:
+            w.proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:  # pragma: no cover
+            pass
+        w.poll()
+        try:
+            os.unlink(w.hb_path)
+        except OSError:
+            pass
+
+    def stop(self) -> dict:
+        """Planned shutdown of every replica (not a failure); returns
+        the summary doc the CLI prints as FLEET_SUMMARY's supervisor
+        block."""
+        for rank in sorted(self.workers):
+            self._retire(self.workers.pop(rank))
+        self._m_size.set(0)
+        return {
+            "target": self.target,
+            "restarts_used": self.restarts_used,
+            "replica_failures": list(self.failures),
+            "postmortems": self.postmortems_written,
+            "postmortem_path": (
+                self.postmortem_path if self.postmortems_written
+                else None
+            ),
+        }
+
+    # -------------------------------------------------------- postmortem
+
+    def _tail(self, w: _Worker, lines: int = 10) -> str:
+        try:
+            with open(w.log_path, errors="replace") as f:
+                return "".join(f.readlines()[-lines:])
+        except OSError:
+            return "(no log)"
+
+    def _write_postmortem(self, w: _Worker, *, reason: str) -> None:
+        """One crashed replica's evidence bundle (same shape as the
+        training supervisor's: heartbeat + flight dump survive even a
+        SIGKILL). Never raises."""
+        from ..utils.obs import read_flight_dump
+
+        rc = w.poll()
+        doc = {
+            "version": 1,
+            "kind": "serve_replica",
+            "written_unix": time.time(),
+            "reason": reason,
+            "target": self.target,
+            "restarts_used": self.restarts_used,
+            "failures": list(self.failures),
+            "workers": [{
+                "rank": w.rank,
+                "pid": w.proc.pid,
+                "returncode": rc,
+                "cause": signal_label(rc) if rc is not None else None,
+                "failed": True,
+                "heartbeat": read_heartbeat(w.hb_path),
+                "flight": read_flight_dump(w.flight_path),
+                "log_tail": self._tail(w),
+            }],
+        }
+        tmp = self.postmortem_path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1, default=str)
+            os.replace(tmp, self.postmortem_path)
+        except OSError:
+            return
+        self.postmortems_written += 1
+        self._m_postmortems.inc()
+        self.log(
+            f"(replica-supervisor: postmortem -> {self.postmortem_path})"
+        )
+
+
 def main(argv=None) -> int:  # pragma: no cover - thin alias
     """`python -m distributed_neural_network_tpu.train.supervisor` =
     tools/launch.py (kept import-light; the CLI lives in tools/)."""

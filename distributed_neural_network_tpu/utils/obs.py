@@ -547,11 +547,14 @@ class HeartbeatFileWriter:
     def __init__(
         self, registry, path: str, *, interval_s: float = 0.5,
         rank: int | None = None, hostname: str | None = None,
-        metrics_url: str | None = None,
+        metrics_url: str | None = None, role: str | None = None,
     ):
         self.registry = registry
         self.path = os.path.abspath(path)
         self.interval_s = float(interval_s)
+        # "serve" marks fleet-router discovery targets (serve/fleet.py
+        # only dispatches to heartbeats advertising role == "serve")
+        self.role = role
         if rank is None:
             env_rank = os.environ.get("JAX_PROCESS_ID")
             try:
@@ -580,6 +583,7 @@ class HeartbeatFileWriter:
             "rank": self.rank,
             "hostname": self.hostname,
             "metrics_url": self.metrics_url,
+            "role": self.role,
         }
         tmp = f"{self.path}.tmp.{os.getpid()}"
         try:

@@ -1,0 +1,898 @@
+"""Serving fleet: failover router, graceful drain via deterministic
+replay migration, and SLO-driven autoscaling (serve/fleet.py,
+serve/scheduler.py drain, train/supervisor.py ReplicaSupervisor,
+tools/serve_fleet.py).
+
+Bars:
+- a sequence exported mid-generation and resumed on a FRESH engine
+  continues byte-identically to the offline `generate()` oracle (the
+  deterministic-replay contract failover and drain both ride on);
+- scheduler drain covers the edge cases: a PARKED (kv_alloc_stall)
+  sequence migrates, a client cancel racing the drain wins (the
+  request is cancelled, not migrated), and draining an empty replica
+  completes immediately; a draining replica 503s new admissions;
+- the router fails a live stream over to a survivor when its replica
+  dies mid-stream, and the client-visible stream is still token-exact
+  vs the oracle with zero client-visible errors; a routed drain
+  migrates a mid-generation stream byte-identically and the router
+  stops dispatching to the draining replica;
+- the autoscaler's triage is PINNED: queue_wait-dominant SLO
+  violations scale up, kv_alloc_stall-dominant ones hold with
+  add-KV-capacity advice (replicas can't fix an undersized pool);
+- fleet-aggregated serve records conserve wall-clock; router_retry
+  provenance flows through reqtrace -> tools/request_trace.py's
+  Failover line; loadgen reports per-request replica + retry counts;
+- ReplicaSupervisor restarts a crashed replica (with postmortem.json)
+  and retires ranks on scale-down without counting them as failures;
+- live_top renders the fleet pane from router metrics + /v1/fleet.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_neural_network_tpu.models import transformer as tfm
+from distributed_neural_network_tpu.serve import (
+    AdmissionError,
+    EngineConfig,
+    SchedulerConfig,
+    ServeEngine,
+    ServeRequest,
+    ServeScheduler,
+)
+from distributed_neural_network_tpu.serve import engine as eng_mod
+from distributed_neural_network_tpu.serve.fleet import (
+    FleetRouter,
+    RouterConfig,
+    aggregate_serve_records,
+    autoscale_decision,
+    slo_readout,
+)
+from distributed_neural_network_tpu.serve.http import ServeServer
+from distributed_neural_network_tpu.serve.reqtrace import (
+    RequestTraceRecorder,
+)
+from distributed_neural_network_tpu.utils.obs import MetricsRegistry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CFG = tfm.TransformerConfig(
+    vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64
+)
+SEED = 0
+
+
+@pytest.fixture(scope="module")
+def params():
+    return tfm.init_params(jax.random.key(SEED), CFG)
+
+
+def _prompt(key, n, vocab=64):
+    return np.asarray(
+        jax.random.randint(jax.random.key(key), (n,), 2, vocab)
+    ).tolist()
+
+
+def _oracle(params, prompt, n_new):
+    return [int(x) for x in np.asarray(tfm.generate(
+        params, jnp.asarray([prompt], jnp.int32), CFG,
+        max_new_tokens=n_new,
+    ))[0, len(prompt):]]
+
+
+def _mk_engine(params, **kw):
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("num_blocks", 64)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("max_seq_len", 64)
+    return ServeEngine(params, CFG, EngineConfig(**kw))
+
+
+def _mk_replica(params, rid, **ekw):
+    registry = MetricsRegistry()
+    engine = _mk_engine(params, **ekw)
+    scheduler = ServeScheduler(
+        engine, SchedulerConfig(max_queue=16), registry=registry,
+    ).start()
+    srv = ServeServer(scheduler, registry, port=0, replica_id=rid)
+    return engine, scheduler, srv
+
+
+def _stream(url, prompt, max_new, timeout=120):
+    """Client-side SSE read via the router or a replica. Returns
+    (tokens, done_doc)."""
+    body = json.dumps({
+        "prompt": prompt, "max_new_tokens": max_new,
+        "temperature": 0.0,
+    }).encode()
+    req = urllib.request.Request(
+        url + "/v1/generate", data=body,
+        headers={"content-type": "application/json",
+                 "x-api-key": "fleet-test"},
+    )
+    toks, done = [], None
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        buf = b""
+        while True:
+            chunk = resp.read(64)
+            if not chunk:
+                break
+            buf += chunk
+            while b"\n\n" in buf:
+                frame, buf = buf.split(b"\n\n", 1)
+                for line in frame.split(b"\n"):
+                    if not line.startswith(b"data: "):
+                        continue
+                    doc = json.loads(line[6:])
+                    if doc.get("done"):
+                        done = doc
+                    elif "token" in doc:
+                        toks.append(doc["token"])
+    return toks, done
+
+
+# ------------------------------------------- deterministic replay core
+
+
+def test_export_resume_byte_identical_on_fresh_engine(params, n_devices):
+    """The contract everything rides on: export a sequence
+    mid-generation, resume it on a DIFFERENT engine instance, and the
+    stitched stream equals the offline oracle token for token."""
+    prompt = _prompt(1, 6)
+    oracle = _oracle(params, prompt, 12)
+    e0 = _mk_engine(params)
+    seq = eng_mod.Sequence(0, prompt, 12)
+    e0.add(seq)
+    while len(seq.out) < 5:
+        e0.step()
+    desc = eng_mod.export_descriptor(seq)
+    emitted = list(desc["emitted"])
+    assert 0 < len(emitted) <= len(seq.out)
+    assert desc["remaining_tokens"] == 12 - len(emitted)
+    e0.cancel(seq.seq_id)
+
+    e1 = _mk_engine(params)  # the survivor: fresh KV pool, same seed
+    seq2 = eng_mod.resume_sequence(desc)
+    e1.add(seq2)
+    while not seq2.finished:
+        e1.step()
+    assert emitted + seq2.out == oracle
+
+
+def test_resume_request_rejects_exhausted_descriptor():
+    desc = {
+        "prompt": [2, 3], "emitted": [4] * 6, "max_new_tokens": 6,
+        "remaining_tokens": 0, "temperature": 0.0, "seed": 0,
+    }
+    with pytest.raises(ValueError):
+        eng_mod.resume_request(desc)
+
+
+# -------------------------------------------------- scheduler drain
+
+
+def _drain_stack(params, **ekw):
+    registry = MetricsRegistry()
+    engine = _mk_engine(params, **ekw)
+    scheduler = ServeScheduler(
+        engine, SchedulerConfig(max_queue=8), registry=registry,
+    ).start()
+    return engine, scheduler, registry
+
+
+def test_drain_empty_replica_completes_immediately(params, n_devices):
+    _, scheduler, registry = _drain_stack(params)
+    try:
+        t0 = time.monotonic()
+        out = scheduler.drain(timeout=10)
+        assert out["completed"] and out["migrated"] == []
+        assert time.monotonic() - t0 < 5
+        assert scheduler.draining
+        assert "serve_draining 1" in registry.render()
+        with pytest.raises(AdmissionError) as ei:
+            scheduler.submit(ServeRequest(prompt=[2], max_new_tokens=1))
+        assert ei.value.status == 503 and ei.value.reason == "draining"
+    finally:
+        scheduler.close(finalize=False)
+
+
+def test_drain_migrates_active_and_queued(params, n_devices):
+    """Mid-generation actives and still-queued requests both come out
+    as replay descriptors; resuming the active one elsewhere continues
+    byte-identically."""
+    engine, scheduler, _ = _drain_stack(params, max_batch=1)
+    try:
+        active = scheduler.submit(ServeRequest(
+            prompt=_prompt(2, 5), max_new_tokens=30, api_key="a",
+        ))
+        queued = scheduler.submit(ServeRequest(
+            prompt=_prompt(3, 4), max_new_tokens=7, api_key="b",
+        ))
+        # wait for real streamed progress on the active request
+        n_streamed = 0
+        deadline = time.monotonic() + 60
+        while n_streamed < 3:
+            assert time.monotonic() < deadline
+            kind, payload = active.events.get(timeout=60)
+            assert kind == "token", payload
+            n_streamed += 1
+        out = scheduler.drain(timeout=30)
+        assert out["completed"], out
+        descs = {d["seq_id"]: d for d in out["migrated"]}
+        assert len(descs) == 2
+        assert active.status == "migrated"
+        assert queued.status == "migrated"
+        d_active = next(
+            d for d in out["migrated"] if d["emitted"]
+        )
+        d_queued = next(
+            d for d in out["migrated"] if not d["emitted"]
+        )
+        assert d_queued["remaining_tokens"] == 7
+        assert d_active["api_key"] == "a"
+        # the migrate event reached the streaming channel
+        kinds = []
+        while not active.events.empty():
+            kinds.append(active.events.get_nowait()[0])
+        assert "migrate" in kinds
+        # replay the active descriptor on a fresh engine: byte-exact
+        e1 = _mk_engine(params)
+        seq = eng_mod.resume_sequence(d_active)
+        e1.add(seq)
+        while not seq.finished:
+            e1.step()
+        assert d_active["emitted"] + seq.out == _oracle(
+            params, d_active["prompt"], 30
+        )
+        assert not engine.active
+    finally:
+        scheduler.close(finalize=False)
+
+
+def test_drain_migrates_parked_kv_stall_sequence(params, n_devices):
+    """A sequence stalled on KV allocation (grew past the pool - the
+    park <-> preempt cycle, reqtrace kv_alloc_stall/preempted_wait)
+    must migrate out on drain, not strand - and replaying it on a
+    ROOMIER survivor finishes byte-identically."""
+    engine, scheduler, _ = _drain_stack(
+        params, max_batch=2, num_blocks=6, block_size=4, max_seq_len=64,
+    )
+    try:
+        hog = scheduler.submit(ServeRequest(
+            prompt=_prompt(4, 8), max_new_tokens=40, api_key="hog",
+        ))
+        # let it decode until allocation stalls it: the pool (6 blocks
+        # of 4) cannot hold 8 prompt + 40 new tokens, so the sequence
+        # ends up parked (kv_alloc_stall) or preempted (preempted_wait)
+        # long before finishing
+        deadline = time.monotonic() + 60
+        stalled = False
+        while time.monotonic() < deadline:
+            snap = scheduler.reqtrace.get(hog.req_id)
+            if snap and (
+                snap.get("state") in ("kv_alloc_stall", "preempted_wait")
+                or (snap.get("causes") or {}).get("kv_alloc_stall")
+            ):
+                stalled = True
+                break
+            time.sleep(0.005)
+        assert stalled, "sequence never stalled on KV allocation"
+        assert hog.status != "done"
+        out = scheduler.drain(timeout=30)
+        assert out["completed"], out
+        assert hog.status == "migrated"
+        assert len(out["migrated"]) == 1
+        desc = out["migrated"][0]
+        assert desc["emitted"], "stalled sequence had streamed tokens"
+        assert not engine.preempted, "drain must clear the parked deque"
+        # a ROOMIER survivor finishes the replayed sequence exactly
+        e1 = _mk_engine(params, num_blocks=64)
+        seq = eng_mod.resume_sequence(desc)
+        e1.add(seq)
+        while not seq.finished:
+            e1.step()
+        assert desc["emitted"] + seq.out == _oracle(
+            params, desc["prompt"], 40
+        )
+    finally:
+        scheduler.close(finalize=False)
+
+
+def test_drain_racing_client_cancel_cancels(params, n_devices):
+    """A client cancel that lands with the drain must win: the request
+    finalizes cancelled and is NOT handed to another replica."""
+    _, scheduler, _ = _drain_stack(params, max_batch=1)
+    try:
+        req = scheduler.submit(ServeRequest(
+            prompt=_prompt(5, 5), max_new_tokens=30,
+        ))
+        kind, _ = req.events.get(timeout=60)  # first token: it's live
+        assert kind == "token"
+        scheduler.cancel(req)
+        out = scheduler.drain(timeout=30)
+        assert out["completed"]
+        assert req.status == "cancelled"
+        assert out["migrated"] == []
+    finally:
+        scheduler.close(finalize=False)
+
+
+# ------------------------------------------------- router + failover
+
+
+def test_router_failover_mid_stream_byte_identical(params, n_devices):
+    """Kill the replica serving a live stream: the router re-dispatches
+    to the survivor with streamed tokens suppressed and the client
+    stream equals the oracle - plus the failure is counted and the
+    done frame carries the retry provenance."""
+    e0, s0, v0 = _mk_replica(params, "rank0")
+    e1, s1, v1 = _mk_replica(params, "rank1")
+    reg = MetricsRegistry()
+    router = FleetRouter(reg, replicas=[
+        ("rank0", v0.url), ("rank1", v1.url),
+    ])
+    prompt = _prompt(6, 6)
+    oracle = _oracle(params, prompt, 48)
+    res = {}
+
+    def client():
+        res["out"] = _stream(router.url, prompt, 48)
+
+    t = threading.Thread(target=client)
+    t.start()
+    try:
+        victim = None
+        deadline = time.monotonic() + 60
+        while victim is None and time.monotonic() < deadline:
+            for rid, (ss, vv) in (("rank0", (s0, v0)),
+                                  ("rank1", (s1, v1))):
+                if ss._by_seq:
+                    victim = rid
+                    ss.close(finalize=False)
+                    vv.close()
+                    break
+            time.sleep(0.005)
+        assert victim is not None, "stream never landed on a replica"
+        t.join(timeout=120)
+        assert not t.is_alive()
+        toks, done = res["out"]
+        assert toks == oracle
+        survivor = "rank1" if victim == "rank0" else "rank0"
+        assert done["replica"] == survivor
+        assert done["router_retries"] >= 1
+        assert reg.counter("fleet_replica_failures_total").value >= 1
+        assert (
+            reg.counter("fleet_router_requests_total")
+            .labels(status="completed").value == 1
+        )
+    finally:
+        router.close()
+        for ss, vv in ((s0, v0), (s1, v1)):
+            try:
+                ss.close(finalize=False)
+                vv.close()
+            except Exception:
+                pass
+
+
+def test_router_drain_migrates_stream_byte_identical(params, n_devices):
+    """POST /v1/drain on the router while a stream is live: the
+    sequence migrates to the survivor via deterministic replay, the
+    client stream is byte-identical, the drained replica 503s new
+    work, and the router stops dispatching to it."""
+    e0, s0, v0 = _mk_replica(params, "rank0")
+    e1, s1, v1 = _mk_replica(params, "rank1")
+    reg = MetricsRegistry()
+    router = FleetRouter(reg, replicas=[
+        ("rank0", v0.url), ("rank1", v1.url),
+    ])
+    prompt = _prompt(7, 6)
+    oracle = _oracle(params, prompt, 40)
+    res = {}
+
+    def client():
+        res["out"] = _stream(router.url, prompt, 40)
+
+    t = threading.Thread(target=client)
+    t.start()
+    try:
+        victim = None
+        deadline = time.monotonic() + 60
+        while victim is None and time.monotonic() < deadline:
+            for rid, ss in (("rank0", s0), ("rank1", s1)):
+                if ss._by_seq:
+                    victim = rid
+                    break
+            time.sleep(0.005)
+        assert victim is not None
+        rq = urllib.request.Request(
+            router.url + "/v1/drain",
+            data=json.dumps({"replica": victim}).encode(),
+            method="POST",
+        )
+        with urllib.request.urlopen(rq, timeout=30) as resp:
+            dd = json.loads(resp.read())
+        assert dd["draining"] and dd["completed"]
+        assert len(dd["migrated"]) >= 1
+        t.join(timeout=120)
+        toks, done = res["out"]
+        assert toks == oracle
+        survivor = "rank1" if victim == "rank0" else "rank0"
+        assert done["replica"] == survivor
+        # drained replica rejects direct admissions with 503
+        victim_srv = v0 if victim == "rank0" else v1
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _stream(victim_srv.url, prompt, 2)
+        assert ei.value.code == 503
+        # router routes around the draining replica
+        _, done2 = _stream(router.url, prompt, 4)
+        assert done2["replica"] == survivor
+        # drain must NOT count as a replica failure
+        assert reg.counter("fleet_replica_failures_total").value == 0
+    finally:
+        router.close()
+        for ss, vv in ((s0, v0), (s1, v1)):
+            try:
+                ss.close(finalize=False)
+                vv.close()
+            except Exception:
+                pass
+
+
+def test_router_unknown_drain_target_404():
+    reg = MetricsRegistry()
+    router = FleetRouter(reg, replicas=[])
+    try:
+        rq = urllib.request.Request(
+            router.url + "/v1/drain",
+            data=json.dumps({"replica": "rank9"}).encode(),
+            method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(rq, timeout=10)
+        assert ei.value.code == 404
+    finally:
+        router.close()
+
+
+def test_router_empty_fleet_503():
+    reg = MetricsRegistry()
+    router = FleetRouter(reg, replicas=[])
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _stream(router.url, [2, 3], 2, timeout=10)
+        assert ei.value.code == 503
+    finally:
+        router.close()
+
+
+def test_pick_replica_least_loaded_and_exclusion():
+    reg = MetricsRegistry()
+    router = FleetRouter(reg, replicas=[
+        ("a", "http://x/a"), ("b", "http://x/b"), ("c", "http://x/c"),
+    ])
+    try:
+        with router._lock:
+            for rid, (q, kv, st) in {
+                "a": (5, 0.2, "up"),
+                "b": (1, 0.1, "up"),
+                "c": (0, 0.0, "down"),
+            }.items():
+                r = router._replicas[rid]
+                r.queue_depth, r.kv_util, r.state = q, kv, st
+        assert router.pick_replica().replica_id == "b"
+        # exclusion prefers a fresh replica...
+        assert router.pick_replica(exclude={"b"}).replica_id == "a"
+        # ...but falls back to an excluded-yet-up one over failing
+        assert router.pick_replica(
+            exclude={"a", "b"}
+        ).replica_id == "b"
+        with router._lock:
+            router._replicas["a"].state = "down"
+            router._replicas["b"].state = "down"
+        assert router.pick_replica() is None
+    finally:
+        router.close()
+
+
+def test_router_discovers_serve_heartbeats(params, tmp_path, n_devices):
+    """Heartbeat-file discovery: a role="serve" heartbeat pointing at a
+    live replica's metrics URL is folded in, scraped, and dispatchable;
+    a stale heartbeat marks the replica DOWN."""
+    _, sched, srv = _mk_replica(params, "rank0")
+    hb = tmp_path / "rank0.json"
+    hb.write_text(json.dumps({
+        "rank": 0, "t": time.time(), "role": "serve",
+        "metrics_url": srv.url,
+    }))
+    # non-serve heartbeats (training workers) are ignored
+    (tmp_path / "trainer.json").write_text(json.dumps({
+        "rank": 7, "t": time.time(), "metrics_url": srv.url,
+    }))
+    reg = MetricsRegistry()
+    router = FleetRouter(
+        reg, watch_dir=str(tmp_path),
+        cfg=RouterConfig(poll_s=0.1, hb_stale_s=2.0),
+    )
+    try:
+        deadline = time.monotonic() + 15
+        while router.up_count() < 1:
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+        reps = {r.replica_id: r for r in router.replicas()}
+        assert set(reps) == {"rank0"}
+        assert reps["rank0"].kv_blocks_total > 0
+        toks, done = _stream(router.url, _prompt(8, 4), 3)
+        assert toks == _oracle(params, _prompt(8, 4), 3)
+        assert done["replica"] == "rank0"
+        # stale heartbeat -> DOWN
+        hb.write_text(json.dumps({
+            "rank": 0, "t": time.time() - 60, "role": "serve",
+            "metrics_url": srv.url,
+        }))
+        deadline = time.monotonic() + 15
+        while router.up_count() > 0:
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+        assert reg.counter("fleet_replica_failures_total").value >= 1
+    finally:
+        router.close()
+        sched.close(finalize=False)
+        srv.close()
+
+
+# ------------------------------------------------------- autoscaler
+
+
+def _gate(dominant, violated=True):
+    return {"ttft_p99": {
+        "value": 1.0, "limit": 0.5, "violated": violated,
+        "dominant": dominant, "shares": {dominant: 1.0},
+    }}
+
+
+def test_autoscale_queue_wait_dominant_scales_up():
+    d = autoscale_decision(
+        actual=1, min_replicas=1, max_replicas=4,
+        gates=_gate("queue_wait"),
+    )
+    assert d["action"] == "scale_up" and d["target"] == 2
+    assert "queue_wait" in d["reason"]
+    # bounded by max_replicas
+    d = autoscale_decision(
+        actual=4, min_replicas=1, max_replicas=4,
+        gates=_gate("queue_wait"),
+    )
+    assert d["action"] == "hold" and d["target"] == 4
+
+
+def test_autoscale_kv_stall_dominant_holds_with_advice():
+    """The PR 14 taxonomy doing triage: a kv_alloc_stall-dominant
+    violation means the per-replica pool is undersized - another
+    replica would be just as starved, so NO scale-up."""
+    d = autoscale_decision(
+        actual=1, min_replicas=1, max_replicas=4,
+        gates=_gate("kv_alloc_stall"),
+    )
+    assert d["action"] == "hold" and d["target"] == 1
+    assert "KV capacity" in d["reason"]
+    # a non-violated gate triggers nothing
+    d = autoscale_decision(
+        actual=1, min_replicas=1, max_replicas=4,
+        gates=_gate("queue_wait", violated=False),
+    )
+    assert d["action"] == "hold" and d["reason"] == "steady"
+
+
+def test_autoscale_queue_depth_and_idle_paths():
+    d = autoscale_decision(
+        actual=2, min_replicas=1, max_replicas=4, queue_depth=9,
+        queue_high=8,
+    )
+    assert d["action"] == "scale_up" and d["target"] == 3
+    d = autoscale_decision(
+        actual=2, min_replicas=1, max_replicas=4, idle_s=120.0,
+        scale_down_idle_s=60.0,
+    )
+    assert d["action"] == "scale_down" and d["target"] == 1
+    # never below min_replicas
+    d = autoscale_decision(
+        actual=1, min_replicas=1, max_replicas=4, idle_s=120.0,
+        scale_down_idle_s=60.0,
+    )
+    assert d["action"] == "hold"
+
+
+def _fleet_records(dominant):
+    spans = {
+        "queue_wait": [["queue_wait", 0.0, 0.9], ["prefill", 0.9, 0.92],
+                       ["decode", 0.92, 1.0]],
+        "kv_alloc_stall": [["queue_wait", 0.0, 0.01],
+                           ["prefill", 0.01, 0.03],
+                           ["kv_alloc_stall", 0.03, 0.9],
+                           ["decode", 0.9, 1.0]],
+    }[dominant]
+    return [{
+        "req_id": i, "state": "done", "spans": spans,
+        "ttft_s": 0.95, "e2e_s": 1.0, "t_first_token_rel": 0.95,
+    } for i in range(4)]
+
+
+def test_slo_readout_dominant_cause_feeds_decision():
+    gates = slo_readout(_fleet_records("queue_wait"),
+                        {"ttft_p99": 0.5})
+    assert gates["ttft_p99"]["violated"]
+    assert gates["ttft_p99"]["dominant"] == "queue_wait"
+    d = autoscale_decision(
+        actual=1, min_replicas=1, max_replicas=4, gates=gates,
+    )
+    assert d["action"] == "scale_up"
+    gates = slo_readout(_fleet_records("kv_alloc_stall"),
+                        {"ttft_p99": 0.5})
+    assert gates["ttft_p99"]["dominant"] == "kv_alloc_stall"
+    d = autoscale_decision(
+        actual=1, min_replicas=1, max_replicas=4, gates=gates,
+    )
+    assert d["action"] == "hold" and "KV capacity" in d["reason"]
+    with pytest.raises(ValueError):
+        slo_readout([], {"bogus_p99": 1.0})
+
+
+# --------------------------------------------- fleet goodput records
+
+
+def test_aggregate_serve_records_conserves():
+    recs = [
+        {"taxonomy": "serve", "wall_s": 10.0, "goodput_s": 6.0,
+         "badput_s": {"prefill": 1.0, "queue_wait": 3.0}, "rank": 0},
+        {"taxonomy": "serve", "wall_s": 5.0, "goodput_s": 2.0,
+         "badput_s": {"prefill": 3.0}, "rank": 1},
+    ]
+    agg = aggregate_serve_records(recs)
+    assert agg["taxonomy"] == "serve" and agg["kind"] == "fleet"
+    assert agg["replicas"] == 2
+    assert agg["wall_s"] == pytest.approx(15.0)
+    assert agg["goodput_s"] == pytest.approx(8.0)
+    assert agg["badput_s"]["prefill"] == pytest.approx(4.0)
+    total = agg["goodput_s"] + sum(agg["badput_s"].values())
+    assert total == pytest.approx(agg["wall_s"])
+    with pytest.raises(AssertionError):
+        aggregate_serve_records([{
+            "taxonomy": "serve", "wall_s": 10.0, "goodput_s": 1.0,
+            "badput_s": {"prefill": 1.0},
+        }])
+    with pytest.raises(ValueError):
+        aggregate_serve_records([])
+
+
+# ------------------------------------- provenance: reqtrace + tools
+
+
+def test_reqtrace_router_retry_provenance():
+    t = [0.0]
+    rec = RequestTraceRecorder(clock=lambda: t[0])
+    rec.arrive(1, "tenant", 4, 8)
+    rec.note_router_retry(1, episodes=2, seconds=0.25)
+    rec.mark(1, "decode")
+    t[0] = 0.5
+    rec.finalize(1, "done")
+    doc = rec.get(1)
+    assert doc["router_retry"] == {"episodes": 2, "seconds": 0.25}
+    # conservation untouched: spans still cover the lifetime
+    assert doc["spans"][-1][2] == pytest.approx(0.5)
+    # an untouched request has NO router_retry key
+    rec.arrive(2, "tenant", 4, 8)
+    rec.finalize(2, "done")
+    assert "router_retry" not in rec.get(2)
+
+
+def test_request_trace_failover_line(tmp_path, capsys):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import request_trace
+
+    spans = [["queue_wait", 0.0, 0.01], ["prefill", 0.01, 0.02],
+             ["decode", 0.02, 0.10]]
+    records = [{
+        "req_id": i, "tenant": "t", "state": "done",
+        "tokens_emitted": 3, "preemptions": 0,
+        "ttft_s": 0.02, "e2e_s": 0.10, "t_first_token_rel": 0.02,
+        "spans": spans, "causes": {}, "engine_s": {}, "episodes": [],
+        "prompt_len": 4, "max_new_tokens": 3, "decode_ticks": 3,
+        "prefill_tokens": 4, "replayed_ticks": 0,
+        **({"router_retry": {"episodes": 2, "seconds": 0.3}}
+           if i == 0 else {}),
+    } for i in range(2)]
+    doc = {
+        "taxonomy": [], "in_flight": [], "recent": records,
+        "counts": {"in_flight": 0, "finalized": 3, "ring": 3,
+                   "evicted": 0, "rejected": {},
+                   "by_state": {"done": 2, "migrated": 1}},
+    }
+    path = tmp_path / "requests.json"
+    path.write_text(json.dumps(doc))
+    assert request_trace.main([str(path)]) == 0
+    out = capsys.readouterr().out
+    assert ("Failover: 1 request(s) arrived re-dispatched "
+            "(2 episode(s), 0.3000s lost to retries); "
+            "1 migrated out by drain") in out
+
+
+def test_loadgen_reports_replica_and_retries(params, n_devices):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import loadgen
+
+    _, sched, srv = _mk_replica(params, "solo")
+    try:
+        summary = loadgen.run_load(
+            srv.url, rate=50.0, n_requests=4, duration=None,
+            prompt_lens=[4], max_new=3, vocab=64, seed=3,
+            api_keys=["t"], temperature=0.0, burst=0,
+            cancel_one=False, timeout=120.0, poisson=False,
+        )
+        assert summary["by_replica"] == {"solo": 4}
+        assert summary["requests_retried"] == 0
+        assert summary["router_retry_episodes"] == 0
+        for r in summary["results"]:
+            assert r.replica == "solo" and r.router_retries == 0
+    finally:
+        sched.close(finalize=False)
+        srv.close()
+
+
+# ------------------------------------------------ replica supervisor
+
+
+def test_replica_supervisor_restart_and_postmortem(tmp_path):
+    from distributed_neural_network_tpu.train.supervisor import (
+        ReplicaSupervisor,
+        SupervisorPolicy,
+    )
+
+    reg = MetricsRegistry()
+    sup = ReplicaSupervisor(
+        [sys.executable, "-c", "import time; time.sleep(120)"],
+        SupervisorPolicy(nprocs=2, max_restarts=2,
+                         restart_backoff_s=0.05, grace_s=1.0),
+        run_dir=str(tmp_path / "run"), registry=reg,
+        log=lambda *_: None,
+    ).start()
+    try:
+        assert sorted(sup.workers) == [0, 1]
+        pid0 = sup.workers[0].proc.pid
+        pid1 = sup.workers[1].proc.pid
+        sup.workers[1].kill(9)  # SIGKILL: unordered death
+        deadline = time.monotonic() + 30
+        while 1 not in sup.workers or sup.workers[1].proc.pid == pid1:
+            assert time.monotonic() < deadline
+            sup.tick()
+            time.sleep(0.05)
+        assert sup.restarts_used == 1
+        assert os.path.exists(sup.postmortem_path)
+        pm = json.loads(open(sup.postmortem_path).read())
+        assert pm["kind"] == "serve_replica"
+        assert pm["workers"][0]["rank"] == 1
+        assert "SIGKILL" in pm["reason"]
+        assert 'worker_failures_total{signal="SIGKILL"} 1' in \
+            reg.render()
+        # rank0 untouched the whole time
+        assert sup.workers[0].proc.pid == pid0
+    finally:
+        sup.stop()
+
+
+def test_replica_supervisor_scale_and_planned_retire(tmp_path):
+    from distributed_neural_network_tpu.train.supervisor import (
+        ReplicaSupervisor,
+        SupervisorPolicy,
+    )
+
+    reg = MetricsRegistry()
+    drained = []
+    sup = ReplicaSupervisor(
+        [sys.executable, "-c", "import time; time.sleep(120)"],
+        SupervisorPolicy(nprocs=1, max_restarts=2, grace_s=1.0),
+        run_dir=str(tmp_path / "run"), registry=reg,
+        log=lambda *_: None,
+    ).start()
+    try:
+        sup.scale_to(3)
+        assert sorted(sup.workers) == [0, 1, 2]
+        # planned retirement: highest ranks go, drain hook runs first,
+        # and NO failure is recorded
+        sup.scale_to(1, drain=drained.append)
+        assert sorted(sup.workers) == [0]
+        assert drained == ["rank1", "rank2"]
+        sup.tick()
+        assert sup.failures == []
+        assert sup.restarts_used == 0
+        text = reg.render()
+        assert 'elastic_restarts_total{direction="grow"} 2' in text
+        assert 'elastic_restarts_total{direction="shrink"} 2' in text
+        assert not os.path.exists(sup.postmortem_path)
+    finally:
+        sup.stop()
+
+
+def test_replica_supervisor_budget_exhaustion_leaves_rank_down(
+        tmp_path):
+    from distributed_neural_network_tpu.train.supervisor import (
+        ReplicaSupervisor,
+        SupervisorPolicy,
+    )
+
+    sup = ReplicaSupervisor(
+        [sys.executable, "-c", "import sys; sys.exit(3)"],
+        SupervisorPolicy(nprocs=1, max_restarts=1,
+                         restart_backoff_s=0.01, grace_s=0.5),
+        run_dir=str(tmp_path / "run"),
+        log=lambda *_: None,
+    ).start()
+    try:
+        deadline = time.monotonic() + 30
+        # crash-loop: first death spends the only restart; the second
+        # death must leave the rank down for good
+        while len(sup.failures) < 2:
+            assert time.monotonic() < deadline
+            sup.tick()
+            time.sleep(0.02)
+        time.sleep(0.1)
+        sup.tick()
+        assert sup.workers == {}
+        assert sup.restarts_used == 1
+        assert all(f["cause"] == "exit:3" for f in sup.failures)
+    finally:
+        sup.stop()
+
+
+# ------------------------------------------------------ live_top pane
+
+
+def test_live_top_renders_fleet_pane():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import live_top
+
+    reg = MetricsRegistry()
+    reg.counter("fleet_router_requests_total").labels(
+        status="completed").inc(7)
+    reg.counter("fleet_router_retries_total").inc(2)
+    reg.counter("fleet_replica_failures_total").inc(1)
+    reg.gauge("fleet_target_replicas").set(3)
+    reg.gauge("fleet_actual_replicas").set(2)
+    snap = {
+        "metrics": live_top.parse_prometheus(reg.render()),
+        "health": None, "source": "test",
+        "fleet": {
+            "target_replicas": 3, "actual_replicas": 2,
+            "router": {"requests_completed": 7, "retries_total": 2,
+                       "replica_failures": 1},
+            "replicas": [
+                {"replica": "rank0", "state": "up", "queue_depth": 1,
+                 "active_sequences": 2, "kv_utilization": 0.25,
+                 "ttft_p99_s": 0.05, "requests_completed": 4,
+                 "dispatched": 5, "inflight": 2, "failures": 0},
+                {"replica": "rank1", "state": "draining",
+                 "queue_depth": 0, "active_sequences": 1,
+                 "kv_utilization": 0.95, "ttft_p99_s": 0.2,
+                 "requests_completed": 3, "dispatched": 4,
+                 "inflight": 1, "failures": 1},
+            ],
+        },
+    }
+    frame = live_top.render(snap, color=False)
+    assert "fleet" in frame
+    assert "replicas 2/3 target" in frame
+    assert "failover retries 2" in frame
+    assert "replica failures 1" in frame
+    assert "rank0" in frame and "up" in frame
+    assert "DRAINING" in frame
+    assert "kv 95%" in frame
+    assert "done 4" in frame and "done 3" in frame

@@ -245,6 +245,12 @@ class EndpointSource:
         completed = labeled_value(
             metrics, "serve_requests_total", status="completed"
         )
+        if completed is None:
+            # fleet router endpoint (serve/fleet.py): same QPS series
+            # from the router-side completed counter
+            completed = labeled_value(
+                metrics, "fleet_router_requests_total", status="completed"
+            )
         if completed is not None:
             now = time.time()
             if self._last_completed is not None:
@@ -271,7 +277,20 @@ class EndpointSource:
                     requests = json.loads(body_rq)
                 except ValueError:
                     pass
-        return {"metrics": metrics, "health": health,
+        # fleet-router targets (serve/fleet.py): per-replica detail +
+        # autoscaler target vs actual from GET /v1/fleet
+        fleet = None
+        if "fleet_router_requests_total" in metrics \
+                or "fleet_replicas" in metrics:
+            err = self.error
+            body_fl = self._get("/v1/fleet")
+            self.error = err
+            if body_fl:
+                try:
+                    fleet = json.loads(body_fl)
+                except ValueError:
+                    pass
+        return {"metrics": metrics, "health": health, "fleet": fleet,
                 "loss_history": list(self.loss_history),
                 "grad_history": list(self.grad_history),
                 "skew_history": list(self.skew_history),
@@ -756,6 +775,86 @@ def render(snap: dict, *, color: bool = True, width: int = 72) -> str:
                 if state in ("kv_alloc_stall", "preempted_wait"):
                     row = c(RED, row)
                 lines.append(row)
+    # serving-fleet view (serve/fleet.py router): autoscaler target vs
+    # actual, router failover counters, and one row per replica with
+    # QPS, TTFT p99, KV occupancy and up/DRAINING/DOWN state - present
+    # when the target is a tools/serve_fleet.py router endpoint
+    fleet_doc = snap.get("fleet")
+    if fleet_doc is None and "fleet_router_requests_total" in m:
+        fleet_doc = {}
+    if fleet_doc is not None:
+        router = fleet_doc.get("router") or {}
+        target = fleet_doc.get(
+            "target_replicas", metric_value(m, "fleet_target_replicas", 0)
+        )
+        actual = fleet_doc.get(
+            "actual_replicas", metric_value(m, "fleet_actual_replicas", 0)
+        )
+        completed = router.get(
+            "requests_completed",
+            labeled_value(
+                m, "fleet_router_requests_total", 0, status="completed"
+            ),
+        )
+        retries = router.get(
+            "retries_total",
+            metric_value(m, "fleet_router_retries_total", 0),
+        )
+        failures = router.get(
+            "replica_failures",
+            metric_value(m, "fleet_replica_failures_total", 0),
+        )
+        tgt_s = f"replicas {int(actual)}/{int(target)} target"
+        if int(actual) < int(target):
+            tgt_s = c(YELLOW, tgt_s)
+        head = (
+            f"fleet       {tgt_s}  completed {int(completed)}"
+            + (
+                c(YELLOW, f"  failover retries {int(retries)}")
+                if retries else "  failover retries 0"
+            )
+            + (
+                c(RED, f"  replica failures {int(failures)}")
+                if failures else ""
+            )
+        )
+        lines.append(head)
+        qps_hist = snap.get("qps_history") or []
+        fleet_qps = qps_hist[-1] if qps_hist else None
+        for rep in fleet_doc.get("replicas") or []:
+            rid = rep.get("replica", "?")
+            state = rep.get("state", "?")
+            state_s = {
+                "up": c(GREEN, "up"),
+                "draining": c(YELLOW, "DRAINING"),
+            }.get(state, c(RED, state.upper()))
+            kv_used = rep.get("kv_blocks_in_use") or 0
+            kv_total = rep.get("kv_blocks_total") or 0
+            util = rep.get("kv_utilization") or (
+                kv_used / kv_total if kv_total else 0.0
+            )
+            kv_col = (
+                GREEN if util < 0.7 else YELLOW if util < 0.9 else RED
+            )
+            ttft99 = rep.get("ttft_p99_s")
+            row = (
+                f"  {rid:<8} {state_s}  "
+                f"q {int(rep.get('queue_depth') or 0)}  "
+                f"act {int(rep.get('active_sequences') or 0)}  "
+                + c(kv_col, f"kv {100.0 * util:.0f}%")
+                + (
+                    f"  ttft p99<={ttft99:.3g}s"
+                    if ttft99 is not None else ""
+                )
+                + f"  done {int(rep.get('requests_completed') or 0)}"
+                + (
+                    c(RED, f"  fail x{int(rep.get('failures') or 0)}")
+                    if rep.get("failures") else ""
+                )
+            )
+            lines.append(row)
+        if fleet_qps is not None:
+            lines.append(f"  fleet {fleet_qps:.2f} req/s")
     phases = m.get("phase_seconds_total") or {}
     if phases:
         lines.append(

@@ -73,7 +73,8 @@ def make_prompts(n: int, lens, vocab: int, seed: int):
 class RequestResult:
     __slots__ = ("idx", "status", "http_status", "tokens", "ttft_s",
                  "gaps_s", "total_s", "error", "prompt", "cancelled_after",
-                 "req_id", "t_send_unix", "t_first_unix", "t_done_unix")
+                 "req_id", "t_send_unix", "t_first_unix", "t_done_unix",
+                 "replica", "router_retries")
 
     def __init__(self, idx, prompt):
         self.idx = idx
@@ -93,6 +94,10 @@ class RequestResult:
         self.t_send_unix = None
         self.t_first_unix = None
         self.t_done_unix = None
+        # fleet provenance (serve/fleet.py done frames): the replica
+        # that finished the stream + failover re-dispatch count
+        self.replica = None
+        self.router_retries = 0
 
 
 def run_one(
@@ -161,6 +166,11 @@ def run_one(
                     res.t_done_unix = time.time()
                     if isinstance(doc.get("req_id"), int):
                         res.req_id = doc["req_id"]
+                    if doc.get("replica") is not None:
+                        res.replica = str(doc["replica"])
+                    res.router_retries = int(
+                        doc.get("router_retries") or 0
+                    )
                     return
                 elif "error" in doc:
                     res.status = "error"
@@ -235,6 +245,13 @@ def run_load(
     gaps = [g for r in results for g in r.gaps_s]
     completed = [r for r in results if r.status == "completed"]
     toks = sum(len(r.tokens) for r in results)
+    # fleet failover visibility: which replicas finished streams, and
+    # how many requests needed a router re-dispatch to survive
+    by_replica: dict = {}
+    for r in completed:
+        if r.replica is not None:
+            by_replica[r.replica] = by_replica.get(r.replica, 0) + 1
+    retried = [r for r in results if r.router_retries > 0]
     return {
         "offered_rps": round(rate, 4),
         "achieved_rps": round(len(completed) / wall, 4) if wall > 0 else None,
@@ -247,6 +264,11 @@ def run_load(
         "ttft_p99_s": percentile(ttfts, 0.99),
         "intertoken_p50_s": percentile(gaps, 0.50),
         "intertoken_p99_s": percentile(gaps, 0.99),
+        "by_replica": by_replica,
+        "requests_retried": len(retried),
+        "router_retry_episodes": sum(
+            r.router_retries for r in retried
+        ),
         "results": results,
     }
 
@@ -453,6 +475,8 @@ def main(argv=None) -> int:
                     "t_send_unix": r.t_send_unix,
                     "t_first_token_unix": r.t_first_unix,
                     "t_done_unix": r.t_done_unix,
+                    "replica": r.replica,
+                    "router_retries": r.router_retries,
                 }) + "\n")
     if problems:
         print("LOADGEN FAILED:", file=sys.stderr)

@@ -163,6 +163,24 @@ def print_report(records: list[dict], doc: dict, n_exemplars: int) -> dict:
             f"({100.0 * acc / prop:.1f}%); draft {drf:.4f}s + verify "
             f"{ver:.4f}s device time inside decode"
         )
+    # fleet failover provenance (serve/reqtrace.py router_retry): how
+    # many of this replica's requests arrived as re-dispatches, plus
+    # sequences this replica migrated OUT during a drain
+    retried = [
+        r for r in records
+        if (r.get("router_retry") or {}).get("episodes")
+    ]
+    migrated = ((counts.get("by_state") or {}).get("migrated", 0))
+    if retried or migrated:
+        eps = sum(r["router_retry"]["episodes"] for r in retried)
+        lost = sum(
+            r["router_retry"].get("seconds") or 0.0 for r in retried
+        )
+        print(
+            f"Failover: {len(retried)} request(s) arrived re-dispatched "
+            f"({eps} episode(s), {lost:.4f}s lost to retries); "
+            f"{migrated} migrated out by drain"
+        )
     gates: dict = {}
     for metric, label in (("ttft", "TTFT"), ("e2e", "E2E")):
         for q in PERCENTILES:

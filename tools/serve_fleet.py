@@ -1,0 +1,275 @@
+#!/usr/bin/env python
+"""serve_fleet: N supervised serve replicas behind the failover router.
+
+The serving-fleet CLI (`serve/fleet.py`, docs/SERVING.md "Serving
+fleet"): a `ReplicaSupervisor` spawns N independent
+`python -m distributed_neural_network_tpu.serve --port 0` replicas
+(stable per-rank heartbeat files advertise each ephemeral /metrics
+URL), a `FleetRouter` fronts them with the same `POST /v1/generate`
+surface plus least-loaded dispatch and bounded failover (a replica
+dying mid-stream re-dispatches to a survivor with already-streamed
+tokens suppressed - client streams stay byte-identical to the offline
+oracle), and an optional autoscaler loop scales the fleet on
+queue-depth and dominant-cause SLO pressure.
+
+Replica flags (model geometry, engine knobs) follow ``--`` and are
+passed through to every replica verbatim - the same flags
+`tools/loadgen.py --check-oracle` needs to rebuild the oracle model.
+
+Examples:
+  # 2 replicas, router on an ephemeral port (URL printed)
+  python tools/serve_fleet.py --replicas 2 --run-dir /tmp/fleet \\
+      --port 0 -- --d-model 64 --n-layers 2 --max-seq-len 256
+
+  # chaos: SIGKILL rank1 8s in (the CI failover leg)
+  python tools/serve_fleet.py --replicas 2 --run-dir /tmp/fleet \\
+      --chaos-kill-rank 1 --chaos-kill-after-s 8 -- --d-model 64
+
+  # autoscale 1..3 on queue pressure + TTFT SLO
+  python tools/serve_fleet.py --replicas 1 --min-replicas 1 \\
+      --max-replicas 3 --autoscale --slo ttft_p99=0.5 \\
+      --run-dir /tmp/fleet -- --d-model 64
+
+SIGTERM/SIGINT stop the fleet cleanly (router closed, replicas
+SIGTERMed - each drains and exits 0) and print one machine-readable
+``FLEET_SUMMARY {json}`` line. Replica crashes write
+``<run-dir>/postmortem.json`` exactly like training workers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def parse_slo(spec: str) -> dict:
+    """``ttft_p99=0.5,e2e_p95=2.0`` -> {key: seconds} (keys validated
+    by serve/fleet.py slo_readout)."""
+    out = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, _, val = part.partition("=")
+        out[key.strip()] = float(val)
+    if not out:
+        raise ValueError("empty --slo spec")
+    return out
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--" in argv:
+        split = argv.index("--")
+        argv, replica_args = argv[:split], argv[split + 1:]
+    else:
+        replica_args = []
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("--replicas", type=int, default=2,
+                   help="initial replica count (default 2)")
+    p.add_argument("--min-replicas", type=int, default=1)
+    p.add_argument("--max-replicas", type=int, default=4)
+    p.add_argument("--run-dir", required=True,
+                   help="heartbeats, logs, per-replica goodput "
+                   "records, postmortem.json")
+    p.add_argument("--port", type=int, default=8080,
+                   help="router port; 0 = ephemeral (URL printed)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--max-restarts", type=int, default=3,
+                   help="replica failure-restart budget for the run")
+    p.add_argument("--restart-backoff-s", type=float, default=0.5)
+    p.add_argument("--grace-s", type=float, default=10.0,
+                   help="retirement SIGTERM -> SIGKILL grace (the "
+                   "drain-and-exit window)")
+    p.add_argument("--poll-s", type=float, default=0.2)
+    p.add_argument("--autoscale", action="store_true",
+                   help="run the SLO-driven autoscaler loop "
+                   "(serve/fleet.py autoscale_decision)")
+    p.add_argument("--autoscale-interval-s", type=float, default=5.0)
+    p.add_argument("--queue-high", type=int, default=8,
+                   help="fleet queue depth that triggers scale-up")
+    p.add_argument("--slo", default=None,
+                   help="SLO gates for the autoscaler, e.g. "
+                   "ttft_p99=0.5,e2e_p95=2.0 - queue_wait-dominant "
+                   "violations scale up; kv_alloc_stall-dominant ones "
+                   "hold with add-KV-capacity advice")
+    p.add_argument("--scale-down-idle-s", type=float, default=60.0)
+    p.add_argument("--duration-s", type=float, default=0.0,
+                   help="stop after this long (0 = until SIGTERM)")
+    p.add_argument("--chaos-kill-rank", type=int, default=None,
+                   help="SIGKILL this replica rank once (CI chaos leg)")
+    p.add_argument("--chaos-kill-after-s", type=float, default=5.0,
+                   help="chaos delay, measured from the moment every "
+                   "replica is up (not from process start), so the "
+                   "kill lands under load regardless of warmup time")
+    args = p.parse_args(argv)
+    if not 1 <= args.min_replicas <= args.replicas <= args.max_replicas:
+        p.error("need 1 <= --min-replicas <= --replicas <= "
+                "--max-replicas")
+    slo = None
+    if args.slo:
+        try:
+            slo = parse_slo(args.slo)
+        except ValueError as e:
+            p.error(f"--slo: {e}")
+
+    from distributed_neural_network_tpu.serve.fleet import (
+        FleetRouter,
+        autoscale_decision,
+        collect_records,
+        slo_readout,
+    )
+    from distributed_neural_network_tpu.train.supervisor import (
+        ReplicaSupervisor,
+        SupervisorPolicy,
+    )
+    from distributed_neural_network_tpu.utils.obs import MetricsRegistry
+
+    registry = MetricsRegistry()
+    command = [
+        sys.executable, "-m", "distributed_neural_network_tpu.serve",
+        "--port", "0", *replica_args,
+    ]
+    # replicas must import the package regardless of the CLI's cwd
+    base_env = dict(os.environ)
+    base_env["PYTHONPATH"] = REPO + (
+        os.pathsep + base_env["PYTHONPATH"]
+        if base_env.get("PYTHONPATH") else ""
+    )
+    policy = SupervisorPolicy(
+        nprocs=args.replicas,
+        min_procs=args.min_replicas,
+        max_restarts=args.max_restarts,
+        restart_backoff_s=args.restart_backoff_s,
+        grace_s=args.grace_s,
+    )
+    sup = ReplicaSupervisor(
+        command, policy, run_dir=args.run_dir, base_env=base_env,
+        registry=registry,
+    ).start()
+    router = FleetRouter(
+        registry, watch_dir=sup.hb_dir, port=args.port, host=args.host,
+    )
+    router.set_target(args.replicas)
+    print(
+        f"fleet router on {router.url} ({args.replicas} replica(s), "
+        f"autoscale {'on' if args.autoscale else 'off'} "
+        f"[{args.min_replicas}..{args.max_replicas}]; endpoints: "
+        "POST /v1/generate, GET /v1/status, GET /v1/fleet, "
+        "POST /v1/drain, /metrics)",
+        flush=True,
+    )
+
+    stop = threading.Event()
+
+    def _stop(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
+
+    t_start = time.monotonic()
+    t_autoscale = t_start
+    t_last_busy = t_start
+    t_all_up = None
+    chaos_done = args.chaos_kill_rank is None
+    while not stop.wait(args.poll_s):
+        sup.tick()
+        now = time.monotonic()
+        if args.duration_s > 0 and now - t_start >= args.duration_s:
+            break
+        if t_all_up is None and sum(
+            1 for r in router.replicas() if r.state == "up"
+        ) >= sup.target:
+            t_all_up = now
+        if not chaos_done and t_all_up is not None \
+                and now - t_all_up >= args.chaos_kill_after_s:
+            # hold fire until the victim is actually serving router
+            # traffic, so the SIGKILL lands mid-stream and the
+            # failover path (not just respawn) is exercised
+            victim = f"rank{args.chaos_kill_rank}"
+            serving = any(
+                r.replica_id == victim and (r.inflight or r.active)
+                for r in router.replicas()
+            )
+            w = sup.workers.get(args.chaos_kill_rank)
+            if w is None or not w.alive():
+                chaos_done = True
+            elif serving:
+                chaos_done = True
+                print(
+                    f"(fleet chaos: SIGKILL rank{args.chaos_kill_rank} "
+                    f"pid {w.proc.pid})",
+                    flush=True,
+                )
+                w.kill(signal.SIGKILL)
+        reps = router.replicas()
+        busy = any(
+            r.queue_depth or r.active or r.inflight for r in reps
+        )
+        if busy:
+            t_last_busy = now
+        if args.autoscale and now - t_autoscale \
+                >= args.autoscale_interval_s:
+            t_autoscale = now
+            gates = None
+            if slo:
+                records = collect_records(
+                    r.url for r in reps if r.state == "up"
+                )
+                if records:
+                    gates = slo_readout(records, slo)
+            decision = autoscale_decision(
+                actual=sup.target,
+                min_replicas=args.min_replicas,
+                max_replicas=args.max_replicas,
+                queue_depth=sum(r.queue_depth for r in reps),
+                queue_high=args.queue_high,
+                gates=gates,
+                idle_s=now - t_last_busy,
+                scale_down_idle_s=args.scale_down_idle_s,
+            )
+            if decision["action"] != "hold":
+                print(
+                    f"(fleet autoscale: {decision['action']} -> "
+                    f"{decision['target']} - {decision['reason']})",
+                    flush=True,
+                )
+                sup.scale_to(
+                    decision["target"], drain=router.drain_replica
+                )
+            router.set_target(decision["target"])
+
+    router.close()
+    sup_summary = sup.stop()
+    print("FLEET_SUMMARY " + json.dumps({
+        "router_url": router.url,
+        "requests_completed": int(
+            registry.counter("fleet_router_requests_total")
+            .labels(status="completed").value
+        ),
+        "router_retries": int(
+            registry.counter("fleet_router_retries_total").value
+        ),
+        "replica_failures_observed": int(
+            registry.counter("fleet_replica_failures_total").value
+        ),
+        "target_replicas": sup.target,
+        "supervisor": sup_summary,
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
