@@ -42,6 +42,13 @@ CPU, before a TPU ever sees the change (docs/STATIC_ANALYSIS.md):
   derives optimal checkpoint cadence (Young/Daly cross-checked), and
   validates itself against real ledger records
   (``tools/fleetsim.py --validate``, gated in CI).
+- ``serve_trace`` - servelint, the serve-side mirror of the pipeline:
+  enumerate the bucket grid ``warmup()`` compiles from an
+  `EngineConfig` alone, trace every decode/prefill/draft/verify bucket
+  program, lint the donation + quant contracts, pin per-bucket
+  flops/HBM/gather/scatter facts into serve manifests, and price the
+  ticks on the `cost.serve_tick_seconds` roofline - the capacity
+  planner behind tools/servelint.py (``run_servelint``).
 """
 
 from .autoshard import (
@@ -68,7 +75,10 @@ from .cost import (
     HardwareModel,
     StepTime,
     dense_step_flops,
+    replicas_for_target,
     score_program,
+    serve_capacity,
+    serve_tick_seconds,
     step_seconds,
 )
 from .fleetsim import (
@@ -96,6 +106,23 @@ from .manifest import (
     save_manifest,
 )
 from .runner import analyze_program, run_shardlint
+from .serve_trace import (
+    SERVE_CONFIGS,
+    SERVE_MANIFEST_SCHEMA,
+    ServeBucketProgram,
+    analyze_serve_program,
+    bucket_programs,
+    build_serve_engine,
+    build_serve_manifest,
+    collect_serve_costs,
+    diff_serve_manifests,
+    enumerate_grid,
+    load_serve_manifest,
+    run_servelint,
+    save_serve_manifest,
+    serve_config_names,
+    static_decode_tokens_per_s,
+)
 from .trace import CollectiveSite, TraceFacts, collect_trace
 
 __all__ = [
@@ -110,14 +137,22 @@ __all__ = [
     "HARDWARE_MODELS",
     "HardwareModel",
     "MANIFEST_SCHEMA",
+    "SERVE_CONFIGS",
+    "SERVE_MANIFEST_SCHEMA",
+    "ServeBucketProgram",
     "SimPolicy",
     "StepTime",
     "TraceFacts",
     "analyze_program",
+    "analyze_serve_program",
+    "bucket_programs",
     "build_manifest",
     "build_plan_doc",
     "build_program",
+    "build_serve_engine",
+    "build_serve_manifest",
     "cadence_search",
+    "collect_serve_costs",
     "collect_trace",
     "compare_records",
     "config_names",
@@ -125,23 +160,33 @@ __all__ = [
     "dense_step_flops",
     "diff_manifests",
     "diff_plans",
+    "diff_serve_manifests",
+    "enumerate_grid",
     "lint_program",
     "load_manifest",
     "load_plan",
+    "load_serve_manifest",
     "manifest_path",
     "plan_path",
     "policy_variants",
     "predict_from_ledger",
     "rank_plans_by_goodput",
     "rank_policies",
+    "replicas_for_target",
     "run_autoshard",
+    "run_servelint",
     "run_shardlint",
     "save_manifest",
     "save_plan",
+    "save_serve_manifest",
     "score_program",
     "search_config",
     "search_plans",
+    "serve_capacity",
+    "serve_config_names",
+    "serve_tick_seconds",
     "simulate",
+    "static_decode_tokens_per_s",
     "step_seconds",
     "synthesize_failure_trace",
     "young_daly_interval",

@@ -511,6 +511,181 @@ def dense_step_flops(param_count: float, tokens_per_step: float) -> float:
     return 6.0 * float(param_count) * float(tokens_per_step)
 
 
+def serve_tick_seconds(
+    bucket, hw: HardwareModel | None = None
+) -> StepTime:
+    """Predicted seconds of ONE serve bucket call (decode / chunked
+    prefill / spec verify) from its traced facts - the serving analogue
+    of `step_seconds`, consumed by the servelint capacity planner
+    (analysis/serve_trace.py) and the fleet twin.
+
+    ``bucket`` is any mapping exposing ``flops`` and ``hbm_bytes`` - a
+    serve manifest's per-bucket doc qualifies, so a supervisor-side
+    tool can price a config it never compiled. Model: compute and HBM
+    streaming overlap (take the max - the weights stream while the MXU
+    works), plus the dispatch floor; serve programs are single-device,
+    so there is no wire term."""
+    hw = hw or HardwareModel()
+
+    def get(key):
+        if isinstance(bucket, dict):
+            return float(bucket.get(key) or 0.0)
+        return float(getattr(bucket, key, 0.0) or 0.0)
+
+    compute_s = get("flops") / hw.flops_per_s
+    memory_s = get("hbm_bytes") / hw.hbm_bytes_per_s
+    return StepTime(
+        step_s=max(compute_s, memory_s) + hw.step_overhead_s,
+        compute_s=compute_s,
+        memory_s=memory_s,
+        comm_s=0.0,
+        overhead_s=hw.step_overhead_s,
+        bound="compute" if compute_s >= memory_s else "memory",
+        flops_per_step=get("flops"),
+        hw=hw.name,
+    )
+
+
+def _full_bucket(manifest: dict, family: str) -> dict | None:
+    """The largest (last-sorted) bucket doc of one family, or None."""
+    docs = [
+        b for b in manifest.get("buckets", []) if b.get("family") == family
+    ]
+    if not docs:
+        return None
+    return max(docs, key=lambda b: tuple(b["bucket"]))
+
+
+def serve_capacity(manifest: dict, hw: HardwareModel | None = None) -> dict:
+    """Static capacity curves of one serve config from its servelint
+    manifest (analysis/serve_trace.py) - the planner view ROADMAP item
+    1 asks for, consumable by analysis/fleetsim.py and the autoscaler
+    sizing logic (`replicas_for_target`):
+
+    - steady-state decode ``tokens_per_s`` at the FULL decode bucket
+      (every slot busy - the per-replica throughput ceiling);
+    - static prefill TTFT per pow2 prompt length: ceil(P / C) chunked
+      prefill calls at the full chunk bucket plus the first decode tick
+      (without chunked prefill, P token-at-a-time decode ticks);
+    - concurrent-sequence KV capacity per prompt+generation length
+      (`kv_capacity_sequences` over the manifest's pool geometry).
+
+    Pure arithmetic over pinned facts - no jax, no engine."""
+    hw = hw or HardwareModel()
+    eng = manifest.get("engine", {})
+    kv = manifest.get("kv", {})
+    out: dict = {"hw": hw.name}
+
+    dec = _full_bucket(manifest, "decode")
+    if dec is not None:
+        tick = serve_tick_seconds(dec, hw)
+        B = int(dec["bucket"][0])
+        out["decode"] = {
+            "bucket": list(dec["bucket"]),
+            "tick_s": tick.step_s,
+            "bound": tick.bound,
+            "tokens_per_s": B / tick.step_s,
+        }
+
+    pre = _full_bucket(manifest, "prefill")
+    chunk = int(pre["bucket"][0]) if pre is not None else 0
+    if pre is not None:
+        ptick = serve_tick_seconds(pre, hw)
+        out["prefill"] = {
+            "bucket": list(pre["bucket"]),
+            "tick_s": ptick.step_s,
+            "tokens_per_s": chunk / ptick.step_s,
+        }
+
+    max_seq = int(eng.get("max_seq_len") or 0)
+    block_size = int(eng.get("block_size") or 1)
+    usable = int(kv.get("usable_blocks") or 0)
+    ttft: dict = {}
+    kv_cap: dict = {}
+    if dec is not None and max_seq:
+        dtick = serve_tick_seconds(dec, hw).step_s
+        p = 1
+        lens = []
+        while p < max_seq:
+            lens.append(p)
+            p *= 2
+        lens.append(max_seq)
+        for P in lens:
+            if pre is not None and chunk:
+                n_calls = -(-P // chunk)
+                ttft[str(P)] = n_calls * ptick.step_s + dtick
+            else:
+                ttft[str(P)] = P * dtick + dtick
+            kv_cap[str(P)] = kv_capacity_sequences(usable, block_size, P)
+    out["ttft_s"] = ttft
+    out["kv_capacity_sequences"] = kv_cap
+    return out
+
+
+def replicas_for_target(
+    capacity: dict,
+    *,
+    target_rps: float,
+    mean_new_tokens: float,
+    prompt_len: int = 0,
+    target_ttft_s: float | None = None,
+) -> dict:
+    """Replica count for a target request rate - the capacity-planner
+    arithmetic the PR 18 autoscaler's ``min_replicas`` should be seeded
+    from (serve/fleet.py autoscale_decision enforces it at runtime;
+    this answers it BEFORE provisioning).
+
+    ``capacity`` is `serve_capacity`'s output (or a manifest's pinned
+    ``capacity[hw]`` block). The demand is ``target_rps *
+    mean_new_tokens`` decode tokens/s against the per-replica ceiling;
+    a ``target_ttft_s`` is checked against the STATIC prefill floor at
+    ``prompt_len`` - a floor above the target is infeasible at any
+    replica count (queueing only adds to it), which the planner reports
+    instead of scaling forever."""
+    dec = capacity.get("decode") or {}
+    per_replica = float(dec.get("tokens_per_s") or 0.0)
+    if per_replica <= 0:
+        raise ValueError(
+            "capacity has no decode tokens_per_s figure - pass "
+            "serve_capacity() output or a manifest capacity block"
+        )
+    import math
+
+    demand = float(target_rps) * float(mean_new_tokens)
+    replicas = max(1, math.ceil(demand / per_replica))
+    out = {
+        "replicas": int(replicas),
+        "demand_tokens_per_s": demand,
+        "per_replica_tokens_per_s": per_replica,
+        "utilization_at_n": demand / (replicas * per_replica),
+        "feasible": True,
+        "why": (
+            f"{demand:,.0f} tok/s demand / {per_replica:,.0f} tok/s "
+            f"per replica -> {replicas} replica(s)"
+        ),
+    }
+    if target_ttft_s is not None and prompt_len:
+        curve = capacity.get("ttft_s") or {}
+        floor = None
+        for key in sorted(curve, key=int):
+            if int(key) >= int(prompt_len):
+                floor = float(curve[key])
+                break
+        if floor is None and curve:
+            floor = float(curve[max(curve, key=int)])
+        out["ttft_floor_s"] = floor
+        if floor is not None and floor > float(target_ttft_s):
+            out["feasible"] = False
+            out["why"] += (
+                f"; INFEASIBLE: static TTFT floor {floor * 1e3:,.1f} ms "
+                f"at prompt {prompt_len} exceeds the "
+                f"{float(target_ttft_s) * 1e3:,.1f} ms target - no "
+                "replica count fixes a per-request floor (shrink the "
+                "model, grow prefill_chunk, or relax the SLO)"
+            )
+    return out
+
+
 def step_seconds(
     bd, hw: HardwareModel | None = None, *, flops_per_step: float = 0.0
 ) -> StepTime:

@@ -465,6 +465,23 @@ class ServeEngine:
             self.ecfg.block_size, "f32" if dtype == "f32" else dtype,
         )
 
+    def compiled_programs(self) -> dict:
+        """Per-bucket-family compiled-program counts (plus ``total``) -
+        the live figure ``GET /v1/status`` reports so a deployment can
+        be reconciled against the servelint grid manifest
+        (analysis/serve_trace.py enumerate_grid): after ``warmup()``
+        the counts match the manifest and must never grow while
+        serving (a growth is an un-warmed bucket paying its XLA
+        compile on a live request)."""
+        fams = {
+            "decode": len(self._step_fns),
+            "prefill": len(self._prefill_fns),
+            "draft": len(self._draft_fns),
+            "verify": len(self._verify_fns),
+        }
+        fams["total"] = sum(fams.values())
+        return fams
+
     def _free_seq(self, seq_id: int) -> int:
         """Free a sequence's blocks; under int8 KV also zero the freed
         blocks' scales - a reused block must start from scale 0 or the
@@ -676,8 +693,15 @@ class ServeEngine:
             nxt = jnp.where(temps > 0.0, sampled, greedy).astype(jnp.int32)
             return k_pool, v_pool, k_scale, v_scale, nxt, logits
 
+        # the pools (and under int8 their scales) are donated: every
+        # call site threads them through and rebinds the outputs, and
+        # an un-donated pool double-buffers the engine's largest
+        # allocation for the life of the step. Params are NEVER donated
+        # (they are not returned - donating them would free the weights
+        # after the first call). servelint audits this contract
+        # per bucket (analysis/serve_trace.py).
         if quantized:
-            fn = jax.jit(step)
+            fn = jax.jit(step, donate_argnums=(1, 2, 3, 4))
         else:
             # bf16 keeps the PR 12 signature (no scale operands)
             def step_bf16(params, k_pool, v_pool, tok, pos, table,
@@ -688,7 +712,7 @@ class ServeEngine:
                 )
                 return k_pool, v_pool, nxt, logits
 
-            fn = jax.jit(step_bf16)
+            fn = jax.jit(step_bf16, donate_argnums=(1, 2))
         self._step_fns[(B, W)] = fn
         return fn
 
@@ -821,8 +845,9 @@ class ServeEngine:
             logits = h[0] @ params["head"].astype(dt).astype(jnp.float32)
             return k_pool, v_pool, k_scale, v_scale, logits  # (C, vocab)
 
+        # pool donation: same contract as _decode_fn (params never)
         if quantized:
-            fn = jax.jit(prefill)
+            fn = jax.jit(prefill, donate_argnums=(1, 2, 3, 4))
         else:
             def prefill_bf16(params, k_pool, v_pool, toks, pos0, table,
                              n_valid):
@@ -832,7 +857,7 @@ class ServeEngine:
                 )
                 return k_pool, v_pool, logits
 
-            fn = jax.jit(prefill_bf16)
+            fn = jax.jit(prefill_bf16, donate_argnums=(1, 2))
         self._prefill_fns[(C, W)] = fn
         return fn
 
@@ -1082,8 +1107,11 @@ class ServeEngine:
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             return k_pool, v_pool, k_scale, v_scale, nxt
 
+        # pool donation: same contract as _decode_fn (params never).
+        # _draft_fn stays donation-free by design - it READS the pools
+        # and returns only draft tokens, so there is nothing to alias.
         if quantized:
-            fn = jax.jit(verify)
+            fn = jax.jit(verify, donate_argnums=(1, 2, 3, 4))
         else:
             def verify_bf16(params, k_pool, v_pool, toks, pos0, table):
                 k_pool, v_pool, _, _, nxt = verify(
@@ -1091,7 +1119,7 @@ class ServeEngine:
                 )
                 return k_pool, v_pool, nxt
 
-            fn = jax.jit(verify_bf16)
+            fn = jax.jit(verify_bf16, donate_argnums=(1, 2))
         self._verify_fns[(B, W)] = fn
         return fn
 

@@ -127,6 +127,11 @@ class ServeServer:
             "engine_ticks": eng.ticks,
             "decode_tokens": eng.decode_tokens,
             "prefill_tokens": eng.prefill_tokens,
+            # per-bucket-family compiled-program counts: reconcile a
+            # live deployment against its servelint grid manifest
+            # (after warmup() the counts match the manifest and must
+            # never grow - analysis/serve_trace.py)
+            "compiled_programs": eng.compiled_programs(),
             "weight_dtype": eng.weight_dtype_name(),
             "spec_decode": eng.spec_k,
             "spec_draft_layers": eng.draft_layers if eng.spec_k else 0,

@@ -1838,6 +1838,14 @@ def measure_serving(
             f"HBM budget ({cap_int8} vs {cap_bf16} max-len sequences)"
         )
 
+    # the servelint cost model's figure for THIS engine, next to the
+    # measured one, so static-vs-measured drift is tracked per bench
+    # run (tools/servelint.py --validate gates the same pair within the
+    # documented tolerance - analysis/serve_trace.py)
+    from ..analysis.serve_trace import static_decode_tokens_per_s
+
+    static_pred = static_decode_tokens_per_s(engine, "cpu-host")
+
     return {
         "devices": f"1x {dev.device_kind}",
         "model": f"d{d_model}/L{n_layers}/H{n_heads} vocab {vocab} {dtype}",
@@ -1850,6 +1858,15 @@ def measure_serving(
         "requests_completed": summary["by_status"].get("completed", 0),
         "requests_total": summary["requests"],
         "tokens_per_s": summary["tokens_per_s"],
+        "static_predicted_tokens_per_s": round(
+            static_pred["tokens_per_s"], 2
+        ),
+        "static_prediction": {
+            "bucket": static_pred["bucket"],
+            "hw": static_pred["hw"],
+            "bound": static_pred["bound"],
+            "tick_s": static_pred["tick_s"],
+        },
         "ttft_p50_s": summary["ttft_p50_s"],
         "ttft_p99_s": summary["ttft_p99_s"],
         "intertoken_p50_s": summary["intertoken_p50_s"],

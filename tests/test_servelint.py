@@ -1,0 +1,400 @@
+"""servelint: static serve-bucket audit + roofline capacity planner.
+
+The load-bearing pins (docs/STATIC_ANALYSIS.md "Serve lint"):
+
+- `enumerate_grid` IS warmup()'s compile set: same fn-cache keys, same
+  program count, for every canonical serve config - and serving real
+  traffic after warmup() compiles ZERO new programs (cache-entry
+  counting over every jitted bucket fn, the RecompileDetector idea
+  applied to the serving engine).
+- The manifests roundtrip (write -> load -> diff == clean) and the diff
+  names what moved: grid buckets (EXTRA/MISSING), per-bucket facts,
+  donation, upcasts - with the jax-version env short-circuit.
+- The injected-defect probes FAIL --check with the bucket named: a
+  dropped KV-pool donation, a silent upcast, an accidental extra
+  bucket dimension.
+- The static tokens/s prediction agrees with a measured figure within
+  the documented tolerance (`VALIDATE_TOLERANCE_FACTOR`).
+
+Everything traces abstractly on CPU; the only execution is the tiny
+serve engines' warmup + a few real decode ticks.
+"""
+
+import json
+import time
+
+import pytest
+
+from distributed_neural_network_tpu.analysis import serve_trace as st
+from distributed_neural_network_tpu.analysis.cost import (
+    HARDWARE_MODELS,
+    replicas_for_target,
+    serve_capacity,
+    serve_tick_seconds,
+)
+from distributed_neural_network_tpu.serve.engine import Sequence
+
+CONFIGS = st.serve_config_names()
+
+
+@pytest.fixture(scope="module")
+def engines():
+    """One warmed engine per canonical serve config (shared: warmup
+    compiles the whole grid, the expensive part)."""
+    built = {}
+    for name in CONFIGS:
+        eng, spec = st.build_serve_engine(name)
+        eng.warmup()
+        built[name] = (eng, spec)
+    return built
+
+
+@pytest.fixture(scope="module")
+def manifest_dir(tmp_path_factory):
+    """Freshly written serve manifests for every config, in a tmp dir
+    (the probe tests diff against these - independent of the
+    checked-in set and of the CI host's jax version)."""
+    d = str(tmp_path_factory.mktemp("serve_manifests"))
+    rc, report = st.run_servelint(CONFIGS, mode="write", manifest_dir=d)
+    assert rc == 0, report
+    return d
+
+
+def _all_bucket_fns(eng):
+    return (
+        list(eng._step_fns.values())
+        + list(eng._prefill_fns.values())
+        + list(eng._draft_fns.values())
+        + list(eng._verify_fns.values())
+    )
+
+
+def _cache_entries(eng):
+    return sum(f._cache_size() for f in _all_bucket_fns(eng))
+
+
+# -------------------------------------------- grid == warmup compile set
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", CONFIGS)
+def test_enumerated_grid_is_warmups_compile_set(engines, name):
+    eng, _ = engines[name]
+    grid = st.enumerate_grid(eng.ecfg)
+    assert set(eng._step_fns) == set(grid["decode"])
+    assert set(eng._prefill_fns) == set(grid.get("prefill", ()))
+    assert set(eng._draft_fns) == set(grid.get("draft", ()))
+    assert set(eng._verify_fns) == set(grid.get("verify", ()))
+    # every grid program compiled exactly once by warmup
+    assert _cache_entries(eng) == st.grid_total(grid)
+    fams = eng.compiled_programs()
+    assert fams["total"] == st.grid_total(grid)
+    for fam in ("decode", "prefill", "draft", "verify"):
+        assert fams[fam] == len(grid.get(fam, ()))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", CONFIGS)
+def test_serving_after_warmup_compiles_zero_new_programs(engines, name):
+    """The grid-budget contract end to end: real traffic (prefill +
+    decode + the spec path on the spec config) touches only warmed
+    buckets - no new fn-cache keys AND no new compile cache entries
+    inside any existing fn."""
+    eng, _ = engines[name]
+    before_programs = eng.compiled_programs()
+    before_entries = _cache_entries(eng)
+    eng.add(Sequence(seq_id=901, prompt=[1, 2, 3], max_new_tokens=4))
+    eng.add(Sequence(seq_id=902, prompt=[5, 6, 7, 8, 9], max_new_tokens=3))
+    for _ in range(64):
+        if not eng.has_work():
+            break
+        eng.step()
+    assert not eng.has_work()
+    assert eng.compiled_programs() == before_programs
+    assert _cache_entries(eng) == before_entries
+
+
+# --------------------------------------------------- manifest roundtrip
+
+
+def test_manifest_roundtrip_and_conformance(manifest_dir):
+    rc, report = st.run_servelint(
+        CONFIGS, mode="check", manifest_dir=manifest_dir
+    )
+    assert rc == 0, report
+    assert report.count("manifest conforms") == len(CONFIGS)
+
+
+def test_manifest_diff_names_grid_and_bucket_changes(manifest_dir):
+    expected = st.load_serve_manifest("serve_bf16", manifest_dir)
+    actual = json.loads(json.dumps(expected))
+
+    # grid budget: an extra bucket is named, family and key
+    actual["grid"]["decode"].append([8, 16])
+    actual["programs_total"] += 1
+    msgs = st.diff_serve_manifests(expected, actual)
+    assert any("EXTRA bucket" in m and "decode[B8,W16]" in m for m in msgs)
+    assert any("compiled-program budget" in m for m in msgs)
+
+    # a missing bucket flips direction
+    actual = json.loads(json.dumps(expected))
+    actual["grid"]["prefill"] = actual["grid"]["prefill"][:-1]
+    msgs = st.diff_serve_manifests(expected, actual)
+    assert any("MISSING bucket" in m and "prefill[" in m for m in msgs)
+
+    # per-bucket fact drift names the bucket
+    actual = json.loads(json.dumps(expected))
+    actual["buckets"][0]["flops"] += 1000
+    b = actual["buckets"][0]
+    label = f"{b['family']}[B{b['bucket'][0]},W{b['bucket'][1]}]"
+    msgs = st.diff_serve_manifests(expected, actual)
+    assert any("flops changed" in m and b["family"] in m for m in msgs), (
+        msgs, label,
+    )
+
+    # donation drift names the bucket
+    actual = json.loads(json.dumps(expected))
+    actual["buckets"][0]["donation"]["n_donated"] = 0
+    msgs = st.diff_serve_manifests(expected, actual)
+    assert any("donation contract changed" in m for m in msgs)
+
+
+def test_manifest_env_mismatch_short_circuits(manifest_dir):
+    expected = st.load_serve_manifest("serve_bf16", manifest_dir)
+    actual = json.loads(json.dumps(expected))
+    actual["jax_version"] = "0.0.0-other"
+    msgs = st.diff_serve_manifests(expected, actual)
+    assert len(msgs) == 1 and "regenerate" in msgs[0]
+
+
+def test_check_without_manifest_fails_with_instruction(tmp_path):
+    rc, report = st.run_servelint(
+        ["serve_bf16"], mode="check", manifest_dir=str(tmp_path)
+    )
+    assert rc == 1
+    assert "no serve manifest" in report and "--write-manifest" in report
+
+
+# ------------------------------------------------------ injected probes
+
+
+def test_probe_dropped_donation_fails_check_naming_bucket(manifest_dir):
+    rc, report = st.run_servelint(
+        ["serve_bf16"], mode="check", manifest_dir=manifest_dir,
+        probe="drop-donation",
+    )
+    assert rc == 1
+    assert "donation" in report
+    # the finding names bucket AND leaf
+    assert "decode[B1,W1]" in report and "k_pool" in report
+
+
+def test_probe_injected_upcast_fails_check_naming_bucket(manifest_dir):
+    rc, report = st.run_servelint(
+        ["serve_bf16"], mode="check", manifest_dir=manifest_dir,
+        probe="upcast",
+    )
+    assert rc == 1
+    assert "upcasts changed" in report and "decode[B" in report
+
+
+def test_probe_extra_bucket_dimension_fails_check_with_grid_diff(
+    manifest_dir,
+):
+    rc, report = st.run_servelint(
+        ["serve_bf16"], mode="check", manifest_dir=manifest_dir,
+        probe="extra-bucket",
+    )
+    assert rc == 1
+    assert "EXTRA bucket" in report and "W16" in report
+    assert "compiled-program budget" in report
+
+
+def test_probeless_check_is_the_clean_baseline(manifest_dir):
+    rc, _ = st.run_servelint(
+        ["serve_bf16"], mode="check", manifest_dir=manifest_dir
+    )
+    assert rc == 0
+
+
+# -------------------------------------------- donation lint (the audit)
+
+
+@pytest.mark.slow
+def test_donation_contract_per_family(engines):
+    """Pools donated in decode/prefill/verify (+ scales when
+    quantized), NEVER the drafter (read-only), NEVER params."""
+    for name in ("serve_int8_kv", "serve_spec_k4"):
+        eng, spec = engines[name]
+        grid = st.enumerate_grid(eng.ecfg)
+        for fam in grid:
+            key = grid[fam][0]
+            p = st.bucket_program(eng, fam, key, config=name,
+                                  quant=spec.quant)
+            r = st.analyze_serve_program(p)
+            assert not [f for f in r.findings if f.severity == "error"], [
+                str(f) for f in r.findings
+            ]
+            donated = r.facts.donated_invars
+            n_param_leaves = p.arg_leaf_counts()[0]
+            # params (arg 0 leaves) never donated
+            assert not any(donated[:n_param_leaves])
+            if fam == "draft":
+                assert not any(donated)
+            else:
+                assert sum(donated) == len(p.donate)
+
+
+# ----------------------------------------------------- pricing + planner
+
+
+def test_serve_tick_seconds_roofline():
+    hw = HARDWARE_MODELS["cpu-host"]
+    t = serve_tick_seconds({"flops": 4e11, "hbm_bytes": 0}, hw)
+    assert t.bound == "compute"
+    assert t.step_s == pytest.approx(2.0 + hw.step_overhead_s)
+    t = serve_tick_seconds({"flops": 0, "hbm_bytes": 80e9}, hw)
+    assert t.bound == "memory"
+    assert t.step_s == pytest.approx(2.0 + hw.step_overhead_s)
+    assert t.comm_s == 0.0
+
+
+def test_serve_capacity_curves(manifest_dir):
+    doc = st.load_serve_manifest("serve_bf16", manifest_dir)
+    cap = serve_capacity(doc, HARDWARE_MODELS["cpu-host"])
+    assert cap["decode"]["tokens_per_s"] > 0
+    ttft = {int(k): v for k, v in cap["ttft_s"].items()}
+    lens = sorted(ttft)
+    # TTFT monotone in prompt length; KV capacity anti-monotone
+    assert all(ttft[a] <= ttft[b] for a, b in zip(lens, lens[1:]))
+    kvc = {int(k): v for k, v in cap["kv_capacity_sequences"].items()}
+    assert all(kvc[a] >= kvc[b] for a, b in zip(lens, lens[1:]))
+    # the manifest pins the same figures (pure arithmetic, no re-trace)
+    pinned = doc["capacity"]["cpu-host"]
+    assert pinned["decode"]["tokens_per_s"] == pytest.approx(
+        cap["decode"]["tokens_per_s"]
+    )
+
+
+def test_replicas_for_target_ceil_and_ttft_floor(manifest_dir):
+    doc = st.load_serve_manifest("serve_bf16", manifest_dir)
+    cap = serve_capacity(doc, HARDWARE_MODELS["cpu-host"])
+    per = cap["decode"]["tokens_per_s"]
+    plan = replicas_for_target(
+        cap, target_rps=per / 10.0, mean_new_tokens=25.0
+    )
+    # demand 2.5x one replica -> 3 replicas
+    assert plan["replicas"] == 3 and plan["feasible"]
+    assert 0 < plan["utilization_at_n"] <= 1.0
+    # a TTFT target below the static floor is infeasible at ANY count
+    floor = min(cap["ttft_s"].values())
+    plan = replicas_for_target(
+        cap, target_rps=1.0, mean_new_tokens=1.0,
+        prompt_len=2, target_ttft_s=floor / 1e3,
+    )
+    assert not plan["feasible"] and "INFEASIBLE" in plan["why"]
+
+
+# ------------------------------------- static prediction vs measurement
+
+
+def test_validate_prediction_arithmetic():
+    v = st.validate_prediction(100.0, 50.0, tolerance_factor=4.0)
+    assert v["ok"] and v["ratio"] == 2.0
+    v = st.validate_prediction(500.0, 50.0, tolerance_factor=4.0)
+    assert not v["ok"] and "drifted" in v["why"]
+    v = st.validate_prediction(15.0, 50.0, tolerance_factor=4.0)
+    assert v["ok"]  # under-prediction inside the band
+    v = st.validate_prediction(0.0, 50.0)
+    assert not v["ok"] and "non-positive" in v["why"]
+
+
+@pytest.mark.slow
+def test_static_prediction_within_tolerance_of_measured_ticks(engines):
+    """The cost-model gate at engine scale: time the REAL full decode
+    bucket (pool outputs threaded back, exactly the serving loop's
+    usage) and require the static tokens/s within the documented
+    factor - the same quantity `tools/servelint.py --validate` gates
+    against the full open-loop bench row."""
+    import jax.numpy as jnp
+
+    eng, _ = engines["serve_bf16"]
+    pred = st.static_decode_tokens_per_s(eng, "cpu-host")
+    B, W = pred["bucket"]
+    fn = eng._step_fns[(B, W)]
+    tok = jnp.zeros((B,), jnp.int32)
+    pos = jnp.zeros((B,), jnp.int32)
+    table = jnp.zeros((B, W), jnp.int32)
+    temps = jnp.zeros((B,), jnp.float32)
+    keys = jnp.zeros((B, 2), jnp.uint32)
+    k_pool, v_pool = eng.k_pool, eng.v_pool
+    # one unmeasured call, then the measured loop
+    k_pool, v_pool, _, _ = fn(
+        eng.params, k_pool, v_pool, tok, pos, table, temps, keys
+    )
+    iters = 30
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        k_pool, v_pool, _, out = fn(
+            eng.params, k_pool, v_pool, tok, pos, table, temps, keys
+        )
+    out.block_until_ready()
+    wall = time.perf_counter() - t0
+    eng.k_pool, eng.v_pool = k_pool, v_pool  # restore threaded pools
+    measured = B * iters / wall
+    verdict = st.validate_prediction(pred["tokens_per_s"], measured)
+    assert verdict["ok"], verdict
+
+
+def test_run_validate_offline_row():
+    """--validate against a recorded bench row (the offline path - no
+    bench run)."""
+    rc, report = st.run_validate(bench_row={
+        "tokens_per_s": 120.0,
+        "static_predicted_tokens_per_s": 400.0,
+    })
+    assert rc == 0 and "OK" in report
+    rc, report = st.run_validate(bench_row={
+        "tokens_per_s": 10.0,
+        "static_predicted_tokens_per_s": 400.0,
+    })
+    assert rc == 1 and "FAIL" in report
+
+
+# --------------------------------------------------------------- CLI-ish
+
+
+def test_unknown_probe_and_mode_raise():
+    with pytest.raises(ValueError, match="probe"):
+        st.run_servelint(["serve_bf16"], probe="nope")
+    with pytest.raises(ValueError, match="mode"):
+        st.run_servelint(["serve_bf16"], mode="nope")
+
+
+@pytest.mark.slow
+def test_compiled_programs_reported_by_status_route(engines):
+    """GET /v1/status carries the per-family compiled-program counts
+    (reconciliation against the grid manifest)."""
+    import json as _json
+    import urllib.request
+
+    from distributed_neural_network_tpu.serve.http import ServeServer
+    from distributed_neural_network_tpu.serve.scheduler import (
+        SchedulerConfig,
+        ServeScheduler,
+    )
+    from distributed_neural_network_tpu.utils.obs import MetricsRegistry
+
+    eng, _ = engines["serve_bf16"]
+    reg = MetricsRegistry()
+    sched = ServeScheduler(eng, SchedulerConfig(), registry=reg).start()
+    srv = ServeServer(sched, reg, port=0)
+    try:
+        with urllib.request.urlopen(srv.url + "/v1/status") as r:
+            doc = _json.loads(r.read())
+    finally:
+        sched.close(finalize=False)
+        srv.close()
+    grid = st.enumerate_grid(eng.ecfg)
+    assert doc["compiled_programs"]["decode"] == len(grid["decode"])
+    assert doc["compiled_programs"]["total"] == st.grid_total(grid)

@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""servelint CLI: static audit + roofline pricing of the serve buckets.
+
+Enumerates the full bucket grid the serving engine's ``warmup()``
+compiles (decode / chunked-prefill / spec draft+verify families x pow2
+batch x pow2 table width, per precision mode), abstractly traces every
+jitted program (no execution, no TPU;
+distributed_neural_network_tpu/analysis/serve_trace.py) and
+
+- lints the donation contract (KV pools + int8 scales donated, params
+  never), dtype upcasts, and the quantized-dtype declaration,
+- prices each bucket on the HardwareModel roofline (static tokens/s,
+  prefill TTFT, KV-capacity curves - the capacity planner),
+- writes or checks the per-config serve manifests
+  (distributed_neural_network_tpu/analysis/manifests/serve_*.json),
+  including the bucket-grid budget: an accidental new bucket dimension
+  fails --check with the grid diff named.
+
+Usage:
+  python tools/servelint.py --list
+  python tools/servelint.py --all --check           # the CI gate
+  python tools/servelint.py --config serve_int8_kv --explain
+  python tools/servelint.py --all --write-manifest  # after an
+                                                    # intentional change
+  python tools/servelint.py --all --check --probe extra-bucket
+                                                    # the CI probe leg:
+                                                    # must exit 1
+  python tools/servelint.py --validate              # static tokens/s vs
+                                                    # a measured serve
+                                                    # bench row
+
+Exit codes: 0 conforming; 1 lint errors, manifest mismatch, or a failed
+--validate gate; 2 a config could not be built/traced or an unknown
+--config name (the known list is printed). See docs/STATIC_ANALYSIS.md
+"Serve lint".
+"""
+
+import argparse
+import os
+import sys
+
+
+def _force_cpu_mesh():
+    """8 virtual CPU devices, set BEFORE jax import (the repo-standard
+    test mesh - tests/conftest.py does the same for pytest)."""
+    flag = "--xla_force_host_platform_device_count=8"
+    if flag not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " " + flag
+        ).strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if "jax" in sys.modules:
+        import jax
+
+        try:  # re-assert against site hooks that pre-import jax
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument(
+        "--config", action="append", default=[],
+        help="serve config name(s): repeatable and/or comma-separated "
+        "(--config a,b); see --list",
+    )
+    ap.add_argument(
+        "--all", action="store_true", help="every canonical serve config"
+    )
+    ap.add_argument(
+        "--list", action="store_true", help="list serve configs and exit"
+    )
+    ap.add_argument(
+        "--write-manifest", action="store_true",
+        help="regenerate the serve manifest(s)",
+    )
+    ap.add_argument(
+        "--check", action="store_true",
+        help="diff fresh traces against the checked-in serve manifest(s) "
+        "- grid budget, per-bucket flops/bytes/traffic, upcasts, "
+        "donation",
+    )
+    ap.add_argument(
+        "--explain", action="store_true",
+        help="per-bucket table (flops, HBM bytes, gather/scatter counts, "
+        "roofline tick) under each config line",
+    )
+    ap.add_argument(
+        "--probe", choices=("extra-bucket", "drop-donation", "upcast"),
+        default=None,
+        help="inject a known defect before tracing (acceptance probes: "
+        "each must fail --check with the bucket named)",
+    )
+    ap.add_argument(
+        "--hw", default="cpu-host",
+        help="hardware model for roofline pricing (tpu-v5e, tpu-v4, "
+        "cpu-host)",
+    )
+    ap.add_argument(
+        "--validate", action="store_true",
+        help="gate the static tokens/s prediction against a measured "
+        "measure_serving bench row (runs an in-process open-loop bench "
+        "at reduced geometry, ~1 min) within the documented tolerance",
+    )
+    ap.add_argument(
+        "--manifest-dir", default=None,
+        help="manifest directory (default: the in-package "
+        "analysis/manifests)",
+    )
+    ap.add_argument(
+        "-q", "--quiet", action="store_true",
+        help="findings and verdicts only",
+    )
+    args = ap.parse_args(argv)
+
+    _force_cpu_mesh()
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    from distributed_neural_network_tpu.analysis import serve_trace
+
+    if args.list:
+        for name in serve_trace.serve_config_names():
+            print(name)
+        return 0
+    if args.write_manifest and args.check:
+        ap.error("--write-manifest and --check are mutually exclusive")
+    if args.validate:
+        rc, report = serve_trace.run_validate(hw=args.hw)
+        print(report)
+        return rc
+    requested = [n for entry in args.config for n in entry.split(",") if n]
+    known = serve_trace.serve_config_names()
+    unknown = [n for n in requested if n not in known]
+    if unknown:
+        print(
+            f"unknown serve config(s): {', '.join(unknown)}\n"
+            f"known configs: {', '.join(known)}"
+        )
+        return 2
+    names = known if args.all or not requested else requested
+    mode = (
+        "write" if args.write_manifest else "check" if args.check else "lint"
+    )
+    rc, report = serve_trace.run_servelint(
+        names, mode=mode, manifest_dir=args.manifest_dir,
+        verbose=not args.quiet, explain=args.explain, probe=args.probe,
+        hw=args.hw,
+    )
+    print(report)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

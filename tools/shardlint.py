@@ -80,6 +80,11 @@ def main(argv=None) -> int:
         "-q", "--quiet", action="store_true",
         help="findings and verdicts only (no per-collective breakdown)",
     )
+    ap.add_argument(
+        "--jobs", type=int, default=1, metavar="N",
+        help="trace N configs in parallel (thread pool; report order "
+        "stays deterministic - input order, not completion order)",
+    )
     args = ap.parse_args(argv)
 
     _force_cpu_mesh()
@@ -107,7 +112,7 @@ def main(argv=None) -> int:
     )
     rc, report = analysis.run_shardlint(
         names, mode=mode, manifest_dir=args.manifest_dir,
-        verbose=not args.quiet, explain=args.explain,
+        verbose=not args.quiet, explain=args.explain, jobs=args.jobs,
     )
     print(report)
     return rc
