@@ -659,6 +659,10 @@ def replicas_for_target(
         "per_replica_tokens_per_s": per_replica,
         "utilization_at_n": demand / (replicas * per_replica),
         "feasible": True,
+        # provenance: this figure ignores queueing - scripts must not
+        # confuse it with the serve twin's dynamic answer
+        # (analysis/fleetsim.py replicas_for_dynamic, which is >= this)
+        "static_only": True,
         "why": (
             f"{demand:,.0f} tok/s demand / {per_replica:,.0f} tok/s "
             f"per replica -> {replicas} replica(s)"

@@ -59,6 +59,29 @@ and on a laptop; cost-model pricing imports `.cost` lazily. Determinism
 is a contract: same seed + trace + policy -> bitwise-identical record
 (`random.Random` over int seeds only; no wall-clock stamps).
 Semantics: docs/OBSERVABILITY.md "Fleet digital twin".
+
+**Serve mode (the second twin).** The back half of this module is the
+SERVING fleet's digital twin: `simulate_serve` replays open-loop
+arrivals (Poisson via `synthesize_arrivals`, or a recorded
+``loadgen --arrival-trace`` stream) through the full per-request
+lifecycle of `serve/scheduler.py` - admission, chunked prefill,
+continuous-batching decode ticks, a modeled KV block pool with
+OutOfBlocks parking and youngest-preempt + replay, spec-decode
+acceptance as a sampled distribution, router dispatch and
+`autoscale_decision` replayed over replica-failure traces - pricing
+each tick from a checked-in servelint manifest via
+`analysis.cost.serve_tick_seconds` (roofline), from measured
+per-request records (`utils/goodput.py extract_serve_distributions`,
+empirical), or from `ServePolicy` fallbacks. It emits a
+schema-compatible ``kind:"sim"`` serve-taxonomy goodput record plus a
+`/v1/requests`-shaped requests document (renderable by
+``tools/goodput.py`` / ``tools/request_trace.py`` unchanged),
+closed-loop-validated against measured serve-smoke runs
+(`predict_serve_from_run`, ``tools/fleetsim.py --serve --validate``),
+and answers the capacity question the static roofline can't:
+`replicas_for_dynamic` searches replica count under QUEUEING until the
+SLO holds, reported alongside `cost.replicas_for_target`'s static
+floor. Semantics: docs/OBSERVABILITY.md "Serve digital twin".
 """
 
 from __future__ import annotations
@@ -67,7 +90,8 @@ import dataclasses
 import json
 import math
 import random
-from dataclasses import dataclass
+from collections import deque
+from dataclasses import dataclass, field
 
 from ..train.supervisor import SupervisorPolicy
 from ..utils.goodput import (
@@ -75,7 +99,10 @@ from ..utils.goodput import (
     GOODPUT_CAUSE,
     IDLE_CAUSE,
     RECORD_VERSION,
+    SERVE_CAUSES,
+    SERVE_GOODPUT_CAUSE,
     extract_distributions,
+    extract_serve_distributions,
     fleet_goodput_record,
     record_causes,
     validate_record,
@@ -991,4 +1018,1543 @@ def rank_plans_by_goodput(
     out.sort(
         key=lambda d: (d["aborted"], -d["progress_steps_per_cap_s"])
     )
+    return out
+
+
+# ======================================================== serve-mode twin
+#
+# Everything below simulates the SERVING fleet (serve/scheduler.py +
+# serve/fleet.py) instead of the training supervisor. Stdlib-only like
+# the rest of the module: serve/* imports jax transitively, so the two
+# pieces of serve arithmetic the twin shares with the runtime - the
+# TTFT/E2E percentile decomposition and the autoscaler policy - exist
+# here as local mirrors, each pinned equal to the real implementation
+# by tests/test_fleetsim_serve.py (the mirror drifts -> the test fails).
+
+#: Per-request span causes (mirror of serve/reqtrace.py REQUEST_CAUSES).
+SERVE_REQUEST_CAUSES = (
+    "queue_wait",
+    "admission",
+    "prefill",
+    "decode",
+    "kv_alloc_stall",
+    "preempted_wait",
+    "stream_write",
+)
+
+
+def _req_tolerance(total: float) -> float:
+    return max(1e-6 * max(total, 1.0), 1e-9)
+
+
+def _serve_percentile(xs, q: float):
+    """Nearest-rank percentile (stdlib mirror of reqtrace.percentile)."""
+    if not xs:
+        return None
+    s = sorted(xs)
+    return s[max(0, math.ceil(q * len(s)) - 1)]
+
+
+def _serve_clipped_causes(rec: dict, metric: str) -> dict:
+    """Per-cause seconds of one request detail clipped at the metric's
+    endpoint (stdlib mirror of reqtrace.clipped_causes)."""
+    if metric == "ttft":
+        clip = rec.get("t_first_token_rel")
+        if clip is None:
+            return {}
+        clip = float(clip)
+    else:
+        clip = _INF
+    out: dict = {}
+    for cause, t0, t1 in rec.get("spans") or ():
+        lo, up = float(t0), min(float(t1), clip)
+        if up > lo:
+            out[cause] = out.get(cause, 0.0) + (up - lo)
+    return out
+
+
+def _serve_decompose(records, metric: str, q: float):
+    """TTFT/E2E percentile + per-cause share decomposition of the tail
+    (stdlib mirror of reqtrace.decompose - the exact arithmetic
+    serve/fleet.py slo_readout judges the real fleet with)."""
+    key = "ttft_s" if metric == "ttft" else "e2e_s"
+    vals = [
+        (r, float(r[key])) for r in records
+        if isinstance(r, dict) and r.get(key) is not None
+    ]
+    if not vals:
+        return None
+    pv = _serve_percentile([v for _, v in vals], q)
+    tail = [r for r, v in vals if v >= pv - 1e-12]
+    acc: dict = {}
+    for r in tail:
+        for cause, s in _serve_clipped_causes(r, metric).items():
+            acc[cause] = acc.get(cause, 0.0) + s
+    total = sum(acc.values())
+    shares = {
+        c: (v / total if total > 0 else 0.0)
+        for c, v in sorted(acc.items())
+    }
+    dominant = (
+        max(shares.items(), key=lambda kv: kv[1])[0] if shares else None
+    )
+    return {"value": pv, "shares": shares, "dominant": dominant}
+
+
+def _autoscale_fallback(
+    *, actual, min_replicas, max_replicas, queue_depth=0, queue_high=8,
+    gates=None, idle_s=0.0, scale_down_idle_s=60.0,
+) -> dict:
+    """Stdlib mirror of serve/fleet.py `autoscale_decision` (pinned
+    equal by test); used when the real one (jax-transitive import)
+    isn't loadable."""
+    violated = {
+        k: g for k, g in (gates or {}).items() if g.get("violated")
+    }
+    queue_dom = [
+        k for k, g in violated.items()
+        if g.get("dominant") == "queue_wait"
+    ]
+    kv_dom = [
+        k for k, g in violated.items()
+        if g.get("dominant") == "kv_alloc_stall"
+    ]
+    if queue_dom:
+        if actual < max_replicas:
+            return {
+                "action": "scale_up", "target": actual + 1,
+                "reason": "queue_wait-dominant SLO violation "
+                f"({', '.join(sorted(queue_dom))})",
+            }
+        return {
+            "action": "hold", "target": actual,
+            "reason": "queue_wait-dominant SLO violation but already "
+            f"at max_replicas={max_replicas}",
+        }
+    if kv_dom:
+        return {
+            "action": "hold", "target": actual,
+            "reason": "kv_alloc_stall-dominant SLO violation "
+            f"({', '.join(sorted(kv_dom))}): add KV capacity "
+            "(--num-blocks / int8-kv), replicas won't help",
+        }
+    if queue_depth >= queue_high:
+        if actual < max_replicas:
+            return {
+                "action": "scale_up", "target": actual + 1,
+                "reason": f"queue depth {queue_depth} >= {queue_high}",
+            }
+        return {
+            "action": "hold", "target": actual,
+            "reason": f"queue depth {queue_depth} but already at "
+            f"max_replicas={max_replicas}",
+        }
+    if idle_s >= scale_down_idle_s and actual > min_replicas:
+        return {
+            "action": "scale_down", "target": actual - 1,
+            "reason": f"idle {idle_s:.0f}s >= {scale_down_idle_s:.0f}s",
+        }
+    return {"action": "hold", "target": actual, "reason": "steady"}
+
+
+def _autoscale(**kw) -> dict:
+    try:
+        from ..serve.fleet import autoscale_decision
+    except Exception:
+        return _autoscale_fallback(**kw)
+    return autoscale_decision(**kw)
+
+
+# ----------------------------------------------------------- arrivals
+
+
+def synthesize_arrivals(
+    rate_rps: float, *,
+    n_requests: int | None = None,
+    horizon_s: float | None = None,
+    prompt_lens=(4, 8, 16),
+    max_new: int = 16,
+    poisson: bool = True,
+    seed: int = 0,
+    dists: "Distributions | None" = None,
+) -> list:
+    """Seeded open-loop arrival stream: ``[{t_s, prompt_len,
+    max_new_tokens}, ...]`` sorted by time. Mirrors tools/loadgen.py
+    pacing (first request at t=0, then exponential or fixed gaps) so a
+    sim replay and a measured run can share one arrival process. When
+    ``dists`` carries serve pools (`extract_serve_distributions`),
+    prompt/output lengths are sampled from the measured workload mix
+    instead of the cycled defaults."""
+    if rate_rps <= 0:
+        raise ValueError(f"rate_rps must be > 0, got {rate_rps}")
+    if n_requests is None and horizon_s is None:
+        raise ValueError("need n_requests or horizon_s")
+    rng = random.Random(int(seed) * 2654435761 % (2 ** 31) + 29)
+    out = []
+    t = 0.0
+    i = 0
+    while True:
+        if n_requests is not None and i >= n_requests:
+            break
+        if horizon_s is not None and t > horizon_s:
+            break
+        if dists is not None and dists.has("prompt_len"):
+            plen = max(1, int(round(dists.sample("prompt_len", rng, 4))))
+        else:
+            plen = int(prompt_lens[i % len(prompt_lens)])
+        if dists is not None and dists.has("output_len"):
+            mnew = max(1, int(round(dists.sample("output_len", rng, max_new))))
+        else:
+            mnew = int(max_new)
+        out.append({
+            "t_s": round(t, 9),
+            "prompt_len": plen,
+            "max_new_tokens": mnew,
+        })
+        i += 1
+        t += rng.expovariate(rate_rps) if poisson else 1.0 / rate_rps
+    return out
+
+
+def load_arrivals(doc) -> list:
+    """Normalize an arrival-trace document (``loadgen --arrival-trace``
+    output ``{"arrivals": [...]}`` or a bare list) into the
+    `synthesize_arrivals` shape, sorted by time."""
+    rows = doc.get("arrivals") if isinstance(doc, dict) else doc
+    if not isinstance(rows, list):
+        raise ValueError(
+            "not an arrival trace (expected {'arrivals': [...]} or a "
+            "list; produce one with tools/loadgen.py --arrival-trace)"
+        )
+    out = []
+    for r in rows:
+        out.append({
+            "t_s": float(r.get("t_s") or 0.0),
+            "prompt_len": max(1, int(r.get("prompt_len") or 1)),
+            "max_new_tokens": max(1, int(r.get("max_new_tokens") or 1)),
+        })
+    out.sort(key=lambda a: a["t_s"])
+    return out
+
+
+# ---------------------------------------------------------- ServePolicy
+
+
+@dataclass
+class ServePolicy:
+    """Everything the serve twin needs to know about one fleet: engine
+    geometry (mirrors serve/scheduler.py SchedulerConfig), fleet/router
+    shape, autoscaler knobs (mirrors serve/fleet.py autoscale_decision),
+    SLO gates, and service-time fallbacks used when neither measured
+    distributions nor a servelint manifest price a tick."""
+
+    # engine geometry (SchedulerConfig mirror)
+    max_batch: int = 4
+    block_size: int = 4
+    usable_blocks: int = 8
+    max_seq_len: int = 32
+    prefill_chunk: int = 4
+    spec_decode: int = 0
+    block_headroom: int = 0
+    max_queue: int = 64
+    idle_poll_s: float = 0.02
+    # fleet shape
+    replicas: int = 1
+    min_replicas: int = 1
+    max_replicas: int = 0          # 0 -> replicas (autoscaling off ceiling)
+    autoscale_every_s: float = 0.0  # 0 -> autoscaler off
+    queue_high: int = 8
+    scale_down_idle_s: float = 60.0
+    provision_s: float = 10.0      # scale-up decision -> replica live
+    restart_gap_s: float = 10.0    # failure -> replacement live
+    slo: dict = field(default_factory=dict)  # e.g. {"ttft_p99": 0.5}
+    # service-time fallbacks (used only without dists/manifest pricing)
+    decode_tick_s: float = 1e-3
+    prefill_token_s: float = 1e-4
+    stream_write_s: float = 0.0
+    spec_accept_rate: float = 0.6
+    label: str = ""
+
+    def with_(self, **changes) -> "ServePolicy":
+        return dataclasses.replace(self, **changes)
+
+    def describe(self) -> dict:
+        d = dataclasses.asdict(self)
+        d.pop("label", None)
+        return d
+
+    @classmethod
+    def from_manifest(cls, manifest: dict, **over) -> "ServePolicy":
+        """Geometry from a checked-in servelint manifest
+        (``analysis/serve/*.json``: ``engine`` + ``kv`` blocks)."""
+        eng = dict(manifest.get("engine") or {})
+        kv = dict(manifest.get("kv") or {})
+        kw = dict(
+            max_batch=int(eng.get("max_batch") or 4),
+            block_size=int(eng.get("block_size") or 4),
+            usable_blocks=int(
+                kv.get("usable_blocks")
+                or max(int(eng.get("num_blocks") or 2) - 1, 1)
+            ),
+            max_seq_len=int(eng.get("max_seq_len") or 32),
+            prefill_chunk=int(eng.get("prefill_chunk") or 4),
+            spec_decode=int(eng.get("spec_decode") or 0),
+        )
+        kw.update(over)
+        return cls(**kw)
+
+    @classmethod
+    def from_record(cls, rec: dict, **over) -> "ServePolicy":
+        """Geometry from a measured serve run record's embedded config
+        (``config.engine`` / ``config.scheduler`` blocks)."""
+        cfg = dict(rec.get("config") or {})
+        eng = dict(cfg.get("engine") or {})
+        sched = dict(cfg.get("scheduler") or {})
+        kw = dict(
+            max_batch=int(eng.get("max_batch") or 4),
+            block_size=int(eng.get("block_size") or 4),
+            usable_blocks=max(int(eng.get("num_blocks") or 2) - 1, 1),
+            max_seq_len=int(eng.get("max_seq_len") or 32),
+            prefill_chunk=int(eng.get("prefill_chunk") or 4),
+            spec_decode=int(eng.get("spec_decode") or 0),
+            max_queue=int(sched.get("max_queue") or 64),
+        )
+        kw.update(over)
+        return cls(**kw)
+
+
+# ----------------------------------------------------------- ServePricer
+
+
+class ServePricer:
+    """Prices one engine call (decode tick at batch B / width W, prefill
+    chunk of N tokens) from the best available source, in preference
+    order mirroring the training twin's:
+
+    - **empirical**: measured per-request pools
+      (`extract_serve_distributions`: ``decode_tick_s`` /
+      ``prefill_token_s`` / ``acceptance_rate``) - validate mode;
+    - **roofline**: a checked-in servelint manifest's bucket grid priced
+      by `analysis.cost.serve_tick_seconds` (lazy import, the planning
+      mode that needs no runtime) - lookup snaps to the smallest bucket
+      >= the requested (B, W), clamped to the grid maximum;
+    - **fallback**: `ServePolicy` constants.
+    """
+
+    def __init__(self, policy: "ServePolicy",
+                 dists: "Distributions | None" = None,
+                 manifest: dict | None = None,
+                 hw="cpu-host"):
+        self.policy = policy
+        self.dists = dists
+        self._decode_grid: dict = {}
+        self._prefill_grid: dict = {}
+        if dists is not None and dists.has("decode_tick_s"):
+            self.mode = "empirical"
+        elif manifest and manifest.get("buckets"):
+            self.mode = "roofline"
+            from .cost import HARDWARE_MODELS, serve_tick_seconds
+            model = (
+                HARDWARE_MODELS[hw] if isinstance(hw, str) else hw
+            )
+            for b in manifest["buckets"]:
+                fam = b.get("family")
+                key = tuple(int(x) for x in b.get("bucket") or ())
+                if fam not in ("decode", "prefill") or len(key) != 2:
+                    continue
+                tick = serve_tick_seconds(b, model).step_s
+                grid = (
+                    self._decode_grid if fam == "decode"
+                    else self._prefill_grid
+                )
+                grid[key] = tick
+            if not self._decode_grid:
+                self.mode = "fallback"
+        else:
+            self.mode = "fallback"
+
+    @staticmethod
+    def _grid_lookup(grid: dict, b: int, w: int) -> float:
+        """Smallest bucket >= (b, w) in both axes - the scheduler's
+        bucket-membership rule - clamped to the grid max."""
+        fits = [k for k in grid if k[0] >= b and k[1] >= w]
+        if fits:
+            key = min(fits)
+        else:
+            key = max(grid)
+        return grid[key]
+
+    def decode_tick(self, batch: int, width: int,
+                    rng: random.Random) -> float:
+        if self.mode == "empirical":
+            return max(
+                self.dists.sample(
+                    "decode_tick_s", rng, self.policy.decode_tick_s
+                ), 1e-9,
+            )
+        if self.mode == "roofline":
+            return max(
+                self._grid_lookup(self._decode_grid, batch, width), 1e-9
+            )
+        return max(self.policy.decode_tick_s, 1e-9)
+
+    def prefill_call(self, tokens: int, width: int,
+                     rng: random.Random) -> float:
+        if tokens <= 0:
+            return 0.0
+        if self.mode == "empirical":
+            per = max(
+                self.dists.sample(
+                    "prefill_token_s", rng, self.policy.prefill_token_s
+                ), 1e-12,
+            )
+            return per * tokens
+        if self.mode == "roofline" and self._prefill_grid:
+            return max(
+                self._grid_lookup(self._prefill_grid, tokens, width), 1e-9
+            )
+        return max(self.policy.prefill_token_s * tokens, 1e-9)
+
+    def acceptance(self, k: int, rng: random.Random) -> int:
+        """Accepted draft tokens out of ``k`` proposed: prefix-truncated
+        sampling (accept while an independent coin lands under the
+        acceptance rate - the spec-decode verifier's actual rule)."""
+        if k <= 0:
+            return 0
+        if self.dists is not None and self.dists.has("acceptance_rate"):
+            rate = min(max(self.dists.sample(
+                "acceptance_rate", rng, self.policy.spec_accept_rate
+            ), 0.0), 1.0)
+        else:
+            rate = min(max(self.policy.spec_accept_rate, 0.0), 1.0)
+        n = 0
+        while n < k and rng.random() < rate:
+            n += 1
+        return n
+
+
+# ------------------------------------------------- sim request / replica
+
+
+class _SimRequest:
+    __slots__ = (
+        "req_id", "arrival", "prompt_len", "max_new", "state", "emitted",
+        "prefill_done", "prefill_target", "tokens_held", "blocks",
+        "spans", "t_admit", "t_wait0", "t_first_token", "t_done",
+        "preemptions", "router_retries", "decode_ticks", "prefill_tokens",
+        "replayed_ticks", "engine_s", "proposed", "accepted", "episodes",
+    )
+
+    def __init__(self, req_id: str, arrival: float, prompt_len: int,
+                 max_new: int):
+        self.req_id = req_id
+        self.arrival = arrival
+        self.prompt_len = prompt_len
+        self.max_new = max_new
+        self.state = "queued"
+        self.emitted = 0
+        self.prefill_done = 0
+        self.prefill_target = prompt_len
+        self.tokens_held = 0
+        self.blocks = 0
+        self.spans = []          # [cause, t0_abs, t1_abs], merged
+        self.t_admit = None
+        self.t_wait0 = arrival
+        self.t_first_token = None
+        self.t_done = None
+        self.preemptions = 0
+        self.router_retries = 0
+        self.decode_ticks = 0
+        self.prefill_tokens = 0
+        self.replayed_ticks = 0
+        self.engine_s = {}
+        self.proposed = 0
+        self.accepted = 0
+        self.episodes = 1
+
+    def span(self, cause: str, t0: float, t1: float):
+        if t1 <= t0:
+            return
+        if self.spans and self.spans[-1][0] == cause \
+                and abs(self.spans[-1][2] - t0) < 1e-12:
+            self.spans[-1][2] = t1
+        else:
+            self.spans.append([cause, t0, t1])
+
+    def charge_engine(self, cause: str, s: float):
+        if s > 0:
+            self.engine_s[cause] = self.engine_s.get(cause, 0.0) + s
+
+    def detail(self, origin: float) -> dict:
+        """`serve/reqtrace.py detail()`-shaped dict, times relative to
+        ``origin`` (the sim's t=0)."""
+        causes = {}
+        for c, t0, t1 in self.spans:
+            causes[c] = round(causes.get(c, 0.0) + (t1 - t0), 9)
+        dominant = (
+            max(causes.items(), key=lambda kv: kv[1])[0] if causes else None
+        )
+        ttft = (
+            self.t_first_token - self.arrival
+            if self.t_first_token is not None else None
+        )
+        e2e = (
+            self.t_done - self.arrival if self.t_done is not None else None
+        )
+        out = {
+            "req_id": self.req_id,
+            "tenant": "sim",
+            "state": self.state,
+            "tokens_emitted": self.emitted,
+            "preemptions": self.preemptions,
+            "dominant_cause": dominant,
+            "ttft_s": round(ttft, 9) if ttft is not None else None,
+            "e2e_s": round(e2e, 9) if e2e is not None else None,
+            "prompt_len": self.prompt_len,
+            "max_new_tokens": self.max_new,
+            "decode_ticks": self.decode_ticks,
+            "prefill_tokens": self.prefill_tokens,
+            "replayed_ticks": self.replayed_ticks,
+            "t_first_token_rel": (
+                round(self.t_first_token - origin, 9)
+                if self.t_first_token is not None else None
+            ),
+            "spans": [
+                [c, round(t0 - origin, 9), round(t1 - origin, 9)]
+                for c, t0, t1 in self.spans
+            ],
+            "causes": causes,
+            "engine_s": {
+                c: round(v, 9) for c, v in sorted(self.engine_s.items())
+            },
+            "episodes": self.episodes,
+        }
+        if self.proposed:
+            out["proposed_tokens"] = self.proposed
+            out["accepted_tokens"] = self.accepted
+            out["acceptance_rate"] = round(
+                self.accepted / self.proposed, 6
+            )
+        if self.router_retries:
+            out["router_retries"] = self.router_retries
+        return out
+
+
+class _Replica:
+    __slots__ = (
+        "idx", "queue", "preempted", "active", "free_blocks", "buckets",
+        "t_up", "up_s", "busy_until", "idle_since", "wait_since", "alive",
+        "pending_kill", "plan", "tick_t0", "tokens", "ticks",
+        "event_samples",
+    )
+
+    def __init__(self, idx: int, t: float, usable_blocks: int):
+        self.idx = idx
+        self.queue = deque()
+        self.preempted = deque()
+        self.active = []
+        self.free_blocks = usable_blocks
+        self.buckets = {c: 0.0 for c in SERVE_CAUSES}
+        self.event_samples = {c: [] for c in SERVE_CAUSES}
+        self.t_up = t
+        self.up_s = 0.0
+        self.busy_until = None    # None -> idle
+        self.idle_since = t
+        self.wait_since = None    # earliest unserved work while idle
+        self.alive = True
+        self.pending_kill = False
+        self.plan = None
+        self.tick_t0 = None
+        self.tokens = 0
+        self.ticks = 0
+
+    def load(self) -> int:
+        return len(self.queue) + len(self.active) + len(self.preempted)
+
+    def charge(self, cause: str, s: float):
+        if s > 0:
+            self.buckets[cause] = self.buckets.get(cause, 0.0) + s
+            self.event_samples.setdefault(cause, []).append(s)
+
+
+# ------------------------------------------------------- serve event loop
+
+
+class _ServeSim:
+    """Discrete-event serving-fleet simulator. Time advances to the next
+    of: arrival, tick completion, idle-poll quantized tick start, replica
+    failure, replica spawn, autoscale timer - events at equal times are
+    processed in one fixed order (spawns, failures, arrivals, tick
+    completions, tick starts, autoscale), so the record is bitwise
+    deterministic for the same policy + arrivals + trace + seed."""
+
+    def __init__(self, policy: ServePolicy, arrivals: list,
+                 pricer: ServePricer, failure_trace=(), seed: int = 0):
+        self.policy = policy
+        self.pricer = pricer
+        self.rng = random.Random((int(seed) * 1000003 + 7) % (2 ** 31))
+        self.arrivals = sorted(
+            arrivals, key=lambda a: (a["t_s"], a.get("prompt_len", 0))
+        )
+        self.failures = sorted(failure_trace, key=lambda e: e.t_s)
+        self.replicas: list = []
+        self.retired: list = []
+        self.pending_spawns: list = []   # spawn-live times
+        self.limbo: deque = deque()      # requests with no live replica
+        self.finalized: list = []
+        self.rejected = 0
+        self.rejected_too_long = 0
+        self.preemptions = 0
+        self.router_retries = 0
+        self.autoscale_log: list = []
+        self.fleet_idle_since = None
+        self._ai = 0                      # next arrival index
+        self._fi = 0                      # next failure index
+        self._next_req = 0
+        self._next_idx = 0
+        self.autoscale_next = (
+            policy.autoscale_every_s if policy.autoscale_every_s > 0
+            else None
+        )
+        for _ in range(max(policy.replicas, 1)):
+            self._spawn(0.0)
+
+    # ---- helpers
+
+    def _spawn(self, t: float) -> "_Replica":
+        rep = _Replica(self._next_idx, t, self.policy.usable_blocks)
+        self._next_idx += 1
+        self.replicas.append(rep)
+        return rep
+
+    def _live(self) -> list:
+        return [r for r in self.replicas if r.alive]
+
+    def blocks_for(self, tokens: int) -> int:
+        return max(
+            (int(tokens) + self.policy.block_size - 1)
+            // self.policy.block_size, 0,
+        )
+
+    def _max_replicas(self) -> int:
+        return self.policy.max_replicas or max(self.policy.replicas, 1)
+
+    # ---- router
+
+    def dispatch(self, s: _SimRequest, t: float):
+        """Least-loaded live-replica dispatch (serve/fleet.py
+        FleetRouter's policy); limbo when no replica is live."""
+        p = self.policy
+        if s.prompt_len + s.max_new > p.max_seq_len or (
+            self.blocks_for(s.prompt_len + s.max_new + 1)
+            + p.block_headroom > p.usable_blocks
+        ):
+            self.rejected_too_long += 1
+            s.state = "rejected"
+            return
+        live = self._live()
+        if not live:
+            self.limbo.append(s)
+            return
+        rep = min(live, key=lambda r: (r.load(), r.idx))
+        if len(rep.queue) >= p.max_queue:
+            self.rejected += 1
+            s.state = "rejected"
+            return
+        rep.queue.append(s)
+        self.fleet_idle_since = None
+        if rep.busy_until is None and rep.wait_since is None:
+            rep.wait_since = t
+
+    # ---- tick start
+
+    def _charge_idle(self, rep: _Replica, t0: float):
+        """Charge the idle window [idle_since, t0]: the part during
+        which a request was already waiting goes to queue_wait (the
+        ledger sweep's priority rule - queue_wait claims otherwise-idle
+        seconds), the rest to idle_other."""
+        span = t0 - rep.idle_since
+        if span <= 0:
+            return
+        qw = 0.0
+        if rep.wait_since is not None:
+            qw = min(max(t0 - max(rep.wait_since, rep.idle_since), 0.0),
+                     span)
+        if qw > 0:
+            rep.charge("queue_wait", qw)
+        if span - qw > 0:
+            rep.charge(IDLE_CAUSE, span - qw)
+        rep.idle_since = t0
+        rep.wait_since = None
+
+    def start_tick(self, rep: _Replica, t0: float):
+        p = self.policy
+        if rep.busy_until is None:
+            self._charge_idle(rep, t0)
+        # re-admit preempted first (the scheduler's rule), FIFO
+        while rep.preempted and len(rep.active) < p.max_batch:
+            s = rep.preempted[0]
+            if self.blocks_for(s.prompt_len + s.emitted + 1) \
+                    > rep.free_blocks:
+                break
+            rep.preempted.popleft()
+            s.span("preempted_wait", s.t_wait0, t0)
+            s.state = "active"
+            s.prefill_target = s.prompt_len + s.emitted
+            s.prefill_done = 0
+            rep.active.append(s)
+        # admit new work
+        while rep.queue and len(rep.active) < p.max_batch:
+            s = rep.queue[0]
+            if self.blocks_for(s.prompt_len + 1) + p.block_headroom \
+                    > rep.free_blocks:
+                break
+            rep.queue.popleft()
+            s.span("queue_wait", s.t_wait0, t0)
+            if s.t_admit is None:
+                s.t_admit = t0
+            s.state = "active"
+            rep.active.append(s)
+        if not rep.active:
+            # nothing admissible yet: one idle-poll stall quantum; the
+            # waiting request keeps accumulating queue_wait
+            rep.plan = None
+            rep.tick_t0 = t0
+            rep.busy_until = t0 + p.idle_poll_s
+            rep.charge("queue_wait" if (rep.queue or rep.preempted)
+                       else IDLE_CAUSE, p.idle_poll_s)
+            return
+        # plan actions oldest-first; youngest-preempt on OutOfBlocks
+        order = sorted(rep.active, key=lambda s: (s.arrival, s.req_id))
+        planned: dict = {}
+        prefills: list = []
+        decoders: list = []
+        for s in order:
+            if s.state != "active" or id(s) in planned:
+                continue
+            if s.prefill_done < s.prefill_target:
+                n = min(p.prefill_chunk, s.prefill_target - s.prefill_done)
+                kind = "prefill"
+                proposed = accepted = 0
+            else:
+                k = max(p.spec_decode, 0)
+                accepted = self.pricer.acceptance(k, self.rng) if k else 0
+                n = min(1 + accepted, s.max_new - s.emitted)
+                proposed = k
+                kind = "decode"
+            new_held = s.tokens_held + n
+            nb = self.blocks_for(new_held + 1)
+            for _attempt in (0, 1):
+                need = nb - s.blocks
+                if need <= rep.free_blocks:
+                    break
+                victims = [
+                    v for v in rep.active
+                    if v is not s and id(v) not in planned and v.blocks > 0
+                    and v.state == "active"
+                ]
+                if not victims:
+                    break
+                victim = max(victims, key=lambda v: (v.arrival, v.req_id))
+                rep.free_blocks += victim.blocks
+                victim.blocks = 0
+                victim.tokens_held = 0
+                victim.prefill_done = 0
+                victim.preemptions += 1
+                victim.episodes += 1
+                victim.state = "preempted"
+                victim.t_wait0 = t0
+                self.preemptions += 1
+                rep.active.remove(victim)
+                rep.preempted.append(victim)
+            need = nb - s.blocks
+            if need > rep.free_blocks:
+                continue                  # parked this tick
+            rep.free_blocks -= need
+            s.blocks = nb
+            planned[id(s)] = True
+            if kind == "prefill":
+                prefills.append((s, n))
+            else:
+                decoders.append((s, n, proposed, accepted))
+        parked = [
+            s for s in rep.active
+            if s.state == "active" and id(s) not in planned
+        ]
+        if not prefills and not decoders:
+            # every admitted sequence is OutOfBlocks-parked
+            d = p.idle_poll_s
+            rep.charge("kv_alloc_stall", d)
+            for s in parked:
+                s.span("kv_alloc_stall", t0, t0 + d)
+                s.charge_engine("kv_alloc_stall", d / len(parked))
+            rep.plan = {"prefills": [], "decoders": [], "stall": True}
+            rep.tick_t0 = t0
+            rep.busy_until = t0 + d
+            return
+        width = max(
+            [s.blocks for s, *_ in prefills]
+            + [s.blocks for s, *_ in decoders] + [1]
+        )
+        prefill_time = 0.0
+        pf = []
+        for s, n in prefills:
+            c = self.pricer.prefill_call(n, s.blocks, self.rng)
+            prefill_time += c
+            pf.append((s, n, c))
+        decode_time = (
+            self.pricer.decode_tick(len(decoders), width, self.rng)
+            if decoders else 0.0
+        )
+        d = prefill_time + decode_time
+        t1 = t0 + d
+        if prefill_time > 0:
+            rep.charge("prefill", prefill_time)
+        if decode_time > 0:
+            rep.charge("decode", decode_time)
+        for s, n, c in pf:
+            s.span("prefill", t0, t1)
+            s.charge_engine("prefill", c)
+        total_emit = sum(n for _, n, _, _ in decoders) or 1
+        for s, n, _, _ in decoders:
+            s.span("decode", t0, t1)
+            s.charge_engine("decode", decode_time * n / total_emit)
+        for s in parked:
+            s.span("kv_alloc_stall", t0, t1)
+            s.charge_engine("kv_alloc_stall", 0.0)
+        rep.plan = {
+            "prefills": pf, "decoders": decoders, "stall": False,
+        }
+        rep.tick_t0 = t0
+        rep.busy_until = t1
+
+    # ---- tick completion
+
+    def complete_tick(self, rep: _Replica, t1: float):
+        p = self.policy
+        plan = rep.plan
+        rep.plan = None
+        if plan is not None and not plan.get("stall"):
+            for s, n, c in plan["prefills"]:
+                s.prefill_done += n
+                s.tokens_held += n
+                s.prefill_tokens += n
+                if s.episodes > 1:
+                    s.replayed_ticks += 1
+            for s, n, proposed, accepted in plan["decoders"]:
+                s.emitted += n
+                s.tokens_held += n
+                s.decode_ticks += 1
+                s.proposed += proposed
+                s.accepted += accepted
+                rep.tokens += n
+                if s.t_first_token is None and n > 0:
+                    s.t_first_token = t1
+                if s.emitted >= s.max_new:
+                    rep.free_blocks += s.blocks
+                    s.blocks = 0
+                    s.state = "done"
+                    s.t_done = t1 + p.stream_write_s
+                    if p.stream_write_s > 0:
+                        s.span("stream_write", t1, s.t_done)
+                        s.charge_engine("stream_write", p.stream_write_s)
+                    rep.active.remove(s)
+                    spanned = sum(u1 - u0 for _, u0, u1 in s.spans)
+                    total = s.t_done - s.arrival
+                    assert abs(spanned - total) <= _req_tolerance(total), (
+                        f"request {s.req_id}: spans {spanned:.9f}s != "
+                        f"lifetime {total:.9f}s"
+                    )
+                    self.finalized.append(s)
+            rep.ticks += 1
+        if rep.pending_kill:
+            self._kill(rep, t1)
+            return
+        if rep.active or rep.preempted or rep.queue:
+            self.start_tick(rep, t1)
+        else:
+            rep.busy_until = None
+            rep.idle_since = t1
+            rep.wait_since = None
+
+    # ---- failure / retirement
+
+    def _kill(self, rep: _Replica, t: float):
+        """Replica death: in-flight and queued requests lose their KV
+        state and bounce back through the router (replay on
+        re-admission), mirroring the PR 18 failover path."""
+        if rep.busy_until is None:
+            self._charge_idle(rep, t)
+        rep.alive = False
+        rep.pending_kill = False
+        rep.busy_until = None
+        rep.up_s += t - rep.t_up
+        self.retired.append(rep)
+        self.replicas.remove(rep)
+        displaced = []
+        for s in rep.active:
+            s.blocks = 0
+            s.tokens_held = 0
+            s.prefill_done = 0
+            s.episodes += 1
+            s.state = "queued"
+            s.t_wait0 = t
+            displaced.append(s)
+        for s in rep.preempted:
+            s.span("preempted_wait", s.t_wait0, t)
+            s.state = "queued"
+            s.t_wait0 = t
+            displaced.append(s)
+        displaced.extend(rep.queue)
+        rep.active = []
+        rep.preempted.clear()
+        rep.queue.clear()
+        for s in displaced:
+            s.router_retries += 1
+            self.router_retries += 1
+            self.dispatch(s, t)
+
+    def _retire_idle(self, t: float) -> bool:
+        idle = [
+            r for r in self._live()
+            if r.busy_until is None and not r.load()
+        ]
+        if not idle:
+            return False
+        rep = max(idle, key=lambda r: r.idx)
+        self._charge_idle(rep, t)
+        rep.alive = False
+        rep.up_s += t - rep.t_up
+        self.retired.append(rep)
+        self.replicas.remove(rep)
+        return True
+
+    # ---- autoscaler replay
+
+    def _gates(self) -> dict:
+        gates = {}
+        window = self.finalized[-64:]
+        details = [s.detail(0.0) for s in window]
+        for key, limit in sorted((self.policy.slo or {}).items()):
+            metric, _, qs = key.partition("_p")
+            if metric not in ("ttft", "e2e") or not qs:
+                continue
+            d = _serve_decompose(details, metric, float(qs) / 100.0)
+            if d is None:
+                continue
+            gates[key] = {
+                "value": d["value"],
+                "limit": float(limit),
+                "violated": d["value"] > float(limit),
+                "dominant": d["dominant"],
+                "shares": d["shares"],
+            }
+        return gates
+
+    def _autoscale_step(self, t: float):
+        p = self.policy
+        live = self._live()
+        actual = len(live) + len(self.pending_spawns)
+        queue_depth = sum(len(r.queue) for r in live) + len(self.limbo)
+        all_idle = live and all(
+            r.busy_until is None and not r.load() for r in live
+        ) and not self.limbo
+        if all_idle:
+            if self.fleet_idle_since is None:
+                self.fleet_idle_since = t
+        else:
+            self.fleet_idle_since = None
+        idle_s = (
+            t - self.fleet_idle_since
+            if self.fleet_idle_since is not None else 0.0
+        )
+        decision = _autoscale(
+            actual=actual,
+            min_replicas=p.min_replicas,
+            max_replicas=self._max_replicas(),
+            queue_depth=queue_depth,
+            queue_high=p.queue_high,
+            gates=self._gates(),
+            idle_s=idle_s,
+            scale_down_idle_s=p.scale_down_idle_s,
+        )
+        if decision["action"] == "scale_up":
+            self.pending_spawns.append(t + p.provision_s)
+        elif decision["action"] == "scale_down":
+            if not self._retire_idle(t):
+                decision = dict(
+                    decision, action="hold",
+                    reason=decision["reason"] + " (no idle replica)",
+                )
+        if decision["action"] != "hold" or decision["reason"] != "steady":
+            self.autoscale_log.append({
+                "t_s": round(t, 9),
+                "replicas": len(self._live()),
+                **decision,
+            })
+
+    # ---- main loop
+
+    def run(self, horizon_s: float | None = None):
+        p = self.policy
+        guard = 0
+        while True:
+            guard += 1
+            assert guard < 10_000_000, "serve sim failed to converge"
+            cands = []
+            if self._ai < len(self.arrivals):
+                cands.append(self.arrivals[self._ai]["t_s"])
+            for rep in self._live():
+                if rep.busy_until is not None:
+                    cands.append(rep.busy_until)
+                elif rep.load():
+                    # the real scheduler wakes on arrival (no poll
+                    # latency on the first admission)
+                    cands.append(max(
+                        rep.idle_since,
+                        rep.wait_since if rep.wait_since is not None
+                        else rep.idle_since,
+                    ))
+            if self._fi < len(self.failures) and (
+                self._ai < len(self.arrivals)
+                or any(r.busy_until is not None or r.load()
+                       for r in self._live())
+                or self.limbo
+            ):
+                cands.append(self.failures[self._fi].t_s)
+            if self.pending_spawns and (self.limbo or (
+                self._ai < len(self.arrivals)
+                or any(r.load() for r in self._live())
+            )):
+                cands.append(min(self.pending_spawns))
+            if self.autoscale_next is not None and (
+                self._ai < len(self.arrivals)
+                or any(r.busy_until is not None or r.load()
+                       for r in self._live())
+                or self.limbo or self.pending_spawns
+            ):
+                cands.append(self.autoscale_next)
+            if not cands:
+                break
+            t = min(cands)
+            if horizon_s is not None and t > horizon_s \
+                    and self._ai >= len(self.arrivals) \
+                    and not any(r.busy_until is not None or r.load()
+                                for r in self._live()) \
+                    and not self.limbo:
+                break
+            # fixed processing order at time t
+            spawned = [x for x in self.pending_spawns if x <= t + 1e-12]
+            if spawned:
+                self.pending_spawns = [
+                    x for x in self.pending_spawns if x > t + 1e-12
+                ]
+                for _ in spawned:
+                    self._spawn(t)
+                while self.limbo:
+                    self.dispatch(self.limbo.popleft(), t)
+            while self._fi < len(self.failures) \
+                    and self.failures[self._fi].t_s <= t + 1e-12:
+                e = self.failures[self._fi]
+                self._fi += 1
+                live = self._live()
+                if not live:
+                    continue
+                victim = sorted(live, key=lambda r: r.idx)[
+                    e.rank % len(live)
+                ]
+                if victim.busy_until is None:
+                    self._kill(victim, t)
+                else:
+                    victim.pending_kill = True
+                self.pending_spawns.append(t + p.restart_gap_s)
+            while self._ai < len(self.arrivals) \
+                    and self.arrivals[self._ai]["t_s"] <= t + 1e-12:
+                a = self.arrivals[self._ai]
+                self._ai += 1
+                s = _SimRequest(
+                    f"sim-{self._next_req:06d}", a["t_s"],
+                    a["prompt_len"], a["max_new_tokens"],
+                )
+                self._next_req += 1
+                self.dispatch(s, t)
+            for rep in sorted(self._live(), key=lambda r: r.idx):
+                if rep.busy_until is not None \
+                        and rep.busy_until <= t + 1e-12:
+                    self.complete_tick(rep, rep.busy_until)
+            for rep in sorted(self._live(), key=lambda r: r.idx):
+                if rep.busy_until is None and rep.load():
+                    start = max(
+                        rep.idle_since,
+                        rep.wait_since if rep.wait_since is not None
+                        else rep.idle_since,
+                    )
+                    if start <= t + 1e-12:
+                        self.start_tick(rep, start)
+            if self.autoscale_next is not None \
+                    and self.autoscale_next <= t + 1e-12:
+                self._autoscale_step(self.autoscale_next)
+                self.autoscale_next += p.autoscale_every_s
+        # close out
+        t_end = 0.0
+        for rep in self.retired:
+            t_end = max(t_end, rep.t_up + rep.up_s)
+        for s in self.finalized:
+            t_end = max(t_end, s.t_done)
+        for rep in self._live():
+            t_end = max(t_end, rep.idle_since, rep.t_up)
+        if horizon_s is not None:
+            t_end = max(t_end, 0.0)
+        self.t_end = t_end
+        for rep in self._live():
+            self._charge_idle(rep, t_end)
+            rep.up_s += t_end - rep.t_up
+
+
+# ------------------------------------------------------- serve simulate
+
+
+def _serve_pcts(details: list) -> dict:
+    out = {}
+    for metric in ("ttft", "e2e"):
+        per = {}
+        for q in (0.50, 0.95, 0.99):
+            d = _serve_decompose(details, metric, q)
+            if d is not None:
+                per[f"p{int(q * 100)}"] = {
+                    "value": round(d["value"], 9),
+                    "shares": {
+                        c: round(v, 6) for c, v in d["shares"].items()
+                    },
+                    "dominant": d["dominant"],
+                }
+        out[metric] = per
+    return out
+
+
+def simulate_serve(
+    policy: ServePolicy,
+    arrivals: list, *,
+    dists: Distributions | None = None,
+    manifest: dict | None = None,
+    hw="cpu-host",
+    failure_trace=(),
+    horizon_s: float | None = None,
+    seed: int = 0,
+    wall_s: float | None = None,
+):
+    """Run the serving-fleet twin over one arrival stream. Returns
+    ``(record, requests_doc)``:
+
+    - ``record``: schema-compatible ``kind:"sim"`` serve-taxonomy
+      goodput record (renderable by ``tools/goodput.py``, gateable by
+      `compare_records` against a measured serve ledger) with predicted
+      TTFT/E2E percentile decompositions under ``predicted``;
+    - ``requests_doc``: a ``GET /v1/requests?full=1``-shaped document
+      (``recent`` = finalized `serve/reqtrace.py detail()` dicts) that
+      ``tools/request_trace.py`` renders unchanged.
+
+    ``wall_s`` stretches the simulated wall to a measured run's (extra
+    time charged to ``idle_other``) so validate-mode share comparisons
+    align on the same denominator. Conservation is ASSERTED per replica,
+    per finalized request, and in aggregate."""
+    from ..utils.goodput import _dist_summary
+
+    pricer = ServePricer(policy, dists, manifest, hw)
+    sim = _ServeSim(policy, arrivals, pricer, failure_trace, seed)
+    sim.run(horizon_s)
+    everyone = sim.retired + sim.replicas
+    buckets = {c: 0.0 for c in SERVE_CAUSES}
+    pooled: dict = {c: [] for c in SERVE_CAUSES}
+    wall = 0.0
+    ticks = 0
+    tokens = 0
+    for rep in everyone:
+        total = sum(rep.buckets.values())
+        assert abs(total - rep.up_s) <= _req_tolerance(rep.up_s), (
+            f"replica {rep.idx}: buckets {total:.9f}s != "
+            f"up {rep.up_s:.9f}s"
+        )
+        for c, v in rep.buckets.items():
+            buckets[c] = buckets.get(c, 0.0) + v
+        for c, xs in rep.event_samples.items():
+            pooled.setdefault(c, []).extend(xs)
+        wall += rep.up_s
+        ticks += rep.ticks
+        tokens += rep.tokens
+    if wall_s is not None and wall_s > wall:
+        buckets[IDLE_CAUSE] += wall_s - wall
+        wall = wall_s
+    goodput = buckets.get(SERVE_GOODPUT_CAUSE, 0.0)
+    badput = {
+        c: round(v, 9) for c, v in buckets.items()
+        if c != SERVE_GOODPUT_CAUSE
+    }
+    attributed = goodput + sum(badput.values())
+    assert abs(attributed - wall) <= _req_tolerance(wall), (
+        f"serve sim conservation: {attributed:.9f}s != {wall:.9f}s"
+    )
+    details = [s.detail(0.0) for s in sim.finalized]
+    in_flight = sum(r.load() for r in sim.replicas) + len(sim.limbo)
+    slo = policy.slo or {}
+    attained = 0
+    for s in sim.finalized:
+        ok = True
+        for key, limit in slo.items():
+            metric, _, _q = key.partition("_p")
+            v = (
+                (s.t_first_token - s.arrival) if metric == "ttft"
+                else (s.t_done - s.arrival)
+            )
+            if v is None or v > float(limit):
+                ok = False
+                break
+        attained += 1 if ok else 0
+    offered = len(sim.arrivals)
+    record = {
+        "version": RECORD_VERSION,
+        "kind": "sim",
+        "taxonomy": "serve",
+        "final": True,
+        "replicas": max(policy.replicas, 1),
+        "replicas_launched": len(everyone),
+        "steps": ticks,
+        "goodput_steps": ticks,
+        "tokens": tokens,
+        "wall_s": round(wall, 9),
+        "goodput_s": round(goodput, 9),
+        "goodput_ratio": round(goodput / wall, 6) if wall > 0 else 0.0,
+        "badput_s": badput,
+        "events": {
+            c: _dist_summary(xs) for c, xs in sorted(pooled.items()) if xs
+        },
+        "requests": {
+            "offered": offered,
+            "completed": len(sim.finalized),
+            "rejected": sim.rejected,
+            "rejected_too_long": sim.rejected_too_long,
+            "in_flight": in_flight,
+            "preemptions": sim.preemptions,
+            "router_retries": sim.router_retries,
+        },
+        "predicted": _serve_pcts(details),
+        "slo_attainment": round(attained / offered, 6) if offered else 1.0,
+        "autoscale": sim.autoscale_log,
+        "sim": {
+            "mode": "serve",
+            "seed": int(seed),
+            "n_arrivals": offered,
+            "pricing": pricer.mode,
+            "policy": policy.describe(),
+        },
+    }
+    validate_record(record)
+    requests_doc = {
+        "taxonomy": "serve",
+        "counts": {
+            "in_flight": in_flight,
+            "finalized": len(sim.finalized),
+            "ring": len(details),
+            "evicted": 0,
+            "by_state": {"done": len(sim.finalized)},
+            "rejected": sim.rejected + sim.rejected_too_long,
+        },
+        "in_flight": [],
+        "recent": details,
+    }
+    return record, requests_doc
+
+
+# ------------------------------------------------------ serve validation
+
+
+#: Percentiles REPORTED by ``--serve --validate``.
+SERVE_PCT_KEYS = (
+    "ttft_p50", "ttft_p95", "ttft_p99", "e2e_p50", "e2e_p95", "e2e_p99",
+)
+
+#: Percentiles GATED by default: p50/p95 only - on a smoke-sized run
+#: (tens of requests) the p99 IS the sample maximum, dominated by one-off
+#: host hiccups no seeded replay can reproduce; it is still printed.
+SERVE_PCT_GATE_KEYS = (
+    "ttft_p50", "ttft_p95", "e2e_p50", "e2e_p95",
+)
+
+
+def compare_serve_percentiles(
+    predicted_details: list, measured_details: list, *,
+    keys=SERVE_PCT_GATE_KEYS, tol: float = 0.5,
+) -> list:
+    """Relative TTFT/E2E percentile agreement between simulated and
+    measured per-request details. Returns violation strings (empty =
+    within tolerance); percentiles and tails via the same
+    `reqtrace.decompose` arithmetic on both sides."""
+    violations = []
+    for key in keys:
+        metric, _, qs = key.partition("_p")
+        q = float(qs) / 100.0
+        dp = _serve_decompose(predicted_details, metric, q)
+        dm = _serve_decompose(measured_details, metric, q)
+        if dp is None or dm is None:
+            violations.append(
+                f"percentile '{key}': "
+                f"{'predicted' if dp is None else 'measured'} side has "
+                f"no finished requests"
+            )
+            continue
+        vp, vm = dp["value"], dm["value"]
+        denom = max(abs(vm), 1e-9)
+        rel = abs(vp - vm) / denom
+        if rel > tol:
+            violations.append(
+                f"percentile '{key}': predicted {vp:.4f}s vs measured "
+                f"{vm:.4f}s (rel diff {rel:.2f} > tol {tol:.2f})"
+            )
+    return violations
+
+
+def arrivals_from_client_rows(client_rows, request_records=()) -> list:
+    """Reconstruct the arrival stream of a measured loadgen run from
+    ``--out-requests`` JSONL rows (send timestamps, relative to the
+    first) joined with per-request records (prompt/max-token mix) by
+    ``req_id``."""
+    by_id = {
+        r.get("req_id"): r for r in request_records or ()
+        if isinstance(r, dict)
+    }
+    rows = [
+        r for r in client_rows or ()
+        if isinstance(r, dict) and r.get("t_send_unix")
+    ]
+    if not rows:
+        return []
+    t0 = min(float(r["t_send_unix"]) for r in rows)
+    out = []
+    for r in sorted(rows, key=lambda r: (float(r["t_send_unix"]),
+                                         str(r.get("req_id")))):
+        det = by_id.get(r.get("req_id")) or {}
+        out.append({
+            "t_s": round(float(r["t_send_unix"]) - t0, 9),
+            "prompt_len": max(int(det.get("prompt_len") or 1), 1),
+            "max_new_tokens": max(
+                int(det.get("max_new_tokens")
+                    or det.get("tokens_emitted") or r.get("n_tokens")
+                    or 1), 1,
+            ),
+        })
+    return out
+
+
+def predict_serve_from_run(
+    measured_record: dict,
+    request_records: list, *,
+    arrivals=None,
+    client_rows=None,
+    seed: int = 0,
+):
+    """Close the serve loop: replay a MEASURED run's exact arrivals and
+    geometry through the twin, pricing ticks from the run's own
+    per-request records (`extract_serve_distributions`). Returns
+    ``(sim_record, requests_doc)``; gate with `compare_records`
+    (bucket shares) + `compare_serve_percentiles` (TTFT/E2E tails)."""
+    validate_record(measured_record)
+    if measured_record.get("taxonomy") != "serve":
+        raise ValueError(
+            "not a serve-taxonomy record (taxonomy="
+            f"{measured_record.get('taxonomy')!r}); serve validation "
+            "needs the server's --run-record output"
+        )
+    dists = Distributions(
+        extract_serve_distributions(request_records, client_rows)
+    )
+    if arrivals is not None:
+        stream = load_arrivals(arrivals)
+    else:
+        stream = arrivals_from_client_rows(client_rows, request_records)
+    if not stream:
+        raise ValueError(
+            "no arrivals to replay (need --arrival-trace output, or "
+            "client rows from loadgen --out-requests)"
+        )
+    policy = ServePolicy.from_record(measured_record, replicas=1)
+    rec, reqdoc = simulate_serve(
+        policy, stream,
+        dists=dists,
+        seed=seed,
+        wall_s=float(measured_record.get("wall_s") or 0.0) or None,
+    )
+    rec["sim"]["mode"] = "serve-validate"
+    rec["sim"]["n_measured_requests"] = len(request_records or ())
+    return rec, reqdoc
+
+
+# ------------------------------------------------- dynamic capacity plan
+
+
+def replicas_for_dynamic(
+    manifest: dict, *,
+    hw: str = "cpu-host",
+    rate_rps: float,
+    slo: dict,
+    mean_new_tokens: int = 16,
+    prompt_len: int = 8,
+    dists: Distributions | None = None,
+    n_requests: int = 200,
+    seed: int = 0,
+    max_replicas: int = 64,
+) -> dict:
+    """The DYNAMIC replica answer `cost.replicas_for_target` can't give:
+    starting AT the static throughput floor (so the dynamic answer is
+    >= it by construction), simulate fixed-size fleets under queueing at
+    ``rate_rps`` until every SLO gate (``{"ttft_p99": 0.5, ...}``)
+    holds on the simulated percentiles. Returns ``{"static": ...,
+    "dynamic": {"replicas", "met", "gates"}, "curve": [...]}`` - the
+    static floor is reported alongside, never silently replaced."""
+    from .cost import HARDWARE_MODELS, replicas_for_target, serve_capacity
+
+    capacity = (manifest.get("capacity") or {}).get(hw) \
+        or serve_capacity(manifest, HARDWARE_MODELS[hw])
+    target_ttft = slo.get("ttft_p99") or slo.get("ttft_p95") \
+        or slo.get("ttft_p50")
+    static = replicas_for_target(
+        capacity,
+        target_rps=rate_rps,
+        mean_new_tokens=mean_new_tokens,
+        prompt_len=prompt_len,
+        target_ttft_s=target_ttft,
+    )
+    arrivals = synthesize_arrivals(
+        rate_rps,
+        n_requests=n_requests,
+        prompt_lens=(prompt_len,),
+        max_new=mean_new_tokens,
+        seed=seed,
+        dists=dists,
+    )
+    floor = max(int(static.get("replicas") or 1), 1)
+    curve = []
+    dynamic = None
+    for n in range(floor, max_replicas + 1):
+        policy = ServePolicy.from_manifest(
+            manifest, replicas=n, slo=dict(slo)
+        )
+        rec, _ = simulate_serve(
+            policy, arrivals,
+            dists=dists, manifest=manifest, hw=hw, seed=seed,
+        )
+        gates = {}
+        met = True
+        for key, limit in sorted(slo.items()):
+            metric, _, qs = key.partition("_p")
+            pct = (rec["predicted"].get(metric) or {}).get(f"p{qs}")
+            value = pct["value"] if pct else None
+            ok = value is not None and value <= float(limit)
+            gates[key] = {
+                "value": value, "limit": float(limit), "met": ok,
+            }
+            met = met and ok
+        done = rec["requests"]["completed"]
+        met = met and done >= rec["requests"]["offered"] \
+            - rec["requests"]["rejected_too_long"]
+        curve.append({
+            "replicas": n,
+            "met": met,
+            "gates": gates,
+            "completed": done,
+            "goodput_ratio": rec["goodput_ratio"],
+            "slo_attainment": rec["slo_attainment"],
+        })
+        if met:
+            dynamic = {"replicas": n, "met": True, "gates": gates}
+            break
+    if dynamic is None:
+        dynamic = {
+            "replicas": max_replicas,
+            "met": False,
+            "gates": curve[-1]["gates"] if curve else {},
+            "why": f"SLO not met by {max_replicas} replicas "
+                   "(kv/geometry-bound, not replica-bound?)",
+        }
+    return {
+        "rate_rps": rate_rps,
+        "slo": dict(slo),
+        "static": static,
+        "dynamic": dynamic,
+        "curve": curve,
+    }
+
+
+# --------------------------------------------------- serve policy sweeps
+
+
+def rank_serve_policies(
+    policies: list, *,
+    rate_rps: float = None,
+    arrivals: list | None = None,
+    dists: Distributions | None = None,
+    manifest: dict | None = None,
+    hw: str = "cpu-host",
+    n_requests: int = 100,
+    failure_rate_per_replica_per_h: float = 0.0,
+    horizon_s: float = 3600.0,
+    seeds=(0, 1),
+) -> list:
+    """Rank `ServePolicy` variants (`policy_variants` works on
+    ServePolicy too - `with_` has the same contract) under COMMON
+    random numbers: every policy sees the same seeded arrival streams
+    and failure traces per seed. The metric is **SLO-attained
+    completions per replica up-second** (``slo_per_capacity_s``) - the
+    serving analogue of the training twin's surviving-progress metric:
+    a policy that over-provisions its way to SLO pays for it in the
+    denominator. Best first."""
+    streams = []
+    for s in seeds:
+        if arrivals is not None:
+            stream = arrivals
+        else:
+            if not rate_rps:
+                raise ValueError("need rate_rps or arrivals")
+            stream = synthesize_arrivals(
+                rate_rps, n_requests=n_requests, seed=s, dists=dists,
+            )
+        trace = ()
+        if failure_rate_per_replica_per_h > 0:
+            trace = synthesize_failure_trace(
+                max(policies[0].replicas, 1),
+                rate_per_chip_per_h=failure_rate_per_replica_per_h,
+                horizon_s=horizon_s, seed=s,
+            )
+        streams.append((s, stream, trace))
+    out = []
+    for policy in policies:
+        recs = [
+            simulate_serve(
+                policy, stream,
+                dists=dists, manifest=manifest, hw=hw,
+                failure_trace=trace, seed=s,
+            )[0]
+            for s, stream, trace in streams
+        ]
+        per_cap = [
+            (r["slo_attainment"] * r["requests"]["completed"])
+            / r["wall_s"] if r["wall_s"] > 0 else 0.0
+            for r in recs
+        ]
+        out.append({
+            "policy": getattr(policy, "label", "") or "base",
+            "slo_per_capacity_s": round(sum(per_cap) / len(per_cap), 9),
+            "slo_attainment": round(
+                sum(r["slo_attainment"] for r in recs) / len(recs), 6
+            ),
+            "completed": sum(r["requests"]["completed"] for r in recs),
+            "rejected": sum(r["requests"]["rejected"] for r in recs),
+            "preemptions": sum(
+                r["requests"]["preemptions"] for r in recs
+            ),
+            "goodput_ratio": round(
+                sum(r["goodput_ratio"] for r in recs) / len(recs), 6
+            ),
+            "wall_s": round(sum(r["wall_s"] for r in recs), 6),
+        })
+    out.sort(key=lambda d: -d["slo_per_capacity_s"])
     return out

@@ -758,48 +758,15 @@ class FleetRouter:
 
 
 # -------------------------------------------- SLO readout + autoscaling
+#
+# The percentile decomposition moved to serve/reqtrace.py (`decompose`)
+# so the serve-mode digital twin (analysis/fleetsim.py) judges its
+# simulated records with the same arithmetic; the old underscore names
+# stay as aliases for in-repo callers and tests.
 
-
-def _percentile(xs, q: float):
-    if not xs:
-        return None
-    s = sorted(xs)
-    return s[max(0, math.ceil(q * len(s)) - 1)]
-
-
-def _clipped_causes(rec: dict, metric: str) -> dict:
-    if metric == "ttft":
-        hi = rec.get("t_first_token_rel")
-        if hi is None:
-            return {}
-    else:
-        hi = float("inf")
-    out: dict = {}
-    for cause, t0, t1 in rec.get("spans") or ():
-        lo, up = float(t0), min(float(t1), hi)
-        if up > lo:
-            out[cause] = out.get(cause, 0.0) + (up - lo)
-    return out
-
-
-def _decompose(records, metric: str, q: float):
-    vals = [
-        (r, v) for r in records
-        if (v := r.get("ttft_s" if metric == "ttft" else "e2e_s"))
-        is not None
-    ]
-    if not vals:
-        return None
-    pv = _percentile([v for _, v in vals], q)
-    tail = [r for r, v in vals if v >= pv - 1e-12]
-    acc: dict = {}
-    for r in tail:
-        for cause, s in _clipped_causes(r, metric).items():
-            acc[cause] = acc.get(cause, 0.0) + s
-    total = sum(acc.values())
-    shares = {c: acc[c] / total for c in acc} if total > 0 else {}
-    dominant = max(shares, key=shares.get) if shares else None
-    return {"value": pv, "shares": shares, "dominant": dominant}
+from .reqtrace import clipped_causes as _clipped_causes  # noqa: E402
+from .reqtrace import decompose as _decompose  # noqa: E402
+from .reqtrace import percentile as _percentile  # noqa: E402
 
 
 def slo_readout(records: list, slo: dict) -> dict:

@@ -563,3 +563,65 @@ class RequestTraceRecorder:
                 "preempt", rec.t_arrival + ep["t_rel"] + off, track=track,
                 req_id=rec.req_id, tokens_held=ep["tokens_held"],
             )
+
+
+# ------------------------------------- percentile decomposition (shared)
+#
+# The canonical TTFT/E2E percentile decomposition over finalized request
+# records - ONE implementation consumed by three readers: the fleet
+# SLO readout / autoscaler (serve/fleet.py slo_readout), the offline
+# report + gates (tools/request_trace.py mirrors it stdlib-side, no
+# package import), and the serve-mode digital twin
+# (analysis/fleetsim.py), which must decompose its SIMULATED records
+# with the very arithmetic the measured ones are judged by.
+
+
+def percentile(xs, q: float):
+    """Nearest-rank percentile over unsorted samples (None if empty)."""
+    if not xs:
+        return None
+    import math
+
+    s = sorted(xs)
+    return s[max(0, math.ceil(q * len(s)) - 1)]
+
+
+def clipped_causes(rec: dict, metric: str) -> dict:
+    """Per-cause seconds of one record's spans, clipped at first-token
+    time for ``metric="ttft"`` (unclipped for ``"e2e"``). Records that
+    never produced a token have no TTFT decomposition ({})."""
+    if metric == "ttft":
+        hi = rec.get("t_first_token_rel")
+        if hi is None:
+            return {}
+    else:
+        hi = float("inf")
+    out: dict = {}
+    for cause, t0, t1 in rec.get("spans") or ():
+        lo, up = float(t0), min(float(t1), hi)
+        if up > lo:
+            out[cause] = out.get(cause, 0.0) + (up - lo)
+    return out
+
+
+def decompose(records, metric: str, q: float):
+    """Decompose one latency percentile by cause over the TAIL (records
+    at or beyond the percentile value): ``{"value", "shares",
+    "dominant"}`` or None when no record carries the metric."""
+    vals = [
+        (r, v) for r in records
+        if (v := r.get("ttft_s" if metric == "ttft" else "e2e_s"))
+        is not None
+    ]
+    if not vals:
+        return None
+    pv = percentile([v for _, v in vals], q)
+    tail = [r for r, v in vals if v >= pv - 1e-12]
+    acc: dict = {}
+    for r in tail:
+        for cause, s in clipped_causes(r, metric).items():
+            acc[cause] = acc.get(cause, 0.0) + s
+    total = sum(acc.values())
+    shares = {c: acc[c] / total for c in acc} if total > 0 else {}
+    dominant = max(shares, key=shares.get) if shares else None
+    return {"value": pv, "shares": shares, "dominant": dominant}

@@ -999,6 +999,80 @@ def extract_distributions(records) -> dict:
     }
 
 
+def extract_serve_distributions(request_records, client_rows=None) -> dict:
+    """The SERVE variant of `extract_distributions`: pool the workload
+    and service-time distributions a serve-mode fleet twin
+    (analysis/fleetsim.py) samples from, out of per-request trace
+    records (serve/reqtrace.py ``detail()`` dicts - a ``GET
+    /v1/requests?full=1`` dump's ``recent`` list qualifies) plus,
+    optionally, the loadgen client's ``--out-requests`` JSONL rows.
+
+    Pooled causes (names chosen so they cannot collide with ledger
+    causes - these are workload/service pools, not wall-clock buckets):
+
+    - ``prompt_len`` / ``output_len``: the request mix (tokens);
+    - ``inter_arrival``: client send-time deltas (needs ``client_rows``);
+    - ``acceptance_rate``: per-request spec-decode accepted/proposed;
+    - ``decode_tick_s`` / ``prefill_token_s``: measured engine service
+      times per decode tick / per prefill token, from each finalized
+      request's fenced ``engine_s`` apportionment - the empirical
+      pricing the twin prefers over the roofline when replaying a
+      measured run (``--validate``), exactly as the training twin
+      prefers measured ``steady_step`` samples.
+
+    Returns the `extract_distributions` document shape with
+    ``taxonomy: "serve"`` added."""
+    pooled: dict = {}
+
+    def pool(cause, samples):
+        p = pooled.setdefault(
+            cause, {"count": 0, "total_s": 0.0, "samples": []}
+        )
+        xs = [float(x) for x in samples if float(x) >= 0.0]
+        p["samples"].extend(xs)
+        p["count"] += len(xs)
+        p["total_s"] += sum(xs)
+
+    n_requests = 0
+    for det in request_records or ():
+        if not isinstance(det, dict) or det.get("state") != "done":
+            continue
+        n_requests += 1
+        pool("prompt_len", [int(det.get("prompt_len") or 0)])
+        pool("output_len", [int(det.get("tokens_emitted") or 0)])
+        if det.get("proposed_tokens"):
+            pool("acceptance_rate",
+                 [float(det.get("acceptance_rate") or 0.0)])
+        eng = det.get("engine_s") or {}
+        ticks = int(det.get("decode_ticks") or 0)
+        dec = float(eng.get("decode") or 0.0)
+        if ticks > 0 and dec > 0:
+            pool("decode_tick_s", [dec / ticks])
+        ptoks = int(det.get("prefill_tokens") or 0)
+        pre = float(eng.get("prefill") or 0.0)
+        if ptoks > 0 and pre > 0:
+            pool("prefill_token_s", [pre / ptoks])
+    sends = sorted(
+        float(row.get("t_send_unix") or 0.0)
+        for row in client_rows or ()
+        if row.get("t_send_unix")
+    )
+    pool("inter_arrival", [b - a for a, b in zip(sends, sends[1:])])
+    return {
+        "version": DISTRIBUTIONS_VERSION,
+        "kind": "distributions",
+        "taxonomy": "serve",
+        "n_records": n_requests,
+        "causes": {
+            c: _dist_summary(
+                p["samples"], count=p["count"], total_s=p["total_s"]
+            )
+            for c, p in sorted(pooled.items())
+        },
+        "derived": {},
+    }
+
+
 def aggregate_records_dir(path: str) -> dict:
     """Fleet-aggregate a directory of per-worker ``gen{g}_rank{r}.json``
     records ON THE FLY - the render path for a run that crashed before

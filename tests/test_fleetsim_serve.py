@@ -1,0 +1,585 @@
+"""Serve-mode fleet twin (analysis/fleetsim.py serve section): queueing
+arithmetic pins (Little's law on the simulated steady state, an
+M/D/1-style utilization -> queue_wait monotonicity check), bitwise
+determinism for same policy+trace+seed, conservation asserted per
+simulated request and in aggregate, the taxonomy/percentile helpers
+pinned against their serve/reqtrace.py canon, KV-pressure and
+spec-decode and failover replay semantics, dynamic capacity planning
+(replicas_for_dynamic >= the static roofline floor), and the
+tools/fleetsim.py --serve CLI plus the live_top predicted-serve pane.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from distributed_neural_network_tpu.analysis import fleetsim as fs
+from distributed_neural_network_tpu.utils import goodput as gp
+from distributed_neural_network_tpu.utils.goodput import (
+    SERVE_CAUSES,
+    SERVE_GOODPUT_CAUSE,
+    extract_serve_distributions,
+    render_record,
+    validate_record,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FLEETSIM_TOOL = os.path.join(REPO, "tools", "fleetsim.py")
+GOODPUT_TOOL = os.path.join(REPO, "tools", "goodput.py")
+REQTRACE_TOOL = os.path.join(REPO, "tools", "request_trace.py")
+MANIFEST = os.path.join(
+    REPO, "distributed_neural_network_tpu", "analysis", "manifests",
+    "serve_bf16.json",
+)
+
+
+def _policy(**kw):
+    base = dict(
+        max_batch=4, block_size=4, usable_blocks=64, max_seq_len=64,
+        prefill_chunk=8, max_queue=1024,
+    )
+    base.update(kw)
+    return fs.ServePolicy(**base)
+
+
+def _sim(policy=None, *, rate=40.0, n=60, seed=0, **kw):
+    pol = policy or _policy()
+    arrivals = fs.synthesize_arrivals(
+        rate, n_requests=n, prompt_lens=(4, 8), max_new=8, seed=seed
+    )
+    return fs.simulate_serve(pol, arrivals, seed=seed, **kw)
+
+
+def _run(args):
+    return subprocess.run(
+        [sys.executable, FLEETSIM_TOOL] + args,
+        capture_output=True, text=True, cwd=REPO,
+    )
+
+
+# ------------------------------------------------------------ the record
+
+
+def test_serve_record_shape_and_validates():
+    rec, reqdoc = _sim()
+    validate_record(rec)
+    assert rec["kind"] == "sim"
+    assert rec["taxonomy"] == "serve"
+    assert set(rec["badput_s"]) == set(SERVE_CAUSES) - {SERVE_GOODPUT_CAUSE}
+    assert rec["requests"]["offered"] == 60
+    assert rec["requests"]["completed"] == 60
+    assert rec["tokens"] == sum(
+        d["tokens_emitted"] for d in reqdoc["recent"]
+    )
+    # renderable by the standard record renderer, unchanged
+    text = render_record(rec)
+    assert "decode" in text and "goodput" in text
+    # the predicted percentile decompositions are present and decomposed
+    for key in ("p50", "p95", "p99"):
+        assert rec["predicted"]["ttft"][key]["value"] >= 0.0
+        assert rec["predicted"]["e2e"][key]["dominant"] in (
+            fs.SERVE_REQUEST_CAUSES
+        )
+
+
+def test_serve_requests_doc_is_request_trace_shaped():
+    _, reqdoc = _sim(n=20)
+    assert reqdoc["taxonomy"] == "serve"
+    assert reqdoc["counts"]["finalized"] == 20
+    det = reqdoc["recent"][0]
+    for key in ("req_id", "state", "ttft_s", "e2e_s", "spans", "causes",
+                "dominant_cause", "tokens_emitted"):
+        assert key in det, key
+    assert det["state"] == "done"
+
+
+def test_serve_sim_bitwise_determinism():
+    a = _sim(seed=3)
+    b = _sim(seed=3)
+    assert json.dumps(a[0], sort_keys=True) == json.dumps(
+        b[0], sort_keys=True
+    )
+    assert json.dumps(a[1], sort_keys=True) == json.dumps(
+        b[1], sort_keys=True
+    )
+    # a different seed must actually change the draw
+    c = _sim(seed=4)
+    assert json.dumps(a[0], sort_keys=True) != json.dumps(
+        c[0], sort_keys=True
+    )
+
+
+def test_serve_conservation_aggregate_and_per_request():
+    rec, reqdoc = _sim(rate=80.0, n=80)
+    attributed = rec["goodput_s"] + sum(rec["badput_s"].values())
+    assert attributed == pytest.approx(rec["wall_s"], rel=1e-6)
+    # per-request: the span decomposition covers the whole lifetime
+    for det in reqdoc["recent"]:
+        span_total = sum(t1 - t0 for _, t0, t1 in det["spans"])
+        assert span_total == pytest.approx(det["e2e_s"], abs=1e-6)
+        assert sum(det["causes"].values()) == pytest.approx(
+            det["e2e_s"], abs=1e-6
+        )
+
+
+def test_serve_wall_stretch_pads_idle_other():
+    rec, _ = _sim(n=10)
+    stretched, _ = _sim(n=10, wall_s=rec["wall_s"] + 5.0)
+    assert stretched["wall_s"] == pytest.approx(rec["wall_s"] + 5.0)
+    assert stretched["badput_s"]["idle_other"] == pytest.approx(
+        rec["badput_s"]["idle_other"] + 5.0
+    )
+
+
+# -------------------------------------------------- queueing arithmetic
+
+
+def test_littles_law_on_steady_state():
+    """L = lambda * W: the time-averaged number-in-system (integrated
+    from the simulated arrival/done intervals) must match offered rate
+    times mean sojourn time on a stable run."""
+    rec, reqdoc = _sim(rate=60.0, n=300, seed=1)
+    assert rec["requests"]["completed"] == 300
+    dets = reqdoc["recent"]
+    # reconstruct absolute arrival times from the same seeded stream
+    arrivals = fs.synthesize_arrivals(
+        60.0, n_requests=300, prompt_lens=(4, 8), max_new=8, seed=1
+    )
+    by_id = {d["req_id"]: d for d in dets}
+    intervals = []
+    for i, a in enumerate(arrivals):
+        det = by_id[f"sim-{i:06d}"]
+        intervals.append((a["t_s"], a["t_s"] + det["e2e_s"]))
+    t_end = max(t1 for _, t1 in intervals)
+    area = sum(t1 - t0 for t0, t1 in intervals)
+    L = area / t_end
+    lam = len(arrivals) / t_end
+    W = area / len(arrivals)
+    assert L == pytest.approx(lam * W, rel=1e-9)  # the identity itself
+    # and the nontrivial stationarity check: offered rate ~ effective
+    lam_offered = len(arrivals) / max(a["t_s"] for a in arrivals)
+    assert L == pytest.approx(lam_offered * W, rel=0.15)
+
+
+def test_md1_utilization_queue_wait_monotonic():
+    """M/D/1-style pin: deterministic service (fallback pricing, no
+    empirical sampling), a single-slot server (max_batch=1 reduces
+    continuous batching to FIFO), increasing arrival rate => mean
+    per-request queue_wait must be non-decreasing, and clearly positive
+    near saturation."""
+    means = []
+    for rate in (20.0, 60.0, 100.0):
+        pol = _policy(max_batch=1)
+        arrivals = fs.synthesize_arrivals(
+            rate, n_requests=200, prompt_lens=(8,), max_new=8, seed=7
+        )
+        _, reqdoc = fs.simulate_serve(pol, arrivals, seed=7)
+        qw = [d["causes"].get("queue_wait", 0.0) for d in reqdoc["recent"]]
+        means.append(sum(qw) / len(qw))
+    assert means[0] <= means[1] <= means[2]
+    assert means[2] > means[0]
+    assert means[2] > 1e-4  # near saturation the queue is real
+
+
+# ------------------------------------------- canon pins (reqtrace/fleet)
+
+
+def test_serve_decompose_matches_reqtrace_canon():
+    from distributed_neural_network_tpu.serve import reqtrace
+
+    _, reqdoc = _sim(rate=100.0, n=60, seed=2)
+    dets = reqdoc["recent"]
+    for metric in ("ttft", "e2e"):
+        for q in (0.5, 0.95, 0.99):
+            ours = fs._serve_decompose(dets, metric, q)
+            canon = reqtrace.decompose(dets, metric, q)
+            assert ours["value"] == pytest.approx(canon["value"])
+            assert ours["dominant"] == canon["dominant"]
+            for c in ours["shares"]:
+                assert ours["shares"][c] == pytest.approx(
+                    canon["shares"][c]
+                )
+
+
+def test_serve_percentile_matches_reqtrace_canon():
+    from distributed_neural_network_tpu.serve import reqtrace
+
+    xs = [0.5, 0.1, 0.9, 0.3, 0.7, 0.2, 0.2]
+    for q in (0.0, 0.5, 0.9, 0.95, 0.99, 1.0):
+        assert fs._serve_percentile(xs, q) == reqtrace.percentile(xs, q)
+    assert fs._serve_percentile([], 0.5) is None
+
+
+def test_autoscale_fallback_matches_real_policy():
+    from distributed_neural_network_tpu.serve.fleet import (
+        autoscale_decision,
+    )
+
+    gate_grid = (
+        None,
+        {"ttft_p99": {"violated": True, "dominant": "queue_wait"}},
+        {"ttft_p99": {"violated": True, "dominant": "kv_alloc_stall"}},
+        {"ttft_p99": {"violated": False, "dominant": "decode"}},
+    )
+    for actual in (1, 3):
+        for queue_depth in (0, 10):
+            for idle_s in (0.0, 120.0):
+                for gates in gate_grid:
+                    kw = dict(
+                        actual=actual, min_replicas=1, max_replicas=3,
+                        queue_depth=queue_depth, queue_high=8,
+                        gates=gates, idle_s=idle_s,
+                        scale_down_idle_s=60.0,
+                    )
+                    assert fs._autoscale_fallback(**kw) == (
+                        autoscale_decision(**kw)
+                    ), kw
+
+
+# --------------------------------------------- KV / spec-decode / fleet
+
+
+def test_kv_pressure_preempts_or_stalls():
+    pol = _policy(usable_blocks=6, max_batch=4, max_seq_len=32)
+    arrivals = [  # a burst: every sequence needs 5 of the 6 blocks
+        {"t_s": 0.0, "prompt_len": 8, "max_new_tokens": 8}
+        for _ in range(6)
+    ]
+    rec, _ = fs.simulate_serve(pol, arrivals, seed=0)
+    assert rec["requests"]["completed"] == 6
+    pressured = (
+        rec["requests"]["preemptions"] > 0
+        or rec["badput_s"]["kv_alloc_stall"] > 0.0
+    )
+    assert pressured
+
+
+def test_too_long_requests_rejected_not_deadlocked():
+    pol = _policy(usable_blocks=4, max_seq_len=32)
+    arrivals = [
+        {"t_s": 0.0, "prompt_len": 8, "max_new_tokens": 16},  # 25 toks
+        {"t_s": 0.0, "prompt_len": 4, "max_new_tokens": 4},   # fits
+    ]
+    rec, _ = fs.simulate_serve(pol, arrivals, seed=0)
+    assert rec["requests"]["rejected_too_long"] == 1
+    assert rec["requests"]["completed"] == 1
+
+
+def test_spec_decode_acceptance_sampling():
+    pol = _policy(spec_decode=4, spec_accept_rate=0.6)
+    rec, reqdoc = _sim(pol, n=40, seed=5)
+    assert rec["requests"]["completed"] == 40
+    spec = [d for d in reqdoc["recent"] if d.get("proposed_tokens")]
+    assert spec, "spec-decode runs must record proposed_tokens"
+    for det in spec:
+        assert 0 <= det["accepted_tokens"] <= det["proposed_tokens"]
+        assert 0.0 <= det["acceptance_rate"] <= 1.0
+    pooled = sum(d["accepted_tokens"] for d in spec) / sum(
+        d["proposed_tokens"] for d in spec
+    )
+    # prefix-truncated acceptance: E[accepted]/k = p(1-p^k) / (k(1-p))
+    p, k = 0.6, 4
+    expected = p * (1 - p ** k) / (k * (1 - p))
+    assert pooled == pytest.approx(expected, abs=0.1)
+
+
+def test_failover_replay_completes_everything():
+    pol = _policy(replicas=2, decode_tick_s=0.02, restart_gap_s=0.2)
+    arrivals = fs.synthesize_arrivals(
+        50.0, n_requests=60, prompt_lens=(8,), max_new=8, seed=0
+    )
+    trace = (fs.FailureEvent(t_s=0.5, rank=0),)
+    rec, reqdoc = fs.simulate_serve(
+        pol, arrivals, seed=0, failure_trace=trace
+    )
+    assert rec["requests"]["completed"] == 60
+    assert rec["replicas_launched"] >= 3  # the respawn shows up
+    assert rec["requests"]["router_retries"] >= 1
+    # displaced requests replay: some request saw >= 1 episode reset
+    assert any(d["episodes"] >= 2 or d.get("router_retries")
+               for d in reqdoc["recent"])
+
+
+def test_autoscale_replay_scales_up_under_queue_pressure():
+    pol = _policy(
+        replicas=1, min_replicas=1, max_replicas=4,
+        autoscale_every_s=0.05, queue_high=4, decode_tick_s=0.02,
+        provision_s=0.1,
+    )
+    arrivals = fs.synthesize_arrivals(
+        200.0, n_requests=120, prompt_lens=(8,), max_new=8, seed=0
+    )
+    rec, _ = fs.simulate_serve(pol, arrivals, seed=0)
+    assert rec["requests"]["completed"] == 120
+    ups = [e for e in rec["autoscale"] if e["action"] == "scale_up"]
+    assert ups, "queue pressure must trigger a scale_up decision"
+    assert rec["replicas_launched"] > 1
+
+
+# ----------------------------------------------- arrivals and pricing
+
+
+def test_load_arrivals_shapes():
+    stream = [{"t_s": 0.0, "prompt_len": 4, "max_new_tokens": 8}]
+    assert fs.load_arrivals(stream) == stream
+    assert fs.load_arrivals({"arrivals": stream}) == stream
+    with pytest.raises(ValueError):
+        fs.load_arrivals({"kind": "nope"})
+
+
+def test_synthesize_arrivals_seeded_and_sorted():
+    a = fs.synthesize_arrivals(10.0, n_requests=50, seed=9)
+    b = fs.synthesize_arrivals(10.0, n_requests=50, seed=9)
+    assert a == b
+    assert a[0]["t_s"] == 0.0
+    assert all(x["t_s"] <= y["t_s"] for x, y in zip(a, a[1:]))
+    mean_gap = a[-1]["t_s"] / (len(a) - 1)
+    assert mean_gap == pytest.approx(0.1, rel=0.5)
+
+
+def test_extract_serve_distributions_feeds_empirical_pricing():
+    _, reqdoc = _sim(n=30)
+    rows = [{"t_send_unix": 100.0 + 0.1 * i} for i in range(30)]
+    doc = extract_serve_distributions(reqdoc["recent"], rows)
+    assert doc["taxonomy"] == "serve"
+    for cause in ("prompt_len", "output_len", "decode_tick_s",
+                  "prefill_token_s", "inter_arrival"):
+        assert cause in doc["causes"], cause
+    assert doc["causes"]["inter_arrival"]["count"] == 29
+    dists = fs.Distributions(doc)
+    pricer = fs.ServePricer(_policy(), dists, None, "cpu-host")
+    assert pricer.mode == "empirical"
+    rec, _ = _sim(n=10, dists=dists)
+    assert rec["sim"]["pricing"] == "empirical"
+
+
+def test_roofline_pricing_from_manifest():
+    with open(MANIFEST) as f:
+        manifest = json.load(f)
+    pol = fs.ServePolicy.from_manifest(manifest)
+    arrivals = fs.synthesize_arrivals(
+        20.0, n_requests=12, prompt_lens=(4,), max_new=4, seed=0
+    )
+    rec, _ = fs.simulate_serve(
+        pol, arrivals, manifest=manifest, hw="cpu-host", seed=0
+    )
+    assert rec["sim"]["pricing"] == "roofline"
+    assert rec["requests"]["completed"] == 12
+
+
+# ------------------------------------------------- capacity planning
+
+
+def test_replicas_for_dynamic_at_least_static_floor():
+    with open(MANIFEST) as f:
+        manifest = json.load(f)
+    out = fs.replicas_for_dynamic(
+        manifest, hw="cpu-host", rate_rps=20.0,
+        slo={"ttft_p99": 0.5, "e2e_p99": 2.0},
+        mean_new_tokens=8, prompt_len=8, n_requests=60, seed=0,
+    )
+    assert out["dynamic"]["replicas"] >= out["static"]["replicas"]
+    assert out["static"].get("static_only") is True
+    assert out["curve"], "the search curve must be reported"
+    assert out["curve"][-1]["met"] is True
+
+
+def test_rank_serve_policies_orders_by_slo_per_capacity():
+    base = _policy(slo={"e2e_p95": 5.0})
+    arrivals = fs.synthesize_arrivals(
+        30.0, n_requests=30, prompt_lens=(8,), max_new=8, seed=0
+    )
+    ranked = fs.rank_serve_policies(
+        [base, base.with_(max_batch=1, label="narrow")],
+        rate_rps=30.0, arrivals=arrivals, dists=None, manifest=None,
+        hw="cpu-host", seeds=(0,),
+    )
+    assert len(ranked) == 2
+    assert (
+        ranked[0]["slo_per_capacity_s"] >= ranked[1]["slo_per_capacity_s"]
+    )
+
+
+def test_compare_serve_percentiles_violation_names_key():
+    _, reqdoc = _sim(n=20, seed=0)
+    dets = reqdoc["recent"]
+    slow = [dict(d, ttft_s=d["ttft_s"] + 10.0, e2e_s=d["e2e_s"] + 10.0)
+            for d in dets]
+    assert fs.compare_serve_percentiles(dets, dets) == []
+    violations = fs.compare_serve_percentiles(dets, slow)
+    assert violations
+    assert any("ttft_p50" in v for v in violations)
+    # p99 stays out of the default gate (smoke-run max statistics)
+    assert not any("p99" in v for v in violations)
+
+
+# ------------------------------------------------------------- the CLI
+
+
+def test_cli_serve_single_run(tmp_path):
+    out = tmp_path / "fleetsim_serve.json"
+    reqs = tmp_path / "sim_reqs.json"
+    r = _run([
+        "--serve", "--rate", "40", "--requests", "30",
+        "--max-new", "8", "--seed", "0",
+        "-o", str(out), "--requests-out", str(reqs),
+    ])
+    assert r.returncode == 0, r.stderr + r.stdout
+    rec = json.loads(out.read_text())
+    assert rec["kind"] == "sim" and rec["taxonomy"] == "serve"
+    # the standard tools render the sim outputs unchanged
+    g = subprocess.run(
+        [sys.executable, GOODPUT_TOOL, str(out)],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert g.returncode == 0, g.stderr
+    assert "decode" in g.stdout and "goodput" in g.stdout
+    t = subprocess.run(
+        [sys.executable, REQTRACE_TOOL, str(reqs)],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert t.returncode == 0, t.stderr
+
+
+def test_cli_serve_replicas_for():
+    r = _run([
+        "--serve", "--manifest", MANIFEST,
+        "--replicas-for", "20,ttft_p99=0.5",
+        "--requests", "40", "--max-new", "8",
+    ])
+    assert r.returncode == 0, r.stderr + r.stdout
+    assert "static floor" in r.stdout
+    assert "dynamic" in r.stdout
+
+
+def test_cli_serve_validate_rc2_on_missing_dir(tmp_path):
+    r = _run(["--serve", "--validate", str(tmp_path / "nope")])
+    assert r.returncode == 2
+
+
+def test_cli_serve_validate_roundtrip_and_disagreement(tmp_path):
+    """End-to-end: simulate a run, write it to a run dir as if measured,
+    validate (rc 0), then inject kv starvation into the measured record
+    and expect rc 1 naming kv_alloc_stall."""
+    rec, reqdoc = _sim(rate=40.0, n=24, seed=0)
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    measured = dict(rec)
+    measured["kind"] = "serve"
+    # a real serve_record carries the engine config the twin replays
+    measured["config"] = {
+        "engine": {
+            "max_batch": 4, "block_size": 4, "num_blocks": 65,
+            "max_seq_len": 64, "prefill_chunk": 8,
+        },
+        "scheduler": {"max_queue": 1024},
+    }
+    (run_dir / "serve_record.json").write_text(json.dumps(measured))
+    (run_dir / "reqs.json").write_text(json.dumps(reqdoc))
+    arrivals = fs.synthesize_arrivals(
+        40.0, n_requests=24, prompt_lens=(4, 8), max_new=8, seed=0
+    )
+    (run_dir / "arrivals.json").write_text(
+        json.dumps({"kind": "arrivals", "version": 1,
+                    "arrivals": arrivals})
+    )
+    r = _run([
+        "--serve", "--validate", str(run_dir),
+        "--ratio-tol", "0.25", "--share-tol", "0.15", "--pct-tol", "0.5",
+    ])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "fleetsim serve validation OK" in r.stdout
+    # inject: half the wall reattributed to kv_alloc_stall
+    bad = json.loads((run_dir / "serve_record.json").read_text())
+    shift = 0.5 * bad["wall_s"]
+    bad["badput_s"]["kv_alloc_stall"] += shift
+    bad["badput_s"]["idle_other"] = max(
+        0.0, bad["badput_s"]["idle_other"] - shift
+    )
+    bad_path = tmp_path / "disagree.json"
+    bad_path.write_text(json.dumps(bad))
+    r2 = _run([
+        "--serve", "--validate", str(run_dir),
+        "--record", str(bad_path),
+        "--ratio-tol", "0.25", "--share-tol", "0.15", "--pct-tol", "0.5",
+    ])
+    assert r2.returncode == 1, r2.stdout + r2.stderr
+    assert "FLEETSIM SERVE VALIDATION FAILED" in r2.stdout
+    assert "kv_alloc_stall" in r2.stdout
+
+
+# --------------------------------------------------- live_top twin pane
+
+
+def _live_top():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import live_top
+
+    return live_top
+
+
+def test_live_top_load_predicted_serve(tmp_path):
+    live_top = _live_top()
+    rec, _ = _sim(n=10)
+    path = tmp_path / "fleetsim_serve.json"
+    path.write_text(json.dumps(rec))
+    loaded = live_top.load_predicted_serve(str(path))
+    assert loaded is not None
+    assert loaded["ratio"] == rec["goodput_ratio"]
+    assert loaded["ttft_p99"] == rec["predicted"]["ttft"]["p99"]["value"]
+    # a training-taxonomy record is NOT a serve prediction
+    train = dict(rec)
+    train["taxonomy"] = "train"
+    path.write_text(json.dumps(train))
+    assert live_top.load_predicted_serve(str(path)) is None
+    # torn/partial writes never crash the dashboard
+    path.write_text('{"taxonomy": "serve", "goodp')
+    assert live_top.load_predicted_serve(str(path)) is None
+    assert live_top.load_predicted_serve(str(tmp_path / "no.json")) is None
+
+
+def test_live_top_find_predicted_serve_sibling(tmp_path):
+    live_top = _live_top()
+    target = tmp_path / "run_record.json"
+    target.write_text("{}")
+    assert live_top.find_predicted_serve(str(target), None) is None
+    sib = tmp_path / "fleetsim_serve.json"
+    sib.write_text("{}")
+    assert live_top.find_predicted_serve(str(target), None) == str(sib)
+    assert live_top.find_predicted_serve(
+        str(target), "/explicit/path.json"
+    ) == "/explicit/path.json"
+
+
+def test_live_top_serve_pane_predicted_vs_actual(tmp_path):
+    live_top = _live_top()
+    rec, _ = _sim(n=10)
+    path = tmp_path / "fleetsim_serve.json"
+    path.write_text(json.dumps(rec))
+    loaded = live_top.load_predicted_serve(str(path))
+    prom = "\n".join([
+        'serve_requests_total{status="completed"} 10',
+        'serve_requests_total{status="accepted"} 10',
+        'serve_ttft_seconds_bucket{le="0.005"} 0',
+        'serve_ttft_seconds_bucket{le="%g"} 10'
+        % max(loaded["ttft_p99"], 0.01),
+        'serve_ttft_seconds_bucket{le="+Inf"} 10',
+        "serve_ttft_seconds_count 10",
+        "serve_ttft_seconds_sum 0.1",
+        "goodput_ratio %g" % rec["goodput_ratio"],
+    ])
+    metrics = live_top.parse_prometheus(prom)
+    snap = {
+        "metrics": metrics, "health": {}, "source": "test",
+        "predicted_serve": loaded,
+    }
+    text = live_top.render(snap, width=100)
+    assert "twin:" in text
+    # agreement within the bands colors the line green
+    assert live_top.GREEN in text or live_top.YELLOW in text
+    # without a prediction the pane stays silent
+    snap.pop("predicted_serve")
+    assert "twin:" not in live_top.render(snap, width=100)

@@ -37,6 +37,29 @@ schema-compatible (`kind: "sim"`): render/diff/gate them with
 tools/goodput.py, and drop `-o fleetsim.json` into a run dir for
 tools/live_top.py's predicted-vs-actual line.
 Semantics: docs/OBSERVABILITY.md "Fleet digital twin".
+
+SERVE MODE (--serve): the serving fleet's twin - same contract, the
+request lifecycle instead of the training loop.
+
+  # forward-simulate a Poisson load against a servelint manifest
+  python tools/fleetsim.py --serve --rate 6 --requests 200 \
+      --manifest distributed_neural_network_tpu/analysis/serve/serve_bf16.json \
+      --hw cpu-host --slo ttft_p99=0.5 [-o fleetsim_serve.json]
+
+  # the DYNAMIC replica answer next to cost.replicas_for_target's
+  # static floor (dynamic >= static by construction)
+  python tools/fleetsim.py --serve --manifest ... --hw cpu-host \
+      --replicas-for 6,ttft_p99=0.5
+
+  # rank autoscaler/admission policy variants by SLO-attained
+  # completions per replica up-second
+  python tools/fleetsim.py --serve --rate 6 --requests 200 --manifest ... \
+      --slo ttft_p99=0.5 --sweep max_batch=2,4,8 --sweep queue_high=4,16
+
+  # closed-loop validation against a measured serve-smoke run dir
+  # (serve_record.json + reqs.json + client_reqs.jsonl [+ arrivals.json])
+  python tools/fleetsim.py --serve --validate rundir \
+      [--ratio-tol 0.15] [--share-tol 0.15] [--pct-tol 0.5]
 """
 
 from __future__ import annotations
@@ -251,6 +274,262 @@ def run_plans(args, policy, dists) -> int:
     return 0
 
 
+def _parse_slo(pairs) -> dict:
+    out = {}
+    for pair in pairs or ():
+        if "=" not in pair:
+            raise ValueError(f"--slo wants KEY=SECONDS (e.g. "
+                             f"ttft_p99=0.5), got {pair!r}")
+        k, v = pair.split("=", 1)
+        out[k.strip()] = float(v)
+    return out
+
+
+def _read_json(path: str):
+    with open(path) as f:
+        return json.load(f)
+
+
+def _load_serve_run_dir(run_dir: str, record_path: str | None):
+    """(measured_record, request_details, client_rows, arrivals) out of
+    a serve-smoke run dir."""
+    record_path = record_path or os.path.join(run_dir, "serve_record.json")
+    measured = read_record(record_path)
+    details = []
+    for name in ("reqs.json", "requests.json"):
+        path = os.path.join(run_dir, name)
+        if os.path.exists(path):
+            doc = _read_json(path)
+            details = list(doc.get("recent") or []) if isinstance(doc, dict) \
+                else list(doc)
+            break
+    rows = []
+    for name in ("client_reqs.jsonl", "client_requests.jsonl"):
+        path = os.path.join(run_dir, name)
+        if os.path.exists(path):
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rows.append(json.loads(line))
+                    except ValueError:
+                        continue  # torn tail
+            break
+    arrivals = None
+    apath = os.path.join(run_dir, "arrivals.json")
+    if os.path.exists(apath):
+        arrivals = _read_json(apath)
+    return measured, details, rows, arrivals
+
+
+def run_serve_validate(args) -> int:
+    run_dir = args.validate
+    try:
+        measured, details, rows, arrivals = _load_serve_run_dir(
+            run_dir, args.record
+        )
+    except (OSError, ValueError) as e:
+        print(f"fleetsim: cannot load the serve run dir: {e}",
+              file=sys.stderr)
+        return 2
+    done = [d for d in details if d.get("state") == "done"]
+    if not done:
+        print(
+            f"fleetsim: no finished per-request records under {run_dir} "
+            "(expected reqs.json - a GET /v1/requests?full=1 dump - "
+            "next to serve_record.json)", file=sys.stderr,
+        )
+        return 2
+    try:
+        pred, reqdoc = fs.predict_serve_from_run(
+            measured, done, arrivals=arrivals, client_rows=rows,
+            seed=args.seed,
+        )
+    except (OSError, ValueError) as e:
+        print(f"fleetsim: {e}", file=sys.stderr)
+        return 2
+    problems = fs.compare_records(
+        pred, measured, ratio_tol=args.ratio_tol, share_tol=args.share_tol
+    )
+    problems += fs.compare_serve_percentiles(
+        reqdoc["recent"], done, tol=args.pct_tol
+    )
+    print(render_record(
+        pred, title=f"Fleetsim serve replay of {run_dir} "
+        f"({len(done)} measured request(s), "
+        f"{pred['sim']['n_arrivals']} arrival(s) replayed)"
+    ))
+    print()
+    print(render_record(measured, title="Measured serve ledger record"))
+    print("\n  predicted vs measured percentiles:")
+    for key in fs.SERVE_PCT_KEYS:
+        metric, _, qs = key.partition("_p")
+        dp = (pred["predicted"].get(metric) or {}).get(f"p{qs}")
+        dm = fs._serve_decompose(done, metric, float(qs) / 100.0)
+        pv = dp["value"] if dp else None
+        mv = dm["value"] if dm else None
+        if pv is None or mv is None:
+            continue
+        print(f"    {key:<10} predicted {pv:>9.4f}s  "
+              f"measured {mv:>9.4f}s  "
+              f"(dominant: {dp['dominant']} / {dm['dominant']})")
+    _write_out(args.json_out, pred)
+    if args.requests_out:
+        with open(args.requests_out, "w") as f:
+            json.dump(reqdoc, f, indent=1)
+        print(f"(fleetsim: simulated requests -> {args.requests_out})")
+    if problems:
+        print(f"\nFLEETSIM SERVE VALIDATION FAILED ({len(problems)} "
+              "disagreement(s)):")
+        for prob in problems:
+            print(f"  - {prob}")
+        print("\nThe serve twin's event model no longer reproduces the "
+              "measured run - fix the drift (or loosen --ratio-tol/"
+              "--share-tol/--pct-tol if the accounting legitimately "
+              "changed).")
+        return 1
+    print(f"\nfleetsim serve validation OK: prediction within "
+          f"ratio-tol {args.ratio_tol:g} / share-tol {args.share_tol:g} "
+          f"/ pct-tol {args.pct_tol:g} of the measured run")
+    return 0
+
+
+def run_serve(args) -> int:
+    manifest = _read_json(args.manifest) if args.manifest else None
+    dists = (
+        fs.Distributions.load(args.distributions)
+        if args.distributions else None
+    )
+    slo = _parse_slo(args.slo)
+    if args.replicas_for:
+        if manifest is None:
+            print("fleetsim: --replicas-for needs --manifest (a "
+                  "servelint manifest prices the static floor)",
+                  file=sys.stderr)
+            return 2
+        parts = [x.strip() for x in args.replicas_for.split(",") if x]
+        rate = float(parts[0])
+        rf_slo = _parse_slo(parts[1:]) or slo
+        if not rf_slo:
+            print("fleetsim: --replicas-for RATE,ttft_p99=X wants at "
+                  "least one SLO gate", file=sys.stderr)
+            return 2
+        res = fs.replicas_for_dynamic(
+            manifest, hw=args.hw, rate_rps=rate, slo=rf_slo,
+            mean_new_tokens=args.max_new, prompt_len=args.prompt_lens[0],
+            dists=dists, n_requests=args.requests, seed=args.seed,
+            max_replicas=args.max_replicas or 64,
+        )
+        st, dy = res["static"], res["dynamic"]
+        print(f"Replica planning at {rate:g} req/s, SLO "
+              + ", ".join(f"{k}<={v:g}s" for k, v in sorted(rf_slo.items()))
+              + f" (hw {args.hw}):")
+        print(f"  static floor (cost.replicas_for_target, no queueing): "
+              f"{st['replicas']} replica(s), "
+              f"util {st['utilization_at_n']:.0%}"
+              + ("" if st.get("feasible", True)
+                 else f"  [INFEASIBLE: {st.get('why')}]"))
+        print(f"  dynamic answer (serve twin, queueing simulated):    "
+              f"{dy['replicas']} replica(s)"
+              + ("" if dy["met"] else f"  [SLO NOT MET: {dy.get('why')}]"))
+        for row in res["curve"]:
+            gates = "  ".join(
+                f"{k}={g['value']:.3f}s{'' if g['met'] else '!'}"
+                for k, g in sorted(row["gates"].items())
+                if g["value"] is not None
+            )
+            print(f"    n={row['replicas']:<3} "
+                  f"{'meets SLO' if row['met'] else 'violates '}  {gates}")
+        if args.json_out:
+            _write_out(args.json_out, res)
+        return 0
+    # arrivals
+    if args.arrival_trace:
+        arrivals = fs.load_arrivals(_read_json(args.arrival_trace))
+    else:
+        if not args.rate:
+            print("fleetsim: --serve wants --rate RPS (or "
+                  "--arrival-trace IN.json)", file=sys.stderr)
+            return 2
+        arrivals = fs.synthesize_arrivals(
+            args.rate, n_requests=args.requests,
+            horizon_s=args.horizon or None,
+            prompt_lens=tuple(args.prompt_lens), max_new=args.max_new,
+            seed=args.seed, dists=dists,
+        )
+    if manifest is not None:
+        policy = fs.ServePolicy.from_manifest(manifest)
+    else:
+        policy = fs.ServePolicy()
+    policy = policy.with_(
+        replicas=args.replicas,
+        max_replicas=args.max_replicas,
+        autoscale_every_s=args.autoscale_every,
+        queue_high=args.queue_high,
+        provision_s=args.provision_s,
+        restart_gap_s=args.restart_gap,
+        slo=slo,
+    )
+    trace = ()
+    if args.failure_rate > 0 and args.serve_failures:
+        trace = fs.synthesize_failure_trace(
+            max(args.replicas, 1),
+            rate_per_chip_per_h=args.failure_rate,
+            horizon_s=args.horizon or 3600.0,
+            seed=args.seed,
+        )
+    if args.sweep:
+        grid = fs.policy_variants(policy, _parse_sweep(args.sweep))
+        ranked = fs.rank_serve_policies(
+            grid, rate_rps=args.rate, arrivals=arrivals, dists=dists,
+            manifest=manifest, hw=args.hw, n_requests=args.requests,
+            failure_rate_per_replica_per_h=(
+                args.failure_rate if args.serve_failures else 0.0
+            ),
+            horizon_s=args.horizon or 3600.0,
+            seeds=tuple(range(args.seed, args.seed + args.seeds)),
+        )
+        print(f"Serve policies ranked by SLO-attained completions per "
+              f"capacity-second ({len(ranked)} candidate(s), "
+              f"{args.seeds} seed(s) averaged):")
+        for i, row in enumerate(ranked):
+            print(f"  #{i + 1} {row['policy']:<44} "
+                  f"{row['slo_per_capacity_s']:.4f}/cap-s  "
+                  f"attain {row['slo_attainment']:.2%}  "
+                  f"done {row['completed']}  rej {row['rejected']}  "
+                  f"preempt {row['preemptions']}")
+        return 0
+    rec, reqdoc = fs.simulate_serve(
+        policy, arrivals, dists=dists, manifest=manifest, hw=args.hw,
+        failure_trace=trace, horizon_s=args.horizon or None,
+        seed=args.seed,
+    )
+    print(render_record(
+        rec, title=f"Fleetsim serve prediction ({len(arrivals)} "
+        f"arrival(s), {rec['replicas']} replica(s), "
+        f"pricing {rec['sim']['pricing']}, seed {args.seed})"
+    ))
+    r = rec["requests"]
+    print(f"  requests: {r['completed']}/{r['offered']} completed, "
+          f"{r['rejected']} rejected, {r['rejected_too_long']} too-long, "
+          f"{r['preemptions']} preemption(s), "
+          f"{r['router_retries']} router retry(s); "
+          f"SLO attainment {rec['slo_attainment']:.2%}")
+    for metric in ("ttft", "e2e"):
+        for q, d in sorted((rec["predicted"].get(metric) or {}).items()):
+            print(f"  predicted {metric}_{q}: {d['value']:.4f}s "
+                  f"(dominant: {d['dominant']})")
+    _write_out(args.json_out, rec)
+    if args.requests_out:
+        with open(args.requests_out, "w") as f:
+            json.dump(reqdoc, f, indent=1)
+        print(f"(fleetsim: simulated requests -> {args.requests_out}; "
+              "render with tools/request_trace.py)")
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description=__doc__,
@@ -320,10 +599,59 @@ def main(argv=None) -> int:
     io.add_argument("--flops-per-step", type=float, default=0.0)
     io.add_argument("-o", "--json-out", metavar="OUT.json",
                     help="write the predicted record (drop fleetsim.json "
-                    "into a run dir for live_top's predicted line)")
+                    "into a run dir for live_top's predicted line, "
+                    "fleetsim_serve.json for the serve pane)")
+    sv = p.add_argument_group("serve mode (--serve)")
+    sv.add_argument("--serve", action="store_true",
+                    help="simulate the SERVING fleet (request lifecycle) "
+                    "instead of the training loop")
+    sv.add_argument("--rate", type=float, default=0.0, metavar="RPS",
+                    help="open-loop Poisson arrival rate")
+    sv.add_argument("--requests", type=int, default=200,
+                    help="arrivals to synthesize (with --rate)")
+    sv.add_argument("--horizon", type=float, default=0.0, metavar="SEC",
+                    help="serve horizon seconds (optional cap)")
+    sv.add_argument("--arrival-trace", metavar="IN.json",
+                    help="replay a recorded arrival stream "
+                    "(tools/loadgen.py --arrival-trace output)")
+    sv.add_argument("--manifest", metavar="MANIFEST.json",
+                    help="servelint manifest: engine/kv geometry + "
+                    "roofline tick pricing (analysis/serve/*.json)")
+    sv.add_argument("--prompt-lens", type=lambda s: [
+                        int(x) for x in s.split(",") if x
+                    ], default=[4, 8, 16], metavar="L1,L2,...")
+    sv.add_argument("--max-new", type=int, default=16)
+    sv.add_argument("--replicas", type=int, default=1)
+    sv.add_argument("--max-replicas", type=int, default=0,
+                    help="autoscaler ceiling (0 = --replicas, "
+                    "autoscaling capped off)")
+    sv.add_argument("--autoscale-every", type=float, default=0.0,
+                    metavar="SEC", help="autoscale_decision replay "
+                    "cadence (0 = off)")
+    sv.add_argument("--queue-high", type=int, default=8)
+    sv.add_argument("--provision-s", type=float, default=10.0,
+                    help="scale-up decision -> replica live")
+    sv.add_argument("--serve-failures", action="store_true",
+                    help="draw replica failures at --failure-rate "
+                    "per replica per hour")
+    sv.add_argument("--slo", action="append", metavar="KEY=SEC",
+                    help="SLO gate, e.g. ttft_p99=0.5 (repeatable)")
+    sv.add_argument("--replicas-for", metavar="RATE,ttft_p99=X",
+                    help="dynamic replica answer for a rate + SLO, "
+                    "reported next to the static floor")
+    sv.add_argument("--pct-tol", type=float, default=0.5,
+                    help="--serve --validate: relative TTFT/E2E "
+                    "percentile tolerance")
+    sv.add_argument("--requests-out", metavar="OUT.json",
+                    help="write the simulated per-request document "
+                    "(tools/request_trace.py renders it)")
     args = p.parse_args(argv)
 
     try:
+        if args.serve:
+            if args.validate:
+                return run_serve_validate(args)
+            return run_serve(args)
         if args.validate:
             return run_validate(args)
         dists = (

@@ -699,6 +699,39 @@ def render(snap: dict, *, color: bool = True, width: int = 72) -> str:
             + (f"  inter-token p99<={it99:.3g}s" if it99 is not None else "")
             + (f"  {spark}" if spark else "")
         )
+        # serve digital twin (tools/fleetsim.py --serve -o
+        # fleetsim_serve.json in the run dir): predicted-vs-actual TTFT
+        # p99 and goodput-ratio gap, color-banded like the training gap
+        # line - a server drifting from its twin means the distributions
+        # are stale or the run is sick
+        pred_serve = snap.get("predicted_serve") or {}
+        if pred_serve:
+            parts = []
+            pv = pred_serve.get("ttft_p99")
+            if pv is not None and ttft99 is not None and pv > 0:
+                rel = (ttft99 - pv) / pv
+                col = (
+                    GREEN if abs(rel) < 0.05
+                    else YELLOW if abs(rel) < 0.15 else RED
+                )
+                parts.append(c(
+                    col,
+                    f"ttft p99 predicted {pv:.3g}s (gap {100.0 * rel:+.0f}%)"
+                ))
+            pr = pred_serve.get("ratio")
+            if pr is not None and gp is not None:
+                sgap = gp - pr
+                col = (
+                    GREEN if abs(sgap) < 0.05
+                    else YELLOW if abs(sgap) < 0.15 else RED
+                )
+                parts.append(c(
+                    col,
+                    f"goodput predicted {100.0 * pr:5.1f}% "
+                    f"(gap {100.0 * sgap:+.1f}pp)"
+                ))
+            if parts:
+                lines.append("  twin: " + "  ".join(parts))
         active = metric_value(m, "serve_active_sequences", 0)
         queued = metric_value(m, "serve_queue_depth", 0)
         kv_used = metric_value(m, "serve_kv_blocks_in_use", 0)
@@ -890,6 +923,51 @@ def find_predicted(target: str, explicit: str | None) -> str | None:
     return None
 
 
+def find_predicted_serve(target: str, explicit: str | None) -> str | None:
+    """Resolve the SERVE twin prediction file: ``--predicted-serve``
+    wins; a file target auto-detects a sibling ``fleetsim_serve.json``
+    (tools/fleetsim.py --serve -o) in its run dir."""
+    if explicit:
+        return explicit
+    if not target.startswith(("http://", "https://")):
+        cand = os.path.join(
+            os.path.dirname(os.path.abspath(target)), "fleetsim_serve.json"
+        )
+        if os.path.isfile(cand):
+            return cand
+    return None
+
+
+def load_predicted_serve(path: str | None) -> dict | None:
+    """{"ratio", "ttft_p99", "path"} from a serve-mode fleetsim record;
+    None when absent/unreadable (torn-file tolerant like
+    `load_predicted`)."""
+    if not path:
+        return None
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        if doc.get("taxonomy") != "serve":
+            return None
+        ttft = (
+            ((doc.get("predicted") or {}).get("ttft") or {}).get("p99")
+            or {}
+        )
+        return {
+            "ratio": (
+                float(doc["goodput_ratio"])
+                if doc.get("goodput_ratio") is not None else None
+            ),
+            "ttft_p99": (
+                float(ttft["value"])
+                if ttft.get("value") is not None else None
+            ),
+            "path": path,
+        }
+    except (OSError, ValueError, TypeError, KeyError):
+        return None
+
+
 def load_predicted(path: str | None) -> dict | None:
     """{"ratio", "effective", "path"} from a fleetsim predicted record
     (tools/fleetsim.py -o); None when absent/unreadable - a dashboard
@@ -930,10 +1008,17 @@ def main(argv=None) -> int:
                     help="fleetsim predicted record for the goodput "
                     "predicted-vs-actual gap (auto-detected as "
                     "fleetsim.json next to a file target)")
+    ap.add_argument("--predicted-serve", metavar="FLEETSIM_SERVE.json",
+                    help="serve-twin predicted record for the serving "
+                    "pane's predicted-vs-actual line (auto-detected as "
+                    "fleetsim_serve.json next to a file target)")
     args = ap.parse_args(argv)
 
     src = make_source(args.target)
     predicted_path = find_predicted(args.target, args.predicted)
+    predicted_serve_path = find_predicted_serve(
+        args.target, args.predicted_serve
+    )
     color = not args.no_color and sys.stdout.isatty()
     if args.once:
         color = not args.no_color and False
@@ -944,6 +1029,10 @@ def main(argv=None) -> int:
                 # re-read each frame: a rerun of tools/fleetsim.py may
                 # refresh the prediction mid-run
                 snap["predicted"] = load_predicted(predicted_path)
+            if snap is not None and predicted_serve_path:
+                snap["predicted_serve"] = load_predicted_serve(
+                    predicted_serve_path
+                )
             if snap is None:
                 err = getattr(src, "error", None)
                 frame = (

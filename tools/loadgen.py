@@ -201,7 +201,26 @@ def run_load(
         burst + n_requests // 2 if cancel_one and n_requests > 0
         else (0 if cancel_one else None)
     )
+    # the paced schedule is PRECOMPUTED (same rng stream as before) so
+    # the exact seeded arrival offsets exist as data - exportable via
+    # --arrival-trace for the serve twin to replay the identical stream
     rng = random.Random(seed + 1)
+    offsets = []
+    t_rel = 0.0
+    for _ in range(n_requests):
+        if poisson:
+            t_rel += rng.expovariate(rate) if rate > 0 else 0.0
+        else:
+            t_rel += 1.0 / rate if rate > 0 else 0.0
+        offsets.append(t_rel)
+    schedule = [
+        {
+            "t_s": 0.0 if i < burst else round(offsets[i - burst], 9),
+            "prompt_len": len(prompts[i]),
+            "max_new_tokens": max_new,
+        }
+        for i in range(n_total)
+    ]
     threads = []
     t_start = time.monotonic()
 
@@ -223,14 +242,10 @@ def run_load(
     for i in range(burst):
         fire(results[i], None)
     # paced open-loop phase
-    t_next = time.monotonic()
+    t_paced = time.monotonic()
     for j in range(n_requests):
         i = burst + j
-        if poisson:
-            t_next += rng.expovariate(rate) if rate > 0 else 0.0
-        else:
-            t_next += 1.0 / rate if rate > 0 else 0.0
-        delay = t_next - time.monotonic()
+        delay = t_paced + offsets[j] - time.monotonic()
         if delay > 0:
             time.sleep(delay)
         fire(results[i], 2 if i == cancel_idx else None)
@@ -270,6 +285,7 @@ def run_load(
             r.router_retries for r in retried
         ),
         "results": results,
+        "schedule": schedule,
     }
 
 
@@ -384,6 +400,10 @@ def main(argv=None) -> int:
                    help="verify streamed completions against offline "
                    "generate() (rebuilds the server's seeded model "
                    "from the flags below)")
+    p.add_argument("--arrival-trace", default=None, metavar="OUT.json",
+                   help="export the exact seeded arrival schedule "
+                   "(times + prompt/max-token mix) for replay by "
+                   "tools/fleetsim.py --serve --arrival-trace")
     p.add_argument("--out", default=None, help="write the JSON summary")
     p.add_argument("--out-requests", default=None,
                    help="write per-request JSONL (send / first-token / "
@@ -444,7 +464,10 @@ def main(argv=None) -> int:
     if args.check_oracle:
         problems.extend(check_oracle(summary, args))
 
-    doc = {k: v for k, v in summary.items() if k != "results"}
+    doc = {
+        k: v for k, v in summary.items()
+        if k not in ("results", "schedule")
+    }
     spec = fetch_spec_stats(args.url, min(args.timeout, 10.0))
     if spec is not None:
         doc["spec"] = spec
@@ -454,6 +477,18 @@ def main(argv=None) -> int:
     if args.out:
         with open(args.out, "w") as f:
             json.dump(doc, f, indent=1)
+            f.write("\n")
+    if args.arrival_trace:
+        with open(args.arrival_trace, "w") as f:
+            json.dump({
+                "kind": "arrivals",
+                "version": 1,
+                "seed": args.seed,
+                "rate": args.rate,
+                "poisson": bool(args.poisson),
+                "burst": max(args.burst, 0),
+                "arrivals": summary["schedule"],
+            }, f, indent=1)
             f.write("\n")
     if args.out_requests:
         with open(args.out_requests, "w") as f:

@@ -152,6 +152,15 @@ def main(argv=None) -> int:
         hw=args.hw,
     )
     print(report)
+    if args.explain:
+        print(
+            "note: roofline figures above are STATIC floors "
+            "(static_only: true - no queueing); for replica counts "
+            "under dynamic load, run the serve twin: "
+            "tools/fleetsim.py --serve --manifest "
+            "distributed_neural_network_tpu/analysis/manifests/"
+            "serve_<config>.json --replicas-for RATE,ttft_p99=X"
+        )
     return rc
 
 
